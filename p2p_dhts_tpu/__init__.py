@@ -1,0 +1,28 @@
+"""p2p_dhts_tpu — a TPU-native peer-to-peer DHT framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the C++
+reference (Patrick-McKeever/P2P-DHTs): the Chord overlay protocol (Stoica et
+al. 2001 with Zave's rectify extension) and the DHash erasure-coded storage
+layer (Cates 2003, Rabin IDA), plus a keyspace-partitioned Merkle index and a
+JSON-RPC wire layer.
+
+Instead of one OS process per peer talking TCP (reference
+`src/chord/chord_peer.cpp`), the whole simulated ring lives as device-resident
+arrays: ids `[N,4]u32`, finger matrix `[N,128]i32`, successor lists `[N,S]i32`.
+Per-peer protocol logic is expressed as pure, batched state-transition
+functions (`vmap`/`lax.while_loop`) so millions of peers and lookups resolve
+as single XLA programs, sharded over a device mesh for multi-chip.
+
+Layer map (mirrors SURVEY.md §1):
+  L1 keyspace   — 128-bit ring ids          (ref: src/data_structures/key.h)
+  L2 storage    — Merkle index + DB         (ref: merkle_tree.h, database.h)
+  L3 ida        — Rabin IDA erasure coding  (ref: src/ida/*)
+  L4 net        — JSON-RPC client/server    (ref: src/networking/*)
+  L5 core.ring  — Chord overlay as arrays   (ref: src/chord/*)
+  L6 dhash      — replication layer         (ref: src/dhash/*)
+"""
+
+__version__ = "0.1.0"
+
+from p2p_dhts_tpu.config import RingConfig, IdaParams  # noqa: F401
+from p2p_dhts_tpu.keyspace import Key  # noqa: F401

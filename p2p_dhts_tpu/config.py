@@ -1,0 +1,91 @@
+"""Framework configuration.
+
+The reference has no config system — everything is hardcoded constructor args
+and magic constants (SURVEY.md §5.6): 5 s maintenance interval
+(`chord_peer.cpp:219`), 3 server threads (`chord_peer.cpp:42`), 5 s client
+timeout (`client.cpp:68`), Merkle fanout 8 (`merkle_tree.h:791`), IDA
+n=14/m=10/p=257 (`dhash_peer.cpp:14-16`), key geometry 16^32
+(`key.h:355`). Here they are real dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class IdaParams:
+    """Rabin IDA parameters (ref: src/ida/ida.cpp:48-57, data_fragment.h:31).
+
+    Invariants enforced by the reference ctor: n > m, p > n, p prime.
+    n fragments are produced, any m reconstruct, so n - m holder losses are
+    tolerated.
+    """
+
+    n: int = 14
+    m: int = 10
+    p: int = 257
+
+    def __post_init__(self) -> None:
+        if not self.n > self.m > 0:
+            raise ValueError(f"IDA requires n > m > 0, got n={self.n} m={self.m}")
+        if self.p <= self.n:
+            raise ValueError(f"IDA requires p > n, got p={self.p} n={self.n}")
+        # Tiny trial-division primality check; p is small (fits a matmul dtype).
+        if self.p < 2 or any(self.p % d == 0 for d in range(2, int(self.p**0.5) + 1)):
+            raise ValueError(f"IDA modulus p={self.p} must be prime")
+
+
+@dataclasses.dataclass(frozen=True)
+class RingConfig:
+    """Geometry + protocol constants for a simulated ring.
+
+    key_bits: ring identifier width. The reference fixes 128
+      (GenericKey<16,32>, key.h:355); kept configurable for tests that mirror
+      the reference's GenericKey<2,8> unit cases.
+    num_fingers: finger-table entries = binary key length (finger_table.h:44).
+    num_succs: successor-list length / DHash replication factor
+      (abstract_chord_peer.cpp:13, dhash_peer.h).
+    merkle_fanout: children per Merkle node (merkle_tree.h:790-791).
+    merkle_leaf_split: max kv-pairs in a leaf before split (merkle_tree.h:126-128).
+    maintenance_interval_s / rpc_timeout_s: host-layer cadence
+      (chord_peer.cpp:219, client.cpp:68).
+    max_hops: static bound on lookup hop iteration inside jit (the reference
+      recurses unboundedly; O(log N) expected).
+    """
+
+    key_bits: int = 128
+    num_succs: int = 3
+    ida: IdaParams = dataclasses.field(default_factory=IdaParams)
+    merkle_fanout: int = 8
+    merkle_leaf_split: int = 8
+    maintenance_interval_s: float = 5.0
+    rpc_timeout_s: float = 5.0
+    max_hops: int = 64
+    # "materialized": fingers live as an [N, key_bits] i32 matrix in HBM.
+    # "computed": fingers derived per-hop via binary search over sorted ids
+    # (memory-free; the 10M-node path, SURVEY.md §7 hard-parts).
+    finger_mode: str = "materialized"
+    # Device mesh axis sizes for the sharded peer axis (None = single device).
+    mesh_shape: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self) -> None:
+        if self.finger_mode not in ("materialized", "computed"):
+            raise ValueError(
+                f"finger_mode must be 'materialized' or 'computed', got "
+                f"{self.finger_mode!r}"
+            )
+        if self.key_bits <= 0:
+            raise ValueError(f"key_bits must be positive, got {self.key_bits}")
+
+    @property
+    def num_fingers(self) -> int:
+        return self.key_bits
+
+    @property
+    def keys_in_ring(self) -> int:
+        return 1 << self.key_bits
+
+
+DEFAULT_CONFIG = RingConfig()

@@ -1,0 +1,181 @@
+"""Host-side ring-identifier math (ref: src/data_structures/key.h).
+
+`Key` is the host twin of the reference's `GenericKey<base, len>`: a point on
+a mod-2^bits identifier circle with the clockwise `in_between` range test that
+every protocol decision reduces to. Ids are SHA-1 derived exactly as the
+reference derives them (`key.h:29-33` uses boost's name_generator_sha1 over
+the DNS namespace — bit-identical to RFC 4122 UUIDv5, i.e. `uuid.uuid5`), so
+fixture hashes pinned by the reference's tests reproduce here verbatim
+(verified: id("127.0.0.1:7002") == 5c22f4050c375657b05b35732eef0130, the
+EXPECTED_SUCC_ID in test_json/chord_tests/GetSuccTest.json).
+
+Device-side keys are `[..., LANES] uint32` little-endian lane vectors (TPUs
+have no 128-bit ints); conversion helpers live here, the jittable lane
+arithmetic in `p2p_dhts_tpu.ops.u128`.
+
+Parity quirks deliberately reproduced from `key.h:103-131` InBetween:
+  * lb == ub  -> membership is `v == ub` regardless of inclusivity.
+  * lb <  ub  -> inclusive: lb <= v <= ub; exclusive: lb < v < ub.
+  * lb >  ub  (wrapped range) -> complement test: inclusive membership is
+    NOT (ub < v < lb); exclusive is NOT (ub <= v <= lb) — faithful to the
+    reference, asserted by parity tests mirroring key_test.cc.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Iterable, Union
+
+import numpy as np
+
+LANES = 4  # 128-bit ids as 4 x uint32, lane 0 = least significant.
+KEY_BITS = 128
+KEYS_IN_RING = 1 << KEY_BITS
+
+IntLike = Union[int, "Key"]
+
+
+def sha1_id(plaintext: str) -> int:
+    """SHA-1 a plaintext to a 128-bit ring id, bit-identical to the reference.
+
+    Reference: `GenerateSha1Hash` (key.h:29-33) — boost name_generator_sha1
+    over ns::dns == RFC4122 UUIDv5 over NAMESPACE_DNS.
+    """
+    return int(uuid.uuid5(uuid.NAMESPACE_DNS, plaintext))
+
+
+def peer_id(ip: str, port: int) -> int:
+    """Peer id = SHA1("ip:port") (ref: abstract_chord_peer.cpp:13-28)."""
+    return sha1_id(f"{ip}:{port}")
+
+
+class Key:
+    """A point on the mod-2^bits identifier circle.
+
+    Mirrors `GenericKey` semantics (key.h:56-281): modular +/-, total-order
+    comparisons on the raw value, hex-string form without leading zeros
+    (`IntToHexStr`, key.h:41-47), and the quirk-faithful `in_between`.
+    """
+
+    __slots__ = ("value", "bits")
+
+    def __init__(self, value: IntLike, bits: int = KEY_BITS):
+        if isinstance(value, Key):
+            bits = value.bits
+            value = value.value
+        self.bits = bits
+        self.value = int(value) % (1 << bits)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_plaintext(cls, plaintext: str, bits: int = KEY_BITS) -> "Key":
+        """Hash plaintext to a key (ref ctor with hashed=False, key.h:70-82)."""
+        return cls(sha1_id(plaintext), bits)
+
+    @classmethod
+    def from_hex(cls, hexstr: str, bits: int = KEY_BITS) -> "Key":
+        """Parse an already-hashed hex id (ref ctor with hashed=True)."""
+        return cls(int(hexstr, 16), bits)
+
+    @classmethod
+    def for_peer(cls, ip: str, port: int) -> "Key":
+        return cls(peer_id(ip, port))
+
+    # -- ring arithmetic ---------------------------------------------------
+    def __add__(self, other: IntLike) -> "Key":
+        return Key((self.value + int(other)) % (1 << self.bits), self.bits)
+
+    def __sub__(self, other: IntLike) -> "Key":
+        return Key((self.value - int(other)) % (1 << self.bits), self.bits)
+
+    def distance_to(self, other: IntLike) -> int:
+        """Clockwise distance from self to other."""
+        return (int(other) - self.value) % (1 << self.bits)
+
+    def in_between(self, lb: IntLike, ub: IntLike, inclusive: bool = True) -> bool:
+        """Clockwise range membership, quirk-faithful to key.h:103-131."""
+        v, lo, hi = self.value, int(lb), int(ub)
+        if lo == hi:
+            return v == hi
+        if lo < hi:
+            return (lo <= v <= hi) if inclusive else (lo < v < hi)
+        # Wrapped range: membership of [lo, hi] is the complement of the
+        # un-wrapped (hi, lo) interval; complement exclusivity flips.
+        return not ((hi < v < lo) if inclusive else (hi <= v <= lo))
+
+    # -- conversions -------------------------------------------------------
+    def __int__(self) -> int:
+        return self.value
+
+    def __index__(self) -> int:
+        return self.value
+
+    def __str__(self) -> str:
+        """Hex without leading zeros, like IntToHexStr (key.h:41-47)."""
+        return format(self.value, "x")
+
+    def to_lanes(self) -> np.ndarray:
+        return int_to_lanes(self.value)
+
+    @classmethod
+    def from_lanes(cls, lanes: np.ndarray) -> "Key":
+        return cls(lanes_to_int(lanes))
+
+    # -- comparisons (raw value order, key.h:204-232) ----------------------
+    def __eq__(self, other: object) -> bool:
+        # Keys from different ring geometries never compare equal (the C++
+        # reference cannot even compare across GenericKey instantiations).
+        if isinstance(other, Key):
+            return self.bits == other.bits and self.value == other.value
+        return isinstance(other, int) and self.value == other
+
+    def __ne__(self, other: object) -> bool:
+        return not self.__eq__(other)
+
+    def __lt__(self, other: IntLike) -> bool:
+        return self.value < int(other)
+
+    def __le__(self, other: IntLike) -> bool:
+        return self.value <= int(other)
+
+    def __gt__(self, other: IntLike) -> bool:
+        return self.value > int(other)
+
+    def __ge__(self, other: IntLike) -> bool:
+        return self.value >= int(other)
+
+    def __hash__(self) -> int:
+        return hash((self.value, self.bits))
+
+    def __repr__(self) -> str:
+        return f"Key(0x{self.value:x}, bits={self.bits})"
+
+
+# ---------------------------------------------------------------------------
+# host <-> device lane conversion (numpy only; jittable math is in ops.u128)
+# ---------------------------------------------------------------------------
+
+def int_to_lanes(value: int) -> np.ndarray:
+    """One 128-bit int -> [LANES] uint32, little-endian lanes."""
+    value = int(value) % KEYS_IN_RING
+    return np.array(
+        [(value >> (32 * i)) & 0xFFFFFFFF for i in range(LANES)], dtype=np.uint32
+    )
+
+
+def ints_to_lanes(values: Iterable[int]) -> np.ndarray:
+    """Batch of ints -> [N, LANES] uint32 (vectorized for multi-million-id rings)."""
+    buf = b"".join((int(v) % KEYS_IN_RING).to_bytes(16, "little") for v in values)
+    return np.frombuffer(buf, dtype="<u4").reshape(-1, LANES).astype(np.uint32)
+
+
+def lanes_to_int(lanes: np.ndarray) -> int:
+    """[LANES] uint32 -> python int."""
+    lanes = np.asarray(lanes, dtype=np.uint64)
+    return sum(int(lanes[i]) << (32 * i) for i in range(LANES))
+
+
+def lanes_to_ints(lanes: np.ndarray) -> list:
+    """[N, LANES] uint32 -> list of python ints."""
+    lanes = np.asarray(lanes)
+    return [lanes_to_int(lanes[i]) for i in range(lanes.shape[0])]

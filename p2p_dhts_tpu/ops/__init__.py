@@ -1,0 +1,1 @@
+"""Device kernels: 128-bit lane math, IDA Vandermonde matmuls, hash compare."""

@@ -1,0 +1,210 @@
+"""Jittable 128-bit ring arithmetic on [..., 4] uint32 lane vectors.
+
+TPUs have no 128-bit (or even 64-bit, without x64 mode) integer lanes, so ring
+ids travel as four little-endian uint32 lanes and every comparison/add/sub
+hand-rolls its carry/borrow chain. This module is the device twin of the
+reference's `GenericKey` (src/data_structures/key.h): `in_between` reproduces
+the clockwise-range quirks of key.h:103-131 exactly (see keyspace.py for the
+quirk catalog), `sub_mod` is the modular clockwise distance, and `bit_length`
+yields the finger-table index in O(1) — the closed form of the reference's
+128-entry linear scan (finger_table.h:115-130): key k lies in finger i of peer
+p  iff  2^i <= (k - id_p) mod 2^128 < 2^(i+1), i.e. i = bit_length(d) - 1.
+
+All functions broadcast over leading batch dims and are jit/vmap/shard_map
+safe (pure, static shapes, no python branching on traced values).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LANES = 4
+_U32 = jnp.uint32
+
+
+def _u32(x):
+    return jnp.asarray(x, dtype=_U32)
+
+
+# ---------------------------------------------------------------------------
+# comparisons — lexicographic over lanes, most-significant (index 3) first
+# ---------------------------------------------------------------------------
+
+def eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a == b elementwise over the trailing lane dim -> bool[...]."""
+    return jnp.all(a == b, axis=-1)
+
+
+def lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a < b (unsigned 128-bit) -> bool[...]."""
+    res = jnp.zeros(a.shape[:-1], dtype=bool)
+    tied = jnp.ones(a.shape[:-1], dtype=bool)
+    for lane in range(LANES - 1, -1, -1):
+        res = res | (tied & (a[..., lane] < b[..., lane]))
+        tied = tied & (a[..., lane] == b[..., lane])
+    return res
+
+
+def le(a: jax.Array, b: jax.Array) -> jax.Array:
+    return ~lt(b, a)
+
+
+def gt(a: jax.Array, b: jax.Array) -> jax.Array:
+    return lt(b, a)
+
+
+def ge(a: jax.Array, b: jax.Array) -> jax.Array:
+    return ~lt(a, b)
+
+
+# ---------------------------------------------------------------------------
+# modular add / sub (mod 2^128 — the ring size, key.h:279-280)
+# ---------------------------------------------------------------------------
+
+def add(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(a + b) mod 2^128, lanewise carry chain."""
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for lane in range(LANES):
+        t = a[..., lane] + b[..., lane]
+        c1 = (t < a[..., lane]).astype(_U32)
+        s = t + carry
+        c2 = (s < t).astype(_U32)
+        out.append(s)
+        carry = c1 | c2
+    return jnp.stack(out, axis=-1)
+
+
+def sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(a - b) mod 2^128 — the clockwise ring distance from b to a."""
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for lane in range(LANES):
+        t = a[..., lane] - b[..., lane]
+        b1 = (a[..., lane] < b[..., lane]).astype(_U32)
+        s = t - borrow
+        b2 = (t < borrow).astype(_U32)
+        out.append(s)
+        borrow = b1 | b2
+    return jnp.stack(out, axis=-1)
+
+
+def add_scalar(a: jax.Array, v: int) -> jax.Array:
+    """(a + small-python-int) mod 2^128. v must be a static 0 <= v < 2^32."""
+    b = jnp.zeros_like(a).at[..., 0].set(_u32(v))
+    return add(a, b)
+
+
+def pow2(k: jax.Array) -> jax.Array:
+    """2^k as a lane vector; k is a traced int32 in [0, 128)."""
+    k = jnp.asarray(k, dtype=jnp.int32)
+    lane_idx = k // 32
+    bit = (_u32(1) << (k % 32).astype(_U32))
+    lanes = jnp.arange(LANES, dtype=jnp.int32)
+    shape = k.shape + (LANES,)
+    return jnp.where(
+        lanes == lane_idx[..., None],
+        jnp.broadcast_to(bit[..., None], shape),
+        jnp.zeros(shape, dtype=_U32),
+    )
+
+
+def add_pow2(a: jax.Array, k: jax.Array) -> jax.Array:
+    """(a + 2^k) mod 2^128 — finger-range starts (finger_table.h:177-188)."""
+    return add(a, pow2(k))
+
+
+# ---------------------------------------------------------------------------
+# bit length — the O(1) finger index
+# ---------------------------------------------------------------------------
+
+def _bit_length32(x: jax.Array) -> jax.Array:
+    """Branchless bit-length of a uint32 -> int32 in [0, 32]."""
+    x = _u32(x)
+    r = jnp.zeros(x.shape, dtype=jnp.int32)
+    for shift in (16, 8, 4, 2, 1):
+        m = x >= (_u32(1) << shift)
+        r = r + jnp.where(m, shift, 0)
+        x = jnp.where(m, x >> shift, x)
+    return r + (x > 0).astype(jnp.int32)
+
+
+def bit_length(a: jax.Array) -> jax.Array:
+    """Bit-length of a u128 -> int32 in [0, 128].
+
+    finger index of clockwise distance d is bit_length(d) - 1: the closed
+    form of the reference's linear range scan, since finger i of peer p
+    covers distances [2^i, 2^(i+1)-1] (finger_table.h:177-188).
+    """
+    lanes_bl = _bit_length32(a)  # [..., LANES] int32
+    lane_off = jnp.arange(LANES, dtype=jnp.int32) * 32
+    per_lane = jnp.where(a > 0, lanes_bl + lane_off, 0)
+    return jnp.max(per_lane, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# clockwise range membership — quirk parity with key.h:103-131
+# ---------------------------------------------------------------------------
+
+def in_between(v: jax.Array, lb: jax.Array, ub: jax.Array, inclusive: bool = True) -> jax.Array:
+    """Clockwise `v in [lb, ub]` with the reference's exact branch structure.
+
+    bool[...] over broadcast batch dims. `inclusive` is a static python bool
+    (the protocol always knows it at trace time).
+    """
+    bounds_equal = eq(lb, ub)
+    on_bound = eq(v, ub)
+
+    lb_lt_ub = lt(lb, ub)
+    if inclusive:
+        plain = le(lb, v) & le(v, ub)
+        wrapped = ~(lt(ub, v) & lt(v, lb))
+    else:
+        plain = lt(lb, v) & lt(v, ub)
+        wrapped = ~(le(ub, v) & le(v, lb))
+
+    return jnp.where(bounds_equal, on_bound, jnp.where(lb_lt_ub, plain, wrapped))
+
+
+# ---------------------------------------------------------------------------
+# sorted search — successor resolution over a sorted id table
+# ---------------------------------------------------------------------------
+
+def searchsorted(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Array:
+    """Index of the first entry >= q in a lexicographically sorted [N, 4] table.
+
+    Returns int32 in [0, N] (N meaning "past the end", i.e. the caller wraps
+    to 0 for ring semantics). Vectorized binary search: log2(N) gather+compare
+    steps over the whole query batch — this is the "fingers-as-computed"
+    successor primitive for rings too large to materialize [N,128] fingers.
+
+    n_valid: optional traced int32 — number of leading valid rows (for
+    capacity-padded tables).
+    """
+    n = sorted_ids.shape[0]
+    hi0 = jnp.int32(n if n_valid is None else n_valid)
+    lo = jnp.zeros(q.shape[:-1], dtype=jnp.int32)
+    hi = jnp.broadcast_to(hi0, q.shape[:-1]).astype(jnp.int32)
+    steps = max(1, (n - 1).bit_length() + 1) if n > 0 else 1
+
+    def body(_, carry):
+        lo, hi = carry
+        active = lo < hi
+        mid = (lo + hi) // 2
+        mid_ids = sorted_ids[mid]
+        go_right = active & lt(mid_ids, q)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def ring_successor(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Array:
+    """Index of the clockwise successor of q in a sorted ring table (wraps)."""
+    n = sorted_ids.shape[0]
+    idx = searchsorted(sorted_ids, q, n_valid)
+    limit = jnp.int32(n if n_valid is None else n_valid)
+    return jnp.where(idx >= limit, 0, idx)

@@ -1,0 +1,23 @@
+"""Test env: force an 8-device virtual CPU platform before jax imports.
+
+Multi-chip sharding paths are validated on a virtual host mesh
+(xla_force_host_platform_device_count); real-TPU execution happens in
+bench.py / __graft_entry__.py, not the unit suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(20260729)

@@ -1,0 +1,116 @@
+"""Host keyspace parity tests.
+
+Mirrors the reference's test/key_test.cc case-for-case (modular +/- and all
+four InBetween quadrants, including the historical differing-length edge case
+at key_test.cc:77-87), plus id-hash parity against hashes pinned in the
+reference's JSON fixtures.
+"""
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.keyspace import (
+    Key,
+    int_to_lanes,
+    ints_to_lanes,
+    lanes_to_int,
+    lanes_to_ints,
+    peer_id,
+    sha1_id,
+)
+
+
+def k8(v):
+    """The reference's EightBitKey = GenericKey<2,8>: a 256-key ring."""
+    return Key(v, bits=8)
+
+
+class TestKeyOps:
+    # key_test.cc AdditionNoModulo
+    def test_addition_no_modulo(self):
+        assert k8(16) + 15 == k8(31)
+
+    # key_test.cc AdditionWithModulo
+    def test_addition_with_modulo(self):
+        assert k8(128) + k8(128) == k8(0)
+
+    # key_test.cc SubstractionNoModulo
+    def test_subtraction_no_modulo(self):
+        assert k8(16) - k8(15) == k8(1)
+
+    # key_test.cc SubstractionWithModulo
+    def test_subtraction_with_modulo(self):
+        assert k8(0) - k8(1) == k8(255)
+
+
+class TestInBetween:
+    # key_test.cc ExclusiveNoModulo
+    def test_exclusive_no_modulo(self):
+        assert Key(75).in_between(0, 99, inclusive=False)
+        assert not Key(99).in_between(0, 99, inclusive=False)
+
+    # key_test.cc ExclusiveWithModulo
+    def test_exclusive_with_modulo(self):
+        assert Key(1).in_between(75, 25, inclusive=False)
+        assert not Key(25).in_between(75, 25, inclusive=False)
+
+    # key_test.cc InclusiveNoModulo
+    def test_inclusive_no_modulo(self):
+        assert Key(75).in_between(0, 99, inclusive=True)
+        assert Key(99).in_between(0, 99, inclusive=True)
+
+    # key_test.cc InclusiveWithModulo
+    def test_inclusive_with_modulo(self):
+        assert Key(1).in_between(75, 25, inclusive=True)
+        assert Key(25).in_between(75, 25, inclusive=True)
+
+    # key_test.cc DifferingLengths — 31-digit hex keys, constant 16^32 ring
+    def test_differing_lengths(self):
+        key = Key.from_hex("f4ee136cb4059b2883450e7e93698be")
+        lb = Key.from_hex("633bd46b5c515992a5ce553d0680bec9")
+        ub = Key.from_hex("f4ee136cb4059b2883450e7e93698bd")
+        assert not key.in_between(lb, ub, inclusive=True)
+
+    def test_equal_bounds_quirk(self):
+        # key.h:108-113 — equal bounds match only the bound itself,
+        # regardless of inclusivity.
+        assert Key(42).in_between(42, 42, inclusive=False)
+        assert Key(42).in_between(42, 42, inclusive=True)
+        assert not Key(43).in_between(42, 42, inclusive=True)
+
+
+class TestIdParity:
+    def test_peer_id_matches_reference_fixture(self):
+        # Pinned in the reference's test_json/chord_tests/GetSuccTest.json:
+        # peer 127.0.0.1:7002 has EXPECTED_SUCC_ID 5c22f40...
+        assert format(peer_id("127.0.0.1", 7002), "x") == (
+            "5c22f4050c375657b05b35732eef0130"
+        )
+        assert format(peer_id("127.0.0.1", 7001), "x") == (
+            "62a0959bff135ad296fbdc29252d927a"
+        )
+
+    def test_hex_string_strips_leading_zeros(self):
+        assert str(Key(0x0000F)) == "f"
+
+    def test_sha1_id_fits_ring(self):
+        for s in ("a", "hello world", "127.0.0.1:9999"):
+            assert 0 <= sha1_id(s) < (1 << 128)
+
+
+class TestLaneConversion:
+    def test_round_trip(self, rng):
+        vals = [int.from_bytes(rng.bytes(16), "big") for _ in range(64)]
+        vals += [0, 1, (1 << 128) - 1, 1 << 64, (1 << 64) - 1]
+        lanes = ints_to_lanes(vals)
+        assert lanes.shape == (len(vals), 4)
+        assert lanes_to_ints(lanes) == vals
+
+    def test_single_round_trip(self):
+        v = 0x5C22F4050C375657B05B35732EEF0130
+        assert lanes_to_int(int_to_lanes(v)) == v
+        assert Key.from_lanes(Key(v).to_lanes()) == Key(v)
+
+    def test_lane_order_little_endian(self):
+        lanes = int_to_lanes(1)
+        assert lanes[0] == 1 and np.all(lanes[1:] == 0)

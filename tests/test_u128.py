@@ -1,0 +1,193 @@
+"""Device u128 lane-math vs. python-int oracle, including quirk parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.keyspace import Key, ints_to_lanes, lanes_to_ints
+from p2p_dhts_tpu.ops import u128
+
+RING = 1 << 128
+
+
+def rand_ints(rng, n, biased=True):
+    """Random 128-bit ints, with a sprinkle of adversarial carry/borrow cases."""
+    vals = [int.from_bytes(rng.bytes(16), "big") for _ in range(n)]
+    if biased:
+        vals[: min(n, 8)] = [
+            0,
+            1,
+            RING - 1,
+            (1 << 64) - 1,
+            1 << 64,
+            (1 << 32) - 1,
+            1 << 32,
+            (1 << 96) + 5,
+        ][: min(n, 8)]
+    return vals
+
+
+class TestComparisons:
+    def test_lt_le_eq(self, rng):
+        a = rand_ints(rng, 64)
+        b = rand_ints(rng, 64)
+        b[:4] = a[:4]  # force some ties
+        la, lb = jnp.asarray(ints_to_lanes(a)), jnp.asarray(ints_to_lanes(b))
+        np.testing.assert_array_equal(
+            np.asarray(u128.lt(la, lb)), np.array([x < y for x, y in zip(a, b)])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(u128.le(la, lb)), np.array([x <= y for x, y in zip(a, b)])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(u128.eq(la, lb)), np.array([x == y for x, y in zip(a, b)])
+        )
+
+
+class TestModularArithmetic:
+    def test_add(self, rng):
+        a, b = rand_ints(rng, 64), rand_ints(rng, 64)
+        la, lb = jnp.asarray(ints_to_lanes(a)), jnp.asarray(ints_to_lanes(b))
+        got = lanes_to_ints(np.asarray(u128.add(la, lb)))
+        assert got == [(x + y) % RING for x, y in zip(a, b)]
+
+    def test_sub(self, rng):
+        a, b = rand_ints(rng, 64), rand_ints(rng, 64)
+        la, lb = jnp.asarray(ints_to_lanes(a)), jnp.asarray(ints_to_lanes(b))
+        got = lanes_to_ints(np.asarray(u128.sub(la, lb)))
+        assert got == [(x - y) % RING for x, y in zip(a, b)]
+
+    def test_add_scalar(self, rng):
+        a = rand_ints(rng, 16)
+        la = jnp.asarray(ints_to_lanes(a))
+        got = lanes_to_ints(np.asarray(u128.add_scalar(la, 1)))
+        assert got == [(x + 1) % RING for x in a]
+
+    def test_pow2_and_add_pow2(self, rng):
+        ks = list(range(0, 128, 7)) + [0, 31, 32, 63, 64, 95, 96, 127]
+        lk = jnp.asarray(ks, dtype=jnp.int32)
+        got = lanes_to_ints(np.asarray(u128.pow2(lk)))
+        assert got == [1 << k for k in ks]
+
+        a = rand_ints(rng, len(ks))
+        la = jnp.asarray(ints_to_lanes(a))
+        got = lanes_to_ints(np.asarray(u128.add_pow2(la, lk)))
+        assert got == [(x + (1 << k)) % RING for x, k in zip(a, ks)]
+
+
+class TestBitLength:
+    def test_exact_powers_and_neighbors(self):
+        vals = [0, 1, 2, 3]
+        for k in (31, 32, 33, 63, 64, 65, 95, 96, 127):
+            vals += [(1 << k) - 1, 1 << k, (1 << k) + 1]
+        la = jnp.asarray(ints_to_lanes(vals))
+        got = np.asarray(u128.bit_length(la))
+        np.testing.assert_array_equal(got, np.array([v.bit_length() for v in vals]))
+
+    def test_random(self, rng):
+        vals = rand_ints(rng, 64)
+        la = jnp.asarray(ints_to_lanes(vals))
+        got = np.asarray(u128.bit_length(la))
+        np.testing.assert_array_equal(got, np.array([v.bit_length() for v in vals]))
+
+
+class TestInBetweenParity:
+    """Device in_between must agree with the host Key (itself pinned to key.h)."""
+
+    @pytest.mark.parametrize("inclusive", [True, False])
+    def test_exhaustive_small_ring_shape(self, inclusive, rng):
+        # Dense randomized sweep incl. equal-bound and wrapped quadrants.
+        n = 512
+        v = rand_ints(rng, n, biased=False)
+        lb = rand_ints(rng, n, biased=False)
+        ub = rand_ints(rng, n, biased=False)
+        # Force quirky quadrants.
+        for i in range(0, 64):
+            lb[i] = ub[i]  # equal bounds
+        for i in range(64, 128):
+            v[i] = lb[i]  # value on lower bound
+        for i in range(128, 192):
+            v[i] = ub[i]  # value on upper bound
+        expect = np.array(
+            [Key(x).in_between(l, u, inclusive) for x, l, u in zip(v, lb, ub)]
+        )
+        got = np.asarray(
+            u128.in_between(
+                jnp.asarray(ints_to_lanes(v)),
+                jnp.asarray(ints_to_lanes(lb)),
+                jnp.asarray(ints_to_lanes(ub)),
+                inclusive,
+            )
+        )
+        np.testing.assert_array_equal(got, expect)
+
+    def test_reference_quadrant_cases(self):
+        # key_test.cc quadrants, evaluated on-device.
+        def dev(v, lo, hi, inc):
+            return bool(
+                u128.in_between(
+                    jnp.asarray(ints_to_lanes([v]))[0],
+                    jnp.asarray(ints_to_lanes([lo]))[0],
+                    jnp.asarray(ints_to_lanes([hi]))[0],
+                    inc,
+                )
+            )
+
+        assert dev(75, 0, 99, False)
+        assert not dev(99, 0, 99, False)
+        assert dev(1, 75, 25, False)
+        assert not dev(25, 75, 25, False)
+        assert dev(75, 0, 99, True)
+        assert dev(99, 0, 99, True)
+        assert dev(1, 75, 25, True)
+        assert dev(25, 75, 25, True)
+
+
+class TestSearchSorted:
+    def test_successor_resolution(self, rng):
+        ids = sorted(set(rand_ints(rng, 128, biased=False)))
+        table = jnp.asarray(ints_to_lanes(ids))
+        queries = rand_ints(rng, 256, biased=False)
+        # Include exact hits and hits past the last entry.
+        queries[:16] = ids[:16]
+        queries[16] = ids[-1] + 1
+        lq = jnp.asarray(ints_to_lanes(queries))
+        got = np.asarray(u128.searchsorted(table, lq))
+        expect = np.array(
+            [next((j for j, x in enumerate(ids) if x >= q), len(ids)) for q in queries]
+        )
+        np.testing.assert_array_equal(got, expect)
+
+    def test_ring_successor_wraps(self, rng):
+        ids = sorted(set(rand_ints(rng, 64, biased=False)))
+        table = jnp.asarray(ints_to_lanes(ids))
+        q = jnp.asarray(ints_to_lanes([ids[-1] + 1]))
+        assert int(u128.ring_successor(table, q)[0]) == 0
+
+    def test_n_valid_padding(self, rng):
+        ids = sorted(set(rand_ints(rng, 32, biased=False)))
+        pad = np.zeros((64, 4), dtype=np.uint32)
+        pad[: len(ids)] = ints_to_lanes(ids)
+        pad[len(ids):] = 0xFFFFFFFF
+        table = jnp.asarray(pad)
+        q = jnp.asarray(ints_to_lanes([ids[-1] + 1, ids[0]]))
+        got = u128.ring_successor(table, q, n_valid=jnp.int32(len(ids)))
+        assert int(got[0]) == 0
+        assert int(got[1]) == 0
+
+
+class TestJitCompatibility:
+    def test_all_ops_jit(self, rng):
+        a = jnp.asarray(ints_to_lanes(rand_ints(rng, 8)))
+        b = jnp.asarray(ints_to_lanes(rand_ints(rng, 8)))
+        jitted = jax.jit(
+            lambda x, y: (
+                u128.add(x, y),
+                u128.sub(x, y),
+                u128.lt(x, y),
+                u128.bit_length(x),
+                u128.in_between(x, y, y, True),
+            )
+        )
+        jitted(a, b)  # must trace + compile cleanly
