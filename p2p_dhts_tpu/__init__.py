@@ -26,3 +26,4 @@ __version__ = "0.1.0"
 
 from p2p_dhts_tpu.config import RingConfig, IdaParams  # noqa: F401
 from p2p_dhts_tpu.keyspace import Key  # noqa: F401
+from p2p_dhts_tpu.ida import IDA, DataBlock, DataFragment  # noqa: F401

@@ -1,0 +1,381 @@
+"""Rabin Information Dispersal Algorithm, TPU-native.
+
+Capability twin of the reference's ``src/ida`` stack (ida.{h,cpp},
+data_fragment.{h,cpp}, data_block.{h,cpp}): split a byte string into
+zero-padded length-m segments, encode them to n fragment rows with a
+Vandermonde matrix mod prime p, reconstruct from any m rows.
+
+Where the reference loops scalar inner products per fragment
+(ida.cpp:59-73), here encode/decode are batched matmuls:
+
+    encode:  [B, n, m] @ [B, m, S] mod p   (one matmul for a whole batch)
+    decode:  vandermonde_inverse(indices) @ fragments, transposed back
+
+Parity quirks deliberately reproduced (see SURVEY.md §7 quirks catalog):
+  * decode strips trailing all-zero segments, then trailing zeros of the
+    final segment (ida.cpp:143-154) — binary payloads ending in 0x00 are
+    corrupted by design; ``DataBlock.decode`` strips NULs again
+    (data_block.cpp:91-94).
+  * fragment JSON wire form packs values as fixed-width base-64,
+    ceil(log64 p) digits each, custom A-Za-z0-9+/ alphabet
+    (data_fragment.cpp:49-62,98-132).
+  * the text form writes "m n p idx:v1 v2 ..." but the text *parser* reads
+    the prefix as "n m p idx" (data_fragment.cpp:74-86 vs :20-32) — an
+    asymmetric round-trip in the reference, faithfully mirrored and
+    documented here.
+  * fragment indices are 1-based (FragsFromMatrix, data_fragment.cpp:171-179).
+  * ``DataBlock`` reconstructed from >= m fragments re-encodes to regenerate
+    all n rows (data_block.cpp:30-54).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .config import IdaParams
+from .ops import modp
+
+BASE64_ALPHABET = (
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/"
+)
+_B64_INDEX = {c: i for i, c in enumerate(BASE64_ALPHABET)}
+
+
+# ---------------------------------------------------------------------------
+# segmenting (host side — bytes in, int arrays out)
+# ---------------------------------------------------------------------------
+
+def split_to_segments(data: bytes, m: int) -> np.ndarray:
+    """bytes -> [S, m] int32, zero-padded tail (ref: SplitToSegments,
+    ida.cpp:177-190). Empty input yields [0, m]."""
+    arr = np.frombuffer(data, dtype=np.uint8).astype(np.int32)
+    n_seg = -(-len(arr) // m) if len(arr) else 0
+    padded = np.zeros(n_seg * m, dtype=np.int32)
+    padded[: len(arr)] = arr
+    return padded.reshape(n_seg, m)
+
+
+def strip_decoded(segments: np.ndarray) -> bytes:
+    """Re-join decoded segments to bytes with the reference's stripping.
+
+    Ref: ida.cpp:143-161 — drop trailing all-zero segments, then trailing
+    zeros of the last remaining segment. The reference loops without a
+    bounds check (UB on all-zero input); here all-zero input yields b"".
+    """
+    segs: List[np.ndarray] = [np.asarray(s) for s in segments]
+    while segs and not np.any(segs[-1]):
+        segs.pop()
+    if not segs:
+        return b""
+    last = segs[-1]
+    nz = np.nonzero(last)[0]
+    segs[-1] = last[: nz[-1] + 1]
+    flat = np.concatenate(segs) if segs else np.zeros(0, dtype=np.int32)
+    return (flat & 0xFF).astype(np.uint8).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels — batched over blocks
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p"))
+def encode_kernel(segments: jax.Array, n: int, m: int, p: int) -> jax.Array:
+    """[..., S, m] int32 segments -> [..., n, S] int32 fragment rows.
+
+    fragment[i][j] = <enc_row_i, segment_j> mod p (ref: ida.cpp:59-73),
+    i.e. E[n, m] @ segments^T — one MXU matmul over any batch of blocks.
+    """
+    enc = jnp.asarray(modp.vandermonde_matrix(n, m, p))
+    seg_t = jnp.swapaxes(segments, -1, -2)  # [..., m, S]
+    return modp.mod_matmul(jnp.broadcast_to(enc, segments.shape[:-2] + (n, m)), seg_t, p)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def decode_kernel(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
+    """Invert encoding: [..., m, S] rows with [..., m] 1-based indices
+    -> [..., S, m] segments.
+
+    Ref: ida.cpp:120-141 (uses the *first m* fragments passed; callers
+    slice). The inverse Vandermonde is computed in-graph so decodes with
+    heterogeneous index sets batch together.
+    """
+    inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
+    out = modp.mod_matmul(inv, rows, p)                  # [..., m, S]
+    return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
+
+
+# ---------------------------------------------------------------------------
+# host API — the reference's IDA class surface
+# ---------------------------------------------------------------------------
+
+class IDA:
+    """Parameterized encoder/decoder (ref: class IDA, ida.h:43-121).
+
+    Invariants n > m, p > n enforced (ida.cpp:48-57) via IdaParams.
+    ``backend="jax"`` routes the matmuls through the jitted kernels;
+    ``backend="numpy"`` is the host fallback for tiny one-off blocks where
+    device dispatch overhead dominates.
+    """
+
+    def __init__(self, n: int = 14, m: int = 10, p: int = 257,
+                 backend: str = "jax"):
+        self.params = IdaParams(n=n, m=m, p=p)  # validates n > m, p > n, p prime
+        if p <= 255:
+            # This class encodes BYTE payloads: segment values span [0, 255]
+            # and decode recovers them only mod p, so p < 257 silently
+            # corrupts data (256 is not prime). The reference never hits
+            # this because every caller keeps p=257 (dhash_peer.cpp:14-16).
+            raise ValueError(
+                f"byte-payload IDA requires p >= 257, got p={p}")
+        self.n, self.m, self.p = n, m, p
+        if backend not in ("jax", "numpy"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self.encoding_matrix = modp.vandermonde_matrix(n, m, p)
+
+    # -- encode ------------------------------------------------------------
+    def encode(self, data: bytes) -> np.ndarray:
+        """bytes -> [n, S] int32 fragment matrix (ref: IDA::Encode)."""
+        segments = split_to_segments(data, self.m)
+        if segments.shape[0] == 0:
+            return np.zeros((self.n, 0), dtype=np.int32)
+        if self.backend == "jax":
+            return np.asarray(
+                encode_kernel(jnp.asarray(segments), self.n, self.m, self.p)
+            )
+        return (self.encoding_matrix.astype(np.int64) @ segments.T.astype(np.int64)
+                % self.p).astype(np.int32)
+
+    def encode_plaintext(self, text: str) -> np.ndarray:
+        """Ref: IDA::EncodePlaintext (ida.cpp:75-78) — bytes of the string."""
+        return self.encode(text.encode("utf-8"))
+
+    # -- decode ------------------------------------------------------------
+    def decode(self, rows: Sequence[Sequence[int]],
+               indices: Sequence[int]) -> bytes:
+        """>= m fragment rows + 1-based indices -> original bytes.
+
+        Uses the first m rows like the reference (ida.cpp:127), applies the
+        reference's trailing-zero stripping.
+        """
+        if len(rows) < self.m:
+            raise ValueError(f"{self.m} frags are required to decode.")
+        rows_m = np.asarray(rows[: self.m], dtype=np.int32)
+        idx_m = np.asarray(indices[: self.m], dtype=np.int32)
+        if len(set(idx_m.tolist())) != self.m:
+            raise ValueError("fragment indices must be distinct")
+        if rows_m.shape[1] == 0:
+            return b""
+        if self.backend == "jax":
+            segments = np.asarray(
+                decode_kernel(jnp.asarray(rows_m), jnp.asarray(idx_m), self.p)
+            )
+        else:
+            inv = np.asarray(modp.vandermonde_inverse(idx_m, self.p))
+            segments = ((inv.astype(np.int64) @ rows_m.astype(np.int64)) % self.p).T
+        return strip_decoded(segments)
+
+    def decode_fragments(self, frags: Sequence["DataFragment"]) -> bytes:
+        """Ref: IDA::Decode(vector<DataFragment>) (ida.cpp:164-175)."""
+        return self.decode([f.values for f in frags], [f.index for f in frags])
+
+    # -- file helpers (ref: ida.cpp:80-118) --------------------------------
+    def encode_file(self, path: str) -> np.ndarray:
+        with open(path, "rb") as fh:
+            return self.encode(fh.read())
+
+    def encode_to_files(self, in_path: str, out_paths: Sequence[str]) -> None:
+        if len(out_paths) != self.n:
+            raise ValueError(f"Number of outfiles should be {self.n}")
+        frags = frags_from_matrix(self.encode_file(in_path),
+                                  self.n, self.m, self.p)
+        for frag, out in zip(frags, out_paths):
+            frag.write_to_file(out)
+
+
+# ---------------------------------------------------------------------------
+# DataFragment — one encoded row + wire forms
+# ---------------------------------------------------------------------------
+
+def _digits_per_val(p: int) -> int:
+    """ceil(log64 p) — fixed digit width per value (data_fragment.cpp:59)."""
+    return max(1, math.ceil(math.log(p) / math.log(64)))
+
+
+def serialize_base64(values: Sequence[int], num_digits: int = 2) -> str:
+    """Fixed-width custom base-64 (ref: SerializeToBase64,
+    data_fragment.cpp:98-115)."""
+    out = []
+    limit = 64 ** num_digits
+    for val in values:
+        val = int(val)
+        if val >= limit:
+            raise ValueError(f"Cannot encode {val}: exceeds max {limit}")
+        digits = []
+        for _ in range(num_digits):
+            digits.append(BASE64_ALPHABET[val % 64])
+            val //= 64
+        out.extend(reversed(digits))
+    return "".join(out)
+
+
+def parse_base64(text: str, num_digits: int = 2) -> List[int]:
+    """Inverse of serialize_base64 (ref: ParseFromBase64,
+    data_fragment.cpp:118-132)."""
+    vals = []
+    for i in range(0, len(text), num_digits):
+        el = 0
+        for j in range(num_digits):
+            el = el * 64 + _B64_INDEX[text[i + j]]
+        vals.append(el)
+    return vals
+
+
+@dataclasses.dataclass
+class DataFragment:
+    """One encoded row + its 1-based index + IDA params.
+
+    Ref: class DataFragment (data_fragment.h:18-100); defaults n=14 m=10
+    p=257 (data_fragment.h:31).
+    """
+
+    values: List[int]
+    index: int
+    n: int = 14
+    m: int = 10
+    p: int = 257
+
+    # -- JSON wire form (the RPC format) -----------------------------------
+    def to_json(self) -> dict:
+        """Ref: DataFragment::ToJson (data_fragment.cpp:49-62)."""
+        return {
+            "M": self.m, "N": self.n, "P": self.p, "INDEX": self.index,
+            "FRAGMENT": serialize_base64(self.values, _digits_per_val(self.p)),
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "DataFragment":
+        """Ref: DataFragment(const Json::Value&) (data_fragment.cpp:11-18)."""
+        p = int(obj["P"])
+        return cls(
+            values=parse_base64(obj["FRAGMENT"], _digits_per_val(p)),
+            index=int(obj["INDEX"]),
+            n=int(obj["N"]), m=int(obj["M"]), p=p,
+        )
+
+    # -- text form (quirk-faithful asymmetric round-trip) ------------------
+    def to_text(self) -> str:
+        """Writes "m n p idx:v1 v2 ...\\n" (ref: operator std::string,
+        data_fragment.cpp:74-86). NOTE the prefix order m-first."""
+        vals = " ".join(str(int(v)) for v in self.values)
+        return f"{self.m} {self.n} {self.p} {self.index}:{vals}\n"
+
+    @classmethod
+    def from_text(cls, text: str) -> "DataFragment":
+        """Parses the prefix as "n m p idx" (ref: data_fragment.cpp:20-32) —
+        the reference swaps n/m relative to to_text; mirrored for wire
+        parity and pinned by tests."""
+        prefix, _, body = text.strip().partition(":")
+        n, m, p, idx = (int(tok) for tok in prefix.split(" "))
+        vals = [int(tok) for tok in body.split(" ")] if body else []
+        return cls(values=vals, index=idx, n=n, m=m, p=p)
+
+    # -- file round-trip (ref: data_fragment.cpp:34-47,181-196) ------------
+    def write_to_file(self, path: str) -> bool:
+        try:
+            with open(path, "w") as fh:
+                json.dump(self.to_json(), fh)
+            return True
+        except OSError:
+            return False
+
+    @classmethod
+    def from_file(cls, path: str) -> "DataFragment":
+        with open(path) as fh:
+            return cls.from_json(json.load(fh))
+
+    def __eq__(self, other: object) -> bool:
+        """Equality is values + index only (data_fragment.cpp:88-91)."""
+        if not isinstance(other, DataFragment):
+            return NotImplemented
+        return list(self.values) == list(other.values) and self.index == other.index
+
+    def __lt__(self, other: "DataFragment") -> bool:
+        return self.index < other.index
+
+
+def frags_from_matrix(matrix: np.ndarray, n: int = 14, m: int = 10,
+                      p: int = 257) -> List[DataFragment]:
+    """[n, S] matrix -> n fragments with 1-based indices
+    (ref: FragsFromMatrix, data_fragment.cpp:171-179)."""
+    return [
+        DataFragment(values=[int(v) for v in matrix[i]], index=i + 1,
+                     n=n, m=m, p=p)
+        for i in range(matrix.shape[0])
+    ]
+
+
+# ---------------------------------------------------------------------------
+# DataBlock — value container for DHash
+# ---------------------------------------------------------------------------
+
+class DataBlock:
+    """A stored value as n fragments (ref: class DataBlock, data_block.h:21-103).
+
+    Construct from a string/bytes (encode) or from >= m fragments
+    (decode then re-encode all n, data_block.cpp:30-54).
+    """
+
+    def __init__(self, data: Optional[bytes] = None, n: int = 14, m: int = 10,
+                 p: int = 257,
+                 fragments: Optional[Sequence[DataFragment]] = None,
+                 backend: str = "jax"):
+        self.n, self.m, self.p = n, m, p
+        self.ida = IDA(n, m, p, backend=backend)
+        if data is not None:
+            if isinstance(data, str):
+                data = data.encode("utf-8")
+            self.original = data
+            self.fragments = frags_from_matrix(self.ida.encode(data), n, m, p)
+        elif fragments is not None:
+            self.original = self.ida.decode_fragments(list(fragments))
+            self.fragments = frags_from_matrix(
+                self.ida.encode(self.original), n, m, p)
+        else:
+            raise ValueError("DataBlock needs data or fragments")
+
+    @classmethod
+    def from_json(cls, obj: dict, backend: str = "jax") -> "DataBlock":
+        """Ref: DataBlock(const Json::Value&) (data_block.cpp:17-28)."""
+        frags = [DataFragment.from_json(f) for f in obj["FRAGMENTS"]]
+        return cls(n=int(obj["N"]), m=int(obj["M"]), p=int(obj["P"]),
+                   fragments=frags, backend=backend)
+
+    def to_json(self) -> dict:
+        return {
+            "N": self.n, "M": self.m, "P": self.p,
+            "FRAGMENTS": [f.to_json() for f in self.fragments],
+        }
+
+    def decode(self) -> str:
+        """Original as text, stripping trailing NULs
+        (ref: DataBlock::Decode, data_block.cpp:81-97)."""
+        return self.original.rstrip(b"\x00").decode("utf-8", errors="surrogateescape")
+
+    def decode_bytes(self) -> bytes:
+        return self.original.rstrip(b"\x00")
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, DataBlock):
+            return NotImplemented
+        return (self.original == other.original
+                and self.fragments == other.fragments)

@@ -176,6 +176,11 @@ def lanes_to_int(lanes: np.ndarray) -> int:
 
 
 def lanes_to_ints(lanes: np.ndarray) -> list:
-    """[N, LANES] uint32 -> list of python ints."""
-    lanes = np.asarray(lanes)
-    return [lanes_to_int(lanes[i]) for i in range(lanes.shape[0])]
+    """[N, LANES] uint32 -> list of python ints (vectorized inverse of
+    ints_to_lanes — one bulk byte conversion, no per-row numpy calls)."""
+    lanes = np.ascontiguousarray(np.asarray(lanes), dtype="<u4")
+    buf = lanes.tobytes()
+    return [
+        int.from_bytes(buf[16 * i : 16 * i + 16], "little")
+        for i in range(lanes.shape[0])
+    ]

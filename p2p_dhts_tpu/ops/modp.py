@@ -1,0 +1,151 @@
+"""Jittable mod-p linear algebra — the compute kernel under the IDA.
+
+The reference does scalar mod-p arithmetic on ``vector<int>`` one inner
+product at a time (src/ida/matrix_math.cpp:26-55). On TPU the same math is a
+batched integer matmul: fragment encode is ``[n, m] @ [m, S] mod p`` and
+decode is an inverse-Vandermonde matmul, both over large block batches.
+
+dtype strategy: values live in int32. When ``k * (p-1)^2 < 2^24`` the matmul
+is lowered through float32 (exact — every intermediate fits the f32 mantissa)
+so it rides the MXU; otherwise an int32 einsum with per-k modular reduction
+is used. For the reference's defaults (m=10, p=257) the float path is exact:
+10 * 256^2 = 655,360 << 2^24.
+
+All functions are pure, shape-static, and vmap/jit/shard_map safe.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_F32_EXACT_LIMIT = 1 << 24
+
+
+def _float_path_exact(k: int, p: int) -> bool:
+    """Is an f32 matmul with contraction length k over values < p exact?"""
+    return k * (p - 1) * (p - 1) < _F32_EXACT_LIMIT
+
+
+def mod_matmul(a: jax.Array, b: jax.Array, p: int) -> jax.Array:
+    """``(a @ b) mod p`` over the trailing two dims; leading dims broadcast.
+
+    a: [..., r, k] int32 with entries in [0, p)
+    b: [..., k, c] int32 with entries in [0, p)
+    returns [..., r, c] int32 in [0, p)
+
+    Reference semantics: MatrixProduct (matrix_math.cpp:35-55) reduces mod p
+    per multiply-add; since inputs are canonical (in [0, p)) the result is
+    identical to reducing once at the end, which is what the MXU path does.
+    """
+    k = a.shape[-1]
+    if _float_path_exact(k, p):
+        prod = jnp.matmul(
+            a.astype(jnp.float32), b.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return (prod.astype(jnp.int32)) % p
+    # Wide path: reduce in chunks small enough that int32 never overflows.
+    chunk = max(1, (2**31 - 1) // max(1, (p - 1) * (p - 1)))
+    out = jnp.zeros(a.shape[:-1] + (b.shape[-1],), dtype=jnp.int32)
+    for start in range(0, k, chunk):
+        end = min(k, start + chunk)
+        part = jnp.einsum(
+            "...rk,...kc->...rc",
+            a[..., start:end].astype(jnp.int32),
+            b[..., start:end, :].astype(jnp.int32),
+        )
+        out = (out + part % p) % p
+    return out
+
+
+def mod_pow(x: jax.Array, e: int, p: int) -> jax.Array:
+    """x**e mod p elementwise; e, p static python ints (binary exponentiation).
+
+    Requires (p-1)^2 < 2^31 so int32 products never overflow (p < 46341 —
+    far above any practical IDA modulus; the reference uses 257).
+    """
+    x = jnp.asarray(x, dtype=jnp.int32) % p
+    result = jnp.ones_like(x)
+    while e > 0:
+        if e & 1:
+            result = (result * x) % p
+        x = (x * x) % p
+        e >>= 1
+    return result
+
+
+def mod_inverse(x: jax.Array, p: int) -> jax.Array:
+    """Multiplicative inverse mod prime p via Fermat: x^(p-2) mod p.
+
+    The reference uses extended Euclid (matrix_math.cpp:66-86); Fermat is the
+    branch-free jittable equivalent for prime p (an IDA invariant,
+    ida.cpp:54-56 requires it implicitly — non-prime p breaks decode).
+    """
+    return mod_pow(x, p - 2, p)
+
+
+def vandermonde_matrix(n: int, m: int, p: int) -> np.ndarray:
+    """Encoding matrix: row a-1 = [a^0, a^1, ..., a^(m-1)] mod p for a=1..n.
+
+    Reference: ConstructEncodingMatrix (matrix_math.cpp:88-101). Host-side —
+    it depends only on static params and is baked into the jitted encode.
+    """
+    rows = np.arange(1, n + 1, dtype=np.int64)
+    out = np.ones((n, m), dtype=np.int64)
+    for j in range(1, m):
+        out[:, j] = (out[:, j - 1] * rows) % p
+    return out.astype(np.int32)
+
+
+def vandermonde_inverse(basis: jax.Array, p: int) -> jax.Array:
+    """Inverse of the square Vandermonde V[i, j] = basis[i]^j, mod prime p.
+
+    basis: [..., m] int32 of distinct values in [1, p) (fragment indices).
+    returns [..., m, m] int32 with (V @ inv) == I mod p.
+
+    Method (distinct from the reference's elementary-symmetric-polynomial
+    construction at matrix_math.cpp:103-168, same unique result): Lagrange
+    interpolation. inv[j, i] = coeff of x^j in l_i(x), where
+    l_i(x) = prod_{t != i} (x - b_t) / prod_{t != i} (b_i - b_t).
+    The numerator polynomials are all synthetic divisions of the master
+    polynomial P(x) = prod_t (x - b_t) by (x - b_i) — O(m^2) total, fully
+    vectorized over both the basis dim and any leading batch dims.
+    """
+    basis = jnp.asarray(basis, dtype=jnp.int32) % p
+    m = basis.shape[-1]
+
+    # Master polynomial coefficients c[0..m]: P(x) = prod (x - b_t).
+    batch = basis.shape[:-1]
+    coeffs = jnp.zeros(batch + (m + 1,), dtype=jnp.int32).at[..., 0].set(1)
+    # Multiply (poly) by (x - b_t) iteratively; static m, unrolled.
+    for t in range(m):
+        b_t = basis[..., t : t + 1]
+        shifted = jnp.concatenate(
+            [jnp.zeros(batch + (1,), jnp.int32), coeffs[..., :-1]], axis=-1
+        )
+        coeffs = (shifted - b_t * coeffs) % p
+    # coeffs[k] = coeff of x^k (ascending); coeffs[m] = 1 is the leading term.
+
+    # Synthetic division of P by (x - b_i) for every i at once, descending:
+    # q_i has degree m-1; q_i[0] = 1; q_i[k] = coeff_desc[k] + b_i * q_i[k-1],
+    # where coeff_desc[k] = coeffs[m - k].
+    qs = [jnp.broadcast_to(jnp.ones(batch + (m,), jnp.int32), batch + (m,))]
+    for k in range(1, m):
+        prev = qs[-1]
+        qs.append((coeffs[..., m - k, None] + basis * prev) % p)
+    q = jnp.stack(qs, axis=-1)  # [..., i, k], q[..., i, k] = coeff of x^(m-1-k)
+
+    # Denominators d_i = prod_{t != i} (b_i - b_t) mod p, vectorized.
+    diff = (basis[..., :, None] - basis[..., None, :]) % p  # [..., i, t]
+    diff = jnp.where(jnp.eye(m, dtype=bool), 1, diff)
+    denom = jnp.ones(batch + (m,), dtype=jnp.int32)
+    for t in range(m):
+        denom = (denom * diff[..., t]) % p
+    inv_denom = mod_inverse(denom, p)  # [..., i]
+
+    scaled = (q * inv_denom[..., None]) % p  # [..., i, k] coeff of x^(m-1-k)
+    # inv[j, i] = coeff of x^j in l_i = scaled[i, m-1-j]  -> flip then transpose.
+    return jnp.swapaxes(jnp.flip(scaled, axis=-1), -1, -2)

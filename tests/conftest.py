@@ -7,7 +7,9 @@ bench.py / __graft_entry__.py, not the unit suite.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: the shell exports JAX_PLATFORMS=axon (real TPU) globally,
+# but the unit suite must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
