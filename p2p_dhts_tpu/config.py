@@ -32,6 +32,11 @@ class IdaParams:
             raise ValueError(f"IDA requires n > m > 0, got n={self.n} m={self.m}")
         if self.p <= self.n:
             raise ValueError(f"IDA requires p > n, got p={self.p} n={self.n}")
+        if (self.p - 1) ** 2 > 2**31 - 1:
+            # Device kernels do mod-p arithmetic in int32; individual
+            # products must not overflow.
+            raise ValueError(f"IDA modulus p={self.p} exceeds int32 kernel "
+                             f"range (need (p-1)^2 < 2^31)")
         # Tiny trial-division primality check; p is small (fits a matmul dtype).
         if self.p < 2 or any(self.p % d == 0 for d in range(2, int(self.p**0.5) + 1)):
             raise ValueError(f"IDA modulus p={self.p} must be prime")

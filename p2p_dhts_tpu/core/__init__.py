@@ -1,0 +1,9 @@
+"""Core protocol layers: ring state, lookup kernels, churn ops."""
+
+from p2p_dhts_tpu.core.ring import (  # noqa: F401
+    RingState,
+    build_ring,
+    find_successor,
+    get_n_successors,
+    owner_of,
+)

@@ -1,0 +1,329 @@
+"""The simulated Chord ring as device-resident arrays + the lookup kernel.
+
+This is the north-star re-design (SURVEY.md §7): where the reference runs one
+OS process per peer and resolves lookups by recursive JSON-RPC forwarding
+(`AbstractChordPeer::GetSuccessor` -> `ChordPeer::ForwardRequest` ->
+`FingerTable::Lookup`, a linear scan of 128 fingers per hop,
+abstract_chord_peer.cpp:318-330 / chord_peer.cpp:185-211 /
+finger_table.h:115-130), here the entire N-peer ring is one `RingState`
+pytree in HBM and a batch of B lookups advances *all* hops in lockstep
+inside a single `lax.while_loop` — one O(1) indexed gather per hop per key
+instead of the reference's 128 InBetween evaluations on 256-bit ints + one
+TCP round-trip.
+
+Routing parity: the kernel reproduces the reference's exact non-textbook
+semantics (pinned by tests/oracle.py + tests/test_ring.py):
+  * finger i covers [id + 2^i, id + 2^(i+1) - 1]; the "containing range"
+    scan collapses to i = bit_length(k - id) - 1 in O(1).
+  * self-hit -> forward to predecessor if alive (chord_peer.cpp:194-196).
+  * dead finger -> successor-list range lookup fallback, else the lookup
+    fails (chord_peer.cpp:201-208, remote_peer_list.cpp:86-110).
+  * termination: key in [min_key, id] clockwise-inclusive
+    (abstract_chord_peer.cpp:720-725).
+
+Two finger modes (RingConfig.finger_mode):
+  * "materialized": fingers live as an [N, 128] int32 peer-index matrix
+    (the direct analog of the reference's tables; 512 B/peer).
+  * "computed": fingers are derived per hop as ring_successor(id + 2^i)
+    by binary search over the sorted id table — no [N,128] matrix, the
+    memory-free path to 10M+ simulated peers. Computed mode assumes an
+    all-alive converged table (it has no stale entries to repair, so the
+    dead-finger fallback path is unreachable by construction).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu import keyspace
+from p2p_dhts_tpu.config import RingConfig, DEFAULT_CONFIG
+from p2p_dhts_tpu.ops import u128
+
+LANES = keyspace.LANES
+
+
+class RingState(NamedTuple):
+    """Whole-ring state: what the reference scatters across N processes.
+
+    Rows are peers, sorted ascending by id; rows >= n_valid are padding.
+    All cross-references (preds/succs/fingers) are row indices, -1 = none.
+    """
+
+    ids: jax.Array                 # [N, 4] u32, sorted ascending
+    alive: jax.Array               # [N] bool
+    n_valid: jax.Array             # scalar i32: number of real rows
+    min_key: jax.Array             # [N, 4] u32: own range lower bound
+    preds: jax.Array               # [N] i32: predecessor row
+    succs: jax.Array               # [N, S] i32: successor-list rows
+    fingers: Optional[jax.Array]   # [N, F] i32 or None (computed mode)
+
+    @property
+    def capacity(self) -> int:
+        return self.ids.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# construction (host side; ids change only at churn, SURVEY.md §7)
+# ---------------------------------------------------------------------------
+
+def _pad_ids(ids_lanes: np.ndarray, capacity: int) -> np.ndarray:
+    out = np.full((capacity, LANES), 0xFFFFFFFF, dtype=np.uint32)
+    out[: ids_lanes.shape[0]] = ids_lanes
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("num_fingers", "chunk"))
+def _materialize_fingers(ids: jax.Array, n_valid: jax.Array,
+                         num_fingers: int, chunk: int = 16) -> jax.Array:
+    """fingers[p, i] = row index of ring-successor(id_p + 2^i) — [N, F] i32.
+
+    The converged-state content of every peer's finger table (what
+    PopulateFingerTable converges to, abstract_chord_peer.cpp:564-613),
+    computed as F binary searches over the sorted table instead of N*F
+    sequential GET_SUCC RPCs.
+    """
+    n = ids.shape[0]
+    cols = []
+    for f0 in range(0, num_fingers, chunk):
+        fs = jnp.arange(f0, min(f0 + chunk, num_fingers), dtype=jnp.int32)
+        starts = u128.add(ids[:, None, :], u128.pow2(fs)[None, :, :])
+        idx = u128.ring_successor(
+            ids, starts.reshape(-1, LANES), n_valid).reshape(n, -1)
+        cols.append(idx)
+    return jnp.concatenate(cols, axis=1).astype(jnp.int32)
+
+
+def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
+               capacity: Optional[int] = None) -> RingState:
+    """Build a fully-converged RingState from 128-bit integer ids.
+
+    The array analog of: every peer has StartChord/Join'ed, every
+    stabilize/fix-fingers round has run to fixpoint. Single-peer rings get
+    min_key = id + 1, i.e. the whole keyspace (abstract_chord_peer.cpp:66-71).
+    """
+    if cfg.key_bits != keyspace.KEY_BITS:
+        # keyspace/u128 lane math is hardcoded to 128-bit ids; a narrower
+        # finger table would silently degrade routing to an O(N) walk.
+        raise ValueError(f"build_ring supports key_bits=128 only, "
+                         f"got {cfg.key_bits}")
+    ids_sorted = sorted(set(int(i) % keyspace.KEYS_IN_RING for i in ids))
+    n = len(ids_sorted)
+    if n == 0:
+        raise ValueError("ring needs at least one peer")
+    capacity = capacity or n
+    if capacity < n:
+        raise ValueError(f"capacity {capacity} < {n} peers")
+    s = cfg.num_succs
+
+    ids_lanes = keyspace.ints_to_lanes(ids_sorted)
+    idx = np.arange(n)
+    preds = np.full(capacity, -1, dtype=np.int32)
+    preds[:n] = (idx - 1) % n
+
+    succs = np.full((capacity, s), -1, dtype=np.int32)
+    for k in range(1, min(s, max(n - 1, 1)) + 1):
+        if n > 1:
+            succs[:n, k - 1] = (idx + k) % n
+
+    min_key_ints = (
+        [(ids_sorted[0] + 1) % keyspace.KEYS_IN_RING] if n == 1
+        else [(ids_sorted[(i - 1) % n] + 1) % keyspace.KEYS_IN_RING
+              for i in range(n)]
+    )
+    min_key = np.zeros((capacity, LANES), dtype=np.uint32)
+    min_key[:n] = keyspace.ints_to_lanes(min_key_ints)
+
+    alive = np.zeros(capacity, dtype=bool)
+    alive[:n] = True
+
+    ids_arr = jnp.asarray(_pad_ids(ids_lanes, capacity))
+    n_valid = jnp.int32(n)
+
+    fingers = None
+    if cfg.finger_mode == "materialized":
+        fingers = _materialize_fingers(ids_arr, n_valid, cfg.num_fingers)
+
+    return RingState(
+        ids=ids_arr,
+        alive=jnp.asarray(alive),
+        n_valid=n_valid,
+        min_key=jnp.asarray(min_key),
+        preds=jnp.asarray(preds),
+        succs=jnp.asarray(succs),
+        fingers=fingers,
+    )
+
+
+def build_ring_from_seeds(seeds: Sequence[Tuple[str, int]],
+                          cfg: RingConfig = DEFAULT_CONFIG,
+                          capacity: Optional[int] = None) -> RingState:
+    """Build from (ip, port) pairs — ids are SHA-1 of "ip:port" exactly like
+    peer construction in the reference (abstract_chord_peer.cpp:13-28)."""
+    return build_ring([keyspace.peer_id(ip, port) for ip, port in seeds],
+                      cfg, capacity)
+
+
+# ---------------------------------------------------------------------------
+# lookup kernel
+# ---------------------------------------------------------------------------
+
+def _succ_list_candidate(state: RingState, cur: jax.Array,
+                         keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized RemotePeerList::Lookup(key, succ=True)
+    (remote_peer_list.cpp:86-110): first successor-list entry whose
+    [prev_id, entry_id] range contains the key. Returns (row, found)."""
+    entries = state.succs[cur]                          # [B, S]
+    valid = entries >= 0
+    entry_ids = state.ids[jnp.maximum(entries, 0)]      # [B, S, 4]
+    own_ids = state.ids[cur]                            # [B, 4]
+    prev_ids = jnp.concatenate(
+        [own_ids[:, None, :], entry_ids[:, :-1, :]], axis=1)
+    hit = valid & u128.in_between(keys[:, None, :], prev_ids, entry_ids, True)
+    j = jnp.argmax(hit, axis=1)
+    found = jnp.any(hit, axis=1)
+    row = jnp.take_along_axis(entries, j[:, None], axis=1)[:, 0]
+    return row, found
+
+
+@functools.partial(jax.jit, static_argnames=("max_hops",))
+def find_successor(state: RingState, keys: jax.Array,
+                   start: jax.Array, max_hops: int = 64
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Batched GetSuccessor: resolve B keys from B starting peers at once.
+
+    keys:  [B, 4] u32
+    start: [B] i32 row indices of the originating peers
+    returns (owner [B] i32, hops [B] i32); failed lookups (the reference
+    throws "Lookup failed", chord_peer.cpp:206) come back as owner -1,
+    hops -1. Lanes that exceed max_hops (a routing loop the reference would
+    recurse on forever) also fail.
+
+    Each while_loop iteration advances EVERY unresolved lane by one hop —
+    the device analog of one recursive GET_SUCC RPC per key.
+    """
+    ids, alive, preds = state.ids, state.alive, state.preds
+    materialized = state.fingers is not None
+
+    def cond(carry):
+        _, _, done, _, it = carry
+        return (~jnp.all(done)) & (it < max_hops)
+
+    def body(carry):
+        cur, hops, done, failed, it = carry
+        cur_s = jnp.maximum(cur, 0)
+        cur_ids = ids[cur_s]
+        local = u128.in_between(keys, state.min_key[cur_s], cur_ids, True)
+        done_now = done | local
+
+        # Finger choice: containing-range scan == bit_length(dist) - 1.
+        dist = u128.sub(keys, cur_ids)
+        fi = jnp.maximum(u128.bit_length(dist) - 1, 0)
+        if materialized:
+            nxt = state.fingers[cur_s, fi]
+        else:
+            starts = u128.add(cur_ids, u128.pow2(fi))
+            nxt = u128.ring_successor(ids, starts, state.n_valid)
+        nxt = jnp.maximum(nxt, 0)
+
+        # Self-hit -> predecessor when alive (chord_peer.cpp:194-196).
+        pred_rows = preds[cur_s]
+        self_hit = (nxt == cur_s) & alive[jnp.maximum(pred_rows, 0)] \
+            & (pred_rows >= 0)
+        nxt = jnp.where(self_hit, pred_rows, nxt)
+
+        # Dead finger -> succ-list fallback (chord_peer.cpp:201-208).
+        need_fb = (~self_hit) & (~alive[nxt])
+        fb_row, fb_found = _succ_list_candidate(state, cur_s, keys)
+        fb_ok = fb_found & alive[jnp.maximum(fb_row, 0)] & (fb_row >= 0)
+        fail_now = (~done_now) & need_fb & (~fb_ok)
+        nxt = jnp.where(need_fb, jnp.where(fb_ok, fb_row, cur_s), nxt)
+
+        advance = (~done_now) & (~fail_now)
+        cur = jnp.where(advance, nxt, cur)
+        hops = jnp.where(advance, hops + 1, hops)
+        failed = failed | fail_now
+        done = done_now | fail_now
+        return cur, hops, done, failed, it + 1
+
+    b = keys.shape[0]
+    cur0 = jnp.asarray(start, dtype=jnp.int32)
+    hops0 = jnp.zeros(b, dtype=jnp.int32)
+    done0 = jnp.zeros(b, dtype=bool)
+    failed0 = jnp.zeros(b, dtype=bool)
+    cur, hops, done, failed, _ = jax.lax.while_loop(
+        cond, body, (cur0, hops0, done0, failed0, jnp.int32(0)))
+
+    # Lanes still in flight when the budget ran out get one final local
+    # check: a route of exactly max_hops hops needs max_hops+1 body
+    # iterations (the last one only to observe termination), so without
+    # this a boundary-length route would be misreported as failed.
+    cur_s = jnp.maximum(cur, 0)
+    local_fin = u128.in_between(keys, state.min_key[cur_s], ids[cur_s], True)
+    resolved = done | (~failed & local_fin)
+    failed = failed | ~resolved  # hop budget exhausted == routing loop
+    owner = jnp.where(failed, -1, cur)
+    hops = jnp.where(failed, -1, hops)
+    return owner, hops
+
+
+@functools.partial(jax.jit, static_argnames=())
+def owner_of(state: RingState, keys: jax.Array) -> jax.Array:
+    """Omniscient 0-hop ownership: row of the ring successor of each key.
+
+    Not a protocol op — the O(log N) "god's eye" resolution used for
+    placement math and as the correctness cross-check for find_successor.
+    """
+    return u128.ring_successor(state.ids, keys, state.n_valid)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "max_hops"))
+def get_n_successors(state: RingState, keys: jax.Array, start: jax.Array,
+                     n: int, max_hops: int = 64
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Batched GetNSuccessors (abstract_chord_peer.cpp:345-373).
+
+    Walks succ(key), succ(owner_id + 1), ... n times, breaking (per lane)
+    when the walk wraps back to the first owner — the reference's
+    already-in-list break. Returns (owners [B, n] i32 with -1 past the
+    break, hops [B, n] i32 per-lookup hop counts, -1 past the break).
+    """
+    def step(carry, _):
+        q, first_owner, stopped = carry
+        owner, hops = find_successor(state, q, start, max_hops)
+        is_first = first_owner < 0
+        wrapped = (~is_first) & (owner == first_owner)
+        stopped = stopped | wrapped | (owner < 0)
+        out_owner = jnp.where(stopped, -1, owner)
+        out_hops = jnp.where(stopped, -1, hops)
+        first_owner = jnp.where(is_first, owner, first_owner)
+        next_q = u128.add_scalar(state.ids[jnp.maximum(owner, 0)], 1)
+        q = jnp.where(stopped[:, None], q, next_q)
+        return (q, first_owner, stopped), (out_owner, out_hops)
+
+    b = keys.shape[0]
+    carry0 = (keys,
+              jnp.full(b, -1, dtype=jnp.int32),
+              jnp.zeros(b, dtype=bool))
+    _, (owners, hops) = jax.lax.scan(step, carry0, None, length=n)
+    return jnp.moveaxis(owners, 0, 1), jnp.moveaxis(hops, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# host conveniences
+# ---------------------------------------------------------------------------
+
+def keys_from_ints(values: Sequence[int]) -> jax.Array:
+    """Python ints -> [B, 4] u32 device keys."""
+    return jnp.asarray(keyspace.ints_to_lanes(values))
+
+
+def keys_from_plaintext(texts: Sequence[str]) -> jax.Array:
+    """SHA-1 hash plaintexts to device keys (host-side hashing, ids only
+    change at ingestion — SURVEY.md §7 hard-parts)."""
+    return keys_from_ints([keyspace.sha1_id(t) for t in texts])

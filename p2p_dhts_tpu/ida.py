@@ -79,8 +79,7 @@ def strip_decoded(segments: np.ndarray) -> bytes:
     last = segs[-1]
     nz = np.nonzero(last)[0]
     segs[-1] = last[: nz[-1] + 1]
-    flat = np.concatenate(segs) if segs else np.zeros(0, dtype=np.int32)
-    return (flat & 0xFF).astype(np.uint8).tobytes()
+    return (np.concatenate(segs) & 0xFF).astype(np.uint8).tobytes()
 
 
 # ---------------------------------------------------------------------------
@@ -218,8 +217,8 @@ def serialize_base64(values: Sequence[int], num_digits: int = 2) -> str:
     limit = 64 ** num_digits
     for val in values:
         val = int(val)
-        if val >= limit:
-            raise ValueError(f"Cannot encode {val}: exceeds max {limit}")
+        if val < 0 or val >= limit:
+            raise ValueError(f"Cannot encode {val}: outside [0, {limit})")
         digits = []
         for _ in range(num_digits):
             digits.append(BASE64_ALPHABET[val % 64])

@@ -41,6 +41,10 @@ def mod_matmul(a: jax.Array, b: jax.Array, p: int) -> jax.Array:
     identical to reducing once at the end, which is what the MXU path does.
     """
     k = a.shape[-1]
+    if (p - 1) * (p - 1) > 2**31 - 1:
+        # The int32 fallback path forms individual a*b products; they must
+        # fit int32 (p <= 46341). Same bound mod_pow documents.
+        raise ValueError(f"mod_matmul requires (p-1)^2 < 2^31, got p={p}")
     if _float_path_exact(k, p):
         prod = jnp.matmul(
             a.astype(jnp.float32), b.astype(jnp.float32),

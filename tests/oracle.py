@@ -1,0 +1,150 @@
+"""Reference-semantics oracle: a pure-python-int mirror of the C++ lookup.
+
+This is NOT part of the framework — it exists so tests can assert that the
+batched device kernels in ``p2p_dhts_tpu.core.ring`` reproduce the
+reference's *exact* routing behavior (owner AND hop count), including its
+non-textbook quirks:
+
+  * finger i of peer p covers [id_p + 2^i, id_p + 2^(i+1) - 1] mod 2^128
+    (finger_table.h:177-188); Lookup is a linear scan returning the
+    *successor of the containing range* (finger_table.h:115-130), not the
+    paper's closest-preceding-finger.
+  * ForwardRequest's self-hit correction: if the finger points at the
+    querying peer itself and its predecessor is alive, forward to the
+    predecessor instead (chord_peer.cpp:194-196).
+  * dead finger -> successor-list range Lookup fallback; no candidate ->
+    lookup failure (chord_peer.cpp:201-208, remote_peer_list.cpp:86-110).
+  * StoredLocally(k) = k in [min_key, id] clockwise-inclusive
+    (abstract_chord_peer.cpp:720-725); hop terminates there
+    (abstract_chord_peer.cpp:318-330).
+  * GetNSuccessors walks succ-of-(prev_id + 1) and breaks on the first
+    repeat (abstract_chord_peer.cpp:345-373).
+
+Hop counting: one hop per SendRequest, i.e. per transfer of the request to
+another peer; a locally-owned key costs 0 hops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+KEY_BITS = 128
+RING = 1 << KEY_BITS
+
+
+def in_between(v: int, lb: int, ub: int, inclusive: bool = True) -> bool:
+    """Clockwise range test, quirk-faithful to key.h:103-131."""
+    if lb == ub:
+        return v == ub
+    if lb < ub:
+        return (lb <= v <= ub) if inclusive else (lb < v < ub)
+    return not ((ub < v < lb) if inclusive else (ub <= v <= lb))
+
+
+@dataclasses.dataclass
+class OraclePeer:
+    id: int
+    min_key: int
+    pred: int                      # predecessor id
+    succs: List[int]               # successor-list ids, ring order from id
+    fingers: List[int]             # finger i -> successor id of [id+2^i, ...]
+    alive: bool = True
+
+
+class OracleRing:
+    """A fully-converged ring of OraclePeers built from a set of ids."""
+
+    def __init__(self, ids: List[int], num_succs: int = 3,
+                 key_bits: int = KEY_BITS):
+        self.key_bits = key_bits
+        self.ring = 1 << key_bits
+        ids = sorted(set(ids))
+        n = len(ids)
+        self.ids = ids
+        self.peers: Dict[int, OraclePeer] = {}
+        for i, pid in enumerate(ids):
+            pred = ids[(i - 1) % n]
+            succs = [ids[(i + k) % n] for k in range(1, min(num_succs, n) + 1)]
+            fingers = [self._ring_successor((pid + (1 << f)) % self.ring)
+                       for f in range(key_bits)]
+            self.peers[pid] = OraclePeer(
+                id=pid,
+                min_key=(pred + 1) % self.ring if n > 1 else (pid + 1) % self.ring,
+                pred=pred,
+                succs=succs,
+                fingers=fingers,
+            )
+
+    def _ring_successor(self, k: int) -> int:
+        """Smallest id clockwise-at-or-after k (host construction helper)."""
+        for pid in self.ids:
+            if pid >= k:
+                return pid
+        return self.ids[0]
+
+    def kill(self, pid: int) -> None:
+        self.peers[pid].alive = False
+
+    # -- reference lookup semantics ----------------------------------------
+
+    def stored_locally(self, peer: OraclePeer, k: int) -> bool:
+        return in_between(k, peer.min_key, peer.id, True)
+
+    def finger_lookup(self, peer: OraclePeer, k: int) -> int:
+        """FingerTable::Lookup linear scan (finger_table.h:115-130)."""
+        for i in range(self.key_bits):
+            lb = (peer.id + (1 << i)) % self.ring
+            ub = (peer.id + (1 << (i + 1)) - 1) % self.ring
+            if in_between(k, lb, ub, True):
+                return peer.fingers[i]
+        raise LookupError("ChordKey not found")
+
+    def succ_list_lookup(self, peer: OraclePeer, k: int) -> Optional[int]:
+        """RemotePeerList::Lookup(key, succ=True) (remote_peer_list.cpp:86-110)."""
+        prev = peer.id
+        for entry in peer.succs:
+            if in_between(k, prev, entry, True):
+                return entry
+            prev = entry
+        return None
+
+    def forward_target(self, peer: OraclePeer, k: int) -> int:
+        """ForwardRequest's choice of next peer (chord_peer.cpp:185-211)."""
+        key_succ = self.finger_lookup(peer, k)
+        if key_succ == peer.id and self.peers[peer.pred].alive:
+            return peer.pred
+        if not self.peers[key_succ].alive:
+            cand = self.succ_list_lookup(peer, k)
+            if cand is not None and self.peers[cand].alive:
+                return cand
+            raise LookupError("Lookup failed")
+        return key_succ
+
+    def find_successor(self, start: int, k: int,
+                       max_hops: int = 400) -> Tuple[int, int]:
+        """GetSuccessor from peer `start` -> (owner id, hop count)."""
+        cur = self.peers[start]
+        hops = 0
+        while not self.stored_locally(cur, k):
+            nxt = self.forward_target(cur, k)
+            if hops >= max_hops:
+                raise LookupError("hop budget exceeded (routing loop)")
+            cur = self.peers[nxt]
+            hops += 1
+        return cur.id, hops
+
+    def get_n_successors(self, start: int, k: int, n: int) -> List[int]:
+        """GetNSuccessors walk with repeat-break
+        (abstract_chord_peer.cpp:345-373)."""
+        out: List[int] = []
+        seen = set()
+        prev = (k - 1) % self.ring
+        for _ in range(n):
+            owner, _ = self.find_successor(start, (prev + 1) % self.ring)
+            if owner in seen:
+                break
+            out.append(owner)
+            seen.add(owner)
+            prev = owner
+        return out

@@ -155,6 +155,13 @@ def test_params_validated():
         IDA(14, 10, 258)  # p not prime (README.md:55 wrongly says 256)
     with pytest.raises(ValueError):
         IDA(5, 3, 11)  # p < 257 silently corrupts byte payloads (mod-p loss)
+    with pytest.raises(ValueError):
+        IDA(14, 10, 65537)  # (p-1)^2 overflows the int32 kernel path
+
+
+def test_base64_rejects_negative():
+    with pytest.raises(ValueError):
+        serialize_base64([-1], 2)
 
 
 def test_jax_numpy_backends_agree(rng):
