@@ -116,7 +116,7 @@ def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
     n = len(ids_sorted)
     if n == 0:
         raise ValueError("ring needs at least one peer")
-    capacity = capacity or n
+    capacity = n if capacity is None else capacity
     if capacity < n:
         raise ValueError(f"capacity {capacity} < {n} peers")
     s = cfg.num_succs
@@ -147,7 +147,12 @@ def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
 
     fingers = None
     if cfg.finger_mode == "materialized":
-        fingers = _materialize_fingers(ids_arr, n_valid, cfg.num_fingers)
+        # Materialize over the n valid rows only (padding rows are never a
+        # current peer, so their fingers are never read); pad with -1.
+        valid = _materialize_fingers(
+            jnp.asarray(ids_lanes), n_valid, cfg.num_fingers)
+        fingers = jnp.full((capacity, cfg.num_fingers), -1, jnp.int32
+                           ).at[:n].set(valid)
 
     return RingState(
         ids=ids_arr,
@@ -193,7 +198,7 @@ def _succ_list_candidate(state: RingState, cur: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("max_hops",))
 def find_successor(state: RingState, keys: jax.Array,
-                   start: jax.Array, max_hops: int = 64
+                   start: jax.Array, max_hops: Optional[int] = None
                    ) -> Tuple[jax.Array, jax.Array]:
     """Batched GetSuccessor: resolve B keys from B starting peers at once.
 
@@ -206,7 +211,13 @@ def find_successor(state: RingState, keys: jax.Array,
 
     Each while_loop iteration advances EVERY unresolved lane by one hop —
     the device analog of one recursive GET_SUCC RPC per key.
+
+    max_hops defaults to RingConfig's default (callers with a custom
+    RingConfig should pass cfg.max_hops explicitly — RingState carries no
+    config).
     """
+    if max_hops is None:
+        max_hops = DEFAULT_CONFIG.max_hops
     ids, alive, preds = state.ids, state.alive, state.preds
     materialized = state.fingers is not None
 
@@ -284,7 +295,7 @@ def owner_of(state: RingState, keys: jax.Array) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("n", "max_hops"))
 def get_n_successors(state: RingState, keys: jax.Array, start: jax.Array,
-                     n: int, max_hops: int = 64
+                     n: int, max_hops: Optional[int] = None
                      ) -> Tuple[jax.Array, jax.Array]:
     """Batched GetNSuccessors (abstract_chord_peer.cpp:345-373).
 

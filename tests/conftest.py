@@ -7,14 +7,22 @@ bench.py / __graft_entry__.py, not the unit suite.
 
 import os
 
-# Unconditional: the shell exports JAX_PLATFORMS=axon (real TPU) globally,
-# but the unit suite must run on the virtual 8-device CPU mesh.
+# The axon sitecustomize force-registers the TPU backend and overrides
+# jax_platforms at the CONFIG level (env vars alone are ignored), so the
+# suite must override it back the same way — before any backend init.
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_flags = [
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"unit suite needs the virtual 8-device CPU mesh, got {jax.devices()}")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
