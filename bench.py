@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -26,7 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, "tests")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tests"))
 
 from p2p_dhts_tpu.config import RingConfig
 from p2p_dhts_tpu.core.ring import (
@@ -44,23 +46,28 @@ def _rand_ids(rng: np.random.RandomState, n: int) -> list:
     return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
 
 
-def _hop_parity_sample(state, key_ints, starts, hops, sample: int = 32) -> bool:
-    """Spot-check hop counts against the reference-semantics oracle."""
+def _hop_parity_sample(state, key_ints, starts, hops, sample: int = 32) -> str:
+    """Spot-check hop counts against the reference-semantics oracle.
+
+    Returns "ok" / "FAIL", or "skipped (ring too large)" when building the
+    O(N*128) host oracle is impractical — surfaced in the JSON output so
+    the headline never silently implies a parity check that didn't run
+    (large-ring parity is pinned by the unit suite at smaller N).
+    """
     from oracle import OracleRing
 
     sorted_ids = keyspace.lanes_to_ints(
         np.asarray(state.ids[: int(state.n_valid)]))
-    # OracleRing construction is O(N * key_bits); sample only small rings.
     if len(sorted_ids) > 20_000:
-        return True  # parity pinned by the unit suite; skip host-side O(N·128)
+        return "skipped (ring too large for host oracle)"
     oracle = OracleRing(sorted_ids)
     idx = np.linspace(0, len(key_ints) - 1, sample).astype(int)
     for j in idx:
         _, want = oracle.find_successor(sorted_ids[int(starts[j])],
                                         key_ints[j])
         if int(hops[j]) != want:
-            return False
-    return True
+            return "FAIL"
+    return "ok"
 
 
 def _sync(*arrays) -> list:
@@ -103,11 +110,12 @@ def run(n_peers: int, n_keys: int, finger_mode: str, repeats: int = 3) -> dict:
     god = owner_of(state, keys)
     assert bool(jnp.all(owner == god)), "owner mismatch vs omniscient resolution"
     assert bool(np.all(hops_np >= 0)), "unresolved lookups"
-    assert _hop_parity_sample(state, key_ints, starts_np, hops_np), \
-        "hop-count parity violation vs reference semantics"
+    parity = _hop_parity_sample(state, key_ints, starts_np, hops_np)
+    assert parity != "FAIL", "hop-count parity violation vs reference semantics"
 
     lookups_per_sec = n_keys / best
     return {
+        "hop_parity": parity,
         "metric": f"find_successor lookups/sec/chip ({n_peers}-node ring, "
                   f"{finger_mode} fingers, batch {n_keys})",
         "value": round(lookups_per_sec, 1),

@@ -7,3 +7,9 @@ from p2p_dhts_tpu.core.ring import (  # noqa: F401
     get_n_successors,
     owner_of,
 )
+from p2p_dhts_tpu.core.churn import (  # noqa: F401
+    fail,
+    join,
+    leave,
+    stabilize_sweep,
+)

@@ -1,0 +1,323 @@
+"""Churn: join / leave / fail / stabilize-rectify as batched array ops.
+
+The reference repairs the ring with per-peer background threads doing RPC
+rounds every 5 s (StabilizeLoop, chord_peer.cpp:213-240): IsAlive probes,
+Notify handshakes (abstract_chord_peer.cpp:138-190), succ-list pred-walks
+(UpdateSuccList, :507-562), full finger re-derivation
+(PopulateFingerTable, :564-613) and Zave's Rectify broadcast on failure
+(:647-698). Here the same repair is ONE jittable whole-ring sweep over the
+RingState arrays (SURVEY.md §2 maps "maintenance thread per peer" to
+"batched whole-ring stabilize/rectify sweep ops").
+
+Design notes / deliberate deviations (same fixpoint, different cadence):
+  * The sweep computes repair targets from ring-global next/prev-alive
+    scan maps instead of bounded-depth RPC discovery, so any density of
+    simultaneous failures is repaired in one sweep where the reference
+    may need several 5 s cycles (its succ lists are only S deep). The
+    reference's tests only pin the *converged* state (after sleep(20) /
+    sleep(40) — chord_test.cpp:731,795); parity tests here assert the
+    identical fixpoint: sweep^k(churned state) == build_ring(alive ids),
+    including min_key custody boundaries.
+  * fail() is the reference's Fail() (chord_peer.cpp:293-300): the peer
+    vanishes silently; every reference to it goes stale until a sweep.
+  * leave() applies LeaveHandler's immediate effects
+    (abstract_chord_peer.cpp:228-260): the alive successor inherits the
+    leaver's range (NEW_MIN) and predecessor (NEW_PRED); successor-list
+    entries are dropped. Fingers stay stale — faithfully: the reference's
+    LeaveHandler reads request["NEW_SUCC"] which Leave() never sets
+    (the SURVEY §7 quirks catalog), so its finger adjustment is a no-op.
+  * join() inserts a sorted batch of new ids (merge + index remap over the
+    capacity-padded table), gives each new peer its converged pred /
+    succ-list / fingers (what Join + PopulateFingerTable(true) produce,
+    abstract_chord_peer.cpp:83-117), and applies the Notify custody
+    transfer to each new peer's successor (HandleNotifyFromPred,
+    chord_peer.cpp:256-280: pred, min_key, AdjustFingers). Other peers'
+    fingers stay stale until a sweep — the reference's FixOtherFingers
+    also only patches O(log N) peers immediately.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.core.ring import (
+    RingState,
+    fingers_for_ids,
+    live_mask,
+    next_alive_map,
+    prev_alive_map,
+)
+from p2p_dhts_tpu.ops import u128
+
+
+def _alive_succ_of_row(na: jax.Array, rows: jax.Array, n: int) -> jax.Array:
+    """Alive ring successor row of a peer row (strictly after it)."""
+    return na[jnp.minimum(rows + 1, n)]
+
+
+def _alive_pred_of_row(pa: jax.Array, rows: jax.Array, n: int) -> jax.Array:
+    """Alive ring predecessor row of a peer row (strictly before it)."""
+    return jnp.where(rows > 0, pa[jnp.maximum(rows - 1, 0)], pa[n - 1])
+
+
+def _succ_chain(na: jax.Array, rows: jax.Array, s: int, n: int) -> jax.Array:
+    """[R, S] successor lists: chain the next-alive map S times from each
+    row, masking wrap-to-self and duplicate entries with -1 (Insert dedups
+    by id, remote_peer_list.cpp:56-58). Single implementation shared by
+    stabilize_sweep and join."""
+    cols = []
+    cur = rows
+    for _ in range(s):
+        cur = na[jnp.minimum(cur + 1, n)]
+        cols.append(cur)
+    out = jnp.stack(cols, axis=1)
+    out = jnp.where(out == rows[:, None], -1, out)
+    for j in range(1, s):
+        dup = (out[:, j:j + 1] == out[:, :j]).any(axis=1)
+        out = out.at[:, j].set(jnp.where(dup, -1, out[:, j]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fail / leave
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def fail(state: RingState, rows: jax.Array) -> RingState:
+    """Silent failure of a batch of peers (ref Fail(),
+    chord_peer.cpp:293-300): only the alive bit changes; every stale
+    reference stays until stabilize_sweep repairs it."""
+    return state._replace(alive=state.alive.at[rows].set(False))
+
+
+@jax.jit
+def leave(state: RingState, rows: jax.Array) -> RingState:
+    """Graceful leave of a batch of peers (ref Leave/LeaveHandler,
+    abstract_chord_peer.cpp:192-260).
+
+    Immediate effects on each leaver's alive successor: inherit the
+    leaver's min_key (NEW_MIN — for a chain of simultaneous leavers, the
+    lowest min_key of the chain) and predecessor (NEW_PRED -> the closest
+    alive predecessor). Successor-list entries naming leavers are cleared
+    (RemotePeerList::Delete). Fingers: untouched (the reference's
+    LeaveHandler finger adjustment is a no-op quirk, see module doc).
+    """
+    state = state._replace(alive=state.alive.at[rows].set(False))
+    n = state.ids.shape[0]
+    na = next_alive_map(state)
+    pa = prev_alive_map(state)
+
+    # Successor of each leaver among survivors; its new custody/pred.
+    succ_rows = _alive_succ_of_row(na, rows, n)
+    pred_rows = _alive_pred_of_row(pa, rows, n)
+    # For leaver chains, several leavers share one alive successor; the
+    # correct inherited min_key is (alive pred id + 1), which equals the
+    # chain-lowest NEW_MIN. Scatter both (duplicate scatters agree).
+    new_min = u128.add_scalar(state.ids[pred_rows], 1)
+    min_key = state.min_key.at[succ_rows].set(new_min)
+    preds = state.preds.at[succ_rows].set(pred_rows)
+
+    # RemotePeerList::Delete of every leaver from every succ list.
+    leaving = jnp.zeros((n,), dtype=bool).at[rows].set(True)
+    succs = jnp.where(leaving[jnp.maximum(state.succs, 0)]
+                      & (state.succs >= 0), -1, state.succs)
+    return state._replace(min_key=min_key, preds=preds, succs=succs)
+
+
+# ---------------------------------------------------------------------------
+# stabilize / rectify sweep
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("refresh_fingers",))
+def stabilize_sweep(state: RingState,
+                    refresh_fingers: bool = True) -> RingState:
+    """One whole-ring maintenance round: the batched analog of every peer
+    concurrently running Stabilize + UpdateSuccList +
+    PopulateFingerTable(false) + Rectify (abstract_chord_peer.cpp:460-698).
+
+    Repairs, for every live peer p:
+      * preds[p]   <- alive ring predecessor (notify fixpoint)
+      * min_key[p] <- pred id + 1 where the pred changed or was dead
+        (HandleNotifyFromPred custody, chord_peer.cpp:256-280; dead-range
+        absorption after Rectify)
+      * succs[p]   <- the S closest alive peers clockwise (UpdateSuccList
+        pred-walk fixpoint)
+      * fingers    <- alive ring successor of id + 2^i for every entry
+        (PopulateFingerTable(false) + ReplaceDeadPeer fixpoint), when
+        refresh_fingers and the state materializes fingers.
+
+    Idempotent: sweep(sweep(s)) == sweep(s); on a fully-converged ring it
+    is the identity (tests pin both).
+    """
+    n = state.ids.shape[0]
+    live = live_mask(state)
+    na = next_alive_map(state)
+    pa = prev_alive_map(state)
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    new_pred = _alive_pred_of_row(pa, rows, n)
+    pred_changed = new_pred != state.preds
+    preds = jnp.where(live, new_pred, state.preds)
+
+    # Custody follows the pred boundary (min_key = pred.id + 1); only
+    # peers whose pred link was repaired move their boundary — matching
+    # HandleNotifyFromPred. (A lone survivor gets pred = itself, so
+    # min_key = id + 1 = full custody, exactly StartChord's invariant.)
+    pred_ids = state.ids[jnp.maximum(new_pred, 0)]
+    new_min = u128.add_scalar(pred_ids, 1)
+    upd_min = live & pred_changed & (new_pred >= 0)
+    min_key = jnp.where(upd_min[:, None], new_min, state.min_key)
+
+    # Successor list: the S closest alive peers clockwise.
+    succs = _succ_chain(na, rows, state.succs.shape[1], n)
+    succs = jnp.where(live[:, None], succs, state.succs)
+
+    fingers = state.fingers
+    if refresh_fingers and state.fingers is not None:
+        fresh = fingers_for_ids(state.ids, state.n_valid, state.ids,
+                                state.fingers.shape[1], na=na)
+        fingers = jnp.where(live[:, None], fresh, state.fingers)
+
+    return state._replace(preds=preds, min_key=min_key, succs=succs,
+                          fingers=fingers)
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def join(state: RingState, new_ids: jax.Array
+         ) -> Tuple[RingState, jax.Array]:
+    """Batched join of K new peers (ref Join + JoinHandler + Notify,
+    abstract_chord_peer.cpp:83-190).
+
+    new_ids: [K, 4] u32, assumed distinct from existing ids and from each
+    other. Requires n_valid + K <= capacity.
+
+    Returns (new state, rows of the joined peers). Each new peer receives
+    its converged pred / min_key / succ list / fingers (the outcome of
+    Join's PopulateFingerTable(true)); its alive successor applies the
+    HandleNotifyFromPred custody handover (pred <- new peer, min_key <-
+    new id + 1, AdjustFingers). Remaining peers' fingers stay stale until
+    stabilize_sweep — as in the reference between maintenance cycles.
+    """
+    n = state.ids.shape[0]
+    k = new_ids.shape[0]
+
+    # Sort the incoming batch (lexicographic over lanes, msb first).
+    sort_ops = [new_ids[:, 3], new_ids[:, 2], new_ids[:, 1], new_ids[:, 0],
+                jnp.arange(k, dtype=jnp.int32)]
+    *_, perm = jax.lax.sort(sort_ops, num_keys=4)
+    new_sorted = new_ids[perm]
+
+    # Merge positions: old row r moves to r + (# new ids < id_r); new id j
+    # lands at searchsorted(old, new_j) + j. Rows >= n_valid (padding) are
+    # routed to index n, which is out of bounds and DROPPED by the
+    # mode="drop" scatters below (never clamped).
+    shift = u128.searchsorted(new_sorted, state.ids)          # [N] in [0, K]
+    valid_row = jnp.arange(n, dtype=jnp.int32) < state.n_valid
+    old_dest = jnp.where(valid_row,
+                         jnp.arange(n, dtype=jnp.int32) + shift, n)
+    new_dest = u128.searchsorted(state.ids, new_sorted, state.n_valid) \
+        + jnp.arange(k, dtype=jnp.int32)
+
+    remap = jnp.full((n + 1,), -1, jnp.int32)  # old row -> new row
+    remap = remap.at[jnp.arange(n)].set(old_dest, mode="drop")
+
+    def remap_idx(a):
+        return jnp.where(a >= 0, remap[jnp.clip(a, 0, n)], a)
+
+    ids = jnp.full_like(state.ids, 0xFFFFFFFF)
+    ids = ids.at[old_dest].set(state.ids, mode="drop")
+    ids = ids.at[new_dest].set(new_sorted)
+
+    alive = jnp.zeros_like(state.alive)
+    alive = alive.at[old_dest].set(state.alive, mode="drop")
+    alive = alive.at[new_dest].set(True)
+
+    min_key = jnp.zeros_like(state.min_key)
+    min_key = min_key.at[old_dest].set(state.min_key, mode="drop")
+
+    preds = jnp.full_like(state.preds, -1)
+    preds = preds.at[old_dest].set(remap_idx(state.preds), mode="drop")
+
+    succs = jnp.full_like(state.succs, -1)
+    succs = succs.at[old_dest].set(remap_idx(state.succs), mode="drop")
+
+    fingers = state.fingers
+    if fingers is not None:
+        fingers = jnp.full_like(state.fingers, -1)
+        fingers = fingers.at[old_dest].set(remap_idx(state.fingers),
+                                           mode="drop")
+
+    mid = state._replace(ids=ids, alive=alive, n_valid=state.n_valid + k,
+                         min_key=min_key, preds=preds, succs=succs,
+                         fingers=fingers)
+
+    # -- converged state for the new peers + notify handover ---------------
+    na = next_alive_map(mid)
+    pa = prev_alive_map(mid)
+    rows = new_dest
+
+    new_pred = _alive_pred_of_row(pa, rows, n)
+    preds = mid.preds.at[rows].set(new_pred)
+    new_min = u128.add_scalar(mid.ids[new_pred], 1)
+    min_key = mid.min_key.at[rows].set(new_min)
+
+    succs = mid.succs.at[rows].set(
+        _succ_chain(na, rows, mid.succs.shape[1], n))
+
+    # Notify the successor: custody handover (HandleNotifyFromPred).
+    succ_rows = _alive_succ_of_row(na, rows, n)
+    preds = preds.at[succ_rows].set(rows)
+    min_key = min_key.at[succ_rows].set(u128.add_scalar(mid.ids[rows], 1))
+
+    fingers = mid.fingers
+    if fingers is not None:
+        f = fingers.shape[1]
+        # New peers: converged fingers (PopulateFingerTable(true)).
+        fingers = fingers.at[rows].set(
+            fingers_for_ids(mid.ids, mid.n_valid, mid.ids[rows], f, na=na))
+        # Notified successors: AdjustFingers — entries whose range start
+        # lands in [new_min, new_id] now point at the new peer.
+        fs = jnp.arange(f, dtype=jnp.int32)
+        starts = u128.add(mid.ids[succ_rows][:, None, :],
+                          u128.pow2(fs)[None, :, :])          # [K, F, 4]
+        hit = u128.in_between(starts, new_min[:, None, :],
+                              mid.ids[rows][:, None, :], True)
+        cur_entries = fingers[succ_rows]
+        fingers = fingers.at[succ_rows].set(
+            jnp.where(hit, rows[:, None], cur_entries))
+
+        # FixOtherFingers (abstract_chord_peer.cpp:615-645): the peers
+        # whose finger ranges cover the new ranges are the ring
+        # predecessors of new_id - 2^(i-1) for i = 1..F. The reference
+        # sends each a Notify whose handler runs AdjustFingers; here those
+        # rows get a full finger refresh against the merged table — a
+        # superset of AdjustFingers (also clears unrelated stale entries),
+        # same fixpoint. Without this, keys in a fresh peer's range are
+        # unroutable from distant starts until a sweep — in the reference
+        # such lookups would recurse between two stale peers and time out.
+        targets = u128.sub(mid.ids[rows][:, None, :],
+                           u128.pow2(fs)[None, :, :])         # [K, F, 4]
+        jt = u128.searchsorted(mid.ids, targets.reshape(-1, u128.LANES),
+                               mid.n_valid)
+        notified = jnp.where(jt > 0, pa[jnp.maximum(jt - 1, 0)], pa[n - 1])
+        notified = jnp.unique(notified, size=notified.shape[0],
+                              fill_value=-1)
+        # -1 fills route to index n, which mode="drop" discards (negative
+        # scatter indices would wrap numpy-style).
+        notified = jnp.where(notified >= 0, notified, n)
+        safe_rows = jnp.minimum(notified, n - 1)
+        fresh_n = fingers_for_ids(mid.ids, mid.n_valid, mid.ids[safe_rows],
+                                  f, na=na)
+        fingers = fingers.at[notified].set(fresh_n, mode="drop")
+
+    out = mid._replace(preds=preds, min_key=min_key, succs=succs,
+                       fingers=fingers)
+    return out, rows

@@ -78,25 +78,39 @@ def _pad_ids(ids_lanes: np.ndarray, capacity: int) -> np.ndarray:
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("num_fingers", "chunk"))
-def _materialize_fingers(ids: jax.Array, n_valid: jax.Array,
-                         num_fingers: int, chunk: int = 16) -> jax.Array:
-    """fingers[p, i] = row index of ring-successor(id_p + 2^i) — [N, F] i32.
+def fingers_for_ids(table_ids: jax.Array, n_valid: jax.Array,
+                    peer_ids: jax.Array, num_fingers: int,
+                    na: Optional[jax.Array] = None,
+                    chunk: int = 16) -> jax.Array:
+    """Converged finger targets for a set of peers — [R, F] i32 rows.
 
-    The converged-state content of every peer's finger table (what
-    PopulateFingerTable converges to, abstract_chord_peer.cpp:564-613),
-    computed as F binary searches over the sorted table instead of N*F
-    sequential GET_SUCC RPCs.
+    fingers[p, i] = row of the ring successor of peer_ids[p] + 2^i in the
+    sorted table: what PopulateFingerTable converges to
+    (abstract_chord_peer.cpp:564-613), computed as F chunked binary
+    searches instead of N*F sequential GET_SUCC RPCs. With `na` (a
+    next_alive_map), dead rows are skipped — the post-repair
+    (ReplaceDeadPeer/Rectify) target. This is THE single implementation;
+    build, stabilize sweep, and join all call it.
     """
-    n = ids.shape[0]
+    r = peer_ids.shape[0]
     cols = []
     for f0 in range(0, num_fingers, chunk):
         fs = jnp.arange(f0, min(f0 + chunk, num_fingers), dtype=jnp.int32)
-        starts = u128.add(ids[:, None, :], u128.pow2(fs)[None, :, :])
-        idx = u128.ring_successor(
-            ids, starts.reshape(-1, LANES), n_valid).reshape(n, -1)
-        cols.append(idx)
+        starts = u128.add(peer_ids[:, None, :], u128.pow2(fs)[None, :, :])
+        j = u128.searchsorted(table_ids, starts.reshape(-1, LANES), n_valid)
+        if na is None:
+            idx = jnp.where(j >= n_valid, 0, j)  # plain ring wrap
+        else:
+            idx = na[j]
+        cols.append(idx.reshape(r, -1))
     return jnp.concatenate(cols, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_fingers", "chunk"))
+def _materialize_fingers(ids: jax.Array, n_valid: jax.Array,
+                         num_fingers: int, chunk: int = 16) -> jax.Array:
+    """Build-time all-alive finger materialization — [N, F] i32."""
+    return fingers_for_ids(ids, n_valid, ids, num_fingers, chunk=chunk)
 
 
 def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
@@ -175,6 +189,48 @@ def build_ring_from_seeds(seeds: Sequence[Tuple[str, int]],
 
 
 # ---------------------------------------------------------------------------
+# alive-neighbor scan maps (shared with churn ops)
+# ---------------------------------------------------------------------------
+
+_BIG = jnp.int32(2**31 - 1)
+
+
+def live_mask(state: RingState) -> jax.Array:
+    n = state.ids.shape[0]
+    return state.alive & (jnp.arange(n, dtype=jnp.int32) < state.n_valid)
+
+
+def next_alive_map(state: RingState) -> jax.Array:
+    """na[j] = smallest alive row >= j, wrapping past the end — [N+1] i32.
+
+    na[searchsorted(q)] is the alive ring successor of key q: the batched
+    analog of succ-list head skipping (Stabilize,
+    abstract_chord_peer.cpp:475-480) + LookupLiving. -1 everywhere if no
+    peer is alive.
+    """
+    live = live_mask(state)
+    n = state.ids.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    pos = jnp.where(live, rows, _BIG)
+    suffix_min = jnp.flip(jax.lax.cummin(jnp.flip(pos)))
+    first = suffix_min[0]  # global min (or _BIG if none alive)
+    ext = jnp.concatenate([suffix_min, jnp.full((1,), _BIG, jnp.int32)])
+    wrapped = jnp.where(ext == _BIG, first, ext)
+    return jnp.where(wrapped == _BIG, -1, wrapped)
+
+
+def prev_alive_map(state: RingState) -> jax.Array:
+    """pa[j] = largest alive row <= j, wrapping below 0 — [N] i32."""
+    live = live_mask(state)
+    n = state.ids.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    pos = jnp.where(live, rows, jnp.int32(-1))
+    prefix_max = jax.lax.cummax(pos)
+    last = prefix_max[-1]
+    return jnp.where(prefix_max < 0, last, prefix_max)
+
+
+# ---------------------------------------------------------------------------
 # lookup kernel
 # ---------------------------------------------------------------------------
 
@@ -220,6 +276,12 @@ def find_successor(state: RingState, keys: jax.Array,
         max_hops = DEFAULT_CONFIG.max_hops
     ids, alive, preds = state.ids, state.alive, state.preds
     materialized = state.fingers is not None
+    if not materialized:
+        # Computed fingers are always-converged: the target of finger i is
+        # the alive ring successor of id + 2^i (what a materialized table
+        # holds after a stabilize sweep). Without the alive mask, dead
+        # rows would act as permanently-stale entries no sweep can repair.
+        na = next_alive_map(state)
 
     def cond(carry):
         _, _, done, _, it = carry
@@ -239,7 +301,7 @@ def find_successor(state: RingState, keys: jax.Array,
             nxt = state.fingers[cur_s, fi]
         else:
             starts = u128.add(cur_ids, u128.pow2(fi))
-            nxt = u128.ring_successor(ids, starts, state.n_valid)
+            nxt = na[u128.searchsorted(ids, starts, state.n_valid)]
         nxt = jnp.maximum(nxt, 0)
 
         # Self-hit -> predecessor when alive (chord_peer.cpp:194-196).

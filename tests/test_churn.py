@@ -1,0 +1,219 @@
+"""Churn op tests: deterministic convergence instead of the reference's
+sleep(20)-style wall-clock waits (SURVEY.md §4 implications).
+
+Strategy: apply fail/leave/join, run stabilize_sweep k times, and assert
+the state is *identical* (canonical per-peer form) to a freshly built
+converged ring over the surviving id set — the same fixpoint the
+reference's integration tests await (ChordIntegration.{Stabilize,
+NodeFailure,GracefulLeave}, chord_test.cpp:645-818).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu import keyspace
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core import churn
+from p2p_dhts_tpu.core.ring import (
+    build_ring,
+    find_successor,
+    keys_from_ints,
+)
+
+from oracle import OracleRing
+
+
+def _random_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+def canonical(state):
+    """{peer id: (min_key, pred id, succ ids, finger target ids)} over the
+    live peers — row-layout independent."""
+    n_valid = int(state.n_valid)
+    ids = keyspace.lanes_to_ints(np.asarray(state.ids[:n_valid]))
+    mins = keyspace.lanes_to_ints(np.asarray(state.min_key[:n_valid]))
+    alive = np.asarray(state.alive[:n_valid])
+    preds = np.asarray(state.preds[:n_valid])
+    succs = np.asarray(state.succs[:n_valid])
+    fingers = (np.asarray(state.fingers[:n_valid])
+               if state.fingers is not None else None)
+
+    def row_id(r):
+        return ids[r] if r >= 0 else None
+
+    out = {}
+    for i in range(n_valid):
+        if not alive[i]:
+            continue
+        f = tuple(row_id(r) for r in fingers[i]) if fingers is not None else None
+        out[ids[i]] = (
+            mins[i],
+            row_id(preds[i]),
+            tuple(row_id(r) for r in succs[i] if r >= 0),
+            f,
+        )
+    return out
+
+
+@pytest.mark.parametrize("mode", ["materialized", "computed"])
+def test_sweep_is_identity_on_converged_ring(rng, mode):
+    ids = _random_ids(rng, 24)
+    cfg = RingConfig(num_succs=3, finger_mode=mode)
+    state = build_ring(ids, cfg)
+    swept = churn.stabilize_sweep(state)
+    assert canonical(swept) == canonical(state)
+
+
+@pytest.mark.parametrize("n_fail", [1, 3])
+def test_fail_then_sweep_converges(rng, n_fail):
+    ids = _random_ids(rng, 20)
+    state = build_ring(ids, RingConfig(num_succs=3))
+    victims = jnp.asarray(sorted(rng.choice(20, size=n_fail, replace=False)),
+                          jnp.int32)
+    sorted_ids = sorted(ids)
+    survivor_ids = [sorted_ids[i] for i in range(20)
+                    if i not in set(np.asarray(victims).tolist())]
+
+    state = churn.fail(state, victims)
+    swept = churn.stabilize_sweep(state)
+    want = build_ring(survivor_ids, RingConfig(num_succs=3))
+    assert canonical(swept) == canonical(want)
+    # Idempotent.
+    assert canonical(churn.stabilize_sweep(swept)) == canonical(want)
+
+
+def test_fail_chain_deeper_than_succ_list(rng):
+    """4 consecutive failures with S=3: the reference needs multiple 5 s
+    cycles; the batched sweep repairs in one (documented deviation — same
+    fixpoint)."""
+    ids = _random_ids(rng, 16)
+    state = build_ring(ids, RingConfig(num_succs=3))
+    victims = jnp.asarray([4, 5, 6, 7], jnp.int32)
+    sorted_ids = sorted(ids)
+    survivors = [sorted_ids[i] for i in range(16) if i not in (4, 5, 6, 7)]
+    swept = churn.stabilize_sweep(churn.fail(state, victims))
+    assert canonical(swept) == canonical(build_ring(survivors,
+                                                    RingConfig(num_succs=3)))
+
+
+def test_custody_absorbed_after_failure(rng):
+    """The failed peer's range [min_key, id] transfers to its alive
+    successor (rectify + notify custody semantics)."""
+    ids = _random_ids(rng, 10)
+    sorted_ids = sorted(ids)
+    state = build_ring(ids, RingConfig(num_succs=3))
+    state = churn.fail(state, jnp.asarray([4], jnp.int32))
+    swept = churn.stabilize_sweep(state)
+    canon = canonical(swept)
+    # Successor row 5 must now own (sorted_ids[3], sorted_ids[5]].
+    min_key_5 = canon[sorted_ids[5]][0]
+    assert min_key_5 == (sorted_ids[3] + 1) % keyspace.KEYS_IN_RING
+
+
+def test_leave_transfers_custody_immediately(rng):
+    ids = _random_ids(rng, 12)
+    sorted_ids = sorted(ids)
+    state = build_ring(ids, RingConfig(num_succs=3))
+    state = churn.leave(state, jnp.asarray([7], jnp.int32))
+    canon = canonical(state)
+    assert sorted_ids[7] not in canon
+    # NEW_MIN handover happens in leave() itself, pre-sweep.
+    assert canon[sorted_ids[8]][0] == (sorted_ids[6] + 1) % keyspace.KEYS_IN_RING
+    assert canon[sorted_ids[8]][1] == sorted_ids[6]  # NEW_PRED
+    # After a sweep: full convergence to the survivor ring.
+    survivors = [sorted_ids[i] for i in range(12) if i != 7]
+    swept = churn.stabilize_sweep(state)
+    assert canonical(swept) == canonical(build_ring(survivors,
+                                                    RingConfig(num_succs=3)))
+
+
+def test_leave_chain(rng):
+    """Adjacent simultaneous leavers: the shared alive successor inherits
+    the chain's lowest min_key."""
+    ids = _random_ids(rng, 12)
+    sorted_ids = sorted(ids)
+    state = build_ring(ids, RingConfig(num_succs=3))
+    state = churn.leave(state, jnp.asarray([3, 4], jnp.int32))
+    canon = canonical(state)
+    assert canon[sorted_ids[5]][0] == (sorted_ids[2] + 1) % keyspace.KEYS_IN_RING
+
+
+@pytest.mark.parametrize("k_new", [1, 4])
+def test_join_then_sweep_converges(rng, k_new, ):
+    old_ids = _random_ids(rng, 12)
+    new_ids = _random_ids(rng, k_new)
+    state = build_ring(old_ids, RingConfig(num_succs=3), capacity=32)
+    state, new_rows = churn.join(
+        state, jnp.asarray(keyspace.ints_to_lanes(new_ids)))
+    assert int(state.n_valid) == 12 + k_new
+
+    # The joined peers' own state is converged IMMEDIATELY (Join +
+    # PopulateFingerTable(true)) — check before any sweep.
+    canon = canonical(state)
+    want = build_ring(old_ids + new_ids, RingConfig(num_succs=3), capacity=32)
+    want_canon = canonical(want)
+    for nid in new_ids:
+        assert canon[nid] == want_canon[nid], "joined peer not converged"
+    # Each new peer's successor applied the custody handover.
+    all_sorted = sorted(old_ids + new_ids)
+    for nid in new_ids:
+        succ = all_sorted[(all_sorted.index(nid) + 1) % len(all_sorted)]
+        assert canon[succ][0] == (nid + 1) % keyspace.KEYS_IN_RING
+        assert canon[succ][1] == nid
+
+    # One sweep converges everyone.
+    swept = churn.stabilize_sweep(state)
+    assert canonical(swept) == want_canon
+
+
+def test_routing_correct_after_unswept_join(rng):
+    """Keys in a freshly joined peer's range must resolve to it even
+    before any stabilize sweep (stale distant fingers route to the old
+    owner, whose adjusted state forwards correctly) — mirrors the
+    reference where lookups work between maintenance cycles."""
+    old_ids = _random_ids(rng, 16)
+    new_id = _random_ids(rng, 1)[0]
+    state = build_ring(old_ids, RingConfig(num_succs=3), capacity=24)
+    state, _ = churn.join(state, jnp.asarray(keyspace.ints_to_lanes([new_id])))
+
+    oracle = OracleRing(old_ids + [new_id], num_succs=3)
+    all_sorted = sorted(old_ids + [new_id])
+    # Query keys across the whole ring, all starts.
+    key_ints = _random_ids(rng, 40) + [new_id, (new_id - 1) % (1 << 128)]
+    starts = rng.randint(0, 17, size=len(key_ints)).astype(np.int32)
+    owner, hops = find_successor(
+        state, keys_from_ints(key_ints), jnp.asarray(starts), max_hops=128)
+    ids_now = keyspace.lanes_to_ints(np.asarray(state.ids[:17]))
+    for j, k in enumerate(key_ints):
+        want = oracle._ring_successor(k)
+        got = ids_now[int(owner[j])] if int(owner[j]) >= 0 else -1
+        assert got == want, f"lane {j}: got {got:#x} want {want:#x}"
+        assert int(hops[j]) >= 0
+
+
+def test_join_after_fail_reuses_ring(rng):
+    """Mixed churn: fail two, join three, sweep, compare to fresh build."""
+    ids = _random_ids(rng, 16)
+    sorted_ids = sorted(ids)
+    new_ids = _random_ids(rng, 3)
+    state = build_ring(ids, RingConfig(num_succs=3), capacity=32)
+    state = churn.fail(state, jnp.asarray([2, 9], jnp.int32))
+    state, _ = churn.join(state, jnp.asarray(keyspace.ints_to_lanes(new_ids)))
+    swept = churn.stabilize_sweep(state)
+    survivors = [sorted_ids[i] for i in range(16) if i not in (2, 9)]
+    want = build_ring(survivors + new_ids, RingConfig(num_succs=3))
+    assert canonical(swept) == canonical(want)
+
+
+def test_sweep_computed_mode_no_fingers(rng):
+    ids = _random_ids(rng, 12)
+    cfg = RingConfig(num_succs=3, finger_mode="computed")
+    state = build_ring(ids, cfg)
+    state = churn.fail(state, jnp.asarray([3], jnp.int32))
+    swept = churn.stabilize_sweep(state)
+    sorted_ids = sorted(ids)
+    survivors = [sorted_ids[i] for i in range(12) if i != 3]
+    assert canonical(swept) == canonical(build_ring(survivors, cfg))
