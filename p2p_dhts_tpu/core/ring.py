@@ -24,11 +24,12 @@ semantics (pinned by tests/oracle.py + tests/test_ring.py):
 Two finger modes (RingConfig.finger_mode):
   * "materialized": fingers live as an [N, 128] int32 peer-index matrix
     (the direct analog of the reference's tables; 512 B/peer).
-  * "computed": fingers are derived per hop as ring_successor(id + 2^i)
-    by binary search over the sorted id table — no [N,128] matrix, the
-    memory-free path to 10M+ simulated peers. Computed mode assumes an
-    all-alive converged table (it has no stale entries to repair, so the
-    dead-finger fallback path is unreachable by construction).
+  * "computed": fingers are derived per hop as the next-ALIVE ring
+    successor of id + 2^i by binary search + alive-scan map — no [N,128]
+    matrix, the memory-free path to 10M+ simulated peers. Computed
+    fingers are always-converged (what a materialized table holds after a
+    stabilize sweep), so the dead-finger fallback path is unreachable by
+    construction and churn needs no finger repair.
 """
 
 from __future__ import annotations

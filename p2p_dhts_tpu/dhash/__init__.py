@@ -1,0 +1,24 @@
+"""DHash: erasure-coded replicated storage over the Chord ring.
+
+Capability twin of the reference's L6 (src/dhash/dhash_peer.{h,cpp}):
+values are IDA-encoded into n fragments striped across the key's n
+successors; any m fragments reconstruct; maintenance re-places fragments
+after churn and repairs missing replicas.
+"""
+
+from p2p_dhts_tpu.dhash.store import (  # noqa: F401
+    FragmentStore,
+    create_batch,
+    empty_store,
+    read_batch,
+)
+from p2p_dhts_tpu.dhash.maintenance import (  # noqa: F401
+    global_maintenance,
+    local_maintenance,
+    presence_matrix,
+)
+from p2p_dhts_tpu.dhash.merkle import (  # noqa: F401
+    MerkleIndex,
+    build_index,
+    diff_indices,
+)

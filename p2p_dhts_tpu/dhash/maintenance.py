@@ -1,0 +1,170 @@
+"""DHash maintenance: batched global re-placement + local replica repair.
+
+The reference runs these per peer every 5 s (MaintenanceLoop,
+dhash_peer.cpp:271-296):
+  * RunGlobalMaintenance (dhash_peer.cpp:298-348): walk own DB ring-wise;
+    keys this peer no longer owns are pushed to their true successors and
+    deleted locally.
+  * RunLocalMaintenance (dhash_peer.cpp:350-365): Merkle-sync own range
+    against each successor; a successor missing a key reads the whole
+    block and stores one fragment (RetrieveMissing, dhash_peer.cpp:367-379).
+
+Here both are single batched ops over the global fragment table:
+  * global_maintenance: every fragment row's holder is reset to the
+    frag_idx-th successor of its key — one get_n_successors batch + one
+    masked update. (Deviation, documented: the reference only checks
+    holder MEMBERSHIP in the successor set and RetrieveMissing stores a
+    random fragment index, so a holder can keep a fragment whose index
+    differs from its position; this op converges to the canonical
+    positional placement instead. Reads never assume positional
+    alignment, so both layouts serve the same reads.)
+  * local_maintenance: per stored block, regenerate missing fragment
+    indices from >= m surviving ones (decode + re-encode, the exact
+    regeneration path of DataBlock(fragments), data_block.cpp:30-54) and
+    append them on their designated holders.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.core.ring import RingState, get_n_successors
+from p2p_dhts_tpu.dhash.store import FragmentStore, _key_window, _sort_store
+from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+from p2p_dhts_tpu.ops import u128
+
+
+@functools.partial(jax.jit, static_argnames=("n", "max_hops"))
+def global_maintenance(ring: RingState, store: FragmentStore,
+                       start: jax.Array, n: int = 14,
+                       max_hops: int = 64) -> FragmentStore:
+    """Re-place every fragment on the frag_idx-th successor of its key.
+
+    start: [C] i32 originating peer rows for the placement lookups (the
+    reference uses each holding peer itself; pass store.holder clamped,
+    or any alive rows).
+    """
+    owners, _ = get_n_successors(ring, store.keys, start, n, max_hops)
+    target = jnp.take_along_axis(
+        owners, jnp.clip(store.frag_idx - 1, 0, n - 1)[:, None], axis=1)[:, 0]
+    # Only fragments on ALIVE holders can be pushed — a dead peer's store
+    # is gone with its process; re-placing its rows would resurrect lost
+    # data. Dead-held rows stay for local_maintenance to purge+regenerate.
+    holder_alive = ring.alive[jnp.maximum(store.holder, 0)] \
+        & (store.holder >= 0)
+    new_holder = jnp.where(store.used & holder_alive & (target >= 0),
+                           target, store.holder)
+    return store._replace(holder=new_holder)
+
+
+def _block_leaders(store: FragmentStore) -> jax.Array:
+    """[C] bool: first row of each distinct key in the sorted store."""
+    c = store.capacity
+    prev_same = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        u128.eq(store.keys[1:], store.keys[:-1]),
+    ])
+    rows = jnp.arange(c, dtype=jnp.int32)
+    return store.used & (rows < store.n_used) & ~prev_same
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p", "max_hops"))
+def local_maintenance(ring: RingState, store: FragmentStore,
+                      start: jax.Array, n: int = 14, m: int = 10,
+                      p: int = 257, max_hops: int = 64
+                      ) -> Tuple[FragmentStore, jax.Array]:
+    """Regenerate missing fragments of every block with >= m survivors.
+
+    For each block (distinct key, found via sorted-store leaders): collect
+    its present fragment indices on alive holders; for each absent index i
+    whose designated holder (the i-th successor) is alive, decode the
+    block from m survivors, re-encode, and append fragment i there.
+
+    Returns (store, repaired_count). Blocks with fewer than m reachable
+    fragments are data loss (the reference's Read would throw) and are
+    left untouched.
+
+    Rows held by dead peers are PURGED first (the reference's failed
+    process takes its FragmentDb with it) — without the purge, a
+    regenerated fragment would coexist with the stale dead-held row of
+    the same (key, index), breaking the n-row-per-key window invariant.
+    """
+    dead_held = store.used & ~(ring.alive[jnp.maximum(store.holder, 0)]
+                               & (store.holder >= 0))
+    store = _sort_store(store._replace(used=store.used & ~dead_held))
+
+    c = store.capacity
+    smax = store.max_segments
+    leaders = _block_leaders(store)
+    lead_rows = jnp.arange(c, dtype=jnp.int32)
+
+    # Window of up to n rows per leader (shared scan, dedup included).
+    win_c, w_valid, w_fidx = _key_window(store, ring, lead_rows,
+                                         store.keys, n)
+    w_valid = w_valid & leaders[:, None]
+
+    # Presence per fragment index 1..n.
+    idx_grid = jnp.arange(1, n + 1, dtype=jnp.int32)
+    present = ((w_fidx[:, :, None] == idx_grid[None, None, :])
+               & w_valid[:, :, None]).any(axis=1)                   # [C, n]
+    n_present = present.sum(axis=1)
+    can_repair = leaders & (n_present >= m) & (n_present < n)
+
+    # Decode from the first m valid fragments.
+    order = jnp.argsort(~w_valid, axis=1, stable=True)[:, :m]
+    sel = jnp.take_along_axis(win_c, order, axis=1)
+    rows_v = store.values[sel]                                      # [C, m, S]
+    idx_v = jnp.where(jnp.take_along_axis(w_valid, order, axis=1),
+                      store.frag_idx[sel], 0)
+    idx_safe = jnp.where(can_repair[:, None], idx_v,
+                         jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
+    segments = decode_kernel(rows_v, idx_safe, p)                   # [C, S, m]
+    all_frags = encode_kernel(segments, n, m, p)                    # [C, n, S]
+
+    # Designated holders for every index.
+    owners, _ = get_n_successors(ring, store.keys, start, n, max_hops)
+    holder_alive = ring.alive[jnp.maximum(owners, 0)] & (owners >= 0)
+    need = can_repair[:, None] & ~present & holder_alive            # [C, n]
+
+    # Append the needed rows.
+    flat_need = need.reshape(-1)
+    dest = store.n_used + jnp.cumsum(flat_need.astype(jnp.int32)) - 1
+    dest = jnp.where(flat_need & (dest < c), dest, c)
+    stored = flat_need & (dest < c)
+
+    rep_keys = jnp.broadcast_to(store.keys[:, None, :], (c, n, 4)).reshape(-1, 4)
+    rep_fidx = jnp.broadcast_to(idx_grid[None, :], (c, n)).reshape(-1)
+    rep_holder = owners.reshape(-1)
+    rep_vals = jnp.pad(all_frags,
+                       ((0, 0), (0, 0), (0, smax - all_frags.shape[2]))
+                       ).reshape(c * n, smax)
+    rep_len = jnp.broadcast_to(store.length[:, None], (c, n)).reshape(-1)
+
+    out = FragmentStore(
+        keys=store.keys.at[dest].set(rep_keys, mode="drop"),
+        frag_idx=store.frag_idx.at[dest].set(rep_fidx, mode="drop"),
+        holder=store.holder.at[dest].set(rep_holder, mode="drop"),
+        values=store.values.at[dest].set(rep_vals, mode="drop"),
+        length=store.length.at[dest].set(rep_len, mode="drop"),
+        used=store.used.at[dest].set(True, mode="drop"),
+        n_used=store.n_used + stored.astype(jnp.int32).sum(),
+    )
+    return _sort_store(out), stored.astype(jnp.int32).sum()
+
+
+@functools.partial(jax.jit, static_argnames=("n", "max_hops"))
+def presence_matrix(ring: RingState, store: FragmentStore,
+                    keys: jax.Array, start: jax.Array, n: int = 14,
+                    max_hops: int = 64) -> jax.Array:
+    """[B, n] bool: is fragment index i of each key present on an alive
+    holder? The batched analog of the Merkle-sync IsMissing check
+    (dhash_peer.cpp:416-447) for known keys."""
+    pos = u128.searchsorted(store.keys, keys, store.n_used)
+    _, valid, fidx = _key_window(store, ring, pos, keys, n)
+    idx_grid = jnp.arange(1, n + 1, dtype=jnp.int32)
+    return ((fidx[:, :, None] == idx_grid[None, None, :])
+            & valid[:, :, None]).any(axis=1)

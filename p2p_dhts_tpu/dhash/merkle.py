@@ -1,0 +1,122 @@
+"""Array Merkle index: keyspace-partitioned hash tree as level arrays.
+
+The reference's MerkleTree (src/data_structures/merkle_tree.h) is an
+8-ary pointer tree over the whole keyspace: leaves split dynamically at
+>8 entries (merkle_tree.h:126-128), node hashes are SHA-1 of concatenated
+child hashes, and leaf hashes cover KEYS ONLY (merkle_tree.h:724-749) —
+value updates are invisible to sync. Anti-entropy walks two trees level
+by level exchanging one node per XCHNG_NODE RPC
+(DHashPeer::SynchronizeHelper, dhash_peer.cpp:381-481).
+
+TPU-native re-design (SURVEY.md §7 hard-parts): a FIXED-depth tree where
+level d is a dense [fanout^d, 4] u32 hash array and a key's leaf bucket
+is its top 3*d id bits — no pointers, no dynamic splits. Per-key hashes
+combine into buckets by lane-wise modular SUM, which is commutative and
+incremental, so building is one segment-sum and EVERY level compare of
+two trees is one vectorized equality — the whole recursive XCHNG_NODE
+exchange collapses into log-depth array compares.
+
+Parity notes:
+  * "equal hashes <=> equal key sets" is preserved in the same sense as
+    the reference: hashes cover keys only, not values.
+  * The hash function differs (the reference SHA-1s hex strings; here
+    keys — already SHA-1 outputs — are mixed and summed). The host wire
+    layer derives reference-exact hashes host-side where needed; the
+    device index is the sync-decision engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MerkleIndex(NamedTuple):
+    """levels[d]: [fanout^d, 4] u32 bucket hashes; levels[0] is the root.
+    counts: [fanout^depth] i32 keys per leaf bucket."""
+    levels: Tuple[jax.Array, ...]
+    counts: jax.Array
+
+    @property
+    def depth(self) -> int:
+        return len(self.levels) - 1
+
+    @property
+    def root(self) -> jax.Array:
+        return self.levels[0][0]
+
+
+def _mix(keys: jax.Array) -> jax.Array:
+    """Per-key 4-lane mix (xorshift-multiply) so bucket sums don't cancel
+    structurally; keys are uniform SHA-1 ids already."""
+    x = keys.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    # Cross-mix lanes so each lane of the bucket hash depends on all 128
+    # bits of the key.
+    x = x + jnp.roll(x, 1, axis=-1) * jnp.uint32(0x9E3779B9)
+    return x
+
+
+def leaf_bucket(keys: jax.Array, depth: int, fanout_bits: int = 3) -> jax.Array:
+    """Top depth*fanout_bits id bits -> leaf bucket (the fixed-depth analog
+    of MerkleTree::ChildNum's depth-scaled bit shifts,
+    merkle_tree.h:704-722)."""
+    width = depth * fanout_bits
+    if width > 31:
+        raise ValueError(f"depth*fanout_bits must be <= 31, got {width}")
+    # width <= 31 keeps the whole bucket inside the top lane.
+    return ((keys[..., 3] >> (32 - width))
+            & jnp.uint32((1 << width) - 1)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "fanout_bits"))
+def build_index(keys: jax.Array, mask: jax.Array, depth: int = 4,
+                fanout_bits: int = 3) -> MerkleIndex:
+    """Build the level arrays for a key set ([K, 4] u32 + [K] bool mask).
+
+    One segment-sum per level; 8^4 = 4096 leaf buckets by default.
+    """
+    fanout = 1 << fanout_bits
+    n_leaf = fanout ** depth
+    bucket = leaf_bucket(keys, depth, fanout_bits)
+    mixed = jnp.where(mask[..., None], _mix(keys), 0)
+
+    leaf = jnp.zeros((n_leaf, 4), jnp.uint32).at[bucket].add(mixed)
+    counts = jnp.zeros((n_leaf,), jnp.int32).at[bucket].add(
+        mask.astype(jnp.int32))
+
+    levels = [leaf]
+    cur = leaf
+    for _ in range(depth):
+        cur = cur.reshape(-1, fanout, 4).sum(axis=1, dtype=jnp.uint32)
+        levels.append(cur)
+    return MerkleIndex(levels=tuple(reversed(levels)), counts=counts)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def diff_indices(a: MerkleIndex, b: MerkleIndex
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Compare two indices: (leaf_diff [n_leaf] bool, nodes_exchanged i32).
+
+    leaf_diff marks buckets whose key sets differ. nodes_exchanged counts
+    the nodes a level-by-level walk would actually transfer (children of
+    differing parents only) — the bandwidth the reference's XCHNG_NODE
+    recursion would use (dhash_peer.cpp:381-481), reported for parity
+    accounting even though the device compares whole levels at once.
+    """
+    exchanged = jnp.int32(1)  # the root exchange
+    parent_diff = jnp.any(a.levels[0] != b.levels[0], axis=-1)  # [1]
+    for d in range(1, len(a.levels)):
+        fanout = a.levels[d].shape[0] // a.levels[d - 1].shape[0]
+        expanded = jnp.repeat(parent_diff, fanout)
+        level_diff = jnp.any(a.levels[d] != b.levels[d], axis=-1)
+        exchanged = exchanged + expanded.astype(jnp.int32).sum()
+        parent_diff = expanded & level_diff
+    return parent_diff, exchanged

@@ -1,0 +1,219 @@
+"""Device-resident fragment store + batched DHash create/read.
+
+The reference scatters each value's n fragments across n peer processes,
+each holding a FragmentDb (MerkleTree<DataFragment>) — writes are n
+CREATE_KEY RPCs after n sequential ring lookups (DHashPeer::Create,
+dhash_peer.cpp:89-129), reads collect m distinct fragments over READ_KEY
+RPCs (dhash_peer.cpp:156-197). Here the whole system's fragments live in
+ONE sorted device table and a batch of B puts/gets is a single XLA
+program: batched get_n_successors placement, one encode matmul, one
+merge-sort append — no per-fragment round trips.
+
+Store layout (struct-of-arrays, sorted by (key, frag_idx), padding tail):
+    keys     [C, 4] u32   DHash key of the block
+    frag_idx [C]    i32   1-based IDA fragment index (FragsFromMatrix)
+    holder   [C]    i32   ring row currently holding this fragment
+    values   [C, S] i32   mod-p fragment row, zero-padded to S segments
+    length   [C]    i32   real segment count of the block
+    used     [C]    bool
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.core.ring import RingState, get_n_successors
+from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+from p2p_dhts_tpu.ops import u128
+
+
+class FragmentStore(NamedTuple):
+    keys: jax.Array      # [C, 4] u32
+    frag_idx: jax.Array  # [C] i32
+    holder: jax.Array    # [C] i32
+    values: jax.Array    # [C, S] i32
+    length: jax.Array    # [C] i32
+    used: jax.Array      # [C] bool
+    n_used: jax.Array    # scalar i32
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def max_segments(self) -> int:
+        return self.values.shape[1]
+
+
+def empty_store(capacity: int, max_segments: int) -> FragmentStore:
+    return FragmentStore(
+        keys=jnp.full((capacity, 4), 0xFFFFFFFF, jnp.uint32),
+        frag_idx=jnp.zeros((capacity,), jnp.int32),
+        holder=jnp.full((capacity,), -1, jnp.int32),
+        values=jnp.zeros((capacity, max_segments), jnp.int32),
+        length=jnp.zeros((capacity,), jnp.int32),
+        used=jnp.zeros((capacity,), bool),
+        n_used=jnp.int32(0),
+    )
+
+
+def _sort_store(store: FragmentStore) -> FragmentStore:
+    """Compacting sort: used rows first, ordered by (key lexicographic,
+    frag_idx); unused/purged rows to the tail. Recomputes n_used, so
+    callers can drop rows by clearing `used` and sorting."""
+    keys = store.keys
+    sort_ops = [
+        (~store.used).astype(jnp.int32),
+        keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0],
+        store.frag_idx,
+        jnp.arange(store.capacity, dtype=jnp.int32),
+    ]
+    *_, perm = jax.lax.sort(sort_ops, num_keys=6)
+    return FragmentStore(
+        keys=keys[perm], frag_idx=store.frag_idx[perm],
+        holder=store.holder[perm], values=store.values[perm],
+        length=store.length[perm], used=store.used[perm],
+        n_used=store.used.astype(jnp.int32).sum(),
+    )
+
+
+def _key_window(store: FragmentStore, ring: RingState, pos: jax.Array,
+                keys: jax.Array, n: int):
+    """THE window scan: up to n candidate rows per key starting at sorted
+    position `pos`, validity-masked (in-store, key match, used, alive
+    holder) with duplicate fragment indices deduplicated (later duplicate
+    loses). Shared by read_batch / local_maintenance / presence_matrix so
+    the window invariant lives in exactly one place.
+
+    Returns (win_c [B, n] clamped row indices, valid [B, n] bool,
+    fidx [B, n] i32).
+    """
+    w = jnp.arange(n, dtype=jnp.int32)[None, :]
+    win = pos[:, None] + w
+    win_c = jnp.minimum(win, store.capacity - 1)
+    valid = (win < store.n_used) \
+        & u128.eq(store.keys[win_c], keys[:, None, :]) \
+        & store.used[win_c] \
+        & ring.alive[jnp.maximum(store.holder[win_c], 0)] \
+        & (store.holder[win_c] >= 0)
+    fidx = store.frag_idx[win_c]
+    dup = (fidx[:, :, None] == fidx[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    earlier = jnp.tril(jnp.ones((n, n), bool), k=-1)[None]
+    valid = valid & ~(dup & earlier).any(axis=2)
+    return win_c, valid, fidx
+
+
+def _purge_keys(store: FragmentStore, keys: jax.Array) -> FragmentStore:
+    """Clear every used row whose key appears in `keys` ([B, 4]) and
+    compact. Gives create_batch overwrite semantics: re-creating a key
+    replaces its fragments instead of accumulating duplicate
+    (key, frag_idx) rows that would break the n-row window invariant."""
+    b = keys.shape[0]
+    sort_ops = [keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0],
+                jnp.arange(b, dtype=jnp.int32)]
+    *_, perm = jax.lax.sort(sort_ops, num_keys=4)
+    skeys = keys[perm]
+    pos = u128.searchsorted(skeys, store.keys)
+    pos_c = jnp.minimum(pos, b - 1)
+    hit = (pos < b) & u128.eq(skeys[pos_c], store.keys) & store.used
+    return _sort_store(store._replace(used=store.used & ~hit))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p", "max_hops"))
+def create_batch(ring: RingState, store: FragmentStore,
+                 keys: jax.Array, segments: jax.Array, lengths: jax.Array,
+                 start: jax.Array, n: int = 14, m: int = 10, p: int = 257,
+                 max_hops: int = 64
+                 ) -> Tuple[FragmentStore, jax.Array]:
+    """Batched DHash Create (ref dhash_peer.cpp:89-129).
+
+    keys:     [B, 4] u32 (already hashed)
+    segments: [B, S, m] i32 zero-padded blocks (split_to_segments)
+    lengths:  [B] i32 real segment counts
+    start:    [B] i32 originating peer rows
+
+    Per lane: encode to n fragment rows, place fragment i-1 on the key's
+    i-th successor (GetNSuccessors walk), require >= m placed (the
+    reference's >= m acks, dhash_peer.cpp:126-128) else the lane fails and
+    stores nothing. Returns (store, ok [B] bool). Requires
+    n_used + B*n <= capacity (overflowing rows are dropped and the lane
+    reports failure).
+    """
+    b = keys.shape[0]
+    smax = store.max_segments
+    store = _purge_keys(store, keys)  # overwrite semantics on re-create
+
+    owners, _ = get_n_successors(ring, keys, start, n, max_hops)   # [B, n]
+    placed = owners >= 0
+    ok = placed.sum(axis=1) >= m
+
+    frags = encode_kernel(segments, n, m, p)                       # [B, n, S]
+    frags = jnp.pad(frags, ((0, 0), (0, 0), (0, smax - frags.shape[2])))
+
+    # Append B*n rows (masked), then merge-sort.
+    rows_keys = jnp.broadcast_to(keys[:, None, :], (b, n, 4)).reshape(-1, 4)
+    rows_fidx = jnp.broadcast_to(
+        jnp.arange(1, n + 1, dtype=jnp.int32)[None, :], (b, n)).reshape(-1)
+    rows_holder = owners.reshape(-1)
+    rows_vals = frags.reshape(b * n, smax)
+    rows_len = jnp.broadcast_to(lengths[:, None], (b, n)).reshape(-1)
+    rows_ok = (placed & ok[:, None]).reshape(-1)
+
+    dest = store.n_used + jnp.cumsum(rows_ok.astype(jnp.int32)) - 1
+    dest = jnp.where(rows_ok & (dest < store.capacity), dest,
+                     store.capacity)  # dropped by mode="drop"
+    stored = rows_ok & (dest < store.capacity)
+
+    new = FragmentStore(
+        keys=store.keys.at[dest].set(rows_keys, mode="drop"),
+        frag_idx=store.frag_idx.at[dest].set(rows_fidx, mode="drop"),
+        holder=store.holder.at[dest].set(rows_holder, mode="drop"),
+        values=store.values.at[dest].set(rows_vals, mode="drop"),
+        length=store.length.at[dest].set(rows_len, mode="drop"),
+        used=store.used.at[dest].set(True, mode="drop"),
+        n_used=store.n_used + stored.astype(jnp.int32).sum(),
+    )
+    # Lanes whose rows overflowed the store are failures.
+    lane_stored = stored.reshape(b, n).sum(axis=1)
+    ok = ok & (lane_stored >= jnp.minimum(m, placed.sum(axis=1)))
+    return _sort_store(new), ok
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p"))
+def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
+               n: int = 14, m: int = 10, p: int = 257
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Batched DHash Read (ref dhash_peer.cpp:156-197).
+
+    Collect up to n stored fragments per key (binary search in the sorted
+    store), keep those on ALIVE holders (a fragment on a failed peer is
+    unreachable, as a READ_KEY to it would fail), pick the first m with
+    DISTINCT indices (the reference's distinct-fragment check,
+    dhash_peer.cpp:180-186), decode.
+
+    Returns (segments [B, S, m] i32, ok [B] bool). Failed lanes (fewer
+    than m reachable distinct fragments — the reference throws) give
+    zeros.
+    """
+    pos = u128.searchsorted(store.keys, keys, store.n_used)        # [B]
+    win_c, w_valid, _ = _key_window(store, ring, pos, keys, n)
+
+    ok = w_valid.sum(axis=1) >= m
+
+    # First m valid window slots, stable order.
+    order = jnp.argsort(~w_valid, axis=1, stable=True)[:, :m]      # [B, m]
+    sel = jnp.take_along_axis(win_c, order, axis=1)                # [B, m]
+    rows = store.values[sel]                                       # [B, m, S]
+    # Failed lanes get distinct dummy indices so the Vandermonde inverse
+    # stays well-defined; their output is masked below.
+    idx = jnp.where(ok[:, None], store.frag_idx[sel],
+                    jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
+
+    segments = decode_kernel(rows, idx, p)                         # [B, S, m]
+    segments = jnp.where(ok[:, None, None], segments, 0)
+    return segments, ok

@@ -1,0 +1,240 @@
+"""DHash layer tests: placement, loss tolerance, maintenance, Merkle sync.
+
+Mirrors the reference's dhash_test.cpp coverage (create/read on rings,
+maintenance after failure) minus the wall-clock sleeps: churn + one
+maintenance op + assertions.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu import keyspace
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core import churn
+from p2p_dhts_tpu.core.ring import build_ring, get_n_successors, keys_from_ints
+from p2p_dhts_tpu.dhash import (
+    build_index,
+    create_batch,
+    diff_indices,
+    empty_store,
+    global_maintenance,
+    local_maintenance,
+    presence_matrix,
+    read_batch,
+)
+from p2p_dhts_tpu.ida import split_to_segments
+
+N_IDA, M_IDA, P_IDA = 5, 3, 257
+SMAX = 8
+
+
+def _random_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+def _make_blocks(rng, b, max_len=SMAX * M_IDA):
+    vals = [bytes(rng.randint(1, 256, size=rng.randint(1, max_len)).tolist())
+            for _ in range(b)]
+    segs = np.zeros((b, SMAX, M_IDA), np.int32)
+    lengths = np.zeros(b, np.int32)
+    for i, v in enumerate(vals):
+        s = split_to_segments(v, M_IDA)
+        segs[i, : s.shape[0]] = s
+        lengths[i] = s.shape[0]
+    return vals, jnp.asarray(segs), jnp.asarray(lengths)
+
+
+def _setup(rng, n_peers=32, b=16, capacity=4096):
+    ring = build_ring(_random_ids(rng, n_peers), RingConfig(num_succs=3))
+    store = empty_store(capacity, SMAX)
+    key_ints = _random_ids(rng, b)
+    keys = keys_from_ints(key_ints)
+    starts = jnp.asarray(rng.randint(0, n_peers, size=b), jnp.int32)
+    vals, segs, lengths = _make_blocks(rng, b)
+    store, ok = create_batch(ring, store, keys, segs, lengths, starts,
+                             N_IDA, M_IDA, P_IDA)
+    return ring, store, keys, starts, vals, segs, lengths, ok
+
+
+def _check_read(ring, store, keys, segs, lengths, want_ok=True):
+    got, ok = read_batch(ring, store, keys, N_IDA, M_IDA, P_IDA)
+    if want_ok:
+        assert bool(jnp.all(ok)), "read failed"
+        got_np = np.asarray(got)
+        for i in range(keys.shape[0]):
+            ln = int(lengths[i])
+            np.testing.assert_array_equal(
+                got_np[i, :ln], np.asarray(segs)[i, :ln],
+                err_msg=f"block {i} corrupted")
+    return ok
+
+
+def test_create_read_roundtrip(rng):
+    ring, store, keys, starts, vals, segs, lengths, ok = _setup(rng)
+    assert bool(jnp.all(ok))
+    assert int(store.n_used) == 16 * N_IDA
+    _check_read(ring, store, keys, segs, lengths)
+
+
+def test_placement_positional(rng):
+    ring, store, keys, starts, *_ = _setup(rng, b=8)
+    owners, _ = get_n_successors(ring, keys, starts, N_IDA)
+    owners = np.asarray(owners)
+    skeys = np.asarray(store.keys[: int(store.n_used)])
+    sfidx = np.asarray(store.frag_idx[: int(store.n_used)])
+    sholder = np.asarray(store.holder[: int(store.n_used)])
+    key_np = np.asarray(keys)
+    for i in range(8):
+        rows = np.where((skeys == key_np[i]).all(axis=1))[0]
+        assert len(rows) == N_IDA
+        for r in rows:
+            assert sholder[r] == owners[i, sfidx[r] - 1]
+
+
+def test_loss_tolerance_and_data_loss(rng):
+    ring, store, keys, starts, vals, segs, lengths, _ = _setup(rng, b=4)
+    owners, _ = get_n_successors(ring, keys, starts, N_IDA)
+    owners = np.asarray(owners)
+    # Kill n-m holders of block 0: still readable.
+    ring2 = churn.fail(ring, jnp.asarray(owners[0, : N_IDA - M_IDA], jnp.int32))
+    got, ok = read_batch(ring2, store, keys, N_IDA, M_IDA, P_IDA)
+    assert bool(ok[0])
+    np.testing.assert_array_equal(
+        np.asarray(got)[0, : int(lengths[0])],
+        np.asarray(segs)[0, : int(lengths[0])])
+    # Kill one more of block 0's holders: unreadable (reference throws).
+    ring3 = churn.fail(ring2, jnp.asarray(owners[0, N_IDA - M_IDA:
+                                                 N_IDA - M_IDA + 1], jnp.int32))
+    _, ok3 = read_batch(ring3, store, keys, N_IDA, M_IDA, P_IDA)
+    assert not bool(ok3[0])
+
+
+def test_local_maintenance_repairs_replicas(rng):
+    ring, store, keys, starts, vals, segs, lengths, _ = _setup(rng, b=6)
+    owners, _ = get_n_successors(ring, keys, starts, N_IDA)
+    owners = np.asarray(owners)
+    # Fail one holder of block 0 (within tolerance), repair the ring.
+    victim = owners[0, 1]
+    ring = churn.fail(ring, jnp.asarray([victim], jnp.int32))
+    ring = churn.stabilize_sweep(ring)
+
+    # Re-place (the successor sets shifted) then regenerate.
+    c = store.capacity
+    any_alive = jnp.argmax(ring.alive).astype(jnp.int32)
+    starts_c = jnp.full((c,), any_alive, jnp.int32)
+    store = global_maintenance(ring, store, starts_c, N_IDA)
+    store, repaired = local_maintenance(ring, store, starts_c,
+                                        N_IDA, M_IDA, P_IDA)
+    assert int(repaired) > 0
+    # Full presence on the new designated holders.
+    b_starts = jnp.full((keys.shape[0],), any_alive, jnp.int32)
+    pres = presence_matrix(ring, store, keys, b_starts, N_IDA)
+    assert bool(jnp.all(pres)), "replication not fully restored"
+    _check_read(ring, store, keys, segs, lengths)
+
+
+def test_global_maintenance_after_join(rng):
+    ring, store, keys, starts, vals, segs, lengths, _ = _setup(rng, b=6)
+    # Join 4 new peers; some become designated holders.
+    new_ids = _random_ids(rng, 4)
+    ring2 = build_ring(
+        keyspace.lanes_to_ints(np.asarray(ring.ids[: int(ring.n_valid)]))
+        + new_ids, RingConfig(num_succs=3))
+    c = store.capacity
+    starts_c = jnp.zeros((c,), jnp.int32)
+    store2 = global_maintenance(ring2, store, starts_c, N_IDA)
+    owners, _ = get_n_successors(
+        ring2, keys, jnp.zeros((keys.shape[0],), jnp.int32), N_IDA)
+    owners = np.asarray(owners)
+    skeys = np.asarray(store2.keys[: int(store2.n_used)])
+    sfidx = np.asarray(store2.frag_idx[: int(store2.n_used)])
+    sholder = np.asarray(store2.holder[: int(store2.n_used)])
+    key_np = np.asarray(keys)
+    for i in range(6):
+        rows = np.where((skeys == key_np[i]).all(axis=1))[0]
+        for r in rows:
+            assert sholder[r] == owners[i, sfidx[r] - 1]
+    _check_read(ring2, store2, keys, segs, lengths)
+
+
+def test_recreate_overwrites(rng):
+    """Re-creating an existing key replaces its fragments (no duplicate
+    (key, frag_idx) rows breaking the window invariant)."""
+    ring, store, keys, starts, vals, segs, lengths, _ = _setup(rng, b=4)
+    vals2, segs2, lengths2 = _make_blocks(rng, 4)
+    store, ok = create_batch(ring, store, keys, segs2, lengths2, starts,
+                             N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(ok))
+    assert int(store.n_used) == 4 * N_IDA  # replaced, not accumulated
+    _check_read(ring, store, keys, segs2, lengths2)
+
+
+def test_create_requires_m_placements(rng):
+    """On a 2-peer ring only 2 successors exist: with m=3 required acks the
+    create must fail (reference throws after < m acks)."""
+    ring = build_ring(_random_ids(rng, 2), RingConfig(num_succs=3))
+    store = empty_store(64, SMAX)
+    keys = keys_from_ints(_random_ids(rng, 2))
+    _, segs, lengths = _make_blocks(rng, 2)
+    store, ok = create_batch(ring, store, keys, segs, lengths,
+                             jnp.zeros(2, jnp.int32), N_IDA, M_IDA, P_IDA)
+    assert not bool(ok[0]) and not bool(ok[1])
+
+
+def test_store_capacity_overflow(rng):
+    ring = build_ring(_random_ids(rng, 16), RingConfig(num_succs=3))
+    store = empty_store(N_IDA * 2, SMAX)  # room for 2 blocks
+    keys = keys_from_ints(_random_ids(rng, 3))
+    _, segs, lengths = _make_blocks(rng, 3)
+    store, ok = create_batch(ring, store, keys, segs, lengths,
+                             jnp.zeros(3, jnp.int32), N_IDA, M_IDA, P_IDA)
+    ok = np.asarray(ok)
+    assert ok.sum() == 2 and int(store.n_used) == 2 * N_IDA
+
+
+# ---------------------------------------------------------------------------
+# Merkle index
+# ---------------------------------------------------------------------------
+
+def test_merkle_equal_sets_equal_roots(rng):
+    ids = _random_ids(rng, 200)
+    a = build_index(keys_from_ints(ids), jnp.ones(200, bool))
+    b = build_index(keys_from_ints(list(reversed(ids))), jnp.ones(200, bool))
+    assert bool(jnp.all(a.root == b.root))
+    diff, exchanged = diff_indices(a, b)
+    assert not bool(diff.any())
+    assert int(exchanged) == 1  # only the root was compared
+
+
+def test_merkle_detects_single_difference(rng):
+    ids = _random_ids(rng, 100)
+    extra = _random_ids(rng, 1)[0]
+    a = build_index(keys_from_ints(ids), jnp.ones(100, bool))
+    b = build_index(keys_from_ints(ids + [extra]), jnp.ones(101, bool))
+    assert not bool(jnp.all(a.root == b.root))
+    diff, exchanged = diff_indices(a, b)
+    from p2p_dhts_tpu.dhash.merkle import leaf_bucket
+    want_bucket = int(leaf_bucket(keys_from_ints([extra]), 4)[0])
+    diff_np = np.asarray(diff)
+    assert diff_np[want_bucket]
+    assert diff_np.sum() == 1
+    assert 1 < int(exchanged) <= sum(8**d for d in range(5))
+
+
+def test_merkle_mask_excludes_keys(rng):
+    ids = _random_ids(rng, 50)
+    mask = jnp.ones(50, bool).at[7].set(False)
+    a = build_index(keys_from_ints(ids), mask)
+    b = build_index(keys_from_ints(ids[:7] + ids[8:]), jnp.ones(49, bool))
+    assert bool(jnp.all(a.root == b.root))
+    assert int(a.counts.sum()) == 49
+
+
+def test_merkle_counts(rng):
+    ids = _random_ids(rng, 300)
+    idx = build_index(keys_from_ints(ids), jnp.ones(300, bool))
+    assert int(idx.counts.sum()) == 300
+    assert idx.levels[-1].shape == (4096, 4)
+    assert idx.levels[0].shape == (1, 4)
