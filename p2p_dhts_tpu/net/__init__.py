@@ -1,0 +1,9 @@
+"""Host JSON-RPC wire layer (reference parity: src/networking)."""
+
+from p2p_dhts_tpu.net.rpc import (  # noqa: F401
+    Client,
+    RequestLog,
+    RpcError,
+    Server,
+    sanitize_json,
+)

@@ -1,0 +1,223 @@
+"""One-shot TCP JSON-RPC client + threaded server, wire-parity with the
+reference (src/networking/client.{h,cpp}, server.h).
+
+Protocol (exactly the reference's):
+  * request: one minified JSON object; client half-closes its send side
+    after writing (client.cpp:60-65); server reads to EOF.
+  * dispatch on req["COMMAND"] against a handler map; unknown command ->
+    error (server.h:193-210).
+  * response envelope: handler result + {"SUCCESS": true}; handler
+    exception -> {"SUCCESS": false, "ERRORS": str} (server.h:151-165);
+    parse failure -> same with the parse error.
+  * client reads the full reply with a 5 s timeout (client.cpp:67-76) and
+    sanitizes trailing garbage after the final '}' (client.cpp:36-49).
+  * liveness = TCP connect probe (client.cpp:98-112) — the system-wide
+    failure detector.
+  * optional request logging into a bounded ring buffer of 32 entries
+    (server.h:119-121,242,364-378).
+
+The reference runs 3 io_context worker threads per server
+(server.h:294-307); here a thread pool of the same default size serves
+parsed connections, with one acceptor thread.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+JsonObj = dict
+Handler = Callable[[JsonObj], JsonObj]
+
+DEFAULT_TIMEOUT_S = 5.0  # client.cpp:68
+REQUEST_LOG_SIZE = 32    # server.h:242
+
+
+class RpcError(RuntimeError):
+    """Transport- or protocol-level RPC failure."""
+
+
+def sanitize_json(payload: str) -> str:
+    """Drop garbage after the final '}' (ref SanitizeJson,
+    client.cpp:36-49). The C++ version appends '}' per split chunk — which
+    leaves one trailing brace that JsonCpp's lenient parser (failIfExtra
+    defaults off) ignores; the equivalent here is truncating at the last
+    '}' and letting raw_decode ignore any remainder."""
+    end = payload.rfind("}")
+    return payload[: end + 1] if end >= 0 else payload
+
+
+class RequestLog:
+    """Fixed-size FIFO of parsed requests (ref ThreadSafeQueue<Json::Value>,
+    thread_safe_queue.h:23-148): PushBack evicts the oldest when full."""
+
+    def __init__(self, max_size: int = REQUEST_LOG_SIZE):
+        self._buf: deque = deque(maxlen=max_size)
+        self._lock = threading.Lock()
+
+    def push_back(self, item: JsonObj) -> None:
+        with self._lock:
+            self._buf.append(item)
+
+    def pop_front(self) -> JsonObj:
+        with self._lock:
+            return self._buf.popleft()
+
+    def at(self, i: int) -> JsonObj:
+        with self._lock:
+            return self._buf[i]
+
+    def get_buffer(self) -> List[JsonObj]:
+        with self._lock:
+            return list(self._buf)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+class Client:
+    """One-shot request client (ref class Client, client.h:24-46)."""
+
+    @staticmethod
+    def make_request(ip_addr: str, port: int, request: JsonObj,
+                     timeout: float = DEFAULT_TIMEOUT_S) -> JsonObj:
+        payload = json.dumps(request, separators=(",", ":")).encode()
+        with socket.create_connection((ip_addr, port),
+                                      timeout=timeout) as sock:
+            sock.sendall(payload)
+            sock.shutdown(socket.SHUT_WR)
+            sock.settimeout(timeout)
+            chunks = []
+            try:
+                while True:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+            except socket.timeout:
+                raise RpcError("RPC reply timed out")
+        raw = b"".join(chunks).decode("utf-8", errors="replace")
+        try:
+            # raw_decode parses the first complete JSON value and ignores
+            # trailing bytes — JsonCpp's failIfExtra=false behavior.
+            obj, _ = json.JSONDecoder().raw_decode(sanitize_json(raw))
+            return obj
+        except json.JSONDecodeError as exc:
+            raise RpcError(f"Error parsing response: {exc}") from exc
+
+    @staticmethod
+    def is_alive(ip_addr: str, port: int, timeout: float = 1.0) -> bool:
+        """TCP connect probe (ref Client::IsAlive, client.cpp:98-112)."""
+        try:
+            with socket.create_connection((ip_addr, port), timeout=timeout):
+                return True
+        except OSError:
+            return False
+
+
+class Server:
+    """Threaded request server (ref class Server, server.h:216-431)."""
+
+    def __init__(self, port: int, handlers: Dict[str, Handler],
+                 num_threads: int = 3, logging_enabled: bool = False,
+                 host: str = "127.0.0.1"):
+        self.port = port
+        self.handlers = dict(handlers)
+        self.logging_enabled = logging_enabled
+        self.request_log = RequestLog()
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        if port == 0:
+            self.port = self._sock.getsockname()[1]
+        self._alive = True
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def run_in_background(self) -> None:
+        """ref Server::RunInBackground (server.h:312-320)."""
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-server-{self.port}")
+        self._accept_thread.start()
+
+    def kill(self) -> None:
+        """Close the acceptor (ref Server::Kill, server.h:354-361)."""
+        if not self._alive:
+            return
+        self._alive = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+    def is_alive(self) -> bool:
+        return self._alive
+
+    def get_log(self) -> List[JsonObj]:
+        """ref Server::GetLog (server.h:399-402)."""
+        return self.request_log.get_buffer()
+
+    # -- internals ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # killed
+            try:
+                self._pool.submit(self._serve_connection, conn)
+            except RuntimeError:
+                conn.close()
+                return  # pool shut down
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(DEFAULT_TIMEOUT_S)
+                chunks = []
+                while True:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                raw = b"".join(chunks).decode("utf-8", errors="replace")
+                resp: JsonObj
+                try:
+                    req = json.loads(raw)
+                except json.JSONDecodeError as exc:
+                    resp = {"SUCCESS": False, "ERRORS": str(exc)}
+                else:
+                    if self.logging_enabled:
+                        self.request_log.push_back(req)
+                    resp = self._process(req)
+                conn.sendall(json.dumps(
+                    resp, separators=(",", ":")).encode())
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        except OSError:
+            pass  # connection dropped; one-shot protocol, nothing to do
+
+    def _process(self, req: JsonObj) -> JsonObj:
+        """Dispatch + envelope (ref Session::HandleRead/ProcessRequest,
+        server.h:128-210)."""
+        try:
+            command = req.get("COMMAND", "")
+            handler = self.handlers.get(command)
+            if handler is None:
+                raise RuntimeError("Invalid command.")
+            resp = handler(req) or {}
+            resp["SUCCESS"] = True
+            return resp
+        except Exception as exc:  # handler errors -> SUCCESS false
+            return {"SUCCESS": False, "ERRORS": str(exc)}

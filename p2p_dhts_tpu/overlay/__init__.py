@@ -1,0 +1,22 @@
+"""Host overlay: real TCP peers speaking the reference wire protocol.
+
+This is the capability-parity layer (SURVEY.md §7 stage 6): ChordPeer /
+DHashPeer classes a user of the reference can switch to — same RPC
+commands, same JSON wire forms, same protocol behavior — with the
+batched device kernels behind a ``backend="jax"`` flag on the lookup
+path (BASELINE.json north star).
+"""
+
+from p2p_dhts_tpu.overlay.merkle_tree import MerkleTree  # noqa: F401
+from p2p_dhts_tpu.overlay.database import (  # noqa: F401
+    FragmentDb,
+    GenericDB,
+    TextDb,
+)
+from p2p_dhts_tpu.overlay.remote_peer import (  # noqa: F401
+    RemotePeer,
+    RemotePeerList,
+)
+from p2p_dhts_tpu.overlay.finger_table import Finger, FingerTable  # noqa: F401
+from p2p_dhts_tpu.overlay.chord_peer import ChordPeer  # noqa: F401
+from p2p_dhts_tpu.overlay.dhash_peer import DHashPeer  # noqa: F401

@@ -1,0 +1,574 @@
+"""Host Chord peer: the reference's AbstractChordPeer + ChordPeer.
+
+Wire-parity re-implementation of src/chord/abstract_chord_peer.{h,cpp}
+and chord_peer.{h,cpp}: a real TCP JSON-RPC peer with the same 8 commands
+{JOIN, NOTIFY, LEAVE, GET_SUCC, GET_PRED, CREATE_KEY, READ_KEY, RECTIFY},
+the same JSON forms, and the same protocol behavior (including the
+non-textbook lookup semantics the device kernels pin — ForwardRequest's
+self-hit -> predecessor correction, succ-list fallback, linear-scan
+range-successor finger lookup).
+
+Differences from the reference, all deliberate:
+  * the server binds BEFORE the id is derived so port=0 (ephemeral) works
+    in tests; with a fixed port the id is byte-identical to the
+    reference's SHA1("ip:port") (abstract_chord_peer.cpp:13-28).
+  * maintenance_interval is a constructor argument (the reference
+    hardcodes 5 s, chord_peer.cpp:219); interval=None disables the
+    thread so tests can step Stabilize deterministically instead of
+    sleeping (SURVEY.md §4 implications).
+  * backend="jax" routes finger lookups through the O(1)/batched device
+    path (BASELINE.json north-star flag); backend="python" is the
+    reference's linear scan.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from p2p_dhts_tpu.keyspace import Key
+from p2p_dhts_tpu.net.rpc import Client, JsonObj, Server
+from p2p_dhts_tpu.overlay.database import TextDb
+from p2p_dhts_tpu.overlay.finger_table import Finger, FingerTable
+from p2p_dhts_tpu.overlay.remote_peer import RemotePeer, RemotePeerList
+
+logger = logging.getLogger("p2p_dhts_tpu.overlay")
+
+KEY_BITS = 128  # ChordKey::BinaryLen()
+
+
+class AbstractChordPeer:
+    """Protocol core (ref AbstractChordPeer, abstract_chord_peer.h:62-415).
+
+    Subclasses register their command handlers by overriding handlers()
+    and implement the pure virtuals: create/read/start_maintenance/
+    keys_as_json/fail/handle_notify_from_pred/absorb_keys/
+    handle_pred_failure/forward_request.
+    """
+
+    def __init__(self, ip_addr: str, port: int, num_succs: int,
+                 backend: str = "python",
+                 maintenance_interval: Optional[float] = 5.0):
+        self.ip_addr = ip_addr
+        self.num_succs = num_succs
+        self.backend = backend
+        self.maintenance_interval = maintenance_interval
+
+        self.server = Server(port, {}, num_threads=3)
+        self.port = self.server.port
+        self.server.handlers.update(self.handlers())
+
+        # id = SHA1("ip:port") (abstract_chord_peer.cpp:13-28)
+        self.id = Key.from_plaintext(f"{self.ip_addr}:{self.port}")
+        self.min_key = Key(self.id)
+        self.predecessor: Optional[RemotePeer] = None
+        self._pred_lock = threading.RLock()
+        self.finger_table = FingerTable(self.id, backend=backend)
+        self.successors = RemotePeerList(num_succs, self.id)
+
+        self._maint_stop = threading.Event()
+        self._maint_thread: Optional[threading.Thread] = None
+        self.server.run_in_background()
+        self.log("Created peer.")
+
+    # -- virtuals ----------------------------------------------------------
+    def handlers(self) -> Dict[str, object]:
+        raise NotImplementedError
+
+    def create(self, key, val):
+        raise NotImplementedError
+
+    def read(self, key):
+        raise NotImplementedError
+
+    def keys_as_json(self) -> JsonObj:
+        raise NotImplementedError
+
+    def absorb_keys(self, kv_pairs: JsonObj) -> None:
+        raise NotImplementedError
+
+    def handle_notify_from_pred(self, new_pred: RemotePeer) -> JsonObj:
+        raise NotImplementedError
+
+    def handle_pred_failure(self, old_pred: RemotePeer) -> None:
+        raise NotImplementedError
+
+    def forward_request(self, key: Key, request: JsonObj) -> JsonObj:
+        raise NotImplementedError
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_chord(self) -> None:
+        """First node owns everything (abstract_chord_peer.cpp:66-71)."""
+        self.min_key = self.id + 1
+        self.start_maintenance()
+
+    def join(self, gateway_ip: str, gateway_port: int) -> None:
+        """ref Join (abstract_chord_peer.cpp:83-117)."""
+        self.log("Joining chord")
+        resp = Client.make_request(gateway_ip, gateway_port,
+                                   {"COMMAND": "JOIN",
+                                    "NEW_PEER": self.peer_as_json()})
+        self.predecessor = RemotePeer.from_json(resp["PREDECESSOR"])
+        self.min_key = self.predecessor.id + 1
+
+        self.populate_finger_table(initialize=True)
+        self.notify(self.finger_table.get_nth_entry(0))
+
+        # Arbitrary cutoff kept for parity (abstract_chord_peer.cpp:103-110).
+        if self.num_succs > 10:
+            for pred in self.get_n_predecessors(self.id, self.num_succs):
+                self.notify(pred)
+            self.successors.populate(
+                self.get_n_successors(self.id + 1, self.num_succs))
+
+        self.fix_other_fingers(self.id)
+        self.start_maintenance()
+
+    def join_handler(self, req: JsonObj) -> JsonObj:
+        """ref JoinHandler (abstract_chord_peer.cpp:119-136)."""
+        new_peer = RemotePeer.from_json(req["NEW_PEER"])
+        new_peer_pred = self.get_predecessor(new_peer.id)
+        self.finger_table.adjust_fingers(new_peer)
+        self.successors.insert(new_peer)
+        return {"PREDECESSOR": new_peer_pred.to_json()}
+
+    def leave(self) -> None:
+        """ref Leave (abstract_chord_peer.cpp:192-226)."""
+        self.log("Leaving chord.")
+        notification = {
+            "COMMAND": "LEAVE",
+            "LEAVING_ID": str(self.id),
+            "NEW_PRED": self.predecessor.to_json(),
+            "NEW_MIN": str(self.min_key),
+            "KEYS_TO_ABSORB": self.keys_as_json(),
+        }
+        for pred in self.get_n_predecessors(self.id, self.num_succs):
+            try:
+                pred.send_request(notification)
+            except RuntimeError:
+                pass
+        succ = self.finger_table.get_nth_entry(0)
+        succ_condones = True
+        if succ.is_alive():
+            succ_resp = succ.send_request(notification)
+            succ_condones = bool(succ_resp.get("SUCCESS"))
+        if succ_condones:
+            self.log("Leaving now.")
+            self.fail()
+        else:
+            raise RuntimeError("Not ready to leave")
+
+    def leave_handler(self, req: JsonObj) -> JsonObj:
+        """ref LeaveHandler (abstract_chord_peer.cpp:228-260).
+
+        Reference quirk mirrored: the final AdjustFingers(NEW_SUCC) is a
+        no-op because Leave() never sets NEW_SUCC (SURVEY.md §7 quirks);
+        here it is simply skipped.
+        """
+        leaving_id = Key.from_hex(req["LEAVING_ID"])
+        if self.predecessor is not None \
+                and leaving_id == self.predecessor.id:
+            old_pred_id = self.predecessor.id
+            self.predecessor = RemotePeer.from_json(req["NEW_PRED"])
+            self.min_key = Key.from_hex(req["NEW_MIN"])
+            self.fix_other_fingers(old_pred_id)
+            self.absorb_keys(req.get("KEYS_TO_ABSORB") or {})
+        self.successors.delete(leaving_id)
+        if self.successors.size() == 0:
+            self.successors.populate(
+                self.get_n_successors(self.id + 1, self.num_succs))
+        return {}
+
+    def fail(self) -> None:
+        raise NotImplementedError
+
+    def start_maintenance(self) -> None:
+        raise NotImplementedError
+
+    # -- notify ------------------------------------------------------------
+    def notify(self, peer_to_notify: RemotePeer) -> None:
+        """ref Notify (abstract_chord_peer.cpp:138-148)."""
+        resp = peer_to_notify.send_request(
+            {"COMMAND": "NOTIFY", "NEW_PEER": self.peer_as_json()})
+        self.absorb_keys(resp.get("KEYS_TO_ABSORB") or {})
+
+    def notify_handler(self, req: JsonObj) -> JsonObj:
+        """ref NotifyHandler (abstract_chord_peer.cpp:150-190)."""
+        new_peer = RemotePeer.from_json(req["NEW_PEER"])
+        self.log(f"Received notify from {new_peer.port}")
+
+        if self.predecessor is not None and not self.predecessor.is_alive():
+            old_pred = self.predecessor
+            resp = self.handle_notify_from_pred(new_peer)
+            self.handle_pred_failure(old_pred)
+            return resp
+
+        self.finger_table.adjust_fingers(new_peer)
+        self.successors.insert(new_peer)
+
+        peer_is_pred = self.predecessor is None or \
+            new_peer.id.in_between(self.predecessor.id, self.id, False)
+        if peer_is_pred:
+            return self.handle_notify_from_pred(new_peer)
+
+        if self.finger_table.empty():
+            self.populate_finger_table(initialize=True)
+        return {}
+
+    # -- files (abstract_chord_peer.cpp:268-304) ----------------------------
+    def upload_file(self, file_path: str) -> None:
+        with open(file_path, "rb") as fh:
+            contents = fh.read()
+        self.create(file_path, contents.decode("utf-8",
+                                               errors="surrogateescape"))
+
+    def download_file(self, file_name: str, output_path: str) -> None:
+        contents = self.read(file_name)
+        with open(output_path, "wb") as fh:
+            fh.write(contents.encode("utf-8", errors="surrogateescape"))
+
+    # -- succ/pred resolution ----------------------------------------------
+    def get_successor(self, key) -> RemotePeer:
+        """ref GetSuccessor (abstract_chord_peer.cpp:313-330)."""
+        key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        if self.stored_locally(key):
+            return self.to_remote_peer()
+        resp = self.forward_request(
+            key, {"COMMAND": "GET_SUCC", "KEY": str(key)})
+        return RemotePeer.from_json(resp)
+
+    def get_succ_handler(self, req: JsonObj) -> JsonObj:
+        return self.get_successor(Key.from_hex(req["KEY"])).to_json()
+
+    def get_n_successors(self, key, n: int) -> List[RemotePeer]:
+        """ref GetNSuccessors with repeat-break
+        (abstract_chord_peer.cpp:345-373)."""
+        key = key if isinstance(key, Key) else Key(key)
+        out: List[RemotePeer] = []
+        seen = set()
+        prev = key - 1
+        for _ in range(n):
+            ith = self.get_successor(prev + 1)
+            if ith.id.value in seen:
+                break
+            out.append(ith)
+            seen.add(ith.id.value)
+            prev = ith.id
+        return out
+
+    def get_predecessor(self, key) -> RemotePeer:
+        """ref GetPredecessor with the succ-list shortcut
+        (abstract_chord_peer.cpp:380-416)."""
+        key = key if isinstance(key, Key) else Key(key)
+        if self.predecessor is None:
+            return self.to_remote_peer()
+        if self.stored_locally(key):
+            return self.predecessor
+        succ_of_key = self.successors.lookup(key)
+        if succ_of_key is not None:
+            try:
+                pred_of_succ = succ_of_key.get_pred()
+                if key.in_between(pred_of_succ.id, succ_of_key.id, True):
+                    return pred_of_succ
+            except RuntimeError:
+                pass
+        resp = self.forward_request(
+            key, {"COMMAND": "GET_PRED", "KEY": str(key)})
+        if resp.get("SUCCESS"):
+            return RemotePeer.from_json(resp)
+        raise RuntimeError(f"Lookup failed w/ error: {resp.get('ERRORS')}")
+
+    def get_pred_handler(self, req: JsonObj) -> JsonObj:
+        return self.get_predecessor(Key.from_hex(req["KEY"])).to_json()
+
+    def get_n_predecessors(self, key, n: int) -> List[RemotePeer]:
+        """ref GetNPredecessors (abstract_chord_peer.cpp:431-449)."""
+        key = key if isinstance(key, Key) else Key(key)
+        out: List[RemotePeer] = []
+        prev = key
+        for i in range(n):
+            ith = self.get_predecessor(prev - 1)
+            out.append(ith)
+            if prev == key and i != 0:
+                break
+            prev = ith.id
+        return out
+
+    # -- maintenance -------------------------------------------------------
+    def stabilize(self) -> None:
+        """ref Stabilize (abstract_chord_peer.cpp:460-505)."""
+        self.log("Running stabilize.")
+        if self.predecessor is not None \
+                and not self.predecessor.is_alive():
+            self.handle_pred_failure(self.predecessor)
+
+        if self.successors.size() == 0:
+            self.successors.populate(
+                self.get_n_successors(self.id + 1, self.num_succs))
+            self.populate_finger_table(initialize=False)
+            return
+
+        immediate_succ = self.successors.get_nth_entry(0)
+        while not immediate_succ.is_alive():
+            self.successors.delete(immediate_succ)
+            immediate_succ = self.successors.get_nth_entry(0)
+
+        pred_of_succ = immediate_succ.get_pred()
+        incorrect_succ = self.id.in_between(pred_of_succ.id,
+                                            immediate_succ.id, True)
+        if incorrect_succ or not pred_of_succ.is_alive():
+            self.log(f"Notifying {immediate_succ.port}")
+            self.notify(immediate_succ)
+
+        self.update_succ_list()
+        self.populate_finger_table(initialize=False)
+
+    def update_succ_list(self) -> None:
+        """ref UpdateSuccList pred-walk gap filling
+        (abstract_chord_peer.cpp:507-562)."""
+        old_peer_list = self.successors.get_entries()
+        previous_succ_id = self.id
+        for nth_entry in old_peer_list:
+            last_entry = nth_entry
+            while True:
+                try:
+                    pred_of_last = last_entry.get_pred()
+                except RuntimeError:
+                    break
+                if pred_of_last.id == previous_succ_id \
+                        or pred_of_last.id == self.id:
+                    break
+                if pred_of_last.is_alive():
+                    self.successors.insert(pred_of_last)
+                last_entry = pred_of_last
+            previous_succ_id = nth_entry.id
+
+        if self.successors.size() < self.num_succs:
+            size = self.successors.size()
+            discrepancy = self.num_succs - size
+            last_succ = self.successors.get_nth_entry(size - 1)
+            for peer in self.get_n_successors(last_succ.id + 1, discrepancy):
+                if peer.id != self.id:
+                    self.successors.insert(peer)
+
+    def populate_finger_table(self, initialize: bool) -> None:
+        """ref PopulateFingerTable (abstract_chord_peer.cpp:564-613):
+        128 sequential GET_SUCCs, each asking the previous entry as the
+        closest known preceding peer."""
+        for i in range(FingerTable.NUM_ENTRIES):
+            lb, ub = self.finger_table.get_nth_range(i)
+            succ_req = {"COMMAND": "GET_SUCC", "KEY": str(lb)}
+            if initialize:
+                if self.stored_locally(lb):
+                    self.finger_table.add_finger(
+                        Finger(lb, ub, self.to_remote_peer()))
+                else:
+                    peer_to_query = self.predecessor if i == 0 \
+                        else self.finger_table.get_nth_entry(i - 1)
+                    resp = peer_to_query.send_request(succ_req)
+                    self.finger_table.add_finger(
+                        Finger(lb, ub, RemotePeer.from_json(resp)))
+            else:
+                if i == 0:
+                    self.finger_table.edit_nth_finger(
+                        0, self.get_successor(lb))
+                else:
+                    peer_to_query = self.finger_table.get_nth_entry(i - 1)
+                    resp = peer_to_query.send_request(succ_req)
+                    self.finger_table.edit_nth_finger(
+                        i, RemotePeer.from_json(resp))
+
+    def fix_other_fingers(self, starting_key: Key) -> None:
+        """ref FixOtherFingers (abstract_chord_peer.cpp:615-645)."""
+        former: Optional[RemotePeer] = None
+        for i in range(1, KEY_BITS + 1):
+            p = self.get_predecessor(Key(starting_key) - (1 << (i - 1)))
+            if former is not None and former == p:
+                continue
+            former = p
+            if p.id == self.id:
+                break
+            if p.is_alive():
+                self.notify(p)
+
+    def rectify(self, failed_peer: RemotePeer) -> None:
+        """ref Rectify — Zave's repair broadcast
+        (abstract_chord_peer.cpp:647-682)."""
+        if failed_peer.is_alive():
+            return
+        self.log(f"Rectifying failure of {failed_peer.port}")
+        req = {"COMMAND": "RECTIFY",
+               "FAILED_NODE": failed_peer.to_json(),
+               "ORIGINATOR": self.peer_as_json()}
+        former: Optional[RemotePeer] = None
+        for i in range(1, KEY_BITS + 1):
+            p = self.get_predecessor(failed_peer.id - (1 << (i - 1)))
+            if former is not None and former == p:
+                continue
+            former = p
+            if p.id == self.id:
+                break
+            if p.is_alive():
+                p.send_request(req)
+
+    def rectify_handler(self, req: JsonObj) -> JsonObj:
+        """ref RectifyHandler (abstract_chord_peer.cpp:684-698)."""
+        originator = RemotePeer.from_json(req["ORIGINATOR"])
+        if originator.id == self.id:
+            return {}
+        failed_node = RemotePeer.from_json(req["FAILED_NODE"])
+        self.successors.delete(failed_node)
+        self.finger_table.replace_dead_peer(failed_node, originator)
+        self.notify(originator)
+        return {}
+
+    # -- misc --------------------------------------------------------------
+    def to_remote_peer(self) -> RemotePeer:
+        return RemotePeer(self.id, self.min_key, self.ip_addr, self.port)
+
+    def peer_as_json(self) -> JsonObj:
+        return self.to_remote_peer().to_json()
+
+    def stored_locally(self, key: Key) -> bool:
+        """key in [min_key, id] (abstract_chord_peer.cpp:720-725)."""
+        return Key(key).in_between(self.min_key, self.id, True)
+
+    def log(self, msg: str) -> None:
+        logger.debug("[%s@%s:%s] %s", self.id, self.ip_addr, self.port, msg)
+
+    # -- maintenance thread plumbing ---------------------------------------
+    def _start_maintenance_thread(self, body) -> None:
+        if self.maintenance_interval is None:
+            return
+        self._maint_stop.clear()
+
+        def loop():
+            last = time.monotonic()
+            while not self._maint_stop.is_set():
+                if time.monotonic() - last < self.maintenance_interval:
+                    time.sleep(0.01)
+                    continue
+                try:
+                    body()
+                except Exception as exc:  # catch-and-continue
+                    self.log(f"CAUGHT {exc} - CONTINUING")
+                last = time.monotonic()
+
+        self._maint_thread = threading.Thread(target=loop, daemon=True)
+        self._maint_thread.start()
+
+    def _stop_maintenance(self) -> None:
+        self._maint_stop.set()
+
+
+class ChordPeer(AbstractChordPeer):
+    """Plain Chord storage peer (ref ChordPeer, chord_peer.{h,cpp}):
+    unreplicated create/read against the key's successor; TextDb."""
+
+    def __init__(self, ip_addr: str, port: int, num_succs: int,
+                 backend: str = "python",
+                 maintenance_interval: Optional[float] = 5.0):
+        self.db = TextDb()
+        super().__init__(ip_addr, port, num_succs, backend,
+                         maintenance_interval)
+
+    def handlers(self):
+        return {
+            "JOIN": self.join_handler,
+            "NOTIFY": self.notify_handler,
+            "LEAVE": self.leave_handler,
+            "GET_SUCC": self.get_succ_handler,
+            "GET_PRED": self.get_pred_handler,
+            "CREATE_KEY": self.create_key_handler,
+            "READ_KEY": self.read_key_handler,
+            "RECTIFY": self.rectify_handler,
+        }
+
+    # -- create/read (chord_peer.cpp:77-177) --------------------------------
+    def create(self, key, val: str) -> None:
+        key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        if self.stored_locally(key):
+            self.db.insert(int(key), val)
+            return
+        succ = self.get_successor(key)
+        if not self.create_key(key, val, succ):
+            raise RuntimeError("Remote creation failed")
+
+    def create_key(self, key: Key, val: str, peer: RemotePeer) -> bool:
+        resp = peer.send_request({"COMMAND": "CREATE_KEY",
+                                  "KEY": str(key), "VALUE": val})
+        return bool(resp.get("SUCCESS"))
+
+    def create_key_handler(self, req: JsonObj) -> JsonObj:
+        key = Key.from_hex(req["KEY"])
+        if not self.stored_locally(key):
+            raise RuntimeError("Key not in range.")
+        self.db.insert(int(key), req["VALUE"])
+        return {}
+
+    def read(self, key) -> str:
+        key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        if self.stored_locally(key):
+            return self.db.lookup(int(key))
+        succ = self.get_successor(key)
+        return self.read_key(key, succ)
+
+    def read_key(self, key: Key, peer: RemotePeer) -> str:
+        resp = peer.send_request({"COMMAND": "READ_KEY", "KEY": str(key)})
+        if resp.get("SUCCESS"):
+            return resp["VALUE"]
+        raise RuntimeError("Key not stored on peer.")
+
+    def read_key_handler(self, req: JsonObj) -> JsonObj:
+        key = Key.from_hex(req["KEY"])
+        if not self.stored_locally(key):
+            raise RuntimeError("Key not stored locally.")
+        return {"VALUE": self.db.lookup(int(key))}
+
+    # -- routing (chord_peer.cpp:185-211) -----------------------------------
+    def forward_request(self, key: Key, request: JsonObj) -> JsonObj:
+        key_succ = self.finger_table.lookup(key)
+        if key_succ.id == self.id and self.predecessor is not None \
+                and self.predecessor.is_alive():
+            key_succ = self.predecessor
+        elif not key_succ.is_alive():
+            succ_lookup = self.successors.lookup(key)
+            if succ_lookup is not None and succ_lookup.is_alive():
+                key_succ = succ_lookup
+            else:
+                raise RuntimeError("Lookup failed")
+        return key_succ.send_request(request)
+
+    # -- key transfer (chord_peer.cpp:242-310) -------------------------------
+    def absorb_keys(self, kv_pairs: JsonObj) -> None:
+        for hex_key, val in (kv_pairs or {}).items():
+            self.db.insert(int(hex_key, 16), val)
+
+    def handle_notify_from_pred(self, new_pred: RemotePeer) -> JsonObj:
+        to_transfer = self.db.read_range(int(self.min_key), int(new_pred.id))
+        data = {format(k, "x"): v for k, v in to_transfer.items()}
+        for k in to_transfer:
+            self.db.delete(k)
+        self.finger_table.adjust_fingers(new_pred)
+        self.predecessor = new_pred
+        self.min_key = new_pred.id + 1
+        return {"KEYS_TO_ABSORB": data}
+
+    def handle_pred_failure(self, old_pred: RemotePeer) -> None:
+        self.finger_table.adjust_fingers(self.to_remote_peer())
+        self.rectify(old_pred)
+
+    def keys_as_json(self) -> JsonObj:
+        return {format(k, "x"): v for k, v in self.db.get_entries()}
+
+    def fail(self) -> None:
+        """Silent exit for fault injection (chord_peer.cpp:293-300)."""
+        self.log("Stopping server/stabilize loop now")
+        if self.server.is_alive():
+            self.server.kill()
+        self._stop_maintenance()
+
+    def start_maintenance(self) -> None:
+        self._start_maintenance_thread(self.stabilize)

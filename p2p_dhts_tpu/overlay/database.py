@@ -1,0 +1,75 @@
+"""Thread-safe per-peer database over the Merkle index.
+
+ref src/data_structures/database.h: GenericDB<V> = MerkleTree index +
+size counter behind read/write locks; aliases FragmentDb =
+GenericDB<DataFragment> and TextDb = GenericDB<std::string>
+(database.h:200-201).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from p2p_dhts_tpu.overlay.merkle_tree import MerkleTree
+
+
+class GenericDB:
+    """ref GenericDB<ValueType> (database.h:28-201)."""
+
+    def __init__(self):
+        self._index = MerkleTree()
+        self._size = 0
+        self._lock = threading.RLock()
+
+    def insert(self, key: int, val: object) -> None:
+        with self._lock:
+            existed = self._index.contains(key)
+            self._index.insert(int(key), val)
+            if not existed:
+                self._size += 1
+
+    def lookup(self, key: int) -> object:
+        with self._lock:
+            return self._index.lookup(int(key))
+
+    def update(self, key: int, val: object) -> None:
+        with self._lock:
+            self._index.update(int(key), val)
+
+    def delete(self, key: int) -> None:
+        with self._lock:
+            self._index.delete(int(key))
+            self._size -= 1
+
+    def contains(self, key: int) -> bool:
+        with self._lock:
+            return self._index.contains(int(key))
+
+    def read_range(self, lb: int, ub: int) -> Dict[int, object]:
+        with self._lock:
+            return self._index.read_range(lb, ub)
+
+    def next(self, key: int) -> Optional[Tuple[int, object]]:
+        with self._lock:
+            return self._index.next(key)
+
+    def get_entries(self) -> List[Tuple[int, object]]:
+        with self._lock:
+            return self._index.get_entries()
+
+    def get_index(self) -> MerkleTree:
+        return self._index
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return self._size
+
+    def __len__(self) -> int:
+        return self.size
+
+
+# ref database.h:200-201
+TextDb = GenericDB
+FragmentDb = GenericDB

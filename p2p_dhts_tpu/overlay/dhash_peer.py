@@ -1,0 +1,341 @@
+"""Host DHash peer: erasure-coded storage over the Chord overlay.
+
+Wire-parity re-implementation of src/dhash/dhash_peer.{h,cpp}: values are
+IDA-encoded DataBlocks whose n fragments stripe across the key's n
+successors; reads collect m distinct fragments; maintenance = Stabilize +
+global re-placement + Merkle-synchronized local repair every cycle, with
+the XCHNG_NODE node-exchange protocol and base-64 fragment wire forms.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from p2p_dhts_tpu.ida import DataBlock, DataFragment
+from p2p_dhts_tpu.keyspace import Key
+from p2p_dhts_tpu.net.rpc import JsonObj
+from p2p_dhts_tpu.overlay.chord_peer import AbstractChordPeer
+from p2p_dhts_tpu.overlay.database import FragmentDb
+from p2p_dhts_tpu.overlay.merkle_tree import MerkleNode, MerkleTree
+from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
+
+KeyRange = Tuple[Key, Key]
+
+
+class _RemoteNodeView:
+    """A serialized Merkle node received over XCHNG_NODE
+    (NonRecursiveSerialize form, merkle_tree.h:592-620)."""
+
+    def __init__(self, obj: JsonObj):
+        self.hash = int(obj["HASH"], 16)
+        self.min_key = int(obj["MIN_KEY"], 16)
+        self.max_key = int(obj["KEY"], 16)
+        self.position: List[int] = list(obj.get("POSITION") or [])
+        self._leaf = "KV_PAIRS" in obj
+        self.kv_keys: List[int] = [
+            int(k, 16) for k in (obj.get("KV_PAIRS") or {})
+        ]
+        self.children: List[JsonObj] = list(obj.get("CHILDREN") or [])
+
+    def is_leaf(self) -> bool:
+        return self._leaf
+
+    def child_hash(self, i: int) -> int:
+        return int(self.children[i]["HASH"], 16)
+
+
+class DHashPeer(AbstractChordPeer):
+    """ref DHashPeer (dhash_peer.h:20-81): num_succs doubles as the
+    replication factor n; IDA params default n=14 m=10 p=257
+    (dhash_peer.cpp:14-16)."""
+
+    def __init__(self, ip_addr: str, port: int, num_replicas: int,
+                 backend: str = "python",
+                 maintenance_interval: Optional[float] = 5.0):
+        self.db = FragmentDb()
+        self.n, self.m, self.p = 14, 10, 257
+        super().__init__(ip_addr, port, num_replicas, backend,
+                         maintenance_interval)
+
+    def handlers(self):
+        return {
+            "JOIN": self.join_handler,
+            "NOTIFY": self.notify_handler,
+            "LEAVE": self.leave_handler,
+            "GET_SUCC": self.get_succ_handler,
+            "GET_PRED": self.get_pred_handler,
+            "CREATE_KEY": self.create_key_handler,
+            "READ_KEY": self.read_key_handler,
+            "READ_RANGE": self.read_range_handler,
+            "XCHNG_NODE": self.exchange_node_handler,
+            "RECTIFY": self.rectify_handler,
+        }
+
+    # -- IDA params (dhash_peer.cpp:488-498) ---------------------------------
+    def get_ida_params(self) -> Tuple[int, int, int]:
+        return self.n, self.m, self.p
+
+    def set_ida_params(self, n: int, m: int, p: int) -> None:
+        self.n, self.m, self.p = n, m, p
+
+    # -- create (dhash_peer.cpp:89-154) --------------------------------------
+    def create(self, key, val: str) -> None:
+        key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        block = DataBlock(val, self.n, self.m, self.p)
+        self.create_block(key, block)
+
+    def create_block(self, key: Key, block: DataBlock) -> None:
+        succ_list = self.get_n_successors(key, self.n)
+        if len(succ_list) < self.m:
+            raise RuntimeError(
+                "Insufficient succs in list to complete request.")
+        num_replicas = 0
+        for i, succ in enumerate(succ_list):
+            frag = block.fragments[i]
+            if succ.id == self.id:
+                self.db.insert(int(key), frag)
+                num_replicas += 1
+            elif succ.is_alive():
+                try:
+                    if self.create_key(key, frag, succ):
+                        num_replicas += 1
+                except RuntimeError:
+                    pass
+        if num_replicas < self.m:
+            raise RuntimeError("Too few succs responded to requests.")
+
+    def create_key(self, key: Key, frag: DataFragment,
+                   peer: RemotePeer) -> bool:
+        resp = peer.send_request({"COMMAND": "CREATE_KEY",
+                                  "KEY": str(key),
+                                  "VALUE": frag.to_json()})
+        return bool(resp.get("SUCCESS"))
+
+    def create_key_handler(self, req: JsonObj) -> JsonObj:
+        key = Key.from_hex(req["KEY"])
+        if self.db.contains(int(key)):
+            raise RuntimeError("Key already exists in db.")
+        self.db.insert(int(key), DataFragment.from_json(req["VALUE"]))
+        return {}
+
+    # -- read (dhash_peer.cpp:156-217) ---------------------------------------
+    def read(self, key) -> str:
+        key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        return self.read_block(key).decode()
+
+    def read_block(self, key: Key) -> DataBlock:
+        succ_list = self.get_n_successors(key, self.num_succs)
+        fragments: Dict[int, DataFragment] = {}
+        for succ in succ_list:
+            if len(fragments) == self.m:
+                break
+            if succ.id == self.id and self.db.contains(int(key)):
+                frag = self.db.lookup(int(key))
+                fragments[frag.index] = frag
+            else:
+                try:
+                    frag = self.read_key(key, succ)
+                    fragments[frag.index] = frag
+                except RuntimeError:
+                    continue
+        if len(fragments) < self.m:
+            raise RuntimeError(f"Less than {self.m} distinct frags.")
+        return DataBlock(fragments=list(fragments.values()),
+                         n=self.n, m=self.m, p=self.p)
+
+    def read_key(self, key: Key, peer: RemotePeer) -> DataFragment:
+        resp = peer.send_request({"COMMAND": "READ_KEY", "KEY": str(key)})
+        return DataFragment.from_json(resp["VALUE"])
+
+    def read_key_handler(self, req: JsonObj) -> JsonObj:
+        key = Key.from_hex(req["KEY"])
+        return {"VALUE": self.db.lookup(int(key)).to_json()}
+
+    # -- range transfer (dhash_peer.cpp:219-253) -----------------------------
+    def read_range_rpc(self, succ: RemotePeer,
+                       key_range: KeyRange) -> Dict[int, DataFragment]:
+        resp = succ.send_request({
+            "COMMAND": "READ_RANGE",
+            "LOWER_BOUND": str(key_range[0]),
+            "UPPER_BOUND": str(key_range[1]),
+        })
+        return {
+            int(kv["KEY"], 16): DataFragment.from_json(kv["VAL"])
+            for kv in (resp.get("KV_PAIRS") or [])
+        }
+
+    def read_range_handler(self, req: JsonObj) -> JsonObj:
+        lb = Key.from_hex(req["LOWER_BOUND"])
+        ub = Key.from_hex(req["UPPER_BOUND"])
+        pairs = [
+            {"KEY": format(k, "x"), "VAL": frag.to_json()}
+            for k, frag in self.db.read_range(int(lb), int(ub)).items()
+        ]
+        self.log(f"Received read range {lb}-{ub}")
+        return {"KV_PAIRS": pairs}
+
+    # -- maintenance (dhash_peer.cpp:265-365) --------------------------------
+    def start_maintenance(self) -> None:
+        def body():
+            self.stabilize()
+            self.run_global_maintenance()
+            self.run_local_maintenance()
+        self._start_maintenance_thread(body)
+
+    def run_global_maintenance(self) -> None:
+        """Walk own DB ring-wise; push misplaced keys to their true
+        successors and delete locally (dhash_peer.cpp:298-348)."""
+        self.log("running global maintenance")
+        current_key = Key(self.id)
+        nxt = self.db.next(int(self.id))
+        starting_key = Key(nxt[0]) if nxt is not None else Key(0)
+        first_iter = True
+        while self.db.next(int(current_key)) is not None:
+            k, _ = self.db.next(int(current_key))
+            next_key = Key(k)
+            if next_key.in_between(self.id, starting_key, True) \
+                    and not first_iter:
+                break
+            first_iter = False
+            succs = self.get_n_successors(next_key, self.n)
+            misplaced = all(s.id != self.id for s in succs)
+            if misplaced and succs:
+                for succ in succs:
+                    try:
+                        have_remote = self.read_range_rpc(
+                            succ, (next_key, succs[0].id))
+                    except RuntimeError:
+                        continue
+                    local = self.db.read_range(int(next_key),
+                                               int(succs[0].id))
+                    for key_int, frag in local.items():
+                        if key_int not in have_remote:
+                            try:
+                                self.create_key(Key(key_int), frag, succ)
+                                self.db.delete(key_int)
+                            except (RuntimeError, KeyError):
+                                pass
+            current_key = succs[0].id if succs else next_key
+        self.log("Global maintenance over")
+
+    def run_local_maintenance(self) -> None:
+        """Merkle-sync own range with every successor
+        (dhash_peer.cpp:350-365)."""
+        self.log("Running local maintenance")
+        if self.db.size == 0:
+            return
+        for i in range(self.successors.size()):
+            succ = self.successors.get_nth_entry(i)
+            if succ.id != self.id:
+                try:
+                    self.synchronize(succ, (self.min_key, Key(self.id)))
+                except RuntimeError:
+                    continue
+        self.log("Local maintenance over")
+
+    def retrieve_missing(self, key: Key) -> None:
+        """Read the whole block, store ONE RANDOM fragment — the
+        reference's exact (quirky) behavior (dhash_peer.cpp:367-379)."""
+        block = self.read_block(key)
+        frag = random.choice(block.fragments)
+        self.db.insert(int(key), frag)
+
+    # -- Merkle sync protocol (dhash_peer.cpp:381-481) -----------------------
+    def synchronize(self, succ: RemotePeer, key_range: KeyRange) -> None:
+        self._synchronize_helper(succ, key_range, self.db.get_index().root)
+
+    def _synchronize_helper(self, succ: RemotePeer, key_range: KeyRange,
+                            local_node: MerkleNode) -> None:
+        remote_node = self.exchange_node(succ, local_node, key_range)
+        self.compare_nodes(remote_node, local_node, succ, key_range)
+        if not remote_node.is_leaf() and not local_node.is_leaf():
+            for i, child in enumerate(local_node.children):
+                if remote_node.child_hash(i) != child.hash:
+                    self._synchronize_helper(succ, key_range, child)
+
+    def compare_nodes(self, remote_node: _RemoteNodeView,
+                      local_node: MerkleNode, succ: RemotePeer,
+                      key_range: KeyRange) -> None:
+        """ref CompareNodes (dhash_peer.cpp:416-441)."""
+        if remote_node.is_leaf():
+            for k in remote_node.kv_keys:
+                if self.is_missing(Key(k), key_range):
+                    self.retrieve_missing(Key(k))
+        elif local_node.is_leaf():
+            # Shape mismatch: pull everything the remote has in this range.
+            succ_kvs = self.read_range_rpc(
+                succ, (Key(local_node.min_key),
+                       Key(local_node.max_key - 1)))
+            for k in succ_kvs:
+                if self.is_missing(Key(k), key_range):
+                    self.retrieve_missing(Key(k))
+
+    def is_missing(self, k: Key, key_range: KeyRange) -> bool:
+        return k.in_between(key_range[0], key_range[1], True) \
+            and not self.db.contains(int(k))
+
+    def exchange_node(self, succ: RemotePeer, node: MerkleNode,
+                      key_range: KeyRange) -> _RemoteNodeView:
+        resp = succ.send_request({
+            "COMMAND": "XCHNG_NODE",
+            "NODE": MerkleTree.serialize_node(node, children=True),
+            "REQUESTER": self.peer_as_json(),
+            "LOWER_BOUND": str(key_range[0]),
+            "UPPER_BOUND": str(key_range[1]),
+        })
+        return _RemoteNodeView(resp)
+
+    def exchange_node_handler(self, req: JsonObj) -> JsonObj:
+        remote_node = _RemoteNodeView(req["NODE"])
+        local_node = self.db.get_index().lookup_by_position(
+            remote_node.position)
+        requester = RemotePeer.from_json(req["REQUESTER"])
+        key_range = (Key.from_hex(req["LOWER_BOUND"]),
+                     Key.from_hex(req["UPPER_BOUND"]))
+        self.compare_nodes(remote_node, local_node, requester, key_range)
+        return MerkleTree.serialize_node(local_node, children=True)
+
+    # -- routing: LookupLiving fallback variant (dhash_peer.cpp:500-529) -----
+    def forward_request(self, key: Key, request: JsonObj) -> JsonObj:
+        key_succ = self.finger_table.lookup(key)
+        if key_succ.id == self.id and self.predecessor is not None \
+                and self.predecessor.is_alive():
+            key_succ = self.predecessor
+        elif not key_succ.is_alive():
+            succ_lookup = self.successors.lookup_living(key)
+            if succ_lookup is not None:
+                key_succ = succ_lookup
+            elif self.successors.size() > 0 \
+                    and self.successors.get_nth_entry(0).is_alive():
+                key_succ = self.successors.get_nth_entry(0)
+            else:
+                raise RuntimeError("Lookup failed")
+        return key_succ.send_request(request)
+
+    # -- joins don't move keys in DHash (dhash_peer.cpp:556-570) -------------
+    def absorb_keys(self, kv_pairs: JsonObj) -> None:
+        pass
+
+    def keys_as_json(self) -> JsonObj:
+        return {}
+
+    def handle_notify_from_pred(self, new_pred: RemotePeer) -> JsonObj:
+        """ref dhash_peer.cpp:531-545 — no key transfer, just links."""
+        self.finger_table.adjust_fingers(new_pred)
+        self.predecessor = new_pred
+        self.min_key = new_pred.id + 1
+        if self.successors.size() == 0:
+            self.successors.populate(
+                self.get_n_successors(self.id + 1, self.num_succs))
+        return {}
+
+    def handle_pred_failure(self, old_pred: RemotePeer) -> None:
+        self.finger_table.adjust_fingers(self.to_remote_peer())
+        self.rectify(old_pred)
+
+    def fail(self) -> None:
+        self.log("Stopping server/stabilize loop now")
+        if self.server.is_alive():
+            self.server.kill()
+        self._stop_maintenance()

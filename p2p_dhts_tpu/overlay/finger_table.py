@@ -1,0 +1,159 @@
+"""128-entry finger table with a ``backend="jax"`` batched lookup path.
+
+ref src/data_structures/finger_table.h: entry i covers
+[start + 2^i, start + 2^(i+1) - 1] (GetNthRange, finger_table.h:177-188);
+Lookup returns the successor of the range containing the key via a linear
+scan (finger_table.h:115-130); AdjustFingers rewrites entries covered by
+a new peer's range (finger_table.h:148-157); ReplaceDeadPeer swaps every
+entry naming a dead peer (finger_table.h:159-168).
+
+The jax backend is the BASELINE.json north star hook: the table's ranges
+are fixed, so "which entry contains key k" is bit_length((k - start) mod
+2^128) - 1 — the O(1) closed form of the linear scan — and a BATCH of
+keys resolves as one vectorized device op (lookup_batch) instead of B
+scans of 128 InBetween evaluations on wide ints.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key, ints_to_lanes
+from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
+
+
+class Finger:
+    """ref struct Finger (finger_table.h:20-28)."""
+
+    __slots__ = ("lower_bound", "upper_bound", "successor")
+
+    def __init__(self, lower_bound: Key, upper_bound: Key,
+                 successor: RemotePeer):
+        self.lower_bound = Key(lower_bound)
+        self.upper_bound = Key(upper_bound)
+        self.successor = successor
+
+
+class FingerTable:
+    """ref FingerTable<PeerType> (finger_table.h:30-288)."""
+
+    NUM_ENTRIES = 128  # binary key length (finger_table.h:44, key.h:152-155)
+
+    def __init__(self, starting_key: Key, backend: str = "python"):
+        if backend not in ("python", "jax"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.starting_key = Key(starting_key)
+        self.backend = backend
+        self._table: List[Finger] = []
+        self._lock = threading.RLock()
+
+    # -- structure ---------------------------------------------------------
+    def add_finger(self, finger: Finger) -> None:
+        with self._lock:
+            self._table.append(finger)
+
+    def get_nth_entry(self, n: int) -> RemotePeer:
+        with self._lock:
+            return self._table[n].successor
+
+    def edit_nth_finger(self, n: int, succ: RemotePeer) -> None:
+        with self._lock:
+            self._table[n].successor = succ
+
+    def get_nth_range(self, n: int) -> Tuple[Key, Key]:
+        """[start + 2^n, start + 2^(n+1) - 1] mod ring
+        (finger_table.h:177-188)."""
+        lb = (int(self.starting_key) + (1 << n)) % KEYS_IN_RING
+        ub = ((int(self.starting_key) + (1 << (n + 1))) % KEYS_IN_RING - 1) \
+            % KEYS_IN_RING
+        return Key(lb), Key(ub)
+
+    def empty(self) -> bool:
+        with self._lock:
+            return not self._table
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    # -- lookup ------------------------------------------------------------
+    def lookup(self, key: Key) -> RemotePeer:
+        """Successor of the range containing key (finger_table.h:115-130).
+
+        python backend: the reference's linear scan, verbatim.
+        jax backend: O(1) closed form (the scan's unique hit is entry
+        bit_length(dist) - 1).
+        """
+        with self._lock:
+            if self.backend == "jax" and len(self._table) == self.NUM_ENTRIES:
+                dist = (int(key) - int(self.starting_key)) % KEYS_IN_RING
+                if dist == 0:
+                    raise LookupError("ChordKey not found")
+                return self._table[dist.bit_length() - 1].successor
+            for finger in self._table:
+                if Key(key).in_between(finger.lower_bound,
+                                       finger.upper_bound, True):
+                    return finger.successor
+            raise LookupError("ChordKey not found")
+
+    def lookup_batch(self, keys: Sequence[Key]) -> List[RemotePeer]:
+        """Resolve a batch of keys in one vectorized op (jax backend) —
+        the device analog of B linear scans."""
+        with self._lock:
+            if len(self._table) != self.NUM_ENTRIES:
+                return [self.lookup(k) for k in keys]
+            start = int(self.starting_key)
+            if self.backend == "jax":
+                from p2p_dhts_tpu.ops import u128
+                import jax.numpy as jnp
+                q = jnp.asarray(ints_to_lanes([int(k) for k in keys]))
+                s = jnp.asarray(ints_to_lanes([start] * len(keys)))
+                d = u128.sub(q, s)
+                idx = np.asarray(u128.bit_length(d)) - 1
+            else:
+                idx = [((int(k) - start) % KEYS_IN_RING).bit_length() - 1
+                       for k in keys]
+            out = []
+            for i in idx:
+                if i < 0:
+                    raise LookupError("ChordKey not found")
+                out.append(self._table[int(i)].successor)
+            return out
+
+    # -- repairs -----------------------------------------------------------
+    def adjust_fingers(self, new_peer: RemotePeer) -> None:
+        """Point entries whose range start lies in [new.min_key, new.id]
+        at the new peer (finger_table.h:148-157)."""
+        with self._lock:
+            for finger in self._table:
+                if finger.lower_bound.in_between(new_peer.min_key,
+                                                 new_peer.id, True):
+                    finger.successor = new_peer
+
+    def replace_dead_peer(self, dead: RemotePeer,
+                          replacement: RemotePeer) -> None:
+        """finger_table.h:159-168."""
+        with self._lock:
+            for finger in self._table:
+                if finger.successor.id == dead.id:
+                    finger.successor = replacement
+
+    # -- wire form (finger_table.h:249-265) ---------------------------------
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "STARTING_KEY": str(self.starting_key),
+                "FINGERS": [
+                    {"LOWER_BOUND": str(f.lower_bound),
+                     "UPPER_BOUND": str(f.upper_bound),
+                     "SUCCESSOR": f.successor.to_json()}
+                    for f in self._table
+                ],
+            }
+
+    def get_entries(self) -> List[Finger]:
+        with self._lock:
+            return list(self._table)

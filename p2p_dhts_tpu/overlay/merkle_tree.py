@@ -1,0 +1,245 @@
+"""Host Merkle tree, hash-compatible with the reference's MerkleTree.
+
+Mirrors src/data_structures/merkle_tree.h: an 8-ary tree partitioning the
+whole 2^128 keyspace; leaves split at more than 8 kv-pairs
+(merkle_tree.h:126-128); node hashes are SHA-1 (the same UUIDv5 derivation
+as ids) of concatenated KEY hex strings at leaves — values are NOT hashed
+(merkle_tree.h:724-749, a deliberate reference property: value updates are
+invisible to sync) — and of concatenated child hashes at internal nodes;
+empty nodes hash to 0. Keys route to children by depth-scaled 3-bit shifts
+(ChildNum, merkle_tree.h:704-722). Ranges are ring-aware (wrapped
+ReadRange splits, merkle_tree.h:168-219; wrap-around Next,
+merkle_tree.h:280-321). NonRecursiveSerialize sends one node plus its
+children with keys-only leaves for the XCHNG_NODE sync protocol
+(merkle_tree.h:592-620).
+
+This host tree backs the per-peer databases of the wire-parity overlay;
+the batched device analog is p2p_dhts_tpu.dhash.merkle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, sha1_id
+
+NUM_CHILDREN = 8          # merkle_tree.h:790-791
+CHILD_BITS = 3            # log2(8)
+MAX_LEAF_SIZE = 8         # leaf splits at > 8 entries (merkle_tree.h:126-128)
+KEY_BITS = 128
+
+
+def _hex(v: int) -> str:
+    """Hex without leading zeros (IntToHexStr, key.h:41-47); 0 -> '0'."""
+    return format(v, "x")
+
+
+class MerkleNode:
+    """One node: covers [min_key, max_key); leaf iff no children."""
+
+    __slots__ = ("min_key", "max_key", "hash", "position", "children", "data")
+
+    def __init__(self, min_key: int, max_key: int,
+                 position: Optional[List[int]] = None):
+        self.min_key = min_key
+        self.max_key = max_key
+        self.hash = 0
+        self.position: List[int] = list(position or [])
+        self.children: List["MerkleNode"] = []
+        self.data: Dict[int, object] = {}
+
+    # -- structure ---------------------------------------------------------
+    def is_leaf(self) -> bool:
+        return not self.children
+
+    def depth(self) -> int:
+        return len(self.position)
+
+    def child_num(self, key: int) -> int:
+        """Route a key to a child slot (ref ChildNum,
+        merkle_tree.h:704-722)."""
+        if key >= self.max_key:
+            return NUM_CHILDREN - 1
+        if key < self.min_key:
+            return 0
+        shift = KEY_BITS - CHILD_BITS * (self.depth() + 1)
+        return (key >> shift) & (NUM_CHILDREN - 1)
+
+    def _create_children(self) -> None:
+        """Split this leaf's range into 8 equal slices and distribute its
+        data (ref CreateChildren, merkle_tree.h:755-779)."""
+        key_range = self.max_key - self.min_key
+        last = self.min_key
+        items = sorted(self.data.items())
+        self.data = {}
+        it = 0
+        for i in range(NUM_CHILDREN):
+            ub = last + key_range // NUM_CHILDREN
+            child = MerkleNode(last, ub, self.position + [i])
+            while it < len(items) and last <= items[it][0] <= ub - 1:
+                child.data[items[it][0]] = items[it][1]
+                it += 1
+            child.rehash()
+            self.children.append(child)
+            last = ub
+
+    def rehash(self) -> None:
+        """ref Rehash (merkle_tree.h:724-749): leaf hash covers KEYS only;
+        internal = hash of concatenated child hex hashes; empty -> 0."""
+        if self.is_leaf():
+            if not self.data:
+                self.hash = 0
+                return
+            concat = "".join(_hex(k) for k in sorted(self.data))
+        else:
+            concat = "".join(_hex(c.hash) for c in self.children)
+            if concat == "0" * NUM_CHILDREN:
+                self.hash = 0
+                return
+        self.hash = sha1_id(concat)
+
+    # -- ops ---------------------------------------------------------------
+    def insert(self, key: int, val: object) -> None:
+        if self.is_leaf():
+            self.data[key] = val
+            if len(self.data) > MAX_LEAF_SIZE:
+                self._create_children()
+        else:
+            self.children[self.child_num(key)].insert(key, val)
+        self.rehash()
+
+    def lookup(self, key: int) -> object:
+        if self.is_leaf():
+            if key not in self.data:
+                raise KeyError("Key nonexistent.")
+            return self.data[key]
+        return self.children[self.child_num(key)].lookup(key)
+
+    def contains(self, key: int) -> bool:
+        if self.is_leaf():
+            return key in self.data
+        return self.children[self.child_num(key)].contains(key)
+
+    def update(self, key: int, val: object) -> None:
+        if self.is_leaf():
+            if key not in self.data:
+                raise KeyError("Key nonexistent.")
+            self.data[key] = val
+        else:
+            self.children[self.child_num(key)].update(key, val)
+        self.rehash()
+
+    def delete(self, key: int) -> None:
+        if self.is_leaf():
+            if key not in self.data:
+                raise KeyError("Key nonexistent.")
+            del self.data[key]
+        else:
+            self.children[self.child_num(key)].delete(key)
+        self.rehash()
+
+    def entries(self) -> Iterator[Tuple[int, object]]:
+        if self.is_leaf():
+            yield from sorted(self.data.items())
+        else:
+            for child in self.children:
+                yield from child.entries()
+
+    def read_simple_range(self, lb: int, ub: int) -> Dict[int, object]:
+        """Keys in [lb, ub] inclusive, non-wrapped."""
+        if ub < self.min_key or lb >= self.max_key:
+            return {}
+        if self.is_leaf():
+            return {k: v for k, v in sorted(self.data.items())
+                    if lb <= k <= ub}
+        out: Dict[int, object] = {}
+        for child in self.children:
+            out.update(child.read_simple_range(lb, ub))
+        return out
+
+
+class MerkleTree:
+    """Public tree API over the root node (ref MerkleTree<ValType>,
+    merkle_tree.h:28-788)."""
+
+    def __init__(self):
+        self.root = MerkleNode(0, KEYS_IN_RING)
+
+    # -- CRUD --------------------------------------------------------------
+    def insert(self, key: int, val: object) -> None:
+        self.root.insert(int(key), val)
+
+    def lookup(self, key: int) -> object:
+        return self.root.lookup(int(key))
+
+    def contains(self, key: int) -> bool:
+        return self.root.contains(int(key))
+
+    def update(self, key: int, val: object) -> None:
+        self.root.update(int(key), val)
+
+    def delete(self, key: int) -> None:
+        self.root.delete(int(key))
+
+    def get_entries(self) -> List[Tuple[int, object]]:
+        return list(self.root.entries())
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.root.entries())
+
+    @property
+    def hash(self) -> int:
+        return self.root.hash
+
+    # -- ring-aware reads (merkle_tree.h:168-219, 280-321) ------------------
+    def read_range(self, lb: int, ub: int) -> Dict[int, object]:
+        """Clockwise [lb, ub] inclusive; wrapped ranges split in two."""
+        lb, ub = int(lb) % KEYS_IN_RING, int(ub) % KEYS_IN_RING
+        if lb <= ub:
+            return self.root.read_simple_range(lb, ub)
+        out = self.root.read_simple_range(lb, KEYS_IN_RING - 1)
+        out.update(self.root.read_simple_range(0, ub))
+        return out
+
+    def next(self, key: int) -> Optional[Tuple[int, object]]:
+        """First stored kv strictly after key, wrapping; None if empty."""
+        key = int(key) % KEYS_IN_RING
+        after = self.root.read_simple_range(key + 1, KEYS_IN_RING - 1)
+        if after:
+            k = min(after)
+            return k, after[k]
+        rest = self.root.read_simple_range(0, key)
+        if rest:
+            k = min(rest)
+            return k, rest[k]
+        return None
+
+    # -- sync protocol support ---------------------------------------------
+    def lookup_by_position(self, position: Sequence[int]) -> MerkleNode:
+        """Follow a child-index path from the root (ref LookupByPosition,
+        merkle_tree.h:330-349)."""
+        node = self.root
+        for step in position:
+            if node.is_leaf():
+                raise KeyError("Position beyond leaf.")
+            node = node.children[step]
+        return node
+
+    @staticmethod
+    def serialize_node(node: MerkleNode, children: bool = True) -> dict:
+        """ref NonRecursiveSerialize (merkle_tree.h:592-620): HASH +
+        range + keys-only KV_PAIRS at leaves + one level of CHILDREN."""
+        out = {
+            "HASH": _hex(node.hash),
+            "MIN_KEY": _hex(node.min_key),
+            "KEY": _hex(node.max_key),
+            "POSITION": list(node.position),
+        }
+        if node.is_leaf():
+            out["KV_PAIRS"] = {_hex(k): "" for k in sorted(node.data)}
+        elif children:
+            out["CHILDREN"] = [
+                MerkleTree.serialize_node(c, children=False)
+                for c in node.children
+            ]
+        return out

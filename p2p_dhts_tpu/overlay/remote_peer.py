@@ -1,0 +1,203 @@
+"""RemotePeer stub + ring-sorted successor list.
+
+ref src/chord/remote_peer.{h,cpp} and remote_peer_list.{h,cpp}: a remote
+peer is {id, min_key, ip, port}; every send is gated on a TCP liveness
+probe and raises on a SUCCESS=false envelope (remote_peer.cpp:28-41);
+the successor list is a bounded vector kept in clockwise order relative
+to its owner's id with a hand-rolled insert (std::set can't express the
+ring order — remote_peer_list.cpp:31-84).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from p2p_dhts_tpu.keyspace import Key
+from p2p_dhts_tpu.net.rpc import Client, JsonObj
+
+
+class RemotePeer:
+    """ref class RemotePeer (remote_peer.h)."""
+
+    def __init__(self, id: Key, min_key: Key, ip_addr: str, port: int):
+        self.id = Key(id)
+        self.min_key = Key(min_key)
+        self.ip_addr = ip_addr
+        self.port = int(port)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_json(cls, obj: JsonObj) -> "RemotePeer":
+        """ref RemotePeer(const Json::Value&) (remote_peer.cpp:21-26)."""
+        if not obj.get("PORT"):
+            raise ValueError("Corrupted JSON")
+        return cls(Key.from_hex(obj["ID"]), Key.from_hex(obj["MIN_KEY"]),
+                   obj["IP_ADDR"], int(obj["PORT"]))
+
+    def to_json(self) -> JsonObj:
+        """ref operator Json::Value (remote_peer.cpp:85-93)."""
+        return {"IP_ADDR": self.ip_addr, "PORT": self.port,
+                "ID": str(self.id), "MIN_KEY": str(self.min_key)}
+
+    # -- RPC ---------------------------------------------------------------
+    def is_alive(self) -> bool:
+        return Client.is_alive(self.ip_addr, self.port)
+
+    def send_request(self, request: JsonObj) -> JsonObj:
+        """ref SendRequest (remote_peer.cpp:28-41): liveness gate, raise
+        on SUCCESS=false."""
+        if not self.is_alive():
+            raise RuntimeError("Peer is down.")
+        resp = Client.make_request(self.ip_addr, self.port, request)
+        if resp.get("SUCCESS"):
+            return resp
+        raise RuntimeError(f"Failed request: {resp}")
+
+    def get_succ(self) -> "RemotePeer":
+        """GET_SUCC(id + 1) (remote_peer.cpp:48-57)."""
+        resp = self.send_request({"COMMAND": "GET_SUCC",
+                                  "KEY": str(self.id + 1)})
+        return RemotePeer.from_json(resp)
+
+    def get_pred(self) -> "RemotePeer":
+        """GET_PRED(id) (remote_peer.cpp:59-68)."""
+        resp = self.send_request({"COMMAND": "GET_PRED",
+                                  "KEY": str(self.id)})
+        return RemotePeer.from_json(resp)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, RemotePeer):
+            return NotImplemented
+        return (self.ip_addr == other.ip_addr and self.id == other.id
+                and self.min_key == other.min_key and self.port == other.port)
+
+    def __lt__(self, other: "RemotePeer") -> bool:
+        return self.id < other.id
+
+    def __repr__(self) -> str:
+        return f"RemotePeer({self.id}@{self.ip_addr}:{self.port})"
+
+
+class RemotePeerList:
+    """Bounded clockwise-sorted peer list (ref RemotePeerList,
+    remote_peer_list.{h,cpp})."""
+
+    def __init__(self, max_entries: int, starting_key: Key):
+        self.max_entries = max_entries
+        self.starting_key = Key(starting_key)
+        self._peers: List[RemotePeer] = []
+        self._lock = threading.RLock()
+
+    def populate(self, peers: List[RemotePeer]) -> None:
+        with self._lock:
+            self._peers = list(peers)
+
+    def insert(self, new_peer: RemotePeer) -> bool:
+        """Clockwise insert relative to starting_key
+        (remote_peer_list.cpp:31-84); dedup by id; evict the tail when
+        over capacity."""
+        with self._lock:
+            if new_peer.port == 0:
+                raise RuntimeError("Corrupted JSON")
+            if not self._peers:
+                self._peers.append(new_peer)
+                return True
+            prev = self.starting_key
+            for i, entry in enumerate(self._peers):
+                if new_peer.id == entry.id:
+                    return False
+                if new_peer.id.in_between(prev, entry.id, True):
+                    self._peers.insert(i, new_peer)
+                    if len(self._peers) > self.max_entries:
+                        self._peers.pop()
+                    return True
+                prev = entry.id
+            if len(self._peers) < self.max_entries:
+                self._peers.append(new_peer)
+                return True
+            return False
+
+    def lookup(self, key: Key, succ: bool = True) -> Optional[RemotePeer]:
+        """Owning entry of key (or its predecessor entry when succ=False)
+        (remote_peer_list.cpp:86-110)."""
+        with self._lock:
+            prev = self.starting_key
+            for i, entry in enumerate(self._peers):
+                if Key(key).in_between(prev, entry.id, True):
+                    if succ:
+                        return entry
+                    return self._peers[i - 1] if i != 0 else None
+                prev = entry.id
+            return None
+
+    def lookup_living(self, key: Key) -> Optional[RemotePeer]:
+        """First alive entry at-or-after the owning one
+        (remote_peer_list.cpp:112-132 — NOTE: the reference's fallback
+        loop condition `i % size < succ_ind` is false on its first
+        iteration, so its scan never runs; here the scan actually works,
+        a documented fix of that defect)."""
+        with self._lock:
+            succ = self.lookup(key, True)
+            if succ is None:
+                return None
+            if succ.is_alive():
+                return succ
+            start = self.get_index(succ)
+            for off in range(1, len(self._peers)):
+                peer = self._peers[(start + off) % len(self._peers)]
+                if peer.is_alive():
+                    return peer
+            return None
+
+    def delete(self, id_or_peer) -> None:
+        with self._lock:
+            target = id_or_peer.id if isinstance(id_or_peer, RemotePeer) \
+                else Key(id_or_peer)
+            for i, entry in enumerate(self._peers):
+                if entry.id == target:
+                    del self._peers[i]
+                    return
+
+    def erase(self) -> None:
+        with self._lock:
+            self._peers = []
+
+    def contains(self, peer: RemotePeer) -> bool:
+        with self._lock:
+            return any(p.id == peer.id for p in self._peers)
+
+    def get_nth_entry(self, n: int) -> RemotePeer:
+        with self._lock:
+            return self._peers[n]
+
+    def first_living(self) -> RemotePeer:
+        with self._lock:
+            peers = list(self._peers)
+        for p in peers:
+            if p.is_alive():
+                return p
+        raise RuntimeError("No living peers")
+
+    def get_index(self, peer: RemotePeer) -> int:
+        with self._lock:
+            for i, p in enumerate(self._peers):
+                if p.id == peer.id:
+                    return i
+            return -1
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._peers)
+
+    def get_entries(self) -> List[RemotePeer]:
+        with self._lock:
+            return list(self._peers)
+
+    def to_json(self) -> JsonObj:
+        with self._lock:
+            return {
+                "MAX_ENTRIES": self.max_entries,
+                "STARTING_KEY": str(self.starting_key),
+                "PEERS": [p.to_json() for p in self._peers],
+            }
