@@ -87,20 +87,31 @@ class Client:
     def make_request(ip_addr: str, port: int, request: JsonObj,
                      timeout: float = DEFAULT_TIMEOUT_S) -> JsonObj:
         payload = json.dumps(request, separators=(",", ":")).encode()
-        with socket.create_connection((ip_addr, port),
-                                      timeout=timeout) as sock:
-            sock.sendall(payload)
-            sock.shutdown(socket.SHUT_WR)
-            sock.settimeout(timeout)
-            chunks = []
-            try:
-                while True:
-                    chunk = sock.recv(65536)
-                    if not chunk:
-                        break
-                    chunks.append(chunk)
-            except socket.timeout:
-                raise RpcError("RPC reply timed out")
+        # Every transport failure surfaces as RpcError (a RuntimeError):
+        # the reference throws boost::system::system_error, which IS-A
+        # std::runtime_error, so its catch(runtime_error) recovery paths
+        # absorb peers dying mid-request (client.cpp:51-96). A raw
+        # ConnectionRefused/ResetError here would bypass every
+        # `except RuntimeError` in the overlay and crash stabilize().
+        try:
+            with socket.create_connection((ip_addr, port),
+                                          timeout=timeout) as sock:
+                sock.sendall(payload)
+                sock.shutdown(socket.SHUT_WR)
+                sock.settimeout(timeout)
+                chunks = []
+                try:
+                    while True:
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            break
+                        chunks.append(chunk)
+                except socket.timeout:
+                    raise RpcError("RPC reply timed out")
+        except RpcError:
+            raise
+        except OSError as exc:
+            raise RpcError(f"RPC transport failure: {exc}") from exc
         raw = b"".join(chunks).decode("utf-8", errors="replace")
         try:
             # raw_decode parses the first complete JSON value and ignores
@@ -139,6 +150,8 @@ class Server:
             self.port = self._sock.getsockname()[1]
         self._alive = True
         self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def run_in_background(self) -> None:
@@ -149,14 +162,41 @@ class Server:
         self._accept_thread.start()
 
     def kill(self) -> None:
-        """Close the acceptor (ref Server::Kill, server.h:354-361)."""
+        """Close the acceptor and all in-flight sessions (ref Server::Kill,
+        server.h:354-361). Deterministic: after kill() returns, the accept
+        thread has exited and no socket owned by this server is open, so a
+        connect probe gets an immediate refusal rather than racing a
+        half-dead acceptor."""
         if not self._alive:
             return
         self._alive = False
         try:
+            # shutdown() wakes a thread blocked in accept(2) — close()
+            # alone does NOT on Linux (the blocked syscall pins the open
+            # file description), which would leave a zombie accept that
+            # consumes the first post-kill connect probe.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # ENOTCONN on some platforms; close still follows
+        try:
             self._sock.close()
         except OSError:
             pass
+        if self._accept_thread is not None and \
+                self._accept_thread is not threading.current_thread():
+            self._accept_thread.join(timeout=DEFAULT_TIMEOUT_S)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                # shutdown(), not close(): close() from this thread leaves
+                # a worker blocked in recv() (same accept(2) fact as above)
+                # and frees the fd number for reuse by another server in
+                # this process; shutdown() wakes the worker and lets its
+                # own `with conn:` do the close.
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._pool.shutdown(wait=False)
 
     def is_alive(self) -> bool:
@@ -173,6 +213,8 @@ class Server:
                 conn, _ = self._sock.accept()
             except OSError:
                 return  # killed
+            with self._conns_lock:
+                self._conns.add(conn)
             try:
                 self._pool.submit(self._serve_connection, conn)
             except RuntimeError:
@@ -207,6 +249,9 @@ class Server:
                     pass
         except OSError:
             pass  # connection dropped; one-shot protocol, nothing to do
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _process(self, req: JsonObj) -> JsonObj:
         """Dispatch + envelope (ref Session::HandleRead/ProcessRequest,
