@@ -235,6 +235,112 @@ def prev_alive_map(state: RingState) -> jax.Array:
 # lookup kernel
 # ---------------------------------------------------------------------------
 
+def _converged_all_alive(state: RingState) -> jax.Array:
+    """Scalar bool: every valid row alive AND min_key == pred_id + 1.
+
+    Under these conditions the reference's StoredLocally test
+    (key in [min_key, id], abstract_chord_peer.cpp:720-725) is equivalent
+    to "cur is the ring successor of key", the self-hit predecessor is
+    always alive, and the dead-finger fallback is unreachable — which is
+    what licenses the lean lookup loop below. O(N) streaming check, no
+    per-hop cost.
+    """
+    n = state.ids.shape[0]
+    valid = jnp.arange(n, dtype=jnp.int32) < state.n_valid
+    all_alive = ~jnp.any(valid & ~state.alive)
+    preds_ok = ~jnp.any(valid & (state.preds < 0))
+    pred_ids = state.ids[jnp.maximum(state.preds, 0)]
+    want_min = u128.add_scalar(pred_ids, 1)
+    mk_ok = ~jnp.any(valid & ~u128.eq(state.min_key, want_min))
+    return all_alive & preds_ok & mk_ok
+
+
+def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
+                 max_hops: int) -> Tuple[jax.Array, jax.Array]:
+    """Lean hop loop for converged all-alive rings — identical route and
+    hop counts to the general loop (the parity obligation), minus
+    everything that can't trigger there: per-hop min_key gathers (16 B),
+    the succ-list fallback ([B,S] gathers + S-wide u128 compares, the
+    round-1 profile's dominant cost), and alive-mask gathers. Termination
+    is cur == ring_successor(key), precomputed once per lane.
+    Per-hop random traffic: ids[cur] 16 B + finger 4 B + pred 4 B.
+
+    Two-phase straggler compaction: hop counts are ~log2(N)-distributed,
+    so the lockstep loop would run ~2x the mean trip count at full batch
+    width for a shrinking tail. Phase 1 runs full-width until <= B/8
+    lanes remain; phase 2 stable-partitions the stragglers into a B/8
+    prefix (two cumsums + one scatter, paid once) and finishes on 1/8 of
+    the width.
+    """
+    ids, preds = state.ids, state.preds
+    materialized = state.fingers is not None
+    owner0 = u128.ring_successor(ids, keys, state.n_valid)
+
+    def body_for(keys_, owner0_):
+        def body(carry):
+            cur, hops, it = carry
+            done = cur == owner0_
+            cur_ids = ids[cur]
+            dist = u128.sub(keys_, cur_ids)
+            fi = jnp.maximum(u128.bit_length(dist) - 1, 0)
+            if materialized:
+                nxt = state.fingers[cur, fi]
+            else:
+                starts = u128.add(cur_ids, u128.pow2(fi))
+                nxt = u128.ring_successor(ids, starts, state.n_valid)
+            # Self-hit -> predecessor (always alive here),
+            # chord_peer.cpp:194-196.
+            nxt = jnp.where(nxt == cur, preds[cur], nxt)
+            cur = jnp.where(done, cur, nxt)
+            hops = jnp.where(done, hops, hops + 1)
+            return cur, hops, it + 1
+        return body
+
+    b = keys.shape[0]
+    p = max(b // 8, 1)
+    cur0 = jnp.asarray(start, dtype=jnp.int32)
+
+    # Phase 1: full width while > p stragglers (and hop budget remains).
+    def cond1(carry):
+        cur, _, it = carry
+        return (jnp.sum(cur != owner0) > p) & (it < max_hops)
+
+    cur, hops, it = jax.lax.while_loop(
+        cond1, body_for(keys, owner0),
+        (cur0, jnp.zeros(b, jnp.int32), jnp.int32(0)))
+
+    # Stable partition: stragglers first. If phase 1 exited on the hop
+    # budget with > p stragglers, they are all failed lookups anyway
+    # (max_hops == routing loop), so losing them past the prefix is safe:
+    # phase 2's loop runs zero trips and the final cur != owner0 test
+    # marks them failed.
+    not_done = cur != owner0
+    n_nd = jnp.cumsum(not_done)
+    pos = jnp.where(not_done, n_nd - 1,
+                    n_nd[-1] + jnp.cumsum(~not_done) - 1).astype(jnp.int32)
+    inv = jnp.zeros(b, jnp.int32).at[pos].set(
+        jnp.arange(b, dtype=jnp.int32))
+    cur_c, hops_c = cur[inv], hops[inv]
+    keys_c, owner0_c = keys[inv], owner0[inv]
+
+    # Phase 2: finish the prefix at 1/8 width.
+    def cond2(carry):
+        cur_p, _, it = carry
+        return (~jnp.all(cur_p == owner0_c[:p])) & (it < max_hops)
+
+    cur_p, hops_p, _ = jax.lax.while_loop(
+        cond2, body_for(keys_c[:p], owner0_c[:p]),
+        (cur_c[:p], hops_c[:p], it))
+
+    cur = jnp.concatenate([cur_p, cur_c[p:]])[pos]
+    hops = jnp.concatenate([hops_p, hops_c[p:]])[pos]
+
+    failed = cur != owner0  # hop budget exhausted == routing loop
+    owner = jnp.where(failed, -1, cur)
+    hops = jnp.where(failed, -1, hops)
+    return owner, hops
+
+
 def _succ_list_candidate(state: RingState, cur: jax.Array,
                          keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Vectorized RemotePeerList::Lookup(key, succ=True)
@@ -253,28 +359,11 @@ def _succ_list_candidate(state: RingState, cur: jax.Array,
     return row, found
 
 
-@functools.partial(jax.jit, static_argnames=("max_hops",))
-def find_successor(state: RingState, keys: jax.Array,
-                   start: jax.Array, max_hops: Optional[int] = None
-                   ) -> Tuple[jax.Array, jax.Array]:
-    """Batched GetSuccessor: resolve B keys from B starting peers at once.
-
-    keys:  [B, 4] u32
-    start: [B] i32 row indices of the originating peers
-    returns (owner [B] i32, hops [B] i32); failed lookups (the reference
-    throws "Lookup failed", chord_peer.cpp:206) come back as owner -1,
-    hops -1. Lanes that exceed max_hops (a routing loop the reference would
-    recurse on forever) also fail.
-
-    Each while_loop iteration advances EVERY unresolved lane by one hop —
-    the device analog of one recursive GET_SUCC RPC per key.
-
-    max_hops defaults to RingConfig's default (callers with a custom
-    RingConfig should pass cfg.max_hops explicitly — RingState carries no
-    config).
-    """
-    if max_hops is None:
-        max_hops = DEFAULT_CONFIG.max_hops
+def _general_lookup(state: RingState, keys: jax.Array,
+                    start: jax.Array, max_hops: int
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Full-semantics hop loop: min_key termination, self-hit correction,
+    dead-finger succ-list fallback — exact behavior under churn."""
     ids, alive, preds = state.ids, state.alive, state.preds
     materialized = state.fingers is not None
     if not materialized:
@@ -344,6 +433,38 @@ def find_successor(state: RingState, keys: jax.Array,
     owner = jnp.where(failed, -1, cur)
     hops = jnp.where(failed, -1, hops)
     return owner, hops
+
+
+@functools.partial(jax.jit, static_argnames=("max_hops",))
+def find_successor(state: RingState, keys: jax.Array,
+                   start: jax.Array, max_hops: Optional[int] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Batched GetSuccessor: resolve B keys from B starting peers at once.
+
+    keys:  [B, 4] u32
+    start: [B] i32 row indices of the originating peers
+    returns (owner [B] i32, hops [B] i32); failed lookups (the reference
+    throws "Lookup failed", chord_peer.cpp:206) come back as owner -1,
+    hops -1. Lanes that exceed max_hops (a routing loop the reference would
+    recurse on forever) also fail.
+
+    Each while_loop iteration advances EVERY unresolved lane by one hop —
+    the device analog of one recursive GET_SUCC RPC per key. Dispatches at
+    runtime (lax.cond — only the taken branch executes) between the lean
+    converged-ring loop and the full-semantics loop; both produce
+    identical routes and hop counts wherever both are defined.
+
+    max_hops defaults to RingConfig's default (callers with a custom
+    RingConfig should pass cfg.max_hops explicitly — RingState carries no
+    config).
+    """
+    if max_hops is None:
+        max_hops = DEFAULT_CONFIG.max_hops
+    return jax.lax.cond(
+        _converged_all_alive(state),
+        lambda: _fast_lookup(state, keys, start, max_hops),
+        lambda: _general_lookup(state, keys, start, max_hops),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -218,6 +218,8 @@ class Server:
             try:
                 self._pool.submit(self._serve_connection, conn)
             except RuntimeError:
+                with self._conns_lock:
+                    self._conns.discard(conn)
                 conn.close()
                 return  # pool shut down
 

@@ -313,6 +313,14 @@ class AbstractChordPeer:
         immediate_succ = self.successors.get_nth_entry(0)
         while not immediate_succ.is_alive():
             self.successors.delete(immediate_succ)
+            if self.successors.size() == 0:
+                # Every listed successor was dead: rebuild from scratch as
+                # the empty-list branch above does, instead of indexing
+                # into the drained list.
+                self.successors.populate(
+                    self.get_n_successors(self.id + 1, self.num_succs))
+                self.populate_finger_table(initialize=False)
+                return
             immediate_succ = self.successors.get_nth_entry(0)
 
         pred_of_succ = immediate_succ.get_pred()

@@ -26,6 +26,7 @@ another peer; a locally-owned key costs 0 hops.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
@@ -48,43 +49,52 @@ class OraclePeer:
     min_key: int
     pred: int                      # predecessor id
     succs: List[int]               # successor-list ids, ring order from id
-    fingers: List[int]             # finger i -> successor id of [id+2^i, ...]
     alive: bool = True
 
 
 class OracleRing:
-    """A fully-converged ring of OraclePeers built from a set of ids."""
+    """A fully-converged ring of OraclePeers built from a set of ids.
+
+    Construction is lazy: peers are materialized on first touch and finger
+    targets are resolved by bisect on demand, so a 1M-id oracle costs
+    O(ids) to build instead of O(ids * 128) — cheap enough for the bench
+    to hop-parity-check its headline-scale ring.
+    """
 
     def __init__(self, ids: List[int], num_succs: int = 3,
                  key_bits: int = KEY_BITS):
         self.key_bits = key_bits
         self.ring = 1 << key_bits
-        ids = sorted(set(ids))
-        n = len(ids)
-        self.ids = ids
+        self.ids = sorted(set(ids))
+        self.num_succs = num_succs
         self.peers: Dict[int, OraclePeer] = {}
-        for i, pid in enumerate(ids):
-            pred = ids[(i - 1) % n]
-            succs = [ids[(i + k) % n] for k in range(1, min(num_succs, n) + 1)]
-            fingers = [self._ring_successor((pid + (1 << f)) % self.ring)
-                       for f in range(key_bits)]
-            self.peers[pid] = OraclePeer(
+
+    def peer(self, pid: int) -> OraclePeer:
+        p = self.peers.get(pid)
+        if p is None:
+            i = bisect.bisect_left(self.ids, pid)
+            assert i < len(self.ids) and self.ids[i] == pid, f"unknown id {pid}"
+            n = len(self.ids)
+            pred = self.ids[(i - 1) % n]
+            succs = [self.ids[(i + k) % n]
+                     for k in range(1, min(self.num_succs, n) + 1)]
+            p = OraclePeer(
                 id=pid,
-                min_key=(pred + 1) % self.ring if n > 1 else (pid + 1) % self.ring,
+                min_key=(pred + 1) % self.ring if n > 1
+                else (pid + 1) % self.ring,
                 pred=pred,
                 succs=succs,
-                fingers=fingers,
             )
+            self.peers[pid] = p
+        return p
 
     def _ring_successor(self, k: int) -> int:
-        """Smallest id clockwise-at-or-after k (host construction helper)."""
-        for pid in self.ids:
-            if pid >= k:
-                return pid
-        return self.ids[0]
+        """Smallest id clockwise-at-or-after k (bisect, wraps)."""
+        i = bisect.bisect_left(self.ids, k)
+        return self.ids[i] if i < len(self.ids) else self.ids[0]
 
     def kill(self, pid: int) -> None:
-        self.peers[pid].alive = False
+        self.peer(pid).alive = False
 
     # -- reference lookup semantics ----------------------------------------
 
@@ -92,12 +102,13 @@ class OracleRing:
         return in_between(k, peer.min_key, peer.id, True)
 
     def finger_lookup(self, peer: OraclePeer, k: int) -> int:
-        """FingerTable::Lookup linear scan (finger_table.h:115-130)."""
+        """FingerTable::Lookup linear scan (finger_table.h:115-130); the
+        converged entry for the containing range is resolved by bisect."""
         for i in range(self.key_bits):
             lb = (peer.id + (1 << i)) % self.ring
             ub = (peer.id + (1 << (i + 1)) - 1) % self.ring
             if in_between(k, lb, ub, True):
-                return peer.fingers[i]
+                return self._ring_successor(lb)
         raise LookupError("ChordKey not found")
 
     def succ_list_lookup(self, peer: OraclePeer, k: int) -> Optional[int]:
@@ -112,11 +123,11 @@ class OracleRing:
     def forward_target(self, peer: OraclePeer, k: int) -> int:
         """ForwardRequest's choice of next peer (chord_peer.cpp:185-211)."""
         key_succ = self.finger_lookup(peer, k)
-        if key_succ == peer.id and self.peers[peer.pred].alive:
+        if key_succ == peer.id and self.peer(peer.pred).alive:
             return peer.pred
-        if not self.peers[key_succ].alive:
+        if not self.peer(key_succ).alive:
             cand = self.succ_list_lookup(peer, k)
-            if cand is not None and self.peers[cand].alive:
+            if cand is not None and self.peer(cand).alive:
                 return cand
             raise LookupError("Lookup failed")
         return key_succ
@@ -124,13 +135,13 @@ class OracleRing:
     def find_successor(self, start: int, k: int,
                        max_hops: int = 400) -> Tuple[int, int]:
         """GetSuccessor from peer `start` -> (owner id, hop count)."""
-        cur = self.peers[start]
+        cur = self.peer(start)
         hops = 0
         while not self.stored_locally(cur, k):
             nxt = self.forward_target(cur, k)
             if hops >= max_hops:
                 raise LookupError("hop budget exceeded (routing loop)")
-            cur = self.peers[nxt]
+            cur = self.peer(nxt)
             hops += 1
         return cur.id, hops
 
