@@ -1,22 +1,38 @@
-"""Benchmark harness — prints ONE JSON line with the headline metric.
+"""Benchmark harness — all five BASELINE.json configs, one JSON line each,
+plus a final combined summary line (the driver tails the last line).
 
-Headline (BASELINE.json): batched find_successor lookups/sec/chip over a
-large simulated Chord ring, with hop-count parity vs. the reference
-semantics (verified on a sampled subset against tests/oracle.py).
+Configs (BASELINE.json.configs):
+  1. chord16    — 16-node ring, 1K-key FindSuccessor, exact hop/owner
+                  parity vs the reference-semantics oracle on every key.
+  2. ida        — Rabin IDA encode+decode MB/s, n=14 m=10 p=257, with a
+                  round-trip identity check (the reference's
+                  information_dispersal_test.cc is empty; these are the
+                  tests it was meant to hold, run at benchmark scale).
+  3. dhash      — batched put/get ops/sec with n-successor fragment
+                  striping + read-after-(n-m)-failures recovery check.
+  4. lookup_1m  — THE HEADLINE: 1M-node ring, 1M-key batched lookup,
+                  materialized fingers, sampled hop parity.
+  5. sweep_10m  — 10M-node ring (computed fingers — no [N,128] matrix),
+                  batched churn (fail+leave+join) + whole-ring
+                  stabilize/rectify sweep + 1M lookups through the
+                  explicit shard_map kernel over all local devices.
 
-vs_baseline is measured against the north-star target of 1.25M
-lookups/sec/chip (= 1M concurrent lookups in <100 ms on a v5e-8, i.e.
-10M/s aggregate / 8 chips); the C++ reference publishes no numbers
-(SURVEY.md §6), so the target is the only quantitative anchor.
+vs_baseline everywhere is measured against the north-star derivative
+1.25M lookups/sec/chip (1M concurrent lookups < 100 ms on a v5e-8 = 8
+chips; the C++ reference publishes no numbers — SURVEY.md §6), except
+ida/dhash which have no published anchor and report vs_baseline null.
 
 Usage:
-    python bench.py            # full: 1M-node ring, 1M-key batch
-    python bench.py --smoke    # quick sanity: 10K ring, 10K keys
+    python bench.py                 # all five configs
+    python bench.py --smoke         # scaled-down quick pass
+    python bench.py --config NAME   # one config (chord16|ida|dhash|
+                                    #             lookup_1m|sweep_10m)
 """
 
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import sys
@@ -31,12 +47,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "tests"))
 
 from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core import churn
 from p2p_dhts_tpu.core.ring import (
     build_ring,
     find_successor,
+    get_n_successors,
     keys_from_ints,
     owner_of,
 )
+from p2p_dhts_tpu.core.sharded import (
+    find_successor_sharded,
+    peer_mesh,
+    shard_ring,
+)
+from p2p_dhts_tpu.dhash.store import create_batch, empty_store, read_batch
+from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
 from p2p_dhts_tpu import keyspace
 
 NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP = 10_000_000 / 8
@@ -46,24 +71,8 @@ def _rand_ids(rng: np.random.RandomState, n: int) -> list:
     return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
 
 
-def _hop_parity_sample(state, key_ints, starts, hops, sample: int = 64) -> str:
-    """Spot-check hop counts against the reference-semantics oracle.
-
-    The oracle is lazy (bisect-resolved fingers, peers on demand), so the
-    check runs at any ring size including the 1M-peer headline config.
-    """
-    from oracle import OracleRing
-
-    sorted_ids = keyspace.lanes_to_ints(
-        np.asarray(state.ids[: int(state.n_valid)]))
-    oracle = OracleRing(sorted_ids)
-    idx = np.linspace(0, len(key_ints) - 1, sample).astype(int)
-    for j in idx:
-        _, want = oracle.find_successor(sorted_ids[int(starts[j])],
-                                        key_ints[j])
-        if int(hops[j]) != want:
-            return "FAIL"
-    return "ok"
+def _rand_lanes(rng: np.random.RandomState, n: int) -> np.ndarray:
+    return np.frombuffer(rng.bytes(16 * n), dtype="<u4").reshape(-1, 4).copy()
 
 
 def _sync(*arrays) -> list:
@@ -76,73 +85,350 @@ def _sync(*arrays) -> list:
     return [np.asarray(a[..., :8]) for a in arrays]
 
 
-def run(n_peers: int, n_keys: int, finger_mode: str, repeats: int = 3) -> dict:
-    rng = np.random.RandomState(20260729)
-    ids = _rand_ids(rng, n_peers)
-    state = build_ring(ids, RingConfig(finger_mode=finger_mode))
+def _time(fn, repeats: int = 3) -> float:
+    """Median-free best-effort wall time: warm (compile) + sync-overhead
+    subtraction + mean over repeats."""
+    out = fn()
+    _sync(*out)
+    t0 = time.perf_counter()
+    _sync(*out)
+    overhead = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn()
+    _sync(*out)
+    return max((time.perf_counter() - t0 - overhead) / repeats, 1e-9)
 
+
+def _emit(rec: dict) -> dict:
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# config 1: 16-node ring, 1K keys, full parity
+# ---------------------------------------------------------------------------
+
+def bench_chord16() -> dict:
+    from oracle import OracleRing
+
+    rng = np.random.RandomState(16)
+    n_peers, n_keys = 16, 1000
+    ids = _rand_ids(rng, n_peers)
+    state = build_ring(ids, RingConfig(finger_mode="materialized"))
     key_ints = _rand_ids(rng, n_keys)
     keys = keys_from_ints(key_ints)
     starts_np = rng.randint(0, n_peers, size=n_keys).astype(np.int32)
     starts = jnp.asarray(starts_np)
 
-    owner, hops = find_successor(state, keys, starts)  # compile + warm
-    _sync(owner, hops)
+    best = _time(lambda: find_successor(state, keys, starts))
+    owner, hops = find_successor(state, keys, starts)
+    owner_np, hops_np = np.asarray(owner), np.asarray(hops)
 
-    # One sync after an already-drained queue measures pure sync overhead
-    # (slice kernel + tunnel round trip), subtracted from the timed runs.
-    t0 = time.perf_counter()
-    _sync(owner, hops)
-    sync_overhead = time.perf_counter() - t0
+    sorted_ids = keyspace.lanes_to_ints(np.asarray(state.ids))
+    oracle = OracleRing(sorted_ids)
+    for j in range(n_keys):  # exact parity on EVERY key
+        want_owner, want_hops = oracle.find_successor(
+            sorted_ids[int(starts_np[j])], key_ints[j])
+        assert sorted_ids[owner_np[j]] == want_owner, "owner parity FAIL"
+        assert hops_np[j] == want_hops, "hop parity FAIL"
 
-    k = max(1, repeats)
-    t0 = time.perf_counter()
-    for _ in range(k):
-        owner, hops = find_successor(state, keys, starts)
-    _sync(owner, hops)
-    best = max((time.perf_counter() - t0 - sync_overhead) / k, 1e-9)
+    lps = n_keys / best
+    return _emit({
+        "config": "chord16",
+        "metric": "find_successor lookups/sec (16-node ring, 1K keys)",
+        "value": round(lps, 1),
+        "unit": "lookups/sec",
+        "vs_baseline": round(lps / NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP, 4),
+        "wall_ms": round(best * 1e3, 3),
+        "mean_hops": round(float(hops_np.mean()), 3),
+        "hop_parity": "ok (exact, all 1000 keys)",
+    })
 
+
+# ---------------------------------------------------------------------------
+# config 2: IDA encode/decode MB/s
+# ---------------------------------------------------------------------------
+
+def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
+    n, m, p = 14, 10, 257
+    rng = np.random.RandomState(42)
+    segments = jnp.asarray(
+        rng.randint(0, 256, size=(blocks, segs, m)), jnp.int32)
+    payload_mb = blocks * segs * m / 1e6  # one value == one byte
+
+    enc_t = _time(lambda: (encode_kernel(segments, n, m, p),))
+    frags = encode_kernel(segments, n, m, p)          # [B, n, S]
+
+    # Decode from a random m-subset of the n fragments per lane (the
+    # realistic read path: any m distinct indices reconstruct).
+    sel = np.stack([rng.choice(n, size=m, replace=False)
+                    for _ in range(blocks)])          # [B, m] in [0, n)
+    rows = jnp.take_along_axis(
+        frags, jnp.asarray(sel)[:, :, None], axis=1)  # [B, m, S]
+    idx = jnp.asarray(sel + 1, jnp.int32)             # 1-based indices
+
+    dec_t = _time(lambda: (decode_kernel(rows, idx, p),))
+    decoded = decode_kernel(rows, idx, p)             # [B, S, m]
+    assert bool(jnp.all(decoded == jnp.moveaxis(segments, 1, 1))), \
+        "IDA round-trip mismatch"
+
+    return _emit({
+        "config": "ida",
+        "metric": f"IDA encode/decode MB/s (n={n} m={m} p={p}, "
+                  f"{blocks} blocks x {segs} segments)",
+        "value": round(payload_mb / enc_t, 1),
+        "unit": "MB/s encode",
+        "decode_mb_s": round(payload_mb / dec_t, 1),
+        "vs_baseline": None,
+        "round_trip": "ok",
+    })
+
+
+# ---------------------------------------------------------------------------
+# config 3: DHash put/get + n-m failure recovery
+# ---------------------------------------------------------------------------
+
+def bench_dhash(n_peers: int = 1024, n_keys: int = 2048) -> dict:
+    n, m, p = 14, 10, 257
+    segs = 4
+    rng = np.random.RandomState(7)
+    ring = build_ring(_rand_lanes(rng, n_peers),
+                      RingConfig(finger_mode="materialized"))
+    keys = keys_from_ints(_rand_ids(rng, n_keys))
+    segments = jnp.asarray(
+        rng.randint(0, 256, size=(n_keys, segs, m)), jnp.int32)
+    lengths = jnp.full((n_keys,), segs, jnp.int32)
+    starts = jnp.asarray(rng.randint(0, n_peers, size=n_keys), jnp.int32)
+    store0 = empty_store(capacity=n_keys * n, max_segments=segs)
+
+    def put():
+        s, ok = create_batch(ring, store0, keys, segments, lengths,
+                             starts, n, m, p)
+        return s.keys, ok
+
+    put_t = _time(put, repeats=1)
+    store, ok = create_batch(ring, store0, keys, segments, lengths,
+                             starts, n, m, p)
+    assert bool(jnp.all(ok)), "puts failed"
+
+    get_t = _time(lambda: read_batch(ring, store, keys, n, m, p),
+                  repeats=2)
+    out, rok = read_batch(ring, store, keys, n, m, p)
+    assert bool(jnp.all(rok)), "gets failed"
+    assert bool(jnp.all(out == segments)), "get payload mismatch"
+
+    # Recovery: fail n-m = 4 peers; every key still reconstructs (each
+    # key's n fragments sit on n distinct successors, so any 4 failures
+    # cost at most 4 fragments — dhash_peer.cpp:189-196's guarantee).
+    victims = jnp.asarray(rng.choice(n_peers, size=n - m, replace=False),
+                          jnp.int32)
+    ring_f = churn.fail(ring, victims)
+    out_f, rok_f = read_batch(ring_f, store, keys, n, m, p)
+    recovered = bool(jnp.all(rok_f)) and bool(jnp.all(out_f == segments))
+    assert recovered, "read after n-m failures FAILED"
+
+    return _emit({
+        "config": "dhash",
+        "metric": f"DHash get ops/sec ({n_peers} peers, {n_keys} keys, "
+                  f"n={n} m={m})",
+        "value": round(n_keys / get_t, 1),
+        "unit": "gets/sec",
+        "put_ops_s": round(n_keys / put_t, 1),
+        "vs_baseline": None,
+        "recovery_after_4_failures": "ok",
+    })
+
+
+# ---------------------------------------------------------------------------
+# config 4 (headline): 1M-node ring batched lookup
+# ---------------------------------------------------------------------------
+
+def _hop_parity_sample(sorted_ids, key_ints, start_ids, hops,
+                       sample: int = 64) -> str:
+    """Spot-check hop counts against the reference-semantics oracle (lazy:
+    bisect-resolved fingers, peers on demand — any ring size)."""
+    from oracle import OracleRing
+
+    oracle = OracleRing(sorted_ids)
+    idx = np.linspace(0, len(key_ints) - 1, sample).astype(int)
+    for j in idx:
+        _, want = oracle.find_successor(start_ids[j], key_ints[j])
+        if int(hops[j]) != want:
+            return "FAIL"
+    return "ok"
+
+
+def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
+                    finger_mode: str = "materialized") -> dict:
+    rng = np.random.RandomState(20260729)
+    state = build_ring(_rand_lanes(rng, n_peers),
+                       RingConfig(finger_mode=finger_mode))
+    n_valid = int(state.n_valid)
+
+    key_ints = _rand_ids(rng, n_keys)
+    keys = keys_from_ints(key_ints)
+    starts_np = rng.randint(0, n_valid, size=n_keys).astype(np.int32)
+    starts = jnp.asarray(starts_np)
+
+    best = _time(lambda: find_successor(state, keys, starts))
+    owner, hops = find_successor(state, keys, starts)
     hops_np = np.asarray(hops)
     god = owner_of(state, keys)
-    assert bool(jnp.all(owner == god)), "owner mismatch vs omniscient resolution"
+    assert bool(jnp.all(owner == god)), "owner mismatch vs omniscient"
     assert bool(np.all(hops_np >= 0)), "unresolved lookups"
-    parity = _hop_parity_sample(state, key_ints, starts_np, hops_np)
-    assert parity != "FAIL", "hop-count parity violation vs reference semantics"
 
-    lookups_per_sec = n_keys / best
-    return {
-        "hop_parity": parity,
+    sorted_ids = keyspace.lanes_to_ints(np.asarray(state.ids[:n_valid]))
+    parity = _hop_parity_sample(
+        sorted_ids, key_ints, [sorted_ids[s] for s in starts_np], hops_np)
+    assert parity != "FAIL", "hop parity violation"
+
+    lps = n_keys / best
+    return _emit({
+        "config": "lookup_1m",
         "metric": f"find_successor lookups/sec/chip ({n_peers}-node ring, "
                   f"{finger_mode} fingers, batch {n_keys})",
-        "value": round(lookups_per_sec, 1),
+        "value": round(lps, 1),
         "unit": "lookups/sec",
-        "vs_baseline": round(
-            lookups_per_sec / NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP, 4),
+        "vs_baseline": round(lps / NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP, 4),
         "wall_ms": round(best * 1e3, 2),
         "mean_hops": round(float(hops_np.mean()), 3),
+        "hop_parity": parity,
         "device": str(jax.devices()[0]),
-    }
+    })
 
+
+# ---------------------------------------------------------------------------
+# config 5: 10M-node ring — churn + sweep + sharded lookups
+# ---------------------------------------------------------------------------
+
+def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
+                    churn_k: int = 8192) -> dict:
+    mesh = peer_mesh()
+    d = len(jax.devices())
+    rng = np.random.RandomState(10)
+
+    cap = ((n_peers + 2 * churn_k + d - 1) // d) * d
+    state = build_ring(_rand_lanes(rng, n_peers),
+                       RingConfig(finger_mode="computed"), capacity=cap)
+    n_valid = int(state.n_valid)
+
+    # Batched churn: fail + leave + join (the reference's churn axis is
+    # process kill / graceful leave / fresh join, chord_peer.cpp:293-300,
+    # abstract_chord_peer.cpp:83-260).
+    fail_rows = jnp.asarray(
+        rng.choice(n_valid, size=churn_k, replace=False), jnp.int32)
+    leave_rows = jnp.asarray(
+        rng.choice(n_valid, size=churn_k, replace=False), jnp.int32)
+    join_ids = jnp.asarray(_rand_lanes(rng, churn_k))
+
+    t0 = time.perf_counter()
+    state = churn.fail(state, fail_rows)
+    state = churn.leave(state, leave_rows)
+    state, _ = churn.join(state, join_ids)
+    _sync(state.ids, state.alive)
+    churn_ms = (time.perf_counter() - t0) * 1e3
+
+    sweep_t = _time(lambda: tuple(churn.stabilize_sweep(state)[:2]),
+                    repeats=2)
+    state = churn.stabilize_sweep(state)
+
+    # Sharded lookups over all local devices (explicit shard_map kernel).
+    sstate = shard_ring(state, mesh)
+    alive_np = np.asarray(sstate.alive)
+    alive_rows = np.flatnonzero(alive_np)
+    key_ints = _rand_ids(rng, n_keys)
+    keys = keys_from_ints(key_ints)
+    starts_np = rng.choice(alive_rows, size=n_keys).astype(np.int32)
+    starts = jnp.asarray(starts_np)
+
+    best = _time(
+        lambda: find_successor_sharded(sstate, keys, starts, mesh),
+        repeats=1)
+    owner, hops = find_successor_sharded(sstate, keys, starts, mesh)
+    owner_np, hops_np = np.asarray(owner), np.asarray(hops)
+    assert bool(np.all(hops_np >= 0)), "unresolved lookups"
+    assert bool(np.all(alive_np[owner_np])), "dead owner"
+
+    # Post-sweep parity: the converged survivor ring routes exactly like a
+    # fresh ring built from the alive ids only (same oracle).
+    ids_np = np.asarray(sstate.ids)
+    alive_ids = keyspace.lanes_to_ints(ids_np[alive_rows])
+    owner_ids = keyspace.lanes_to_ints(ids_np[owner_np[:256]])
+    from oracle import OracleRing
+    oracle = OracleRing(alive_ids)
+    parity = "ok"
+    alive_id_of = {int(r): alive_ids[i] for i, r in enumerate(alive_rows)}
+    for j in np.linspace(0, 255, 48).astype(int):
+        want_owner, want_hops = oracle.find_successor(
+            alive_id_of[int(starts_np[j])], key_ints[j])
+        if owner_ids[j] != want_owner or int(hops_np[j]) != want_hops:
+            parity = "FAIL"
+            break
+    assert parity == "ok", "post-churn hop parity violation"
+
+    lps = n_keys / best
+    return _emit({
+        "config": "sweep_10m",
+        "metric": f"sharded lookups/sec/chip ({n_peers}-node ring, "
+                  f"computed fingers, {d} device(s), churn "
+                  f"{3 * churn_k} peers + sweep)",
+        "value": round(lps, 1),
+        "unit": "lookups/sec",
+        "vs_baseline": round(lps / NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP, 4),
+        "wall_ms": round(best * 1e3, 2),
+        "churn_ms": round(churn_ms, 1),
+        "sweep_ms": round(sweep_t * 1e3, 1),
+        "mean_hops": round(float(hops_np.mean()), 3),
+        "hop_parity": parity,
+    })
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--smoke", action="store_true",
-                    help="small config for quick sanity")
-    ap.add_argument("--peers", type=int, default=None)
-    ap.add_argument("--keys", type=int, default=None)
-    ap.add_argument("--mode", default=None,
-                    choices=["materialized", "computed"])
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--config", default=None,
+                    choices=["chord16", "ida", "dhash", "lookup_1m",
+                             "sweep_10m"])
     args = ap.parse_args()
 
     if args.smoke:
-        n_peers, n_keys, mode = 10_000, 10_000, "materialized"
+        runs = {
+            "chord16": bench_chord16,
+            "ida": lambda: bench_ida(blocks=512, segs=32),
+            "dhash": lambda: bench_dhash(n_peers=128, n_keys=256),
+            "lookup_1m": lambda: bench_lookup_1m(10_000, 10_000),
+            "sweep_10m": lambda: bench_sweep_10m(100_000, 10_000, 512),
+        }
     else:
-        n_peers, n_keys, mode = 1_000_000, 1_000_000, "materialized"
-    n_peers = args.peers or n_peers
-    n_keys = args.keys or n_keys
-    mode = args.mode or mode
+        runs = {
+            "chord16": bench_chord16,
+            "ida": bench_ida,
+            "dhash": bench_dhash,
+            "lookup_1m": bench_lookup_1m,
+            "sweep_10m": bench_sweep_10m,
+        }
+    if args.config:
+        runs = {args.config: runs[args.config]}
 
-    print(json.dumps(run(n_peers, n_keys, mode)))
+    results = []
+    for name, fn in runs.items():
+        results.append(fn())
+        gc.collect()
+
+    headline = next((r for r in results if r["config"] == "lookup_1m"),
+                    results[-1])
+    _emit({
+        "metric": headline["metric"],
+        "value": headline["value"],
+        "unit": headline["unit"],
+        "vs_baseline": headline["vs_baseline"],
+        "hop_parity": headline.get("hop_parity"),
+        "device": str(jax.devices()[0]),
+        "configs": results,
+    })
 
 
 if __name__ == "__main__":

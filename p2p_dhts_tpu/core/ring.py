@@ -114,9 +114,24 @@ def _materialize_fingers(ids: jax.Array, n_valid: jax.Array,
     return fingers_for_ids(ids, n_valid, ids, num_fingers, chunk=chunk)
 
 
-def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
+def _lanes_add1(x: np.ndarray) -> np.ndarray:
+    """(x + 1) mod 2^128 on [N, 4] u32 lanes — vectorized carry chain."""
+    out = x.copy()
+    carry = np.ones(x.shape[0], dtype=bool)
+    for lane in range(LANES):
+        out[:, lane] = np.where(carry, out[:, lane] + np.uint32(1),
+                                out[:, lane])
+        carry = carry & (out[:, lane] == 0)
+    return out
+
+
+def build_ring(ids, cfg: RingConfig = DEFAULT_CONFIG,
                capacity: Optional[int] = None) -> RingState:
     """Build a fully-converged RingState from 128-bit integer ids.
+
+    `ids` is a sequence of python ints OR an [N, 4] uint32 lane array
+    (little-endian lanes, as keyspace.ints_to_lanes produces) — the lane
+    path is fully vectorized so 10M-peer rings build in seconds.
 
     The array analog of: every peer has StartChord/Join'ed, every
     stabilize/fix-fingers round has run to fixpoint. Single-peer rings get
@@ -127,8 +142,20 @@ def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
         # finger table would silently degrade routing to an O(N) walk.
         raise ValueError(f"build_ring supports key_bits=128 only, "
                          f"got {cfg.key_bits}")
-    ids_sorted = sorted(set(int(i) % keyspace.KEYS_IN_RING for i in ids))
-    n = len(ids_sorted)
+    if isinstance(ids, np.ndarray) and ids.ndim == 2:
+        lanes = np.ascontiguousarray(ids, dtype=np.uint32)
+    else:
+        lanes = keyspace.ints_to_lanes(ids)
+    # Sort ascending (lane 3 most significant) and dedup — the vectorized
+    # twin of sorted(set(ids)).
+    order = np.lexsort((lanes[:, 0], lanes[:, 1], lanes[:, 2], lanes[:, 3]))
+    lanes = lanes[order]
+    if lanes.shape[0] > 1:
+        keep = np.concatenate(
+            [[True], np.any(lanes[1:] != lanes[:-1], axis=1)])
+        lanes = lanes[keep]
+    ids_lanes = lanes
+    n = ids_lanes.shape[0]
     if n == 0:
         raise ValueError("ring needs at least one peer")
     capacity = n if capacity is None else capacity
@@ -136,7 +163,6 @@ def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
         raise ValueError(f"capacity {capacity} < {n} peers")
     s = cfg.num_succs
 
-    ids_lanes = keyspace.ints_to_lanes(ids_sorted)
     idx = np.arange(n)
     preds = np.full(capacity, -1, dtype=np.int32)
     preds[:n] = (idx - 1) % n
@@ -146,13 +172,9 @@ def build_ring(ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
         if n > 1:
             succs[:n, k - 1] = (idx + k) % n
 
-    min_key_ints = (
-        [(ids_sorted[0] + 1) % keyspace.KEYS_IN_RING] if n == 1
-        else [(ids_sorted[(i - 1) % n] + 1) % keyspace.KEYS_IN_RING
-              for i in range(n)]
-    )
     min_key = np.zeros((capacity, LANES), dtype=np.uint32)
-    min_key[:n] = keyspace.ints_to_lanes(min_key_ints)
+    min_key[:n] = _lanes_add1(np.roll(ids_lanes, 1, axis=0) if n > 1
+                              else ids_lanes)
 
     alive = np.zeros(capacity, dtype=bool)
     alive[:n] = True

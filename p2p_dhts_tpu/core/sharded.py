@@ -1,0 +1,232 @@
+"""Peer-axis scale-out: explicit shard_map lookup + sharded maintenance.
+
+This is SURVEY.md §7 stage 7 — the TPU-native replacement for the
+reference's entire distribution story (one OS process per peer, TCP
+JSON-RPC between them, chord_peer.cpp:42-43): the sorted id table, finger
+matrix, succ lists and alive mask are sharded row-wise ("peer" axis)
+across a jax.sharding.Mesh, and cross-shard communication is XLA
+collectives over ICI instead of sockets.
+
+Two distribution regimes, chosen per op the way the scaling-book recipe
+prescribes:
+
+  * The *lookup hop loop* (latency-critical, irregular access) is an
+    explicit `shard_map` kernel with a hand-placed collective schedule:
+    every device holds the full (replicated) lane state and its own table
+    shard; per hop each shard computes its local successor candidate by
+    binary search and the winner is an `lax.pmin` over the peer axis
+    (candidates are global row indices, and the table is globally sorted,
+    so min-row == min-id — no id exchange needed). Row gathers from
+    sharded tables are one-hot masked reads + `lax.psum`.
+  * The *churn sweep* (bulk-parallel, regular) runs the single-device
+    `stabilize_sweep`/`join`/`leave`/`fail` programs on sharded arrays and
+    lets GSPMD insert the collectives — sharding annotations via
+    `shard_ring`.
+
+Parity: the hop loop reproduces the converged-ring route of
+`ring.find_successor` exactly (tests assert equality of owners and hop
+counts on an 8-device virtual mesh), which in turn carries the pinned
+reference semantics (finger_table.h:115-130's containing-range scan,
+chord_peer.cpp:194-196's self-hit correction).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_dhts_tpu.config import DEFAULT_CONFIG
+from p2p_dhts_tpu.core.ring import RingState
+from p2p_dhts_tpu.ops import u128
+
+_INT_MAX = jnp.int32(2**31 - 1)
+
+
+def peer_mesh(devices=None, axis: str = "peer") -> Mesh:
+    """1-D mesh over the peer axis (all local devices by default)."""
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shard_ring(state: RingState, mesh: Mesh, axis: str = "peer"
+               ) -> RingState:
+    """Place a RingState row-sharded over `axis` (scalars replicated).
+
+    Capacity must divide evenly by the axis size — build the ring with
+    `capacity=` rounded up to a multiple of the device count.
+    """
+    d = mesh.shape[axis]
+    n = state.ids.shape[0]
+    if n % d != 0:
+        raise ValueError(f"capacity {n} not divisible by {d} devices; "
+                         f"pass capacity=ceil(n/{d})*{d} to build_ring")
+    row = NamedSharding(mesh, P(axis))
+    row2d = NamedSharding(mesh, P(axis, None))
+    repl = NamedSharding(mesh, P())
+    return RingState(
+        ids=jax.device_put(state.ids, row2d),
+        alive=jax.device_put(state.alive, row),
+        n_valid=jax.device_put(state.n_valid, repl),
+        min_key=jax.device_put(state.min_key, row2d),
+        preds=jax.device_put(state.preds, row),
+        succs=jax.device_put(state.succs, row2d),
+        fingers=None if state.fingers is None
+        else jax.device_put(state.fingers, row2d),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "max_hops"))
+def find_successor_sharded(state: RingState, keys: jax.Array,
+                           start: jax.Array, mesh: Mesh,
+                           axis: str = "peer",
+                           max_hops: Optional[int] = None
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Batched GetSuccessor over a peer-axis-sharded converged ring.
+
+    The scale-out twin of `ring.find_successor`'s fast path (same route,
+    same hop counts — see module doc): lane state replicated, table
+    sharded, one pmin + up to two psums of [B]-shaped data per hop over
+    ICI. Supports both finger modes; computed mode is the memory-free
+    path to 10M+ peers (no [N,128] matrix anywhere).
+
+    Converged rings (run the sweep first after churn): dead rows are
+    skipped by the successor search exactly as computed fingers skip them
+    (`ring.py`: always-converged finger targets), so post-sweep routing
+    matches the general single-device loop. keys [B,4] u32, start [B] i32
+    -> (owner [B] i32, hops [B] i32, -1 on hop-budget exhaustion).
+    """
+    if max_hops is None:
+        max_hops = DEFAULT_CONFIG.max_hops
+    d = mesh.shape[axis]
+    n = state.ids.shape[0]
+    block = n // d
+    materialized = state.fingers is not None
+
+    tables = (state.ids, state.preds, state.alive) + (
+        (state.fingers,) if materialized else ())
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=((P(axis, None), P(axis), P(axis)) + ((P(axis, None),)
+                                                       if materialized
+                                                       else ()),
+                  P(), P(None, None), P(None)),
+        out_specs=(P(None), P(None)),
+        check_vma=False)
+    def kernel(tables, n_valid, keys, start):
+        ids_blk = tables[0]
+        preds_blk = tables[1]
+        alive_blk = tables[2]
+        off = jax.lax.axis_index(axis).astype(jnp.int32) * block
+
+        # Local next-alive map: for local slot j, the smallest ALIVE
+        # local row >= j (suffix cummin over alive positions), _INT_MAX
+        # if the suffix holds none — the per-shard piece of
+        # ring.next_alive_map.
+        slots = jnp.arange(block, dtype=jnp.int32)
+        live_blk = alive_blk & (off + slots < n_valid)
+        pos = jnp.where(live_blk, slots, _INT_MAX)
+        suffix = jnp.flip(jax.lax.cummin(jnp.flip(pos)))
+        suffix_ext = jnp.concatenate(
+            [suffix, jnp.full((1,), _INT_MAX, jnp.int32)])
+        first_alive = jnp.where(suffix[0] == _INT_MAX, _INT_MAX,
+                                off + suffix[0])
+        global_first = jax.lax.pmin(first_alive, axis)
+
+        def ring_succ(q):
+            """Global alive ring-successor row of q: local binary search,
+            local next-alive skip, then pmin over shards (the table is
+            globally sorted, so min valid global row == min id); no
+            candidate anywhere wraps to the globally-first alive row."""
+            j = u128.searchsorted(ids_blk, q)            # [B] in [0, block]
+            jj = suffix_ext[j]                           # alive slot >= j
+            cand = jnp.where(jj == _INT_MAX, _INT_MAX, off + jj)
+            best = jax.lax.pmin(cand, axis)
+            return jnp.where(best == _INT_MAX, global_first, best)
+
+        def gather1(tbl_blk, rows):
+            """tbl_blk [block] i32 at global rows — masked-own + psum."""
+            loc = rows - off
+            own = (loc >= 0) & (loc < block)
+            v = jnp.where(own, tbl_blk[jnp.clip(loc, 0, block - 1)], 0)
+            return jax.lax.psum(v, axis)
+
+        def gather_ids(rows):
+            """ids at global rows [B] -> [B,4] u32 via int32 psum (one
+            non-zero contributor per lane, so modular add is exact)."""
+            loc = rows - off
+            own = (loc >= 0) & (loc < block)
+            v = ids_blk[jnp.clip(loc, 0, block - 1)].astype(jnp.int32)
+            v = jnp.where(own[:, None], v, 0)
+            return jax.lax.psum(v, axis).astype(jnp.uint32)
+
+        def gather_finger(rows, fi):
+            f_blk = tables[3]
+            loc = rows - off
+            own = (loc >= 0) & (loc < block)
+            v = f_blk[jnp.clip(loc, 0, block - 1), fi]
+            return jax.lax.psum(jnp.where(own, v, 0), axis)
+
+        owner0 = ring_succ(keys)
+
+        def cond(carry):
+            cur, _, it = carry
+            return (~jnp.all(cur == owner0)) & (it < max_hops)
+
+        def body(carry):
+            cur, hops, it = carry
+            done = cur == owner0
+            cur_ids = gather_ids(cur)
+            dist = u128.sub(keys, cur_ids)
+            fi = jnp.maximum(u128.bit_length(dist) - 1, 0)
+            if materialized:
+                nxt = gather_finger(cur, fi)
+            else:
+                starts = u128.add(cur_ids, u128.pow2(fi))
+                nxt = ring_succ(starts)
+            # Self-hit -> predecessor (chord_peer.cpp:194-196).
+            nxt = jnp.where(nxt == cur, gather1(preds_blk, cur), nxt)
+            cur = jnp.where(done, cur, nxt)
+            hops = jnp.where(done, hops, hops + 1)
+            return cur, hops, it + 1
+
+        b = keys.shape[0]
+        cur0 = jnp.asarray(start, jnp.int32)
+        cur, hops, _ = jax.lax.while_loop(
+            cond, body, (cur0, jnp.zeros(b, jnp.int32), jnp.int32(0)))
+        failed = cur != owner0
+        return (jnp.where(failed, -1, cur), jnp.where(failed, -1, hops))
+
+    return kernel(tables, state.n_valid, keys,
+                  jnp.asarray(start, jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def owner_of_sharded(state: RingState, keys: jax.Array, mesh: Mesh,
+                     axis: str = "peer") -> jax.Array:
+    """Sharded omniscient ownership (`ring.owner_of` twin): local binary
+    search per shard + pmin — the 0-hop placement primitive used by the
+    dhash layer at scale."""
+    d = mesh.shape[axis]
+    block = state.ids.shape[0] // d
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(), P(None, None)),
+        out_specs=P(None), check_vma=False)
+    def kernel(ids_blk, n_valid, keys):
+        off = jax.lax.axis_index(axis).astype(jnp.int32) * block
+        j = u128.searchsorted(ids_blk, keys)
+        grow = off + j
+        valid = (j < block) & (grow < n_valid)
+        best = jax.lax.pmin(jnp.where(valid, grow, _INT_MAX), axis)
+        return jnp.where(best == _INT_MAX, 0, best)
+
+    return kernel(state.ids, state.n_valid, keys)

@@ -1,0 +1,134 @@
+"""Sharded scale-out vs single-device parity on the virtual 8-device mesh.
+
+The explicit shard_map lookup kernel (core/sharded.py) must produce the
+exact owners AND hop counts of the single-device kernel (which is itself
+parity-pinned against the reference oracle in test_ring.py), and the
+GSPMD-sharded churn sweep must reach the same fixpoint as the
+single-device sweep.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core import churn
+from p2p_dhts_tpu.core.ring import (
+    build_ring,
+    find_successor,
+    keys_from_ints,
+    owner_of,
+)
+from p2p_dhts_tpu.core.sharded import (
+    find_successor_sharded,
+    owner_of_sharded,
+    peer_mesh,
+    shard_ring,
+)
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return peer_mesh()
+
+
+@pytest.mark.parametrize("mode", ["materialized", "computed"])
+def test_sharded_lookup_matches_single_device(rng, mesh, mode):
+    n, b = 256, 128
+    ids = _rand_ids(rng, n)
+    state = build_ring(ids, RingConfig(finger_mode=mode))
+    keys = keys_from_ints(_rand_ids(rng, b))
+    starts = jnp.asarray(rng.randint(0, n, size=b), jnp.int32)
+
+    want_owner, want_hops = find_successor(state, keys, starts)
+
+    sstate = shard_ring(state, mesh)
+    got_owner, got_hops = find_successor_sharded(sstate, keys, starts, mesh)
+
+    np.testing.assert_array_equal(np.asarray(got_owner),
+                                  np.asarray(want_owner))
+    np.testing.assert_array_equal(np.asarray(got_hops),
+                                  np.asarray(want_hops))
+
+
+def test_sharded_owner_of_matches(rng, mesh):
+    n, b = 512, 256
+    state = build_ring(_rand_ids(rng, n),
+                       RingConfig(finger_mode="computed"))
+    keys = keys_from_ints(_rand_ids(rng, b))
+    want = owner_of(state, keys)
+    got = owner_of_sharded(shard_ring(state, mesh), keys, mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sharded_lookup_uneven_valid_rows(rng, mesh):
+    """n_valid not a multiple of the shard count: padding rows live only
+    in the tail shards and must never win the pmin."""
+    n = 200  # capacity padded to 256 -> last shard mostly padding
+    ids = _rand_ids(rng, n)
+    state = build_ring(ids, RingConfig(finger_mode="computed"),
+                       capacity=256)
+    b = 64
+    keys = keys_from_ints(_rand_ids(rng, b))
+    starts = jnp.asarray(rng.randint(0, n, size=b), jnp.int32)
+    want_owner, want_hops = find_successor(state, keys, starts)
+    sstate = shard_ring(state, mesh)
+    got_owner, got_hops = find_successor_sharded(sstate, keys, starts, mesh)
+    np.testing.assert_array_equal(np.asarray(got_owner),
+                                  np.asarray(want_owner))
+    np.testing.assert_array_equal(np.asarray(got_hops),
+                                  np.asarray(want_hops))
+
+
+def test_sharded_sweep_matches_single_device(rng, mesh):
+    """GSPMD path: churn (fail batch) + stabilize sweep on sharded arrays
+    equals the single-device result element-for-element."""
+    n = 256
+    ids = _rand_ids(rng, n)
+    state = build_ring(ids, RingConfig(finger_mode="materialized"))
+    victims = jnp.asarray(rng.choice(n, size=17, replace=False), jnp.int32)
+
+    plain = churn.stabilize_sweep(churn.fail(state, victims))
+
+    sstate = shard_ring(state, mesh)
+    ssweep = churn.stabilize_sweep(churn.fail(sstate, victims))
+
+    for name in ("ids", "alive", "n_valid", "min_key", "preds", "succs",
+                 "fingers"):
+        a, b_ = getattr(plain, name), getattr(ssweep, name)
+        if a is None:
+            assert b_ is None
+            continue
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_),
+                                      err_msg=name)
+
+
+def test_sharded_lookup_after_churn_and_sweep(rng, mesh):
+    """The scale-out workflow: fail peers -> sweep (GSPMD) -> sharded
+    lookup (shard_map) routes every key to the true survivor owner."""
+    n, b = 256, 96
+    state = build_ring(_rand_ids(rng, n), RingConfig(finger_mode="computed"))
+    sstate = shard_ring(state, mesh)
+    victims = jnp.asarray(rng.choice(n, size=31, replace=False), jnp.int32)
+    sstate = churn.stabilize_sweep(churn.leave(sstate, victims))
+
+    keys = keys_from_ints(_rand_ids(rng, b))
+    alive_rows = np.flatnonzero(np.asarray(sstate.alive))
+    starts = jnp.asarray(rng.choice(alive_rows, size=b), jnp.int32)
+
+    got_owner, got_hops = find_successor_sharded(sstate, keys, starts, mesh)
+    want_owner, want_hops = find_successor(sstate, keys, starts)
+
+    np.testing.assert_array_equal(np.asarray(got_owner),
+                                  np.asarray(want_owner))
+    np.testing.assert_array_equal(np.asarray(got_hops),
+                                  np.asarray(want_hops))
+    assert bool(jnp.all(got_owner >= 0))
+    # Owners must be alive survivors.
+    assert bool(jnp.all(sstate.alive[got_owner]))
