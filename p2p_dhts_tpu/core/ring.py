@@ -34,8 +34,9 @@ Two finger modes (RingConfig.finger_mode):
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,11 +50,23 @@ from p2p_dhts_tpu.ops import u128
 LANES = keyspace.LANES
 
 
-class RingState(NamedTuple):
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("ids", "alive", "n_valid", "min_key", "preds", "succs",
+                 "fingers"),
+    meta_fields=("max_hops",))
+@dataclasses.dataclass(frozen=True)
+class RingState:
     """Whole-ring state: what the reference scatters across N processes.
 
     Rows are peers, sorted ascending by id; rows >= n_valid are padding.
     All cross-references (preds/succs/fingers) are row indices, -1 = none.
+
+    `max_hops` rides along as STATIC pytree metadata (not an array leaf):
+    build_ring stamps it from RingConfig so every lookup op honors a
+    custom config without threading it through each call site by hand.
+    Being static, it is available at trace time for loop bounds and
+    changing it retraces — the same contract as a static_argnames arg.
     """
 
     ids: jax.Array                 # [N, 4] u32, sorted ascending
@@ -63,10 +76,15 @@ class RingState(NamedTuple):
     preds: jax.Array               # [N] i32: predecessor row
     succs: jax.Array               # [N, S] i32: successor-list rows
     fingers: Optional[jax.Array]   # [N, F] i32 or None (computed mode)
+    max_hops: int = DEFAULT_CONFIG.max_hops
 
     @property
     def capacity(self) -> int:
         return self.ids.shape[0]
+
+    def _replace(self, **kw) -> "RingState":
+        """NamedTuple-style functional update (all call sites use this)."""
+        return dataclasses.replace(self, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +217,7 @@ def build_ring(ids, cfg: RingConfig = DEFAULT_CONFIG,
         preds=jnp.asarray(preds),
         succs=jnp.asarray(succs),
         fingers=fingers,
+        max_hops=cfg.max_hops,
     )
 
 
@@ -215,7 +234,11 @@ def build_ring_from_seeds(seeds: Sequence[Tuple[str, int]],
 # alive-neighbor scan maps (shared with churn ops)
 # ---------------------------------------------------------------------------
 
-_BIG = jnp.int32(2**31 - 1)
+# Python int, NOT a jnp constant: a module-scope jnp.int32(...) creates a
+# concrete device array at import time, which force-initializes the default
+# backend the moment this module is imported — fatal in driver processes
+# whose TPU runtime is unusable (MULTICHIP_r02 libtpu-mismatch crash).
+_BIG = 2**31 - 1
 
 
 def live_mask(state: RingState) -> jax.Array:
@@ -476,12 +499,13 @@ def find_successor(state: RingState, keys: jax.Array,
     converged-ring loop and the full-semantics loop; both produce
     identical routes and hop counts wherever both are defined.
 
-    max_hops defaults to RingConfig's default (callers with a custom
-    RingConfig should pass cfg.max_hops explicitly — RingState carries no
-    config).
+    max_hops defaults to the value build_ring stamped into the state from
+    its RingConfig (static pytree metadata), so a custom
+    RingConfig(max_hops=...) is honored everywhere without explicit
+    threading; an explicit argument still overrides per call.
     """
     if max_hops is None:
-        max_hops = DEFAULT_CONFIG.max_hops
+        max_hops = state.max_hops
     return jax.lax.cond(
         _converged_all_alive(state),
         lambda: _fast_lookup(state, keys, start, max_hops),

@@ -42,11 +42,12 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from p2p_dhts_tpu.config import DEFAULT_CONFIG
 from p2p_dhts_tpu.core.ring import RingState
 from p2p_dhts_tpu.ops import u128
 
-_INT_MAX = jnp.int32(2**31 - 1)
+# Python int on purpose — a module-scope jnp constant would initialize the
+# default backend at import time (see core/ring.py:_BIG).
+_INT_MAX = 2**31 - 1
 
 
 def peer_mesh(devices=None, axis: str = "peer") -> Mesh:
@@ -70,7 +71,7 @@ def shard_ring(state: RingState, mesh: Mesh, axis: str = "peer"
     row = NamedSharding(mesh, P(axis))
     row2d = NamedSharding(mesh, P(axis, None))
     repl = NamedSharding(mesh, P())
-    return RingState(
+    return state._replace(
         ids=jax.device_put(state.ids, row2d),
         alive=jax.device_put(state.alive, row),
         n_valid=jax.device_put(state.n_valid, repl),
@@ -103,7 +104,7 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
     -> (owner [B] i32, hops [B] i32, -1 on hop-budget exhaustion).
     """
     if max_hops is None:
-        max_hops = DEFAULT_CONFIG.max_hops
+        max_hops = state.max_hops  # static metadata stamped by build_ring
     d = mesh.shape[axis]
     n = state.ids.shape[0]
     block = n // d

