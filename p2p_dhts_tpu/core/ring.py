@@ -390,13 +390,26 @@ def _succ_list_candidate(state: RingState, cur: jax.Array,
                          keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Vectorized RemotePeerList::Lookup(key, succ=True)
     (remote_peer_list.cpp:86-110): first successor-list entry whose
-    [prev_id, entry_id] range contains the key. Returns (row, found)."""
+    [prev_id, entry_id] range contains the key. Returns (row, found).
+
+    -1 holes (left mid-list by churn.leave's RemotePeerList::Delete
+    analog) are skipped when deriving each entry's range lower bound: the
+    reference's list is COMPACT (Delete erases the element, neighbors
+    become adjacent, remote_peer_list.cpp:134-150), so slot j's lower
+    bound is the id of the last VALID entry before j (own id if none) —
+    not the id of whatever row a hole's -1 would clamp-gather to.
+    """
     entries = state.succs[cur]                          # [B, S]
     valid = entries >= 0
     entry_ids = state.ids[jnp.maximum(entries, 0)]      # [B, S, 4]
     own_ids = state.ids[cur]                            # [B, 4]
-    prev_ids = jnp.concatenate(
-        [own_ids[:, None, :], entry_ids[:, :-1, :]], axis=1)
+    s = entries.shape[1]
+    prev_cols = []
+    prev = own_ids                                      # [B, 4]
+    for j in range(s):                                  # S is small (~8)
+        prev_cols.append(prev)
+        prev = jnp.where(valid[:, j:j + 1], entry_ids[:, j, :], prev)
+    prev_ids = jnp.stack(prev_cols, axis=1)             # [B, S, 4]
     hit = valid & u128.in_between(keys[:, None, :], prev_ids, entry_ids, True)
     j = jnp.argmax(hit, axis=1)
     found = jnp.any(hit, axis=1)

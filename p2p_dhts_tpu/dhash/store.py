@@ -143,10 +143,28 @@ def create_batch(ring: RingState, store: FragmentStore,
     stores nothing. Returns (store, ok [B] bool). Requires
     n_used + B*n <= capacity (overflowing rows are dropped and the lane
     reports failure).
+
+    Duplicate keys WITHIN one batch follow the sequential reference's
+    last-writer-wins: only the highest lane bearing a key stores rows
+    (earlier duplicates report their own placement success but their
+    fragments are superseded, exactly as a later Create overwrites an
+    earlier one) — without this, both lanes' rows would land in the store
+    and break the n-rows-per-key window invariant `_key_window` relies on.
     """
     b = keys.shape[0]
     smax = store.max_segments
     store = _purge_keys(store, keys)  # overwrite semantics on re-create
+
+    # Mark lanes superseded by a later lane with the same key: sort by
+    # (key, lane); a sorted position followed by an equal key is not the
+    # last writer.
+    lane = jnp.arange(b, dtype=jnp.int32)
+    sort_ops = [keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0], lane]
+    *_, perm = jax.lax.sort(sort_ops, num_keys=5)
+    skeys = keys[perm]
+    next_same = jnp.concatenate(
+        [u128.eq(skeys[1:], skeys[:-1]), jnp.zeros((1,), bool)])
+    superseded = jnp.zeros(b, bool).at[perm].set(next_same)
 
     owners, _ = get_n_successors(ring, keys, start, n, max_hops)   # [B, n]
     placed = owners >= 0
@@ -162,7 +180,7 @@ def create_batch(ring: RingState, store: FragmentStore,
     rows_holder = owners.reshape(-1)
     rows_vals = frags.reshape(b * n, smax)
     rows_len = jnp.broadcast_to(lengths[:, None], (b, n)).reshape(-1)
-    rows_ok = (placed & ok[:, None]).reshape(-1)
+    rows_ok = (placed & ok[:, None] & ~superseded[:, None]).reshape(-1)
 
     dest = store.n_used + jnp.cumsum(rows_ok.astype(jnp.int32)) - 1
     dest = jnp.where(rows_ok & (dest < store.capacity), dest,
@@ -178,9 +196,20 @@ def create_batch(ring: RingState, store: FragmentStore,
         used=store.used.at[dest].set(True, mode="drop"),
         n_used=store.n_used + stored.astype(jnp.int32).sum(),
     )
-    # Lanes whose rows overflowed the store are failures.
+    # Lanes whose rows overflowed the store are failures. A superseded
+    # duplicate lane reports its WINNER's verdict: its own data was
+    # (logically) overwritten, so "success" is only true if the key is
+    # actually in the store afterwards — i.e. the last writer stored.
     lane_stored = stored.reshape(b, n).sum(axis=1)
-    ok = ok & (lane_stored >= jnp.minimum(m, placed.sum(axis=1)))
+    ok_stored = ok & (lane_stored >= jnp.minimum(m, placed.sum(axis=1)))
+    # winner (last sorted position of each key group) for every lane:
+    # suffix-min of winner positions over the sorted order, mapped back.
+    pos_b = jnp.arange(b, dtype=jnp.int32)
+    winner_pos = jnp.where(~next_same, pos_b, b)          # sorted coords
+    winner_pos = jnp.flip(jax.lax.cummin(jnp.flip(winner_pos)))
+    winner_lane = perm[jnp.minimum(winner_pos, b - 1)]    # [B] sorted
+    winner_of = jnp.zeros(b, jnp.int32).at[perm].set(winner_lane)
+    ok = jnp.where(superseded, ok_stored[winner_of], ok_stored)
     return _sort_store(new), ok
 
 

@@ -85,7 +85,15 @@ class Client:
 
     @staticmethod
     def make_request(ip_addr: str, port: int, request: JsonObj,
-                     timeout: float = DEFAULT_TIMEOUT_S) -> JsonObj:
+                     timeout: Optional[float] = None) -> JsonObj:
+        # Default resolved at CALL time so a harness can lower
+        # rpc.DEFAULT_TIMEOUT_S process-wide: deep recursive handler
+        # chains right after mass churn can exhaust the 3-per-server
+        # worker pool (a reference-faithful design, server.h:294-307) and
+        # those requests only un-wedge via this timeout — the reference's
+        # tests wait out the same stalls with sleep(20)/sleep(40).
+        if timeout is None:
+            timeout = DEFAULT_TIMEOUT_S
         payload = json.dumps(request, separators=(",", ":")).encode()
         # Every transport failure surfaces as RpcError (a RuntimeError):
         # the reference throws boost::system::system_error, which IS-A

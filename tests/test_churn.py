@@ -217,3 +217,27 @@ def test_sweep_computed_mode_no_fingers(rng):
     sorted_ids = sorted(ids)
     survivors = [sorted_ids[i] for i in range(12) if i != 3]
     assert canonical(swept) == canonical(build_ring(survivors, cfg))
+
+
+def test_succ_list_hole_fallback_before_sweep(rng):
+    """Round-2 advisor finding (a): after churn.leave pokes -1 holes into
+    successor lists, a pre-sweep lookup that needs the dead-finger
+    fallback must derive each entry's range lower bound from the last
+    VALID preceding entry (the reference's list is compacted by
+    RemotePeerList::Delete) — not from the hole's clamped row-0 id, which
+    made this exact route fail spuriously."""
+    n = 16
+    ids = [(i + 1) << 120 for i in range(n)]  # sorted, deterministic
+    state = build_ring(ids, RingConfig(num_succs=3))
+    # Row n-1 holds the largest id; its low fingers and succ list head all
+    # point at row 0. Leave row 0: finger stays stale (quirk parity), the
+    # succ-list entry becomes a -1 hole.
+    state = churn.leave(state, jnp.asarray([0], jnp.int32))
+
+    k = ids[n - 1] + 2  # forces fi=1 -> stale finger at left row 0
+    owner, hops = find_successor(
+        state, keys_from_ints([k]), jnp.asarray([n - 1], jnp.int32))
+    # The compacted fallback routes via the next valid entry (row 1), the
+    # alive successor that inherited the leaver's range.
+    assert int(owner[0]) == 1, f"fallback mis-routed: owner {int(owner[0])}"
+    assert int(hops[0]) >= 0

@@ -238,3 +238,47 @@ def test_merkle_counts(rng):
     assert int(idx.counts.sum()) == 300
     assert idx.levels[-1].shape == (4096, 4)
     assert idx.levels[0].shape == (1, 4)
+
+
+def test_duplicate_keys_in_one_batch_last_writer_wins(rng):
+    """Round-2 advisor finding (b): duplicate keys WITHIN one batch must
+    not accumulate 2n rows for the key (breaking the n-rows-per-key
+    window invariant); the last lane wins, as sequential reference
+    Creates would overwrite."""
+    ring = build_ring(_random_ids(rng, 32), RingConfig(num_succs=3))
+    store = empty_store(4096, SMAX)
+    key = _random_ids(rng, 1)[0]
+    other = _random_ids(rng, 1)[0]
+    keys = keys_from_ints([key, other, key])  # lanes 0 and 2 collide
+    vals, segs, lengths = _make_blocks(rng, 3)
+    starts = jnp.asarray(rng.randint(0, 32, size=3), jnp.int32)
+    store, ok = create_batch(ring, store, keys, segs, lengths, starts,
+                             N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(ok))  # earlier duplicate reports success too
+    assert int(store.n_used) == 2 * N_IDA  # 2 distinct keys, n rows each
+
+    got, rok = read_batch(ring, store, keys, N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(rok))
+    got_np = np.asarray(got)
+    # Lane 2 (the last writer) defines the stored payload for `key`.
+    np.testing.assert_array_equal(
+        got_np[2, : int(lengths[2])], np.asarray(segs)[2, : int(lengths[2])])
+    np.testing.assert_array_equal(got_np[0], got_np[2])
+    np.testing.assert_array_equal(
+        got_np[1, : int(lengths[1])], np.asarray(segs)[1, : int(lengths[1])])
+
+
+def test_duplicate_key_superseded_lane_fails_if_winner_overflows(rng):
+    """If the WINNING duplicate lane cannot store (capacity overflow), the
+    superseded lane must not report success either — after _purge_keys the
+    key is simply gone, and a True verdict would claim a readable key."""
+    ring = build_ring(_random_ids(rng, 32), RingConfig(num_succs=3))
+    store = empty_store(M_IDA - 1, SMAX)  # fewer than m rows of room
+    key = _random_ids(rng, 1)[0]
+    keys = keys_from_ints([key, key])
+    vals, segs, lengths = _make_blocks(rng, 2)
+    store, ok = create_batch(ring, store, keys, segs, lengths,
+                             jnp.zeros(2, jnp.int32), N_IDA, M_IDA, P_IDA)
+    assert not bool(ok[0]) and not bool(ok[1])
+    _, rok = read_batch(ring, store, keys, N_IDA, M_IDA, P_IDA)
+    assert not bool(rok[0])

@@ -1,0 +1,335 @@
+"""Replay of the reference's OWN JSON fixtures against the host overlay.
+
+The reference builds multi-peer rings declaratively from JSON fixture
+files with pinned expected ids/hashes (test/json_reader.h:50-102; e.g.
+test/test_json/chord_tests/GetSuccTest.json). This suite loads the ACTUAL
+fixture files from /root/reference/test/test_json/ and replays each
+scenario through this package's wire-parity host layer, asserting the
+reference's pinned EXPECTED_* values — turning claimed parity into pinned
+parity.
+
+Ring bring-up mirrors ChordFromJson (json_reader.h:50-69): StartChord on
+peers[0], every later peer joins through peers[0], fixed fixture ports so
+SHA-1(ip:port) ids reproduce the exact pinned layouts. The reference's
+sleep()-based convergence waits become deterministic stabilize rounds
+(SURVEY.md §4 implications).
+
+The two 18-peer DHash fixtures double as the reference-scale integration
+tests (dhash_test.cpp:213-291): maintenance after leave AND after fail.
+"""
+
+import json
+import os
+
+import pytest
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key
+from p2p_dhts_tpu.net import rpc
+from p2p_dhts_tpu.overlay.chord_peer import ChordPeer
+from p2p_dhts_tpu.overlay.dhash_peer import DHashPeer
+
+FIXTURES = "/root/reference/test/test_json"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(FIXTURES), reason="reference fixtures not mounted")
+
+
+def load(rel):
+    with open(os.path.join(FIXTURES, rel)) as f:
+        return json.load(f)
+
+
+def hex_key(s: str) -> Key:
+    """Fixture hex strings are already-hashed keys (GenericKey's
+    hashed=true ctor, key.h:70-82); they may be 31 chars (no leading-zero
+    padding in IntToHexStr)."""
+    return Key(int(s, 16))
+
+
+@pytest.fixture
+def fast_rpc_timeout():
+    """Lower the wire-parity 5 s RPC timeout for the mass-churn replays:
+    post-churn recursive handler chains can wedge on the 3-per-server
+    worker pool until the client timeout frees them (the reference waits
+    these same stalls out with sleep(20)/sleep(40), dhash_test.cpp:252).
+    0.5 s keeps each stall short without changing any outcome."""
+    old = rpc.DEFAULT_TIMEOUT_S
+    rpc.DEFAULT_TIMEOUT_S = 0.5
+    yield
+    rpc.DEFAULT_TIMEOUT_S = old
+
+
+@pytest.fixture
+def ring_from_json():
+    """ChordFromJson twin: build peers from fixture PEER entries, start
+    chord on [0], join the rest through [0], run deterministic stabilize
+    rounds in place of the reference's background loop."""
+    peers = []
+
+    def build(peer_jsons, cls=ChordPeer, rounds=2, **kw):
+        ring = []  # this call's ring only (a test may build several)
+        for i, pj in enumerate(peer_jsons):
+            p = cls(pj["IP"], int(pj["PORT"]), int(pj["NUM_SUCCS"]),
+                    maintenance_interval=None, **kw)
+            ring.append(p)
+            peers.append(p)
+            if i == 0:
+                p.start_chord()
+            else:
+                p.join(ring[0].ip_addr, ring[0].port)
+            # Fixtures that pin ids let us verify the determinism trick
+            # up front: id == SHA-1("ip:port").
+            if "ID" in pj:
+                assert p.id == hex_key(pj["ID"]), \
+                    f"peer {pj['PORT']}: id mismatch vs pinned fixture"
+        converge(ring, rounds)
+        return ring
+
+    yield build
+    for p in peers:
+        try:
+            p.fail()
+        except Exception:
+            pass
+
+
+def converge(peers, rounds=2):
+    """Deterministic stand-in for the reference's 5 s StabilizeLoop +
+    sleep(6..40) waits: every live peer stabilizes, catch-and-continue
+    (chord_peer.cpp:225-238), repeated `rounds` times."""
+    for _ in range(rounds):
+        for p in peers:
+            try:
+                p.stabilize()
+            except RuntimeError:
+                pass
+
+
+def maintain_dhash(peers, rounds=2):
+    """One deterministic MaintenanceLoop round per peer (dhash_peer.cpp:
+    271-296): stabilize + global + local maintenance."""
+    for _ in range(rounds):
+        for p in peers:
+            try:
+                p.stabilize()
+                p.run_global_maintenance()
+                p.run_local_maintenance()
+            except RuntimeError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# chord_tests
+# ---------------------------------------------------------------------------
+
+def test_get_succ_fixture(ring_from_json):
+    """GetSuccTest.json: the finger-table and predecessor lookup cases
+    (chord_test.cpp's GetSucc tests)."""
+    fx = load("chord_tests/GetSuccTest.json")
+
+    # GET_SUCC_FROM_FINGER_TABLE: ring {7001, 7002}; the pinned successor.
+    sub = fx["GET_SUCC_FROM_FINGER_TABLE"]
+    peers = ring_from_json(sub["PEERS"])
+    succ = peers[0].get_successor(hex_key(sub["KEY_TO_LOOKUP"]))
+    assert succ.id == hex_key(sub["EXPECTED_SUCC_ID"])
+
+    # GET_SUCC_FROM_PREDECESSOR: ring {7003, 7004}; the key lands in the
+    # originating peer's predecessor's range (self-hit -> predecessor,
+    # chord_peer.cpp:194-196). No pinned id in the fixture; the expected
+    # owner is the ring successor of the key among the two known ids.
+    sub2 = fx["GET_SUCC_FROM_PREDECESSOR"]
+    peers2 = ring_from_json(sub2["PEERS"])
+    k = hex_key(sub2["KEY_TO_LOOKUP"])
+    ids = sorted(int(p.id) for p in peers2)
+    want = next((i for i in ids if i >= int(k)), ids[0])
+    got = peers2[0].get_successor(k)
+    assert int(got.id) == want
+
+
+def test_get_pred_fixture(ring_from_json):
+    """GetPredTest.json GET_PRED_IN_SUCC_LIST: 3-peer ring whose pinned
+    ids AND min_keys must reproduce, then a predecessor lookup resolved
+    via the successor list (abstract_chord_peer.cpp:394-423)."""
+    fx = load("chord_tests/GetPredTest.json")["GET_PRED_IN_SUCC_LIST"]
+    peers = ring_from_json(fx["PEERS"])
+    by_port = {p.port: p for p in peers}
+    for pj in fx["PEERS"]:
+        p = by_port[int(pj["PORT"])]
+        assert p.id == hex_key(pj["ID"])
+        assert int(p.min_key) == int(hex_key(pj["MIN_KEY"]))
+
+    # Predecessor of a key owned by peers[0]: the peer whose id precedes
+    # it on the ring (largest id below the owner).
+    ids = sorted(int(p.id) for p in peers)
+    k = int(peers[0].id)  # a key exactly at peers[0]'s id
+    owner_idx = ids.index(k)
+    want_pred = ids[(owner_idx - 1) % len(ids)]
+    got = peers[0].get_predecessor(Key(k))
+    assert int(got.id) == want_pred
+
+
+def test_chord_integration_join_fixture(ring_from_json):
+    """ChordIntegrationJoinTest.json: 6-node ring, 10 plaintext creates;
+    every peer's pinned EXPECTED_PREDECESSOR_ID and pinned hashed
+    EXPECTED_KV_PAIRS must land exactly (chord_test.cpp:645-683)."""
+    fx = load("chord_tests/ChordIntegrationJoinTest.json")
+    peers = ring_from_json(fx["PEERS"])
+
+    for k, v in fx["KV_PAIRS"].items():
+        peers[0].create(k, v)
+
+    for i, pj in enumerate(fx["PEERS"]):
+        p = peers[i]
+        assert p.predecessor.id == hex_key(pj["EXPECTED_PREDECESSOR_ID"]), \
+            f"peer {p.port}: wrong predecessor"
+        for hk, hv in pj["EXPECTED_KV_PAIRS"].items():
+            got = p.db.lookup(int(hex_key(hk)))
+            assert got == hv, f"peer {p.port}: key {hk} -> {got} != {hv}"
+
+
+def test_chord_integration_stabilize_fixture(ring_from_json):
+    """ChordIntegrationStabilizeTest.json: after one stabilize cycle every
+    peer's successor list matches the pinned EXPECTED_SUCCS
+    (chord_test.cpp:722-742)."""
+    fx = load("chord_tests/ChordIntegrationStabilizeTest.json")
+    peers = ring_from_json(fx["PEERS"])
+    for i, pj in enumerate(fx["PEERS"]):
+        got = [int(s.id) for s in peers[i].successors.get_entries()]
+        want = [int(hex_key(h)) for h in pj["EXPECTED_SUCCS"]]
+        assert got[: len(want)] == want, \
+            f"peer {peers[i].port}: succ list mismatch"
+
+
+def test_chord_integration_graceful_leave_fixture(ring_from_json):
+    """ChordIntegrationGracefulLeaveTest.json: 100 keys, all but one peer
+    leaves, the last peer must still read every key
+    (chord_test.cpp:751-774)."""
+    fx = load("chord_tests/ChordIntegrationGracefulLeaveTest.json")
+    peers = ring_from_json(fx["PEERS"])
+    n = len(peers)
+    for i in range(100):
+        peers[i % n].create(f"key{i}", f"value{i}")
+    for p in peers[: n - 1]:
+        p.leave()
+    last = peers[n - 1]
+    for i in range(100):
+        assert last.read(f"key{i}") == f"value{i}"
+
+
+def test_chord_integration_node_failure_fixture(ring_from_json):
+    """ChordIntegrationNodeFailureTest.json: fail peers[0:2] of 6, run
+    the stabilize rounds the reference awaits with sleep(40), then check
+    the survivors re-tiled the ring (chord_test.cpp:783-818; the fixture
+    file carries no EXPECTED_MINKEY/PREDECESSOR pins — the reference
+    compares against the empty string there, a known fixture gap — so the
+    converged-ring invariant is the meaningful assertion)."""
+    fx = load("chord_tests/ChordIntegrationNodeFailureTest.json")
+    peers = ring_from_json(fx["PEERS"])
+    peers[0].fail()
+    peers[1].fail()
+    survivors = peers[2:]
+    # sleep(40) in the reference = 8 five-second stabilize cycles.
+    converge(survivors, rounds=8)
+
+    by_id = sorted(survivors, key=lambda p: int(p.id))
+    n = len(by_id)
+    for i, p in enumerate(by_id):
+        want_pred = by_id[(i - 1) % n]
+        assert p.predecessor is not None
+        assert p.predecessor.id == want_pred.id
+        assert int(p.min_key) == (int(want_pred.id) + 1) % KEYS_IN_RING
+        # Successor-list healing, to the extent the PROTOCOL guarantees
+        # it: the reference's UpdateSuccList only inserts living peers
+        # and only the head-skip in Stabilize deletes dead entries
+        # (abstract_chord_peer.cpp:477-481,507-562), so dead NON-head
+        # entries may linger; the meaningful invariant is that the first
+        # living entry is the true next survivor.
+        first_living = p.successors.first_living()
+        assert int(first_living.id) == int(by_id[(i + 1) % n].id)
+
+
+# ---------------------------------------------------------------------------
+# dhash_tests
+# ---------------------------------------------------------------------------
+
+def test_dhash_global_maintenance_fixture(ring_from_json):
+    """GlobalMaintenanceTest.json MISPLACED_KEYS: misplaced fragments
+    inserted white-box into peers[TESTED_IND] must ALL move off it after
+    one RunGlobalMaintenance — its Merkle index hash ends equal to the
+    pinned EXPECTED_TESTED_HASH ("0" == empty tree) and the keys land on
+    peers[CORRECT_SUCC_IND] (dhash_test.cpp:123-149).
+
+    Port note: this machine's TPU tunnel relay permanently listens on
+    the fixture's ports 8102/8103, so the sockets run on an offset port
+    set (18600..18603) chosen so the ring has the fixture's structure:
+    every inserted key's ring successor is peers[CORRECT_SUCC_IND] and
+    not the tested peer. The fixture's pinned ids themselves are
+    asserted as pure host-keyspace parity (no sockets needed)."""
+    from p2p_dhts_tpu.ida import DataBlock
+
+    fx = load("dhash_tests/GlobalMaintenanceTest.json")["MISPLACED_KEYS"]
+    for pj in fx["PEERS"]:  # pinned id parity: id == SHA-1("ip:port")
+        assert Key.for_peer(pj["IP"], int(pj["PORT"])) == hex_key(pj["ID"])
+
+    remapped = [{**pj, "PORT": 18600 + i, "ID": None}
+                for i, pj in enumerate(fx["PEERS"])]
+    for pj in remapped:
+        del pj["ID"]
+    peers = ring_from_json(remapped, cls=DHashPeer)
+    for p in peers:
+        p.set_ida_params(2, 1, 257)  # the test's adjust_ida_params lambda
+
+    tested = peers[fx["TESTED_IND"]]
+    correct = peers[fx["CORRECT_SUCC_IND"]]
+    for hk in fx["KEYS_TO_INSERT"]:  # the remapped ring keeps the layout
+        k = int(hex_key(hk))
+        ids = sorted(int(p.id) for p in peers)
+        owner = next((i for i in ids if i >= k), ids[0])
+        assert owner == int(correct.id) and owner != int(tested.id)
+    for hk, val in fx["KEYS_TO_INSERT"].items():
+        block = DataBlock(val.encode(), 2, 1, 257)
+        tested.db.insert(int(hex_key(hk)), block.fragments[0])
+
+    tested.run_global_maintenance()
+
+    assert tested.db.get_index().root.hash == int(fx["EXPECTED_TESTED_HASH"],
+                                                  16)
+    for hk in fx["KEYS_TO_INSERT"]:
+        assert correct.db.contains(int(hex_key(hk))), \
+            f"key {hk} not pushed to the correct successor"
+
+
+def test_dhash_integration_maintenance_after_leave_fixture(ring_from_json,
+                                                           fast_rpc_timeout):
+    """DHashIntegrationMaintenanceAfterLeaveTest.json: 18-peer DHash ring
+    (n=14), 4 peers leave, remaining peers must still read every key
+    after maintenance (dhash_test.cpp:236-260)."""
+    fx = load("dhash_tests/DHashIntegrationMaintenanceAfterLeaveTest.json")
+    peers = ring_from_json(fx["PEERS"], cls=DHashPeer, rounds=1)
+    for k, v in fx["KV_PAIRS"].items():
+        peers[0].create(k, v)
+    for i in fx["LEAVING_INDICES"]:
+        peers[i].leave()
+    remaining = [peers[i] for i in fx["REMAINING_INDICES"]]
+    maintain_dhash(remaining, rounds=1)
+    for k, v in fx["KV_PAIRS"].items():
+        for p in remaining:
+            assert p.read(k) == v, f"peer {p.port} lost key {k}"
+
+
+def test_dhash_integration_maintenance_after_fail_fixture(ring_from_json,
+                                                          fast_rpc_timeout):
+    """DHashIntegrationMaintenanceAfterFailTest.json: same at 18 peers
+    with 4 silent FAILURES (n - m = 4 is exactly the loss tolerance,
+    dhash_peer.cpp:189-196; dhash_test.cpp:262-291)."""
+    fx = load("dhash_tests/DHashIntegrationMaintenanceAfterFailTest.json")
+    peers = ring_from_json(fx["PEERS"], cls=DHashPeer, rounds=1)
+    for k, v in fx["KV_PAIRS"].items():
+        peers[0].create(k, v)
+    for i in fx["FAILING_INDICES"]:
+        peers[i].fail()
+    remaining = [peers[i] for i in fx["REMAINING_INDICES"]]
+    maintain_dhash(remaining, rounds=2)
+    for k, v in fx["KV_PAIRS"].items():
+        for p in remaining:
+            assert p.read(k) == v, f"peer {p.port} lost key {k}"
