@@ -1,0 +1,88 @@
+"""Checkpoint / resume of device ring + store state (SURVEY.md §5.5).
+
+The reference's peers are memory-only; its nearest persistence analogs
+are fragment/file writes (ida.cpp:105-118, data_fragment.cpp:34-47),
+which the host layer mirrors in `ida.py`. This module adds what the
+reference never had and SURVEY §5.5 directs the rebuild to provide: a
+whole-simulation snapshot. A RingState / FragmentStore is a flat pytree
+of device arrays plus static metadata, so a checkpoint is one npz file —
+device->host gather on save, host->device upload on restore.
+
+Format: a single .npz whose keys are `ring/<field>`, `store/<field>`,
+plus `meta/*` scalars (format version, max_hops). `fingers` may be
+absent (computed-finger mode). Either section may be omitted.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.core.ring import RingState
+from p2p_dhts_tpu.dhash.store import FragmentStore
+
+FORMAT_VERSION = 1
+
+_RING_FIELDS = ("ids", "alive", "n_valid", "min_key", "preds", "succs")
+_STORE_FIELDS = ("keys", "frag_idx", "holder", "values", "length", "used",
+                 "n_used")
+
+
+def save_checkpoint(path: str, ring: Optional[RingState] = None,
+                    store: Optional[FragmentStore] = None) -> None:
+    """Write ring and/or store state to `path` (.npz, atomic rename)."""
+    if ring is None and store is None:
+        raise ValueError("nothing to checkpoint")
+    payload = {"meta/version": np.int64(FORMAT_VERSION)}
+    if ring is not None:
+        for f in _RING_FIELDS:
+            payload[f"ring/{f}"] = np.asarray(getattr(ring, f))
+        if ring.fingers is not None:
+            payload["ring/fingers"] = np.asarray(ring.fingers)
+        payload["meta/max_hops"] = np.int64(ring.max_hops)
+    if store is not None:
+        for f in _STORE_FIELDS:
+            payload[f"store/{f}"] = np.asarray(getattr(store, f))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(fh, **payload)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Tuple[Optional[RingState],
+                                        Optional[FragmentStore]]:
+    """Read a checkpoint; returns (ring or None, store or None)."""
+    with np.load(path) as z:
+        version = int(z["meta/version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(f"checkpoint format {version} != "
+                             f"{FORMAT_VERSION}")
+        ring = None
+        if "ring/ids" in z:
+            ring = RingState(
+                ids=jnp.asarray(z["ring/ids"]),
+                alive=jnp.asarray(z["ring/alive"]),
+                n_valid=jnp.asarray(z["ring/n_valid"]),
+                min_key=jnp.asarray(z["ring/min_key"]),
+                preds=jnp.asarray(z["ring/preds"]),
+                succs=jnp.asarray(z["ring/succs"]),
+                fingers=(jnp.asarray(z["ring/fingers"])
+                         if "ring/fingers" in z else None),
+                max_hops=int(z["meta/max_hops"]),
+            )
+        store = None
+        if "store/keys" in z:
+            store = FragmentStore(
+                keys=jnp.asarray(z["store/keys"]),
+                frag_idx=jnp.asarray(z["store/frag_idx"]),
+                holder=jnp.asarray(z["store/holder"]),
+                values=jnp.asarray(z["store/values"]),
+                length=jnp.asarray(z["store/length"]),
+                used=jnp.asarray(z["store/used"]),
+                n_used=jnp.asarray(z["store/n_used"]),
+            )
+    return ring, store

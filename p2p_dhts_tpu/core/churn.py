@@ -122,9 +122,14 @@ def leave(state: RingState, rows: jax.Array) -> RingState:
     preds = state.preds.at[succ_rows].set(pred_rows)
 
     # RemotePeerList::Delete of every leaver from every succ list.
+    # The gather runs on FLATTENED indices: a [N,S]-shaped index array
+    # into a 1-D table sends the XLA TPU compiler down a pathological
+    # path (~20 MINUTES of compile at N=10M, BENCH_r02's "19-minute
+    # churn"); the identical 1-D gather compiles in seconds.
     leaving = jnp.zeros((n,), dtype=bool).at[rows].set(True)
-    succs = jnp.where(leaving[jnp.maximum(state.succs, 0)]
-                      & (state.succs >= 0), -1, state.succs)
+    flat = state.succs.reshape(-1)
+    hit = leaving[jnp.maximum(flat, 0)] & (flat >= 0)
+    succs = jnp.where(hit, -1, flat).reshape(state.succs.shape)
     return state._replace(min_key=min_key, preds=preds, succs=succs)
 
 
@@ -308,8 +313,12 @@ def join(state: RingState, new_ids: jax.Array
         jt = u128.searchsorted(mid.ids, targets.reshape(-1, u128.LANES),
                                mid.n_valid)
         notified = jnp.where(jt > 0, pa[jnp.maximum(jt - 1, 0)], pa[n - 1])
-        notified = jnp.unique(notified, size=notified.shape[0],
-                              fill_value=-1)
+        # Sort-based dedup (jnp.unique lowers to a much heavier program):
+        # duplicates become -1, which the scatter below drops.
+        notified = jnp.sort(notified)
+        first_of_run = jnp.concatenate(
+            [jnp.ones((1,), bool), notified[1:] != notified[:-1]])
+        notified = jnp.where(first_of_run, notified, -1)
         # -1 fills route to index n, which mode="drop" discards (negative
         # scatter indices would wrap numpy-style).
         notified = jnp.where(notified >= 0, notified, n)

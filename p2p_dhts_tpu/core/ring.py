@@ -280,6 +280,50 @@ def prev_alive_map(state: RingState) -> jax.Array:
 # lookup kernel
 # ---------------------------------------------------------------------------
 
+def placement_converged(state: RingState) -> jax.Array:
+    """Scalar bool: every LIVE row has its alive ring predecessor in
+    `preds` and min_key == pred_id + 1 — i.e. custody boundaries tile the
+    surviving ring exactly (the post-sweep invariant). Weaker than
+    `_converged_all_alive` (dead rows allowed), strong enough that the
+    i-th successor of any key is simply the i-th next-alive row after its
+    owner — which licenses the O(n)-gather placement fast path in
+    dhash.store (vs n sequential full lookup sweeps)."""
+    live = live_mask(state)
+    n = state.ids.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    pa = prev_alive_map(state)
+    want_pred = jnp.where(rows > 0, pa[jnp.maximum(rows - 1, 0)], pa[n - 1])
+    preds_ok = ~jnp.any(live & (state.preds != want_pred))
+    pred_ids = state.ids[jnp.maximum(want_pred, 0)]
+    want_min = u128.add_scalar(pred_ids, 1)
+    mk_ok = ~jnp.any(live & ~u128.eq(state.min_key, want_min))
+    return preds_ok & mk_ok
+
+
+def n_successors_converged(state: RingState, keys: jax.Array, n: int
+                           ) -> jax.Array:
+    """[B, n] i32 owners of keys on a placement-converged ring: the alive
+    ring successor of each key, then n-1 next-alive steps — n single
+    gathers per key instead of n full hop-loop sweeps. Stops with -1 when
+    the walk wraps back to the first owner (GetNSuccessors'
+    already-in-list break, abstract_chord_peer.cpp:345-373). Caller must
+    hold `placement_converged(state)` (see dhash.store.placement_owners
+    for the guarded dispatch)."""
+    na = next_alive_map(state)
+    nn = state.ids.shape[0]
+    first = na[u128.searchsorted(state.ids, keys, state.n_valid)]
+    b = keys.shape[0]
+    cols = []
+    cur = first
+    stopped = first < 0  # no alive peer at all
+    for _ in range(n):
+        cols.append(jnp.where(stopped, -1, cur))
+        nxt = na[jnp.minimum(jnp.maximum(cur, -1) + 1, nn)]
+        stopped = stopped | (nxt == first)
+        cur = nxt
+    return jnp.stack(cols, axis=1)
+
+
 def _converged_all_alive(state: RingState) -> jax.Array:
     """Scalar bool: every valid row alive AND min_key == pred_id + 1.
 
@@ -300,6 +344,57 @@ def _converged_all_alive(state: RingState) -> jax.Array:
     return all_alive & preds_ok & mk_ok
 
 
+def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
+                       cur0: jax.Array, max_hops: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Straggler-compacted lockstep hop driver, shared by `_fast_lookup`
+    and the shard_map kernel (core/sharded.py — all its lane state is
+    replicated, so the permutation is shard-safe).
+
+    body_for(keys, owner0) -> while_loop body over (cur, hops, it);
+    termination is cur == owner0 per lane. Hop counts are ~log2(N)
+    distributed, so a single full-width loop runs ~2x the mean trip count
+    for a shrinking tail: phase 1 runs full-width until <= B/8 lanes
+    remain, then a stable partition (two cumsums + one scatter, paid
+    once) packs the stragglers into a B/8 prefix and phase 2 finishes at
+    1/8 width. If phase 1 exits on the hop budget with > B/8 stragglers
+    they are failed lookups anyway (max_hops == routing loop), so losing
+    them past the prefix is safe: phase 2 runs zero trips and the final
+    cur != owner0 test marks them failed. Returns (cur, hops).
+    """
+    b = keys.shape[0]
+    p = max(b // 8, 1)
+
+    def cond1(carry):
+        cur, _, it = carry
+        return (jnp.sum(cur != owner0) > p) & (it < max_hops)
+
+    cur, hops, it = jax.lax.while_loop(
+        cond1, body_for(keys, owner0),
+        (cur0, jnp.zeros(b, jnp.int32), jnp.int32(0)))
+
+    not_done = cur != owner0
+    n_nd = jnp.cumsum(not_done)
+    pos = jnp.where(not_done, n_nd - 1,
+                    n_nd[-1] + jnp.cumsum(~not_done) - 1).astype(jnp.int32)
+    inv = jnp.zeros(b, jnp.int32).at[pos].set(
+        jnp.arange(b, dtype=jnp.int32))
+    cur_c, hops_c = cur[inv], hops[inv]
+    keys_c, owner0_c = keys[inv], owner0[inv]
+
+    def cond2(carry):
+        cur_p, _, it = carry
+        return (~jnp.all(cur_p == owner0_c[:p])) & (it < max_hops)
+
+    cur_p, hops_p, _ = jax.lax.while_loop(
+        cond2, body_for(keys_c[:p], owner0_c[:p]),
+        (cur_c[:p], hops_c[:p], it))
+
+    cur = jnp.concatenate([cur_p, cur_c[p:]])[pos]
+    hops = jnp.concatenate([hops_p, hops_c[p:]])[pos]
+    return cur, hops
+
+
 def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
                  max_hops: int) -> Tuple[jax.Array, jax.Array]:
     """Lean hop loop for converged all-alive rings — identical route and
@@ -307,15 +402,9 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
     everything that can't trigger there: per-hop min_key gathers (16 B),
     the succ-list fallback ([B,S] gathers + S-wide u128 compares, the
     round-1 profile's dominant cost), and alive-mask gathers. Termination
-    is cur == ring_successor(key), precomputed once per lane.
+    is cur == ring_successor(key), precomputed once per lane; the loop
+    itself is the shared straggler-compacted `two_phase_hop_loop`.
     Per-hop random traffic: ids[cur] 16 B + finger 4 B + pred 4 B.
-
-    Two-phase straggler compaction: hop counts are ~log2(N)-distributed,
-    so the lockstep loop would run ~2x the mean trip count at full batch
-    width for a shrinking tail. Phase 1 runs full-width until <= B/8
-    lanes remain; phase 2 stable-partitions the stragglers into a B/8
-    prefix (two cumsums + one scatter, paid once) and finishes on 1/8 of
-    the width.
     """
     ids, preds = state.ids, state.preds
     materialized = state.fingers is not None
@@ -341,44 +430,8 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
             return cur, hops, it + 1
         return body
 
-    b = keys.shape[0]
-    p = max(b // 8, 1)
     cur0 = jnp.asarray(start, dtype=jnp.int32)
-
-    # Phase 1: full width while > p stragglers (and hop budget remains).
-    def cond1(carry):
-        cur, _, it = carry
-        return (jnp.sum(cur != owner0) > p) & (it < max_hops)
-
-    cur, hops, it = jax.lax.while_loop(
-        cond1, body_for(keys, owner0),
-        (cur0, jnp.zeros(b, jnp.int32), jnp.int32(0)))
-
-    # Stable partition: stragglers first. If phase 1 exited on the hop
-    # budget with > p stragglers, they are all failed lookups anyway
-    # (max_hops == routing loop), so losing them past the prefix is safe:
-    # phase 2's loop runs zero trips and the final cur != owner0 test
-    # marks them failed.
-    not_done = cur != owner0
-    n_nd = jnp.cumsum(not_done)
-    pos = jnp.where(not_done, n_nd - 1,
-                    n_nd[-1] + jnp.cumsum(~not_done) - 1).astype(jnp.int32)
-    inv = jnp.zeros(b, jnp.int32).at[pos].set(
-        jnp.arange(b, dtype=jnp.int32))
-    cur_c, hops_c = cur[inv], hops[inv]
-    keys_c, owner0_c = keys[inv], owner0[inv]
-
-    # Phase 2: finish the prefix at 1/8 width.
-    def cond2(carry):
-        cur_p, _, it = carry
-        return (~jnp.all(cur_p == owner0_c[:p])) & (it < max_hops)
-
-    cur_p, hops_p, _ = jax.lax.while_loop(
-        cond2, body_for(keys_c[:p], owner0_c[:p]),
-        (cur_c[:p], hops_c[:p], it))
-
-    cur = jnp.concatenate([cur_p, cur_c[p:]])[pos]
-    hops = jnp.concatenate([hops_p, hops_c[p:]])[pos]
+    cur, hops = two_phase_hop_loop(body_for, keys, owner0, cur0, max_hops)
 
     failed = cur != owner0  # hop budget exhausted == routing loop
     owner = jnp.where(failed, -1, cur)

@@ -42,7 +42,13 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from p2p_dhts_tpu.core.ring import RingState
+from p2p_dhts_tpu.core.ring import (
+    RingState,
+    live_mask,
+    next_alive_map,
+    placement_converged,
+    two_phase_hop_loop,
+)
 from p2p_dhts_tpu.ops import u128
 
 # Python int on purpose — a module-scope jnp constant would initialize the
@@ -83,6 +89,31 @@ def shard_ring(state: RingState, mesh: Mesh, axis: str = "peer"
     )
 
 
+BUCKET_BITS = 16  # top-id-bits bucket table: 256 KiB/shard, exact search
+
+
+def routing_converged(state: RingState) -> jax.Array:
+    """Scalar bool: is the state converged ENOUGH for the sharded kernel?
+
+    Delegates to `ring.placement_converged` (live rows carry their alive
+    ring predecessor — the self-hit correction target,
+    chord_peer.cpp:194-196 — and the matching custody boundary);
+    fail()/sweep-pending states violate it, leave()/join() repair it
+    inline. For materialized fingers it additionally spot-checks the head
+    finger (finger 0 == next alive row), a cheap necessary condition for
+    a swept table; higher fingers are trusted as the sweep's output.
+    Plain GSPMD ops, one O(N/D) elementwise pass per shard.
+    """
+    ok = placement_converged(state)
+    if state.fingers is not None:
+        n = state.ids.shape[0]
+        live = live_mask(state)
+        rows = jnp.arange(n, dtype=jnp.int32)
+        want_f0 = next_alive_map(state)[jnp.minimum(rows + 1, n)]
+        ok = ok & ~jnp.any(live & (state.fingers[:, 0] != want_f0))
+    return ok
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "max_hops"))
 def find_successor_sharded(state: RingState, keys: jax.Array,
                            start: jax.Array, mesh: Mesh,
@@ -93,15 +124,31 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
 
     The scale-out twin of `ring.find_successor`'s fast path (same route,
     same hop counts — see module doc): lane state replicated, table
-    sharded, one pmin + up to two psums of [B]-shaped data per hop over
+    sharded, one pmin + one fused psum of [B]-shaped data per hop over
     ICI. Supports both finger modes; computed mode is the memory-free
     path to 10M+ peers (no [N,128] matrix anywhere).
 
-    Converged rings (run the sweep first after churn): dead rows are
+    The three fast-path optimizations (each matters at 10M, where every
+    B-sized HBM gather is the unit of cost):
+      * bucketed successor search — a per-shard 2^16-bucket table cuts
+        each binary search from log2(block) to ~log2(occupancy) gather
+        steps (u128.bucket_starts);
+      * fused per-hop gathers — id lanes + predecessor ride ONE psum
+        ([B,5] i32) instead of two collectives;
+      * two-phase straggler compaction — hop counts are ~log2(N)
+        distributed, so after the bulk resolves the loop repacks the
+        <= B/8 stragglers into a prefix and finishes at 1/8 width
+        (`ring._fast_lookup`'s trick, replicated lane state makes the
+        permutation shard-safe).
+
+    Converged rings only (run the sweep first after churn): dead rows are
     skipped by the successor search exactly as computed fingers skip them
     (`ring.py`: always-converged finger targets), so post-sweep routing
-    matches the general single-device loop. keys [B,4] u32, start [B] i32
-    -> (owner [B] i32, hops [B] i32, -1 on hop-budget exhaustion).
+    matches the general single-device loop. The precondition is GUARDED:
+    `routing_converged` runs first and an un-swept state fails every
+    lane loudly (all -1) instead of returning silently wrong routes.
+    keys [B,4] u32, start [B] i32 -> (owner [B] i32, hops [B] i32, -1 on
+    hop-budget exhaustion or an unconverged ring).
     """
     if max_hops is None:
         max_hops = state.max_hops  # static metadata stamped by build_ring
@@ -141,32 +188,35 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
                                 off + suffix[0])
         global_first = jax.lax.pmin(first_alive, axis)
 
+        bstarts = u128.bucket_starts(ids_blk, BUCKET_BITS)
+
         def ring_succ(q):
-            """Global alive ring-successor row of q: local binary search,
-            local next-alive skip, then pmin over shards (the table is
-            globally sorted, so min valid global row == min id); no
-            candidate anywhere wraps to the globally-first alive row."""
-            j = u128.searchsorted(ids_blk, q)            # [B] in [0, block]
+            """Global alive ring-successor row of q: bucketed local
+            binary search, local next-alive skip, then pmin over shards
+            (the table is globally sorted, so min valid global row ==
+            min id); no candidate anywhere wraps to the globally-first
+            alive row."""
+            j = u128.searchsorted_bucketed(ids_blk, q, bstarts,
+                                           BUCKET_BITS)  # [B] in [0, block]
             jj = suffix_ext[j]                           # alive slot >= j
             cand = jnp.where(jj == _INT_MAX, _INT_MAX, off + jj)
             best = jax.lax.pmin(cand, axis)
             return jnp.where(best == _INT_MAX, global_first, best)
 
-        def gather1(tbl_blk, rows):
-            """tbl_blk [block] i32 at global rows — masked-own + psum."""
+        def gather_ids_pred(rows):
+            """ids + predecessor at global rows in ONE fused psum:
+            [B,5] i32 (4 id lanes reinterpreted + pred row). Exactly one
+            shard contributes non-zero per lane, so the modular int32 add
+            is exact."""
             loc = rows - off
             own = (loc >= 0) & (loc < block)
-            v = jnp.where(own, tbl_blk[jnp.clip(loc, 0, block - 1)], 0)
-            return jax.lax.psum(v, axis)
-
-        def gather_ids(rows):
-            """ids at global rows [B] -> [B,4] u32 via int32 psum (one
-            non-zero contributor per lane, so modular add is exact)."""
-            loc = rows - off
-            own = (loc >= 0) & (loc < block)
-            v = ids_blk[jnp.clip(loc, 0, block - 1)].astype(jnp.int32)
+            loc_c = jnp.clip(loc, 0, block - 1)
+            v = jnp.concatenate(
+                [ids_blk[loc_c].astype(jnp.int32),
+                 preds_blk[loc_c][:, None]], axis=1)
             v = jnp.where(own[:, None], v, 0)
-            return jax.lax.psum(v, axis).astype(jnp.uint32)
+            out = jax.lax.psum(v, axis)
+            return out[:, :4].astype(jnp.uint32), out[:, 4]
 
         def gather_finger(rows, fi):
             f_blk = tables[3]
@@ -177,36 +227,49 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
 
         owner0 = ring_succ(keys)
 
-        def cond(carry):
-            cur, _, it = carry
-            return (~jnp.all(cur == owner0)) & (it < max_hops)
+        def body_for(keys_, owner0_):
+            def body(carry):
+                cur, hops, it = carry
+                done = cur == owner0_
+                cur_ids, cur_pred = gather_ids_pred(cur)
+                dist = u128.sub(keys_, cur_ids)
+                fi = jnp.maximum(u128.bit_length(dist) - 1, 0)
+                if materialized:
+                    nxt = gather_finger(cur, fi)
+                else:
+                    starts = u128.add(cur_ids, u128.pow2(fi))
+                    nxt = ring_succ(starts)
+                # Self-hit -> predecessor (chord_peer.cpp:194-196).
+                nxt = jnp.where(nxt == cur, cur_pred, nxt)
+                cur = jnp.where(done, cur, nxt)
+                hops = jnp.where(done, hops, hops + 1)
+                return cur, hops, it + 1
+            return body
 
-        def body(carry):
-            cur, hops, it = carry
-            done = cur == owner0
-            cur_ids = gather_ids(cur)
-            dist = u128.sub(keys, cur_ids)
-            fi = jnp.maximum(u128.bit_length(dist) - 1, 0)
-            if materialized:
-                nxt = gather_finger(cur, fi)
-            else:
-                starts = u128.add(cur_ids, u128.pow2(fi))
-                nxt = ring_succ(starts)
-            # Self-hit -> predecessor (chord_peer.cpp:194-196).
-            nxt = jnp.where(nxt == cur, gather1(preds_blk, cur), nxt)
-            cur = jnp.where(done, cur, nxt)
-            hops = jnp.where(done, hops, hops + 1)
-            return cur, hops, it + 1
-
-        b = keys.shape[0]
+        # Shared straggler-compacted driver (ring.two_phase_hop_loop):
+        # every lane-state input is replicated across shards, so the
+        # partition permutation is identical everywhere and the psum/pmin
+        # collectives inside body_for stay aligned.
         cur0 = jnp.asarray(start, jnp.int32)
-        cur, hops, _ = jax.lax.while_loop(
-            cond, body, (cur0, jnp.zeros(b, jnp.int32), jnp.int32(0)))
+        cur, hops = two_phase_hop_loop(body_for, keys, owner0, cur0,
+                                       max_hops)
+
         failed = cur != owner0
         return (jnp.where(failed, -1, cur), jnp.where(failed, -1, hops))
 
-    return kernel(tables, state.n_valid, keys,
-                  jnp.asarray(start, jnp.int32))
+    # Guard BEFORE the kernel (lax.cond: only the taken branch executes):
+    # an un-swept state fails every lane with one O(N/D) predicate pass
+    # instead of spinning the full hop loop just to discard it.
+    starts_i = jnp.asarray(start, jnp.int32)
+
+    def fail_all():
+        neg = jnp.full((keys.shape[0],), -1, jnp.int32)
+        return neg, neg
+
+    return jax.lax.cond(
+        routing_converged(state),
+        lambda: kernel(tables, state.n_valid, keys, starts_i),
+        fail_all)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))

@@ -32,8 +32,9 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from p2p_dhts_tpu.core.ring import RingState, get_n_successors
-from p2p_dhts_tpu.dhash.store import FragmentStore, _key_window, _sort_store
+from p2p_dhts_tpu.core.ring import RingState
+from p2p_dhts_tpu.dhash.store import (
+    FragmentStore, _key_window, _sort_store, placement_owners)
 from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
 from p2p_dhts_tpu.ops import u128
 
@@ -48,7 +49,7 @@ def global_maintenance(ring: RingState, store: FragmentStore,
     reference uses each holding peer itself; pass store.holder clamped,
     or any alive rows).
     """
-    owners, _ = get_n_successors(ring, store.keys, start, n, max_hops)
+    owners = placement_owners(ring, store.keys, start, n, max_hops)
     target = jnp.take_along_axis(
         owners, jnp.clip(store.frag_idx - 1, 0, n - 1)[:, None], axis=1)[:, 0]
     # Only fragments on ALIVE holders can be pushed — a dead peer's store
@@ -126,7 +127,7 @@ def local_maintenance(ring: RingState, store: FragmentStore,
     all_frags = encode_kernel(segments, n, m, p)                    # [C, n, S]
 
     # Designated holders for every index.
-    owners, _ = get_n_successors(ring, store.keys, start, n, max_hops)
+    owners = placement_owners(ring, store.keys, start, n, max_hops)
     holder_alive = ring.alive[jnp.maximum(owners, 0)] & (owners >= 0)
     need = can_repair[:, None] & ~present & holder_alive            # [C, n]
 

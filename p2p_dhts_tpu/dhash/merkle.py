@@ -19,10 +19,23 @@ exchange collapses into log-depth array compares.
 Parity notes:
   * "equal hashes <=> equal key sets" is preserved in the same sense as
     the reference: hashes cover keys only, not values.
-  * The hash function differs (the reference SHA-1s hex strings; here
-    keys — already SHA-1 outputs — are mixed and summed). The host wire
-    layer derives reference-exact hashes host-side where needed; the
-    device index is the sync-decision engine.
+  * The hash function differs: the reference SHA-1s concatenated hex
+    strings; here each key (already a SHA-1 output) is avalanche-mixed
+    and bucket-combined by lane-wise modular SUM. The sum is commutative
+    and NOT collision-resistant against an adversary who controls keys
+    (e.g. keys crafted so their mixes cancel), so this index is strictly
+    an anti-entropy engine between HONEST stores — the reference's
+    MerkleTree serves the same non-Byzantine role (its leaf hashes cover
+    keys only, so an adversary can already serve wrong values there).
+  * Reference-EXACT hashes (SHA-1 of concatenated key hex strings,
+    merkle_tree.h:724-749) live in the host layer:
+    overlay/merkle_tree.py computes them and the host DHash sync path
+    uses them on the wire (overlay/dhash_peer.py synchronize /
+    exchange_node, XCHNG_NODE parity); the fixture replay pins one
+    (tests/test_fixtures.py::test_dhash_global_maintenance_fixture,
+    EXPECTED_TESTED_HASH). Device index and host tree are two
+    implementations of the same role at two trust/precision points, not
+    a claimed hash-compatibility.
 """
 
 from __future__ import annotations

@@ -26,9 +26,33 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from p2p_dhts_tpu.core.ring import RingState, get_n_successors
+from p2p_dhts_tpu.core.ring import (
+    RingState,
+    get_n_successors,
+    n_successors_converged,
+    placement_converged,
+)
 from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
 from p2p_dhts_tpu.ops import u128
+
+
+def placement_owners(ring: RingState, keys: jax.Array, start: jax.Array,
+                     n: int, max_hops=None) -> jax.Array:
+    """[B, n] i32: rows of each key's first n successors — fragment i-1
+    goes on row [:, i-1] (DHashPeer::Create, dhash_peer.cpp:106-123).
+
+    Runtime dispatch (lax.cond — only the taken branch executes): on a
+    placement-converged ring the n successors of a key are its owner and
+    the n-1 next-alive rows after it (one gather each); otherwise the
+    full GetNSuccessors hop-loop walk runs. The walk costs n sequential
+    batched lookup sweeps, so the fast path is what makes bulk puts and
+    maintenance placement O(n) gathers instead of O(n * hops * log N).
+    """
+    return jax.lax.cond(
+        placement_converged(ring),
+        lambda: n_successors_converged(ring, keys, n),
+        lambda: get_n_successors(ring, keys, start, n, max_hops)[0],
+    )
 
 
 class FragmentStore(NamedTuple):
@@ -166,7 +190,7 @@ def create_batch(ring: RingState, store: FragmentStore,
         [u128.eq(skeys[1:], skeys[:-1]), jnp.zeros((1,), bool)])
     superseded = jnp.zeros(b, bool).at[perm].set(next_same)
 
-    owners, _ = get_n_successors(ring, keys, start, n, max_hops)   # [B, n]
+    owners = placement_owners(ring, keys, start, n, max_hops)      # [B, n]
     placed = owners >= 0
     ok = placed.sum(axis=1) >= m
 

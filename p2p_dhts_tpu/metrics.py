@@ -1,0 +1,96 @@
+"""Structured metrics + profiler tracing (SURVEY.md §5.1 green field).
+
+The reference's only observability is `AbstractChordPeer::Log` — raw
+stdout lines (abstract_chord_peer.cpp:714-718) — plus the Server's
+optional 32-entry request ring buffer (server.h:364-378, mirrored in
+net/rpc.py RequestLog). This module adds what the reference never had:
+
+  * `Metrics` — a process-wide, thread-safe registry of counters and
+    latency timers. The RPC server counts every dispatched command and
+    error; clients time requests; overlay maintenance ops count rounds.
+    `snapshot()` returns a plain dict for tests/bench JSON.
+  * `timed(name)` — context manager / decorator recording wall-clock
+    latency (count / total / max) under `timers`.
+  * `device_trace(path)` — context manager around `jax.profiler` for
+    TPU timeline capture of the device kernels (no-op if the profiler
+    is unavailable on the platform, e.g. the CPU test mesh).
+
+Everything is stdlib + optional jax.profiler; recording a metric is a
+dict update under one lock — cheap enough for the RPC dispatch path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+
+class Metrics:
+    """Thread-safe counters + timers registry."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._timers: Dict[str, Dict[str, float]] = {}
+
+    def inc(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            t = self._timers.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            t["count"] += 1
+            t["total_s"] += seconds
+            t["max_s"] = max(t["max_s"], seconds)
+
+    @contextlib.contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers": {k: dict(v) for k, v in self._timers.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+
+#: Process-wide default registry (the RPC layer and overlay peers record
+#: here; tests may swap in their own Metrics instance).
+METRICS = Metrics()
+
+
+@contextlib.contextmanager
+def device_trace(path: str, enabled: bool = True) -> Iterator[None]:
+    """jax.profiler trace of everything inside the block to `path`
+    (TensorBoard format). Degrades to a no-op when profiling is
+    unsupported on the active platform."""
+    if not enabled:
+        yield
+        return
+    try:
+        import jax
+        jax.profiler.start_trace(path)
+    except Exception:
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass

@@ -26,9 +26,12 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
+
+from p2p_dhts_tpu.metrics import METRICS
 
 JsonObj = dict
 Handler = Callable[[JsonObj], JsonObj]
@@ -94,6 +97,20 @@ class Client:
         # tests wait out the same stalls with sleep(20)/sleep(40).
         if timeout is None:
             timeout = DEFAULT_TIMEOUT_S
+        METRICS.inc("rpc.client.requests")
+        t0 = time.perf_counter()
+        try:
+            return Client._make_request_inner(ip_addr, port, request,
+                                              timeout)
+        except RpcError:
+            METRICS.inc("rpc.client.errors")
+            raise
+        finally:
+            METRICS.observe("rpc.client.request", time.perf_counter() - t0)
+
+    @staticmethod
+    def _make_request_inner(ip_addr: str, port: int, request: JsonObj,
+                            timeout: float) -> JsonObj:
         payload = json.dumps(request, separators=(",", ":")).encode()
         # Every transport failure surfaces as RpcError (a RuntimeError):
         # the reference throws boost::system::system_error, which IS-A
@@ -265,14 +282,27 @@ class Server:
 
     def _process(self, req: JsonObj) -> JsonObj:
         """Dispatch + envelope (ref Session::HandleRead/ProcessRequest,
-        server.h:128-210)."""
+        server.h:128-210), with structured metrics the reference lacks
+        (SURVEY.md §5.1): per-command counters + dispatch latency.
+        Everything including the COMMAND read stays inside the try so a
+        valid-JSON non-object body ([1,2], "hi") still gets the
+        SUCCESS:false envelope, as it did via the reference's
+        exception-to-envelope path. Counter keys are bounded to KNOWN
+        commands (peer-supplied garbage would otherwise grow the metrics
+        dict without limit); unknown ones share one counter."""
         try:
             command = req.get("COMMAND", "")
-            handler = self.handlers.get(command)
-            if handler is None:
-                raise RuntimeError("Invalid command.")
-            resp = handler(req) or {}
+            if command in self.handlers:
+                METRICS.inc(f"rpc.server.command.{command}")
+            else:
+                METRICS.inc("rpc.server.invalid_command")
+            with METRICS.timed("rpc.server.dispatch"):
+                handler = self.handlers.get(command)
+                if handler is None:
+                    raise RuntimeError("Invalid command.")
+                resp = handler(req) or {}
             resp["SUCCESS"] = True
             return resp
         except Exception as exc:  # handler errors -> SUCCESS false
+            METRICS.inc("rpc.server.handler_error")
             return {"SUCCESS": False, "ERRORS": str(exc)}

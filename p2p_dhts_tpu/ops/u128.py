@@ -171,6 +171,20 @@ def in_between(v: jax.Array, lb: jax.Array, ub: jax.Array, inclusive: bool = Tru
 # sorted search — successor resolution over a sorted id table
 # ---------------------------------------------------------------------------
 
+def _bisect_step(sorted_ids: jax.Array, q: jax.Array, lo: jax.Array,
+                 hi: jax.Array):
+    """One halving of every query's [lo, hi) window: the shared body of
+    searchsorted and searchsorted_bucketed (gather mid row, lex compare,
+    shrink the active windows)."""
+    active = lo < hi
+    mid = (lo + hi) // 2
+    mid_ids = sorted_ids[mid]
+    go_right = active & lt(mid_ids, q)
+    lo = jnp.where(go_right, mid + 1, lo)
+    hi = jnp.where(active & ~go_right, mid, hi)
+    return lo, hi
+
+
 def searchsorted(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Array:
     """Index of the first entry >= q in a lexicographically sorted [N, 4] table.
 
@@ -189,14 +203,7 @@ def searchsorted(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Array
     steps = max(1, (n - 1).bit_length() + 1) if n > 0 else 1
 
     def body(_, carry):
-        lo, hi = carry
-        active = lo < hi
-        mid = (lo + hi) // 2
-        mid_ids = sorted_ids[mid]
-        go_right = active & lt(mid_ids, q)
-        lo = jnp.where(go_right, mid + 1, lo)
-        hi = jnp.where(active & ~go_right, mid, hi)
-        return lo, hi
+        return _bisect_step(sorted_ids, q, *carry)
 
     lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
     return lo
@@ -208,3 +215,52 @@ def ring_successor(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Arr
     idx = searchsorted(sorted_ids, q, n_valid)
     limit = jnp.int32(n if n_valid is None else n_valid)
     return jnp.where(idx >= limit, 0, idx)
+
+
+# ---------------------------------------------------------------------------
+# bucketed sorted search — fewer gathers per query on big tables
+# ---------------------------------------------------------------------------
+
+def bucket_starts(sorted_ids: jax.Array, bits: int) -> jax.Array:
+    """[2^bits + 1] i32 bucket table over the top `bits` id bits.
+
+    starts[b] = index of the first row whose top bits are >= b, so rows
+    with top bits exactly b live in [starts[b], starts[b+1]). Computed as
+    one batched binary search for the 2^bits bucket boundary keys (NOT a
+    scatter-add histogram: a 10M-update scatter is exactly the op class
+    that sends the TPU compiler into multi-minute lowering, while this
+    searchsorted pattern is the kernel's own proven-fast primitive).
+    Amortized over the hop loop the table cuts every query's binary
+    search from log2(N) gather steps to log2(bucket occupancy) — ~24 vs
+    ~6 B-sized gathers per search at N = 10M, and HBM gathers are the
+    whole cost of computed-finger mode.
+    """
+    nb = 2 ** bits
+    n = sorted_ids.shape[0]
+    bvals = (jnp.arange(nb, dtype=jnp.uint32) << _u32(32 - bits))
+    q = jnp.zeros((nb, LANES), _U32).at[:, 3].set(bvals)
+    starts = searchsorted(sorted_ids, q).astype(jnp.int32)
+    return jnp.concatenate([starts, jnp.full((1,), n, jnp.int32)])
+
+
+def searchsorted_bucketed(sorted_ids: jax.Array, q: jax.Array,
+                          starts: jax.Array, bits: int) -> jax.Array:
+    """searchsorted() with per-query bounds from a bucket_starts table.
+
+    Exact for any id distribution (the binary search runs to
+    convergence via while_loop); the bucket table only narrows the
+    initial [lo, hi) window.
+    """
+    b = (q[..., 3] >> _u32(32 - bits)).astype(jnp.int32)
+    lo = starts[b]
+    hi = starts[b + 1]
+
+    def cond(carry):
+        lo, hi = carry
+        return jnp.any(lo < hi)
+
+    def body(carry):
+        return _bisect_step(sorted_ids, q, *carry)
+
+    lo, _ = jax.lax.while_loop(cond, body, (lo, hi))
+    return lo

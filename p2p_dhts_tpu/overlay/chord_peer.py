@@ -29,6 +29,7 @@ import time
 from typing import Dict, List, Optional
 
 from p2p_dhts_tpu.keyspace import Key
+from p2p_dhts_tpu.metrics import METRICS
 from p2p_dhts_tpu.net.rpc import Client, JsonObj, Server
 from p2p_dhts_tpu.overlay.database import TextDb
 from p2p_dhts_tpu.overlay.finger_table import Finger, FingerTable
@@ -299,6 +300,7 @@ class AbstractChordPeer:
     # -- maintenance -------------------------------------------------------
     def stabilize(self) -> None:
         """ref Stabilize (abstract_chord_peer.cpp:460-505)."""
+        METRICS.inc("overlay.stabilize_rounds")
         self.log("Running stabilize.")
         if self.predecessor is not None \
                 and not self.predecessor.is_alive():

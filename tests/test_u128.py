@@ -191,3 +191,23 @@ class TestJitCompatibility:
             )
         )
         jitted(a, b)  # must trace + compile cleanly
+
+
+def test_bucketed_searchsorted_matches_plain(rng):
+    from p2p_dhts_tpu.ops import u128 as u
+    import numpy as np
+    import jax.numpy as jnp
+    for n, bits in [(513, 6), (4096, 12)]:
+        lanes = np.frombuffer(rng.bytes(16 * n), dtype="<u4").reshape(-1, 4).copy()
+        lanes = lanes[np.lexsort((lanes[:, 0], lanes[:, 1], lanes[:, 2],
+                                  lanes[:, 3]))]
+        ids = jnp.asarray(lanes)
+        q = jnp.asarray(np.frombuffer(rng.bytes(16 * 256),
+                                      dtype="<u4").reshape(-1, 4).copy())
+        q = jnp.concatenate([q, ids[:3], ids[-2:],
+                             jnp.zeros((1, 4), jnp.uint32),
+                             jnp.full((1, 4), 0xFFFFFFFF, jnp.uint32)])
+        want = u.searchsorted(ids, q)
+        got = u.searchsorted_bucketed(ids, q, u.bucket_starts(ids, bits),
+                                      bits)
+        assert bool(jnp.all(want == got)), (n, bits)
