@@ -51,13 +51,19 @@ class AbstractChordPeer:
 
     def __init__(self, ip_addr: str, port: int, num_succs: int,
                  backend: str = "python",
-                 maintenance_interval: Optional[float] = 5.0):
+                 maintenance_interval: Optional[float] = 5.0,
+                 num_server_threads: int = 3):
+        # num_server_threads defaults to the reference's 3 io workers
+        # (chord_peer.cpp:42). Deep recursive handler chains right after
+        # mass churn can exhaust 3 workers and wedge until the client
+        # timeout (the reference sleeps these stalls out); harnesses may
+        # raise it to trade threads for wall-clock.
         self.ip_addr = ip_addr
         self.num_succs = num_succs
         self.backend = backend
         self.maintenance_interval = maintenance_interval
 
-        self.server = Server(port, {}, num_threads=3)
+        self.server = Server(port, {}, num_threads=num_server_threads)
         self.port = self.server.port
         self.server.handlers.update(self.handlers())
 
@@ -479,10 +485,11 @@ class ChordPeer(AbstractChordPeer):
 
     def __init__(self, ip_addr: str, port: int, num_succs: int,
                  backend: str = "python",
-                 maintenance_interval: Optional[float] = 5.0):
+                 maintenance_interval: Optional[float] = 5.0,
+                 num_server_threads: int = 3):
         self.db = TextDb()
         super().__init__(ip_addr, port, num_succs, backend,
-                         maintenance_interval)
+                         maintenance_interval, num_server_threads)
 
     def handlers(self):
         return {

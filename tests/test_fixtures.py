@@ -63,16 +63,25 @@ def fast_rpc_timeout():
 def ring_from_json():
     """ChordFromJson twin: build peers from fixture PEER entries, start
     chord on [0], join the rest through [0], run deterministic stabilize
-    rounds in place of the reference's background loop."""
-    peers = []
+    rounds in place of the reference's background loop.
+
+    Teardown fail()s every peer in every ring list the factory returned —
+    INCLUDING peers appended later (add_json_nodes appends in place), so
+    late joiners don't leak servers on the pinned fixture ports."""
+    rings = []
 
     def build(peer_jsons, cls=ChordPeer, rounds=2, **kw):
         ring = []  # this call's ring only (a test may build several)
+        rings.append(ring)
+        # 8 io workers instead of the reference's 3: the big churn
+        # replays otherwise wedge worker pools on recursive handler
+        # chains until the client timeout frees them (protocol-faithful
+        # but slow; the reference sleeps through the same stalls).
+        kw.setdefault("num_server_threads", 8)
         for i, pj in enumerate(peer_jsons):
             p = cls(pj["IP"], int(pj["PORT"]), int(pj["NUM_SUCCS"]),
                     maintenance_interval=None, **kw)
             ring.append(p)
-            peers.append(p)
             if i == 0:
                 p.start_chord()
             else:
@@ -86,11 +95,12 @@ def ring_from_json():
         return ring
 
     yield build
-    for p in peers:
-        try:
-            p.fail()
-        except Exception:
-            pass
+    for ring in rings:
+        for p in ring:
+            try:
+                p.fail()
+            except Exception:
+                pass
 
 
 def converge(peers, rounds=2):
@@ -333,3 +343,128 @@ def test_dhash_integration_maintenance_after_fail_fixture(ring_from_json,
     for k, v in fx["KV_PAIRS"].items():
         for p in remaining:
             assert p.read(k) == v, f"peer {p.port} lost key {k}"
+
+
+def add_json_nodes(ring, peer_jsons, cls, **kw):
+    """AddJsonNodesToChord twin (json_reader.h:80-102): new nodes join
+    through peers[1] to avoid gateway-knowledge bias."""
+    kw.setdefault("num_server_threads", 8)
+    out = []
+    for pj in peer_jsons:
+        p = cls(pj["IP"], int(pj["PORT"]), int(pj["NUM_SUCCS"]),
+                maintenance_interval=None, **kw)
+        ring.append(p)
+        out.append(p)
+        p.join(ring[1].ip_addr, ring[1].port)
+        if "ID" in pj:
+            assert p.id == hex_key(pj["ID"])
+    return out
+
+
+def test_chord_integration_create_and_read_fixture(ring_from_json):
+    """ChordIntegrationCreateAndReadTest.json: 100 keys created from every
+    peer, readable from every peer (chord_test.cpp:695-715)."""
+    fx = load("chord_tests/ChordIntegrationCreateAndReadTest.json")
+    peers = ring_from_json(fx["PEERS"])
+    n = len(peers)
+    for i in range(0, 100, n):
+        for j in range(n):
+            peers[j].create(str(i + j), str(i + j))
+    for i in range(100):
+        for p in peers:
+            assert p.read(str(i)) == str(i)
+
+
+def test_dhash_integration_create_and_read_fixture(ring_from_json):
+    """DHashIntegrationCreateAndReadTest.json: 28-peer DHash ring (n=14),
+    one create, readable from EVERY peer (dhash_test.cpp:213-226)."""
+    fx = load("dhash_tests/DHashIntegrationCreateAndReadTest.json")
+    peers = ring_from_json(fx["PEERS"], cls=DHashPeer, rounds=1)
+    peers[0].create(fx["KEY"], fx["VAL"])
+    for p in peers:
+        assert p.read(fx["KEY"]) == fx["VAL"]
+
+
+def _dhash_sync_ring(ring_from_json, sub, create_keys):
+    """Build a SetIdaParams(3,2,257) DHash ring from a Synchronize
+    fixture sub-object (the adjust_ida_params lambda of
+    dhash_test.cpp:29-32), create the given keys through peers[0], join
+    PEERS_TO_JOIN, and return (peers, last_joined)."""
+    peers = ring_from_json(sub["PEERS"], cls=DHashPeer)
+    for p in peers:
+        p.set_ida_params(3, 2, 257)
+    for hk, hv in create_keys:
+        peers[0].create(hex_key(hk), hv)
+    joined = add_json_nodes(peers, sub["PEERS_TO_JOIN"], DHashPeer)
+    for p in joined:
+        p.set_ida_params(3, 2, 257)
+    return peers, joined[-1]
+
+
+def test_dhash_synchronize_fixtures(ring_from_json):
+    """LocalMaintenanceTest.json — the three DHashSynchronize scenarios
+    (dhash_test.cpp:20-110): single-key diff synced; diff OUTSIDE the
+    given range NOT synced; deep-tree sync across differing structures."""
+    fx = load("dhash_tests/LocalMaintenanceTest.json")
+
+    # DEPTH_ONE_SINGLE_KEY: trees equal after synchronize.
+    sub = fx["DEPTH_ONE_SINGLE_KEY"]
+    peers, new = _dhash_sync_ring(
+        ring_from_json, sub,
+        [(sub["KEY_TO_INSERT"], sub["VAL_TO_INSERT"])])
+    peers[0].synchronize(new.to_remote_peer(),
+                         (peers[0].min_key, peers[0].id))
+    assert new.db.get_index().root.hash == peers[0].db.get_index().root.hash
+
+    # SYNCHRONIZE_USES_GIVEN_RANGE: diff outside range stays.
+    sub2 = fx["SYNCHRONIZE_USES_GIVEN_RANGE"]
+    peers2, new2 = _dhash_sync_ring(
+        ring_from_json, sub2,
+        [(sub2["KEY_TO_INSERT"], sub2["VAL_TO_INSERT"])])
+    peers2[0].synchronize(
+        new2.to_remote_peer(),
+        (hex_key(sub2["SYNCHRONIZE_LOWER_BOUND"]),
+         hex_key(sub2["SYNCHRONIZE_UPPER_BOUND"])))
+    assert new2.db.get_index().root.hash \
+        != peers2[0].db.get_index().root.hash
+
+    # HIGH_DEPTH: >8 adjacent keys force a leaf split; sync across the
+    # differing tree structures still equalizes.
+    sub3 = fx["HIGH_DEPTH"]
+    peers3, new3 = _dhash_sync_ring(ring_from_json, sub3,
+                                    list(sub3["KEYS_TO_INSERT"].items()))
+    peers3[0].synchronize(
+        new3.to_remote_peer(),
+        (hex_key(sub3["SYNCHRONIZE_LOWER_BOUND"]),
+         hex_key(sub3["SYNCHRONIZE_UPPER_BOUND"])))
+    assert new3.db.get_index().root.hash \
+        == peers3[0].db.get_index().root.hash
+
+
+def test_dhash_exchange_node_fixture(ring_from_json):
+    """ExchangeNodeTest.json: EXISTING_NODE returns the remote's
+    equivalently-positioned node; NON_EXISTENT_NODE (deeper local tree)
+    raises (dhash_test.cpp:157-208)."""
+    fx = load("dhash_tests/ExchangeNodeTest.json")
+
+    sub = fx["EXISTING_NODE"]
+    peers = ring_from_json(sub["PEERS"], cls=DHashPeer)
+    for p in peers:
+        p.set_ida_params(3, 2, 257)
+    remote = peers[0].exchange_node(
+        peers[1].to_remote_peer(), peers[0].db.get_index().root,
+        (peers[0].id + 1, peers[0].id))
+    assert remote.hash == peers[1].db.get_index().root.hash
+
+    sub2 = fx["NON_EXISTENT_NODE"]
+    peers2 = ring_from_json(sub2["PEERS"], cls=DHashPeer)
+    for p in peers2:
+        p.set_ida_params(3, 2, 257)
+    from p2p_dhts_tpu.ida import DataBlock
+    for hk, hv in sub2["KEYS_TO_INSERT"].items():
+        peers2[0].db.insert(int(hex_key(hk)),
+                            DataBlock(hv, 3, 2, 257).fragments[0])
+    deep_child = peers2[0].db.get_index().root.children[0]
+    with pytest.raises(RuntimeError):
+        peers2[0].exchange_node(peers2[1].to_remote_peer(), deep_child,
+                                (peers2[0].id + 1, peers2[0].id))
