@@ -112,11 +112,26 @@ def fingers_for_ids(table_ids: jax.Array, n_valid: jax.Array,
     build, stabilize sweep, and join all call it.
     """
     r = peer_ids.shape[0]
+    n = table_ids.shape[0]
+    # Big tables get a bucket table built once for all chunks: each of
+    # the r*F searches drops from log2(n) to ~log2(occupancy) gathers
+    # (u128.bucket_starts) — the bulk of a 1M+-ring materialization.
+    big = n >= (1 << u128.DEFAULT_BUCKET_BITS)
+    if big:
+        bstarts = u128.bucket_starts(table_ids, u128.DEFAULT_BUCKET_BITS)
     cols = []
     for f0 in range(0, num_fingers, chunk):
         fs = jnp.arange(f0, min(f0 + chunk, num_fingers), dtype=jnp.int32)
         starts = u128.add(peer_ids[:, None, :], u128.pow2(fs)[None, :, :])
-        j = u128.searchsorted(table_ids, starts.reshape(-1, LANES), n_valid)
+        q = starts.reshape(-1, LANES)
+        if big:
+            # Padding-safe without the n_valid bound: padding rows are
+            # all-0xFF and sort last, so both searches agree everywhere
+            # (see u128.ring_successor_bucketed).
+            j = u128.searchsorted_bucketed(table_ids, q, bstarts,
+                                           u128.DEFAULT_BUCKET_BITS)
+        else:
+            j = u128.searchsorted(table_ids, q, n_valid)
         if na is None:
             idx = jnp.where(j >= n_valid, 0, j)  # plain ring wrap
         else:
@@ -408,7 +423,21 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
     """
     ids, preds = state.ids, state.preds
     materialized = state.fingers is not None
-    owner0 = u128.ring_successor(ids, keys, state.n_valid)
+    # Big rings resolve successors through a bucket table (built once per
+    # call, amortized over the batch): owner0 always, plus every hop in
+    # computed-finger mode.
+    big = ids.shape[0] >= (1 << u128.DEFAULT_BUCKET_BITS)
+    if big:
+        bstarts = u128.bucket_starts(ids, u128.DEFAULT_BUCKET_BITS)
+
+        def ring_succ(q):
+            return u128.ring_successor_bucketed(
+                ids, q, bstarts, u128.DEFAULT_BUCKET_BITS, state.n_valid)
+    else:
+        def ring_succ(q):
+            return u128.ring_successor(ids, q, state.n_valid)
+
+    owner0 = ring_succ(keys)
 
     def body_for(keys_, owner0_):
         def body(carry):
@@ -421,7 +450,7 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
                 nxt = state.fingers[cur, fi]
             else:
                 starts = u128.add(cur_ids, u128.pow2(fi))
-                nxt = u128.ring_successor(ids, starts, state.n_valid)
+                nxt = ring_succ(starts)
             # Self-hit -> predecessor (always alive here),
             # chord_peer.cpp:194-196.
             nxt = jnp.where(nxt == cur, preds[cur], nxt)

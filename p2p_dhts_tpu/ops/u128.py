@@ -221,6 +221,11 @@ def ring_successor(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Arr
 # bucketed sorted search — fewer gathers per query on big tables
 # ---------------------------------------------------------------------------
 
+#: Default top-bits width for bucket tables; callers gate bucketing on
+#: table size >= 2**DEFAULT_BUCKET_BITS (below that a plain binary
+#: search is already as cheap as the table build).
+DEFAULT_BUCKET_BITS = 16
+
 def bucket_starts(sorted_ids: jax.Array, bits: int) -> jax.Array:
     """[2^bits + 1] i32 bucket table over the top `bits` id bits.
 
@@ -241,6 +246,22 @@ def bucket_starts(sorted_ids: jax.Array, bits: int) -> jax.Array:
     q = jnp.zeros((nb, LANES), _U32).at[:, 3].set(bvals)
     starts = searchsorted(sorted_ids, q).astype(jnp.int32)
     return jnp.concatenate([starts, jnp.full((1,), n, jnp.int32)])
+
+
+def ring_successor_bucketed(sorted_ids: jax.Array, q: jax.Array,
+                            starts: jax.Array, bits: int,
+                            n_valid=None) -> jax.Array:
+    """ring_successor() via a bucket_starts table — identical result.
+
+    Capacity-padded tables work unchanged: padding rows are all-0xFF
+    lanes, which sort after every real id and land in the last bucket,
+    so the first index >= q is never a padding row unless q exceeds all
+    real ids — exactly the wrap-to-0 case.
+    """
+    n = sorted_ids.shape[0]
+    idx = searchsorted_bucketed(sorted_ids, q, starts, bits)
+    limit = jnp.int32(n if n_valid is None else n_valid)
+    return jnp.where(idx >= limit, 0, idx)
 
 
 def searchsorted_bucketed(sorted_ids: jax.Array, q: jax.Array,

@@ -287,3 +287,31 @@ def test_get_n_successors_parity(rng, n_peers, n_req):
         want = oracle.get_n_successors(sorted_ids[starts[j]], key_ints[j], n_req)
         got = [_row_to_id(state, int(r)) for r in owners[j] if int(r) >= 0]
         assert got == want, f"lane {j}: got {got} want {want}"
+
+
+def test_bucketed_big_ring_parity(rng):
+    """Rings past the bucket-table threshold (2^16 rows) resolve through
+    u128.searchsorted_bucketed; owners must match the omniscient
+    resolution exactly and hop counts must match the oracle on a
+    sample, in both finger modes."""
+    n = 70_000  # > 1 << 16
+    lanes = np.frombuffer(rng.bytes(16 * n), dtype="<u4").reshape(-1, 4).copy()
+    key_ints = _random_ids(rng, 64)
+    keys = keys_from_ints(key_ints)
+    starts = jnp.asarray(rng.randint(0, n, size=64), jnp.int32)
+
+    sorted_lanes = lanes[np.lexsort((lanes[:, 0], lanes[:, 1],
+                                     lanes[:, 2], lanes[:, 3]))]
+    sorted_ids = keyspace.lanes_to_ints(sorted_lanes)
+    oracle = OracleRing(sorted_ids)
+
+    for mode in ("materialized", "computed"):
+        state = build_ring(lanes, RingConfig(finger_mode=mode))
+        owner, hops = find_successor(state, keys, starts)
+        god = owner_of(state, keys)
+        assert bool(jnp.all(owner == god)), f"owner mismatch ({mode})"
+        for j in range(0, 64, 4):
+            want_owner, want_hops = oracle.find_successor(
+                sorted_ids[int(starts[j])], key_ints[j])
+            assert sorted_ids[int(owner[j])] == want_owner
+            assert int(hops[j]) == want_hops, f"hop mismatch ({mode})"
