@@ -215,7 +215,7 @@ class DHashPeer(AbstractChordPeer):
                             try:
                                 self.create_key(Key(key_int), frag, succ)
                                 self.db.delete(key_int)
-                            except (RuntimeError, KeyError):
+                            except RuntimeError:
                                 pass
             current_key = succs[0].id if succs else next_key
         self.log("Global maintenance over")

@@ -1,5 +1,14 @@
 """Host Merkle tree, hash-compatible with the reference's MerkleTree.
 
+Missing-key/position errors raise RuntimeError (not KeyError): the
+reference throws std::runtime_error from Lookup/Update/Delete
+(merkle_tree.h:153,231,255) and every overlay recovery path catches
+RuntimeError — a KeyError would sail through them (found replaying
+ReadKeyTest.json NON_EXISTENT_KEY). LookupByPosition differs upstream:
+the reference returns std::nullopt and its caller dies on .value()
+(bad_optional_access, dhash_peer.cpp:469-477); here the same condition
+raises RuntimeError so the RPC envelope reports it instead of crashing.
+
 Mirrors src/data_structures/merkle_tree.h: an 8-ary tree partitioning the
 whole 2^128 keyspace; leaves split at more than 8 kv-pairs
 (merkle_tree.h:126-128); node hashes are SHA-1 (the same UUIDv5 derivation
@@ -111,7 +120,7 @@ class MerkleNode:
     def lookup(self, key: int) -> object:
         if self.is_leaf():
             if key not in self.data:
-                raise KeyError("Key nonexistent.")
+                raise RuntimeError("Key nonexistent.")
             return self.data[key]
         return self.children[self.child_num(key)].lookup(key)
 
@@ -123,7 +132,7 @@ class MerkleNode:
     def update(self, key: int, val: object) -> None:
         if self.is_leaf():
             if key not in self.data:
-                raise KeyError("Key nonexistent.")
+                raise RuntimeError("Key nonexistent.")
             self.data[key] = val
         else:
             self.children[self.child_num(key)].update(key, val)
@@ -132,7 +141,7 @@ class MerkleNode:
     def delete(self, key: int) -> None:
         if self.is_leaf():
             if key not in self.data:
-                raise KeyError("Key nonexistent.")
+                raise RuntimeError("Key nonexistent.")
             del self.data[key]
         else:
             self.children[self.child_num(key)].delete(key)
@@ -221,7 +230,7 @@ class MerkleTree:
         node = self.root
         for step in position:
             if node.is_leaf():
-                raise KeyError("Position beyond leaf.")
+                raise RuntimeError("Position beyond leaf.")
             node = node.children[step]
         return node
 

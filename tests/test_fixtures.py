@@ -468,3 +468,182 @@ def test_dhash_exchange_node_fixture(ring_from_json):
     with pytest.raises(RuntimeError):
         peers2[0].exchange_node(peers2[1].to_remote_peer(), deep_child,
                                 (peers2[0].id + 1, peers2[0].id))
+
+
+def _peer_req_json(obj):
+    """Fixture NEW_PEER objects carry "IP" where the wire form uses
+    "IP_ADDR", and some omit MIN_KEY (the reference's jsoncpp ctor
+    silently reads "" / null there, remote_peer.cpp:24); normalize for
+    RemotePeer.from_json."""
+    out = dict(obj)
+    out.setdefault("IP_ADDR", out.get("IP", ""))
+    out.setdefault("MIN_KEY", "0")
+    return out
+
+
+def test_notify_fixtures(ring_from_json):
+    """NotifyTest.json — the three NotifyHandler cases
+    (chord_test.cpp:241-326): from-pred custody+key transfer, from-succ
+    list/finger adoption, irrelevant-node no-op."""
+    fx = load("chord_tests/NotifyTest.json")
+
+    # NOTIFY_FROM_PRED: pred updates, min_key follows, keys transfer.
+    sub = fx["NOTIFY_FROM_PRED"]
+    peers = ring_from_json(sub["PEERS"])
+    for hk, hv in sub["KEYS_TO_STORE"].items():
+        peers[0].create(hex_key(hk), hv)
+    resp = peers[0].notify_handler(
+        {"NEW_PEER": _peer_req_json(sub["JSON_REQ"]["NEW_PEER"])})
+    new_id = hex_key(sub["JSON_REQ"]["NEW_PEER"]["ID"])
+    assert peers[0].predecessor.id == new_id
+    assert int(peers[0].min_key) == (int(new_id) + 1) % KEYS_IN_RING
+    got = {int(k, 16): v
+           for k, v in (resp.get("KEYS_TO_ABSORB") or {}).items()}
+    want = {int(k, 16): v for k, v in sub["KEYS_TO_XFER"].items()}
+    assert got == want
+
+    # NOTIFY_FROM_SUCC: new peer becomes the head successor and every
+    # finger entry (a 2-peer ring's fingers all point at the lone other
+    # peer, and AdjustFingers rewrites them all).
+    sub2 = fx["NOTIFY_FROM_SUCC"]
+    peers2 = ring_from_json(sub2["PEERS"])
+    new_peer2 = _peer_req_json(sub2["JSON_REQ"]["NEW_PEER"])
+    peers2[0].notify_handler({"NEW_PEER": new_peer2})
+    new_id2 = hex_key(new_peer2["ID"])
+    assert peers2[0].successors.get_nth_entry(0).id == new_id2
+    for i in range(peers2[0].finger_table.size()):
+        assert peers2[0].finger_table.get_nth_entry(i).id == new_id2
+
+    # NOTIFY_FROM_IRRELEVANT_NODE: neither pred nor succ list changes.
+    sub3 = fx["NOTIFY_FROM_IRRELEVANT_NODE"]
+    peers3 = ring_from_json(sub3["PEERS"])
+    new_peer3 = _peer_req_json(sub3["JSON_REQ"]["NEW_PEER"])
+    peers3[0].notify_handler({"NEW_PEER": new_peer3})
+    new_id3 = hex_key(new_peer3["ID"])
+    assert peers3[0].predecessor.id != new_id3
+    assert all(int(s.id) != int(new_id3)
+               for s in peers3[0].successors.get_entries())
+
+
+def test_stabilize_fixtures(ring_from_json):
+    """StabilizeTest.json (chord_test.cpp:327-388): dead-successor
+    skipping and the notify-succ-with-dead-pred repair."""
+    fx = load("chord_tests/StabilizeTest.json")
+
+    sub = fx["CHECKS_SUCCS"]
+    peers = ring_from_json(sub["PEERS"])
+    for i, pj in enumerate(sub["PEERS"]):
+        if pj["KILL"]:
+            peers[i].fail()
+    peers[0].stabilize()
+    assert peers[0].successors.get_nth_entry(0).id \
+        == hex_key(sub["EXPECTED_SUCC_ID"])
+
+    sub2 = fx["NOTIFIES_SUCC_WITH_DEAD_PRED"]
+    peers2 = ring_from_json(sub2["PEERS"])
+    for i, pj in enumerate(sub2["PEERS"]):
+        if pj["KILL"]:
+            peers2[i].fail()
+    peers2[sub2["STABILIZE_IND"]].stabilize()
+    assert peers2[sub2["TESTED_IND"]].predecessor.id \
+        == hex_key(sub2["EXPECTED_PRED_ID"])
+
+
+@pytest.mark.parametrize("case", ["SINGLE_NODE_BETWEEN_SUCCS",
+                                  "MULTIPLE_NODES_BETWEEN_SUCCS",
+                                  "CLOCKWISE_EXPANSION_NEEDED",
+                                  "NO_CHANGES_NEEDED"])
+def test_update_succ_list_fixtures(ring_from_json, case):
+    """UpdateSuccTest.json (chord_test.cpp:389-488): pred-walk gap
+    filling discovers late joiners; clockwise expansion refills a short
+    list; a current list is left unchanged.
+
+    NO_CHANGES_NEEDED's fixture pins ids that are NOT SHA-1("ip:port")
+    (stale upstream data — the reference's ChordFromJson derives ids
+    from ip:port, so its own EXPECT_EQ against those ids cannot pass
+    either); for that case the pinned-id fields are dropped and the
+    semantic claim is asserted instead: with the real ids the joiners
+    fall outside the first num_succs successors, so update_succ_list
+    changes nothing and the list stays the true clockwise list."""
+    fx = load("chord_tests/UpdateSuccTest.json")[case]
+    stale = case == "NO_CHANGES_NEEDED"
+    base_peers = ([{k: v for k, v in pj.items() if k != "ID"}
+                   for pj in fx["PEERS"]] if stale else fx["PEERS"])
+    peers = ring_from_json(base_peers)
+    before = [int(s.id) for s in peers[0].successors.get_entries()]
+    join_jsons = ([{k: v for k, v in pj.items() if k != "ID"}
+                   for pj in fx["JOINING_PEERS"]] if stale
+                  else fx["JOINING_PEERS"])
+    add_json_nodes(peers, join_jsons, ChordPeer)
+    peers[0].update_succ_list()
+    got = [int(s.id) for s in peers[0].successors.get_entries()]
+    if stale:
+        assert got == before  # no changes needed
+        all_ids = sorted(int(p.id) for p in peers)
+        me = int(peers[0].id)
+        clockwise = [i for i in all_ids if i > me] + \
+                    [i for i in all_ids if i < me]
+        assert got == clockwise[: len(got)]
+    else:
+        want = [int(hex_key(e["ID"])) for e in fx["EXPECTED_SUCCS"]]
+        assert got[: len(want)] == want
+
+
+def test_leave_fixtures(ring_from_json):
+    """LeaveTest.json (chord_test.cpp:489-559): leave updates the
+    successor's pred and min_key and transfers the leaver's keys."""
+    fx = load("chord_tests/LeaveTest.json")
+
+    sub = fx["LEAVE_UPDATES_PRED"]
+    peers = ring_from_json(sub["PEERS"])
+    peers[sub["LEAVE_INDEX"]].leave()
+    assert peers[sub["TEST_INDEX"]].predecessor.id \
+        == hex_key(sub["EXPECTED_PRED_ID"])
+
+    sub2 = fx["LEAVE_UPDATES_MINKEY"]
+    peers2 = ring_from_json(sub2["PEERS"])
+    peers2[sub2["LEAVE_INDEX"]].leave()
+    assert int(peers2[sub2["TEST_INDEX"]].min_key) \
+        == int(hex_key(sub2["EXPECTED_MINKEY"]))
+
+    sub3 = fx["LEAVE_TRANSFERS_KEYS"]
+    peers3 = ring_from_json(sub3["PEERS"])
+    for hk, hv in sub3["KVS_TO_TRANSFER"].items():
+        peers3[0].create(hex_key(hk), hv)
+    peers3[sub3["LEAVE_INDEX"]].leave()
+    tested = peers3[sub3["TEST_INDEX"]]
+    for hk, hv in sub3["KVS_TO_TRANSFER"].items():
+        assert tested.db.contains(int(hex_key(hk)))
+        assert tested.db.lookup(int(hex_key(hk))) == hv
+
+
+def test_create_read_key_handler_fixtures(ring_from_json):
+    """CreateKeyTest.json + ReadKeyTest.json (chord_test.cpp:560-644):
+    handler-level CREATE_KEY/READ_KEY incl. the non-local-key and
+    missing-key error paths."""
+    cfx = load("chord_tests/CreateKeyTest.json")
+
+    sub = cfx["VALID"]
+    peers = ring_from_json([sub["PEER"]])
+    peers[0].create_key_handler(sub["JSON_REQ"])
+    k = int(hex_key(sub["EXPECTED_KEY"]))
+    assert peers[0].db.contains(k)
+    assert peers[0].db.lookup(k) == sub["EXPECTED_VAL"]
+
+    sub2 = cfx["NON_LOCAL_KEY"]
+    peers2 = ring_from_json([sub2["PEER"]])
+    peers2[0].min_key = Key(peers2[0].id)  # occupy zero keyspace
+    with pytest.raises(RuntimeError):
+        peers2[0].create_key_handler(sub2["JSON_REQ"])
+
+    rfx = load("chord_tests/ReadKeyTest.json")
+    sub3 = rfx["VALID"]
+    peers3 = ring_from_json([sub3["PEER"]])
+    peers3[0].create_key_handler(sub3["CREATE_REQ"])
+    resp = peers3[0].read_key_handler(sub3["READ_REQ"])
+    assert resp["VALUE"] == sub3["EXPECTED_VAL"]
+
+    sub4 = rfx["NON_EXISTENT_KEY"]
+    peers4 = ring_from_json([sub4["PEER"]])
+    with pytest.raises(RuntimeError):
+        peers4[0].read_key_handler(sub4["READ_REQ"])

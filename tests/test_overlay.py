@@ -136,7 +136,9 @@ def test_merkle_delete_changes_hash():
     h = tree.hash
     tree.delete(ks[0])
     assert tree.hash != h
-    with pytest.raises(KeyError):
+    # RuntimeError, matching the reference's std::runtime_error (so the
+    # overlay's catch-and-continue paths see it).
+    with pytest.raises(RuntimeError):
         tree.lookup(ks[0])
 
 
