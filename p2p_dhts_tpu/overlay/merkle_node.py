@@ -1,0 +1,477 @@
+"""Compact sparse Merkle tree — port of the reference's DEPRECATED
+CSMerkleNode (src/data_structures/merkle_node.h:1-945).
+
+The reference carries two Merkle indexes: the active keyspace-partitioned
+MerkleTree (merkle_tree.h, ours in overlay/merkle_tree.py) and this
+earlier compact-sparse design, deprecated by its own header
+(merkle_node.h:2) yet still unit-tested upstream
+(test/merkle_tree_test.cc:5-23).  It is ported here for inventory
+completeness: a binary Merkle tree where a new key's position is chosen
+by XOR distance — floor(log2(key1 ^ key2)) (Distance,
+merkle_node.h:57-61) — per the compact sparse Merkle tree construction
+(eprint 2018/955) Cates' thesis approximates.
+
+Semantics mirrored from the reference:
+  * Leaf hash = SHA-1 of the VALUE string (ctor 1, merkle_node.h:90-96 —
+    unlike the active MerkleTree, whose leaf hashes cover keys only).
+  * Interior node: key = max(left.key, right.key), hash =
+    SHA-1(hex(left.hash) + hex(right.hash)) (ConcatHash,
+    merkle_node.h:70-73,101-110).
+  * Insert descends toward the child at smaller XOR distance
+    (merkle_node.h:547-590); equal distances append the new leaf beside
+    the current subtree, ordered by key (merkle_node.h:570-580).
+  * Lookup/Contains retrace the insertion path; equal distances mean
+    "not present" (merkle_node.h:628-655, 847-870).
+  * Delete promotes the sibling (merkle_node.h:768-802); Update rebuilds
+    the spine (merkle_node.h:725-758).
+  * ReadRange prunes on the left-max-key order and is ring-aware through
+    Key.in_between (merkle_node.h:665-717).
+  * Positions (left=False/right=True paths from the root) are reassigned
+    after every mutation (FixPositions, merkle_node.h:884-901) and drive
+    LookupPosition / NonRecursiveSerialize — the node-addressing scheme
+    the XCHNG_NODE sync protocol of this generation used.
+
+Documented fixes (not bugs ported): the reference's recursive
+Insert/Update/Delete/ReadRange helpers sometimes read the OUTER object's
+`left_`/`right_`/`root_` members instead of the `root` parameter
+(merkle_node.h:573-574, 731, 742, 771, 785) — harmless only on the paths
+its one test exercises; this port consistently uses the current subtree.
+Missing-key errors raise RuntimeError to match the overlay's error
+taxonomy (see overlay/merkle_tree.py module doc).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, sha1_id
+
+
+def _hex(v: int) -> str:
+    """Hex without leading zeros (IntToHexStr, key.h:41-47); 0 -> '0'."""
+    return format(v, "x")
+
+
+def distance(key1: int, key2: int) -> int:
+    """floor(log2(key1 ^ key2)) (merkle_node.h:57-61); -1 when equal
+    (the reference's log2(0) = -inf: strictly below every real
+    distance, so an exact-key match always wins the descent)."""
+    return (int(key1) ^ int(key2)).bit_length() - 1
+
+
+def concat_hash(hash1: int, hash2: int) -> int:
+    """SHA-1 of the concatenated hex forms (ConcatHash,
+    merkle_node.h:70-73)."""
+    return sha1_id(_hex(hash1) + _hex(hash2))
+
+
+class CSNode:
+    """One node. Leaf: (key, value, hash=SHA1(value)). Interior:
+    key = max child key, hash = concat_hash of child hashes."""
+
+    __slots__ = ("key", "hash", "value", "left", "right", "position")
+
+    def __init__(self, key: int, hash_: int, value: Optional[object],
+                 left: Optional["CSNode"], right: Optional["CSNode"]):
+        self.key = key
+        self.hash = hash_
+        self.value = value
+        self.left = left
+        self.right = right
+        self.position: List[bool] = []
+
+    @classmethod
+    def leaf(cls, key: int, value: object) -> "CSNode":
+        # hash_(val, false): SHA-1 of the value's string form
+        # (merkle_node.h:90-96).
+        return cls(int(key), sha1_id(str(value)), value, None, None)
+
+    @classmethod
+    def interior(cls, left: "CSNode", right: "CSNode") -> "CSNode":
+        return cls(max(left.key, right.key),
+                   concat_hash(left.hash, right.hash), None, left, right)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.left is None and self.right is None
+
+    def min_key(self) -> int:
+        """Leftmost key in the subtree (GetMinKey, merkle_node.h:395-401)."""
+        node = self
+        while node.left is not None:
+            node = node.left
+        return node.key
+
+    def fix_positions(self, dirs: List[bool]) -> None:
+        """Reassign root-to-node direction paths (FixPositions,
+        merkle_node.h:884-901)."""
+        self.position = list(dirs)
+        if self.left is not None:
+            self.left.fix_positions(dirs + [False])
+        if self.right is not None:
+            self.right.fix_positions(dirs + [True])
+
+    def leaves(self) -> Iterator["CSNode"]:
+        if self.is_leaf:
+            yield self
+            return
+        yield from self.left.leaves()
+        yield from self.right.leaves()
+
+
+class CSMerkleNode:
+    """Tree facade over CSNode, the port of the reference class (which
+    doubles as its own root handle, merkle_node.h:79-208)."""
+
+    def __init__(self) -> None:
+        self.root: Optional[CSNode] = None
+
+    # -- mutation ----------------------------------------------------------
+
+    def insert(self, key: int, value: object) -> None:
+        """Insert / overwrite (Insert, merkle_node.h:208-226,547-620)."""
+        key = int(key)
+        if self.root is None:
+            self.root = CSNode.leaf(key, value)
+        else:
+            self.root = self._insert(self.root, key, value)
+        self.root.fix_positions([])
+
+    def _insert(self, root: CSNode, key: int, value: object) -> CSNode:
+        if root.is_leaf:
+            # InsertLeaf (merkle_node.h:602-620): same key overwrites,
+            # otherwise the leaf gains a key-ordered sibling.
+            if root.key == key:
+                return CSNode.leaf(key, value)
+            new_leaf = CSNode.leaf(key, value)
+            return (CSNode.interior(new_leaf, root) if key < root.key
+                    else CSNode.interior(root, new_leaf))
+
+        if root.left.is_leaf and root.left.key == key:
+            return CSNode.interior(CSNode.leaf(key, value), root.right)
+        if root.right.is_leaf and root.right.key == key:
+            return CSNode.interior(root.left, CSNode.leaf(key, value))
+
+        l_dist = distance(key, root.left.key)
+        r_dist = distance(key, root.right.key)
+        if l_dist == r_dist:
+            # Equidistant: the new leaf becomes the subtree's sibling,
+            # ordered against its smallest key (merkle_node.h:570-580;
+            # outer-member read fixed, see module doc).
+            new_leaf = CSNode.leaf(key, value)
+            min_key = min(root.left.key, root.right.key)
+            return (CSNode.interior(new_leaf, root) if key < min_key
+                    else CSNode.interior(root, new_leaf))
+        if l_dist < r_dist:
+            return CSNode.interior(self._insert(root.left, key, value),
+                                   root.right)
+        return CSNode.interior(root.left,
+                               self._insert(root.right, key, value))
+
+    def update(self, key: int, new_value: object) -> None:
+        """Rewrite a key's value (Update, merkle_node.h:265-276,725-758).
+        A missing key is silently a no-op upstream; mirrored."""
+        if self.root is None:
+            raise RuntimeError("key does not exist in tree")
+        self.root = self._update(self.root, int(key), new_value)
+        self.root.fix_positions([])
+
+    def _update(self, root: CSNode, key: int, new_value: object) -> CSNode:
+        if root.is_leaf:
+            return CSNode.leaf(key, new_value) if root.key == key else root
+        if root.left.is_leaf and root.left.key == key:
+            return CSNode.interior(CSNode.leaf(key, new_value), root.right)
+        if root.right.is_leaf and root.right.key == key:
+            return CSNode.interior(root.left, CSNode.leaf(key, new_value))
+        l_dist = distance(key, root.left.key)
+        r_dist = distance(key, root.right.key)
+        if l_dist == r_dist:
+            return root
+        if l_dist < r_dist:
+            return CSNode.interior(self._update(root.left, key, new_value),
+                                   root.right)
+        return CSNode.interior(root.left,
+                               self._update(root.right, key, new_value))
+
+    def delete(self, key: int) -> None:
+        """Remove a key; the sibling replaces the parent (Delete,
+        merkle_node.h:283-300,768-802)."""
+        if self.root is None:
+            raise RuntimeError("key does not exist in tree")
+        self.root = self._delete(self.root, int(key))
+        if self.root is not None:
+            self.root.fix_positions([])
+
+    def _delete(self, root: CSNode, key: int) -> Optional[CSNode]:
+        if root.is_leaf:
+            return None if root.key == key else root
+        if root.left.is_leaf and root.left.key == key:
+            return root.right
+        if root.right.is_leaf and root.right.key == key:
+            return root.left
+        l_dist = distance(key, root.left.key)
+        r_dist = distance(key, root.right.key)
+        if l_dist == r_dist:
+            return root  # not present (merkle_node.h:792-795)
+        if l_dist < r_dist:
+            return CSNode.interior(self._delete(root.left, key), root.right)
+        return CSNode.interior(root.left, self._delete(root.right, key))
+
+    # -- queries -----------------------------------------------------------
+
+    def lookup(self, key: int) -> object:
+        """Value for key, RuntimeError if absent (Lookup,
+        merkle_node.h:235-243,628-655)."""
+        if self.root is None:
+            raise RuntimeError("key does not exist in tree")
+        return self._lookup(self.root, int(key))
+
+    def _lookup(self, root: CSNode, key: int) -> object:
+        if root.is_leaf:
+            if root.key == key:
+                return root.value
+            raise RuntimeError("Value not in tree")
+        if root.left.is_leaf and root.left.key == key:
+            return root.left.value
+        if root.right.is_leaf and root.right.key == key:
+            return root.right.value
+        l_dist = distance(key, root.left.key)
+        r_dist = distance(key, root.right.key)
+        if l_dist < r_dist:
+            return self._lookup(root.left, key)
+        if r_dist < l_dist:
+            return self._lookup(root.right, key)
+        raise RuntimeError("Value not in tree")
+
+    def contains(self, key: int) -> bool:
+        """Contains (merkle_node.h:332-344,847-870)."""
+        if self.root is None:
+            return False
+        return self._contains(self.root, int(key))
+
+    def _contains(self, root: CSNode, key: int) -> bool:
+        if root.is_leaf:
+            return root.key == key
+        if (root.left.is_leaf and root.left.key == key) or \
+           (root.right.is_leaf and root.right.key == key):
+            return True
+        l_dist = distance(key, root.left.key)
+        r_dist = distance(key, root.right.key)
+        if l_dist < r_dist:
+            return self._contains(root.left, key)
+        if r_dist < l_dist:
+            return self._contains(root.right, key)
+        return False
+
+    def read_range(self, lower_bound: int, upper_bound: int) -> Dict[int, object]:
+        """kv pairs with key in the (ring-aware, inclusive) range
+        (ReadRange, merkle_node.h:251-258,665-717).
+
+        Documented fix: the reference prunes subtrees with LINEAR key
+        comparisons (merkle_node.h:679,699) while testing leaves with the
+        ring-aware InBetween, so a wrapped range (ub < lb) under-returns
+        there; here a wrapped range is split at the ring origin into two
+        linear ranges first (the same split the active MerkleTree does,
+        merkle_tree.h:168-219)."""
+        if self.root is None:
+            return {}
+        lb, ub = int(lower_bound), int(upper_bound)
+        if lb <= ub:
+            return self._read_range(self.root, lb, ub)
+        out = self._read_range(self.root, lb, KEYS_IN_RING - 1)
+        out.update(self._read_range(self.root, 0, ub))
+        return out
+
+    def _read_range(self, root: CSNode, lb: int, ub: int) -> Dict[int, object]:
+        from p2p_dhts_tpu.keyspace import Key
+        results: Dict[int, object] = {}
+        if root.is_leaf:
+            if Key(root.key).in_between(lb, ub, True):
+                results[root.key] = root.value
+            return results
+        # Left subtree holds every key <= left.key (its max): prune when
+        # even that max is below the lower bound (merkle_node.h:679-696).
+        if lb <= root.left.key:
+            if root.left.is_leaf:
+                if Key(root.left.key).in_between(lb, ub, True):
+                    results[root.left.key] = root.left.value
+            else:
+                results.update(self._read_range(root.left, lb, ub))
+        # Right subtree only matters once the left max enters the range
+        # (merkle_node.h:699-714). Documented fix: the reference recurses
+        # right with the LEFT child's key as the new lower bound
+        # (merkle_node.h:707-710), which loosens the range whenever the
+        # left prune fired (left.key < lb) and returns keys in
+        # (left.key, lb); the original bound is kept here.
+        if root.left.key <= ub:
+            if root.right.is_leaf:
+                if Key(root.right.key).in_between(lb, ub, True):
+                    results[root.right.key] = root.right.value
+            else:
+                results.update(self._read_range(root.right, lb, ub))
+        return results
+
+    def next(self, key: int) -> Optional[Tuple[int, object]]:
+        """Next-greatest kv pair after key, None at the end (Next,
+        merkle_node.h:304-327,812-835) — no ring wraparound, unlike the
+        active MerkleTree.
+
+        Documented fixes: the reference's recursion returns nullptr
+        whenever it bottoms out at a leaf (merkle_node.h:814-816), losing
+        the successor for any key that is a left-subtree maximum at depth
+        >= 3, and the left-leaf-match case returns the raw right node
+        (merkle_node.h:820-823), which its public wrapper dereferences as
+        a leaf (bad_optional_access on interior nodes,
+        merkle_node.h:319-325). Here the successor search descends on the
+        left-max-key order (the same order the reference prunes by) and
+        always resolves to a leaf."""
+        if self.root is None:
+            return None
+        node = self._next(self.root, int(key))
+        if node is None:
+            return None
+        return (node.key, node.value)
+
+    def _next(self, root: CSNode, key: int) -> Optional[CSNode]:
+        if root.is_leaf:
+            return root if root.key > key else None
+        # The left subtree holds every key <= left.key (its max): the
+        # successor lives there iff that max exceeds key.
+        if root.left.key > key:
+            found = self._next(root.left, key)
+            if found is not None:
+                return found
+        return self._next(root.right, key)
+
+    def lookup_position(self, directions: Sequence[bool]) -> Optional[CSNode]:
+        """Walk left(False)/right(True) from the root (LookupPosition,
+        merkle_node.h:350-371)."""
+        node = self.root
+        for go_right in directions:
+            if node is None:
+                return None
+            node = node.right if go_right else node.left
+        return node
+
+    def overlaps(self, lower_bound: int, upper_bound: int) -> bool:
+        """Does the tree hold any key in the ring range? (Overlaps,
+        merkle_node.h:379-391)."""
+        from p2p_dhts_tpu.keyspace import Key
+        if self.root is None:
+            return False
+        if self.root.is_leaf:
+            return Key(self.root.key).in_between(lower_bound, upper_bound,
+                                                 True)
+        min_key = self.root.min_key()
+        return (Key(lower_bound).in_between(min_key, self.root.key, True) or
+                Key(upper_bound).in_between(min_key, self.root.key, True))
+
+    # -- accessors / wire forms --------------------------------------------
+
+    @property
+    def hash(self) -> int:
+        return 0 if self.root is None else self.root.hash
+
+    @property
+    def key(self) -> Optional[int]:
+        return None if self.root is None else self.root.key
+
+    @property
+    def size(self) -> int:
+        return 0 if self.root is None else sum(1 for _ in self.root.leaves())
+
+    def items(self) -> Dict[int, object]:
+        if self.root is None:
+            return {}
+        return {n.key: n.value for n in self.root.leaves()}
+
+    def copy(self) -> "CSMerkleNode":
+        """Value-semantics copy (the reference's copy ctor / assignment,
+        merkle_node.h:142-190, exercised by merkle_tree_test.cc:5-23)."""
+        out = CSMerkleNode()
+        if self.root is not None:
+            out.root = self._copy_node(self.root)
+            out.root.fix_positions([])
+        return out
+
+    @staticmethod
+    def _copy_node(node: CSNode) -> CSNode:
+        if node.is_leaf:
+            return CSNode.leaf(node.key, node.value)
+        return CSNode.interior(CSMerkleNode._copy_node(node.left),
+                               CSMerkleNode._copy_node(node.right))
+
+    def non_recursive_serialize(self, node: Optional[CSNode] = None,
+                                children: bool = True) -> dict:
+        """One node (+ optionally its children, themselves child-free) for
+        node exchange (NonRecursiveSerialize, merkle_node.h:470-496)."""
+        if node is None:
+            node = self.root
+        if node is None:
+            return {}
+        out = {"HASH": _hex(node.hash), "KEY": _hex(node.key),
+               "POSITION": [bool(b) for b in node.position]}
+        if node.value is not None:
+            out["VALUE"] = str(node.value)
+        if children and node.left is not None:
+            out["LEFT"] = self.non_recursive_serialize(node.left, False)
+        if children and node.right is not None:
+            out["RIGHT"] = self.non_recursive_serialize(node.right, False)
+        return out
+
+    def to_json(self) -> dict:
+        """Full recursive JSON (operator Json::Value,
+        merkle_node.h:498-524)."""
+        return self._node_json(self.root) if self.root is not None else {}
+
+    def _node_json(self, node: CSNode) -> dict:
+        out = {"HASH": _hex(node.hash), "KEY": _hex(node.key),
+               "POSITION": [bool(b) for b in node.position]}
+        if node.value is not None:
+            out["VALUE"] = str(node.value)
+        if node.left is not None:
+            out["LEFT"] = self._node_json(node.left)
+        if node.right is not None:
+            out["RIGHT"] = self._node_json(node.right)
+        return out
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "CSMerkleNode":
+        """Rebuild from to_json output (ctor 3, merkle_node.h:115-136)."""
+        out = cls()
+        if obj:
+            out.root = cls._node_from_json(obj)
+            out.root.fix_positions([])
+        return out
+
+    @classmethod
+    def _node_from_json(cls, obj: dict) -> CSNode:
+        if "LEFT" in obj or "RIGHT" in obj:
+            return CSNode.interior(cls._node_from_json(obj["LEFT"]),
+                                   cls._node_from_json(obj["RIGHT"]))
+        node = CSNode.leaf(int(obj["KEY"], 16), obj.get("VALUE"))
+        # A keys-only wire form has no VALUE; keep the transmitted hash.
+        node.hash = int(obj["HASH"], 16)
+        return node
+
+    def to_string(self) -> str:
+        """Debug pretty-print (ToString, merkle_node.h:913-945)."""
+        if self.root is None:
+            return "<empty>"
+        return self._to_string(self.root, 0)
+
+    def _to_string(self, node: CSNode, level: int) -> str:
+        tabs = "\t" * level
+        res = f"{tabs}HASH: {_hex(node.hash)}\n{tabs}KEY: {_hex(node.key)}"
+        if node.value is not None:
+            res += f"\n{tabs}VALUE: {node.value}"
+        if node.position:
+            res += f"\n{tabs}POSITION:" + "".join(
+                f" {int(b)}" for b in node.position)
+        if node.left is not None:
+            res += (f"\n{tabs}LEFT: {{\n{self._to_string(node.left, level + 1)}"
+                    f"\n{tabs}}}")
+        if node.right is not None:
+            res += (f"\n{tabs}RIGHT: {{\n"
+                    f"{self._to_string(node.right, level + 1)}\n{tabs}}}")
+        return res
