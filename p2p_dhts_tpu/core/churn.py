@@ -122,13 +122,20 @@ def leave(state: RingState, rows: jax.Array) -> RingState:
     preds = state.preds.at[succ_rows].set(pred_rows)
 
     # RemotePeerList::Delete of every leaver from every succ list.
-    # The gather runs on FLATTENED indices: a [N,S]-shaped index array
-    # into a 1-D table sends the XLA TPU compiler down a pathological
-    # path (~20 MINUTES of compile at N=10M, BENCH_r02's "19-minute
-    # churn"); the identical 1-D gather compiles in seconds.
-    leaving = jnp.zeros((n,), dtype=bool).at[rows].set(True)
+    # Membership is resolved by BINARY SEARCH into the sorted [K] leaver
+    # set, not by gathering a [N]-bool mask at the [N*S] entry values:
+    # on the XLA TPU compiler a large-index gather from a large 1-D
+    # table is shape-sensitively pathological — the same HLO compiled in
+    # 8 s at capacity 10,016,768 and 20+ MINUTES at 10,016,384 (round
+    # 3 bisect; round 2's 19-minute churn was the same cliff). The
+    # searchsorted form reads only the K-sized table (VMEM-resident)
+    # and compiles in ~1 s at every shape tried.
+    if rows.shape[0] == 0:  # static shape: nothing left the ring
+        return state._replace(min_key=min_key, preds=preds)
+    srt = jnp.sort(rows)
     flat = state.succs.reshape(-1)
-    hit = leaving[jnp.maximum(flat, 0)] & (flat >= 0)
+    pos = jnp.searchsorted(srt, flat, side="left")
+    hit = (srt[jnp.minimum(pos, rows.shape[0] - 1)] == flat) & (flat >= 0)
     succs = jnp.where(hit, -1, flat).reshape(state.succs.shape)
     return state._replace(min_key=min_key, preds=preds, succs=succs)
 

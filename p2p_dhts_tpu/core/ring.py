@@ -245,6 +245,98 @@ def build_ring_from_seeds(seeds: Sequence[Tuple[str, int]],
                       cfg, capacity)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "capacity"))
+def ring_genesis(lanes: jax.Array, cfg: RingConfig = DEFAULT_CONFIG,
+                 capacity: Optional[int] = None) -> RingState:
+    """build_ring's device twin: derive a converged RingState from RAW
+    (unsorted, possibly-duplicated) [K, 4] u32 id lanes as ONE XLA
+    program — sort, dedup, neighbor derivation, optional finger
+    materialization all on device.
+
+    Exists because the host path's `jnp.asarray` uploads are the
+    dominant cost at scale: a 10M-peer state is ~0.5 GB of arrays, which
+    the axon tunnel moves at ~300 KB/s — tens of MINUTES of wall clock
+    for data the device can derive from ids in milliseconds (this was
+    round 3's mysterious 30-minute "churn compile": the first sync after
+    build_ring waited out the queued uploads). Duplicate ids compact to
+    padding exactly like build_ring's host-side `sorted(set(ids))`, so
+    `n_valid` is traced, not `K`.
+    """
+    k = lanes.shape[0]
+    if k == 0:
+        raise ValueError("ring needs at least one peer")
+    capacity = k if capacity is None else capacity
+    if capacity < k:
+        raise ValueError(f"capacity {capacity} < {k} peers")
+    s = cfg.num_succs
+
+    # Sort by id (lane 3 most significant).
+    l0, l1, l2, l3 = (lanes[:, i] for i in range(LANES))
+    l3, l2, l1, l0 = jax.lax.sort((l3, l2, l1, l0), num_keys=4)
+    srt = jnp.stack([l0, l1, l2, l3], axis=1)
+    # Dedup: push duplicate rows to the end (stable sort on the dup
+    # flag keeps the id order among survivors), pad them out.
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), bool), jnp.all(srt[1:] == srt[:-1], axis=1)])
+    dup_i, perm = jax.lax.sort(
+        (dup.astype(jnp.int32), jnp.arange(k, dtype=jnp.int32)), num_keys=1)
+    srt = jnp.where(dup_i[:, None].astype(bool), _u32_max(), srt[perm])
+    n_valid = jnp.int32(k) - dup.sum().astype(jnp.int32)
+
+    ids = jnp.full((capacity, LANES), 0xFFFFFFFF, jnp.uint32)
+    ids = ids.at[:k].set(srt)
+
+    rows = jnp.arange(capacity, dtype=jnp.int32)
+    valid = rows < n_valid
+    alive = valid
+
+    preds = jnp.where(valid, (rows - 1) % n_valid, -1)
+
+    # succs col k-1 = (row + k) % n_valid, only for k <= n_valid - 1: the
+    # single-peer ring has an all-empty succ list, as build_ring's host
+    # loop (guarded by n > 1) produces.
+    reach = n_valid - 1
+    succ_cols = []
+    for j in range(1, s + 1):
+        col = jnp.where(valid & (j <= reach), (rows + j) % n_valid, -1)
+        succ_cols.append(col)
+    succs = jnp.stack(succ_cols, axis=1)
+
+    prev_ids = ids[jnp.where(valid, preds, 0)]
+    min_key = jnp.where(valid[:, None],
+                        u128.add_scalar(prev_ids, 1),
+                        jnp.zeros((1, LANES), jnp.uint32))
+
+    fingers = None
+    if cfg.finger_mode == "materialized":
+        fingers = fingers_for_ids(ids[:k], n_valid, ids[:k],
+                                  cfg.num_fingers)
+        fingers = jnp.where(valid[:k, None], fingers, -1)
+        fingers = jnp.full((capacity, cfg.num_fingers), -1, jnp.int32
+                           ).at[:k].set(fingers)
+
+    return RingState(ids=ids, alive=alive, n_valid=n_valid,
+                     min_key=min_key, preds=preds, succs=succs,
+                     fingers=fingers, max_hops=cfg.max_hops)
+
+
+def _u32_max() -> jax.Array:
+    return jnp.full((LANES,), 0xFFFFFFFF, jnp.uint32)
+
+
+def build_ring_random(prng_key: jax.Array, n_peers: int,
+                      cfg: RingConfig = DEFAULT_CONFIG,
+                      capacity: Optional[int] = None) -> RingState:
+    """Genesis of an n-peer ring with uniform random ids, entirely on
+    device — the at-scale construction path (zero bulk host->device
+    transfer; see ring_genesis). The id draw is `jax.random.bits` under
+    threefry, so a host CPU backend REPLAYS the identical ids from the
+    same key — how the bench's hop-parity oracle gets the id table
+    without a 160 MB device->host download."""
+    lanes = jax.random.bits(prng_key, (n_peers, LANES), jnp.uint32)
+    return ring_genesis(lanes, cfg=cfg, capacity=capacity)
+
+
 # ---------------------------------------------------------------------------
 # alive-neighbor scan maps (shared with churn ops)
 # ---------------------------------------------------------------------------

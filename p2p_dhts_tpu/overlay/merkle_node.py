@@ -36,15 +36,17 @@ Insert/Update/Delete/ReadRange helpers sometimes read the OUTER object's
 `left_`/`right_`/`root_` members instead of the `root` parameter
 (merkle_node.h:573-574, 731, 742, 771, 785) — harmless only on the paths
 its one test exercises; this port consistently uses the current subtree.
-Missing-key errors raise RuntimeError to match the overlay's error
-taxonomy (see overlay/merkle_tree.py module doc).
+Missing-key LOOKUPS and any mutation of an empty tree raise RuntimeError
+to match the overlay's error taxonomy (see overlay/merkle_tree.py module
+doc); update/delete of a key absent from a NON-empty tree silently no-op,
+as the reference's recursions do.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
-from p2p_dhts_tpu.keyspace import KEYS_IN_RING, sha1_id
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key, sha1_id
 
 
 def _hex(v: int) -> str:
@@ -170,7 +172,12 @@ class CSMerkleNode:
 
     def update(self, key: int, new_value: object) -> None:
         """Rewrite a key's value (Update, merkle_node.h:265-276,725-758).
-        A missing key is silently a no-op upstream; mirrored."""
+
+        Error contract mirrors the reference exactly: an EMPTY tree
+        raises (the `!root_` branch throws, merkle_node.h:271-275); a
+        non-empty tree missing the key is a silent no-op (the recursion
+        returns the subtree unchanged on the equidistant and
+        leaf-mismatch paths, merkle_node.h:730-753)."""
         if self.root is None:
             raise RuntimeError("key does not exist in tree")
         self.root = self._update(self.root, int(key), new_value)
@@ -195,7 +202,8 @@ class CSMerkleNode:
 
     def delete(self, key: int) -> None:
         """Remove a key; the sibling replaces the parent (Delete,
-        merkle_node.h:283-300,768-802)."""
+        merkle_node.h:283-300,768-802). Same error contract as update:
+        empty tree raises, non-empty tree missing the key no-ops."""
         if self.root is None:
             raise RuntimeError("key does not exist in tree")
         self.root = self._delete(self.root, int(key))
@@ -283,7 +291,6 @@ class CSMerkleNode:
         return out
 
     def _read_range(self, root: CSNode, lb: int, ub: int) -> Dict[int, object]:
-        from p2p_dhts_tpu.keyspace import Key
         results: Dict[int, object] = {}
         if root.is_leaf:
             if Key(root.key).in_between(lb, ub, True):
@@ -291,24 +298,16 @@ class CSMerkleNode:
             return results
         # Left subtree holds every key <= left.key (its max): prune when
         # even that max is below the lower bound (merkle_node.h:679-696).
-        if lb <= root.left.key:
-            if root.left.is_leaf:
-                if Key(root.left.key).in_between(lb, ub, True):
-                    results[root.left.key] = root.left.value
-            else:
-                results.update(self._read_range(root.left, lb, ub))
         # Right subtree only matters once the left max enters the range
         # (merkle_node.h:699-714). Documented fix: the reference recurses
         # right with the LEFT child's key as the new lower bound
         # (merkle_node.h:707-710), which loosens the range whenever the
         # left prune fired (left.key < lb) and returns keys in
         # (left.key, lb); the original bound is kept here.
+        if lb <= root.left.key:
+            results.update(self._read_range(root.left, lb, ub))
         if root.left.key <= ub:
-            if root.right.is_leaf:
-                if Key(root.right.key).in_between(lb, ub, True):
-                    results[root.right.key] = root.right.value
-            else:
-                results.update(self._read_range(root.right, lb, ub))
+            results.update(self._read_range(root.right, lb, ub))
         return results
 
     def next(self, key: int) -> Optional[Tuple[int, object]]:
@@ -356,7 +355,6 @@ class CSMerkleNode:
     def overlaps(self, lower_bound: int, upper_bound: int) -> bool:
         """Does the tree hold any key in the ring range? (Overlaps,
         merkle_node.h:379-391)."""
-        from p2p_dhts_tpu.keyspace import Key
         if self.root is None:
             return False
         if self.root.is_leaf:

@@ -241,3 +241,17 @@ def test_succ_list_hole_fallback_before_sweep(rng):
     # alive successor that inherited the leaver's range.
     assert int(owner[0]) == 1, f"fallback mis-routed: owner {int(owner[0])}"
     assert int(hops[0]) >= 0
+
+
+def test_leave_empty_batch_is_identity(rng):
+    """leave() with zero leavers must not touch successor lists (the
+    searchsorted membership probe has no table to search)."""
+    import numpy as np
+    from p2p_dhts_tpu.core.ring import build_ring
+    lanes = np.frombuffer(rng.bytes(16 * 64), dtype="<u4").reshape(-1, 4).copy()
+    state = build_ring(lanes)
+    out = churn.leave(state, jnp.zeros((0,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out.succs),
+                                  np.asarray(state.succs))
+    np.testing.assert_array_equal(np.asarray(out.alive),
+                                  np.asarray(state.alive))

@@ -144,13 +144,13 @@ def test_overlaps():
     tree, ks = build(8)
     sks = sorted(ks)
     assert tree.overlaps(sks[0], sks[-1])
-    # A range strictly between two adjacent keys still OVERLAPS by the
-    # reference's bounds test (merkle_node.h:379-391) only when a bound
-    # falls inside [min_key, max_key]; one outside both misses.
+    # Both bounds in the wrap gap past max_key: neither falls inside
+    # [min_key, max_key], so the reference's bounds test
+    # (merkle_node.h:379-391) reports no overlap.
     lo = (sks[-1] + 1) % KEYS_IN_RING
-    hi = (sks[0] - 1) % KEYS_IN_RING
-    if lo <= hi:  # degenerate only if ring positions collide
-        assert not tree.overlaps(lo, lo)
+    assert not tree.overlaps(lo, lo)
+    # A bound inside the span overlaps even with the other outside.
+    assert tree.overlaps(sks[3], lo)
 
 
 def test_copy_value_semantics():

@@ -315,3 +315,67 @@ def test_bucketed_big_ring_parity(rng):
                 sorted_ids[int(starts[j])], key_ints[j])
             assert sorted_ids[int(owner[j])] == want_owner
             assert int(hops[j]) == want_hops, f"hop mismatch ({mode})"
+
+
+def test_ring_genesis_matches_host_build(rng):
+    """Device genesis (ring_genesis / build_ring_random) must derive the
+    same converged state build_ring does from the same lanes — incl.
+    duplicate-id compaction and both finger modes."""
+    import jax
+
+    n = 300
+    lanes = np.frombuffer(rng.bytes(16 * n), dtype="<u4").reshape(-1, 4).copy()
+    lanes[37] = lanes[0]          # two duplicate ids: dedup to padding
+    lanes[251] = lanes[100]
+    cap = n + 40
+
+    for mode in ("computed", "materialized"):
+        host = build_ring(lanes, RingConfig(finger_mode=mode), capacity=cap)
+        dev = ring_mod.ring_genesis(jnp.asarray(lanes),
+                                    cfg=RingConfig(finger_mode=mode),
+                                    capacity=cap)
+        assert int(dev.n_valid) == int(host.n_valid) == n - 2
+        nv = int(host.n_valid)
+        np.testing.assert_array_equal(np.asarray(dev.ids)[:nv],
+                                      np.asarray(host.ids)[:nv])
+        np.testing.assert_array_equal(np.asarray(dev.alive),
+                                      np.asarray(host.alive))
+        np.testing.assert_array_equal(np.asarray(dev.preds)[:nv],
+                                      np.asarray(host.preds)[:nv])
+        np.testing.assert_array_equal(np.asarray(dev.succs)[:nv],
+                                      np.asarray(host.succs)[:nv])
+        np.testing.assert_array_equal(np.asarray(dev.min_key)[:nv],
+                                      np.asarray(host.min_key)[:nv])
+        if mode == "materialized":
+            np.testing.assert_array_equal(np.asarray(dev.fingers)[:nv],
+                                          np.asarray(host.fingers)[:nv])
+
+    # Random genesis: lookups route identically to a host build of the
+    # SAME ids (replayed from the threefry key, as the bench oracle does).
+    key = jax.random.PRNGKey(7)
+    state = ring_mod.build_ring_random(key, 500)
+    replay = np.asarray(jax.random.bits(key, (500, 4), jnp.uint32))
+    host = build_ring(replay)
+    assert int(state.n_valid) == int(host.n_valid)
+    keys = keys_from_ints(_random_ids(rng, 64))
+    starts = jnp.asarray(rng.randint(0, 500, size=64), jnp.int32)
+    o1, h1 = find_successor(state, keys, starts)
+    o2, h2 = find_successor(host, keys, starts)
+    assert bool(jnp.all(o1 == o2)) and bool(jnp.all(h1 == h2))
+
+
+def test_ring_genesis_single_and_two_peer_parity(rng):
+    """Degenerate ring sizes: genesis must match build_ring exactly —
+    single peer has an EMPTY succ list (build_ring's n>1 guard) and the
+    whole keyspace as its range."""
+    for n in (1, 2, 3):
+        lanes = np.frombuffer(rng.bytes(16 * n), dtype="<u4").reshape(-1, 4).copy()
+        host = build_ring(lanes, RingConfig(finger_mode="computed"))
+        dev = ring_mod.ring_genesis(jnp.asarray(lanes),
+                                    cfg=RingConfig(finger_mode="computed"))
+        np.testing.assert_array_equal(np.asarray(dev.succs),
+                                      np.asarray(host.succs))
+        np.testing.assert_array_equal(np.asarray(dev.preds),
+                                      np.asarray(host.preds))
+        np.testing.assert_array_equal(np.asarray(dev.min_key),
+                                      np.asarray(host.min_key))
