@@ -52,14 +52,16 @@ import jax
 import jax.numpy as jnp
 
 # Persistent compilation cache: the 10M-shape programs cost minutes of
-# XLA compile on this 1-core host (shape-sensitively up to ~20 min, see
-# core/churn.py leave notes); caching them on disk makes every bench run
-# after the first pay only execution. Harmless when the dir is cold.
+# XLA compile (shape-sensitively up to ~20 min, see core/churn.py leave
+# notes); caching them on disk makes every bench run after the first pay
+# only execution. Scoped per platform: entries written under the
+# remote-compile TPU path must not be offered to a local CPU run (their
+# host-feature flags differ — XLA warns about potential SIGILL).
 jax.config.update(
     "jax_compilation_cache_dir",
     os.environ.get("CHORDAX_COMPILE_CACHE",
                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".jax_cache")))
+                                ".jax_cache", jax.default_backend())))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -69,9 +71,11 @@ from p2p_dhts_tpu.config import RingConfig
 from p2p_dhts_tpu.core import churn
 from p2p_dhts_tpu.core.ring import (
     build_ring,
+    build_ring_random,
     find_successor,
     get_n_successors,
     keys_from_ints,
+    materialize_converged_fingers,
     owner_of,
 )
 from p2p_dhts_tpu.core.sharded import (
@@ -346,9 +350,14 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
     rng = np.random.RandomState(10)
 
     cap = ((n_peers + 2 * churn_k + d - 1) // d) * d
-    state = build_ring(_rand_lanes(rng, n_peers),
-                       RingConfig(finger_mode="computed"), capacity=cap)
+    # Device genesis (ring_genesis): the state derives on device from a
+    # threefry draw — no host build (~12 s of rand+lexsort) and no bulk
+    # upload (~0.5 GB at ~20 MB/s through the tunnel).
+    state = build_ring_random(jax.random.PRNGKey(10), n_peers,
+                              RingConfig(finger_mode="computed"),
+                              capacity=cap)
     n_valid = int(state.n_valid)
+    assert n_valid == n_peers, "random 128-bit ids collided (p ~ 5e-25)"
 
     # Batched churn: fail + leave + join (the reference's churn axis is
     # process kill / graceful leave / fresh join, chord_peer.cpp:293-300,
@@ -385,6 +394,21 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
 
     sweep_t = _time(_sweep_once, repeats=2)
     state = churn.stabilize_sweep(state)
+
+    # Serving pattern (ring.materialize_converged_fingers doc): churn +
+    # sweep ran in computed mode (no [N,128] matrix to keep consistent);
+    # lookups are served from materialized converged finger blocks — one
+    # row gather per hop instead of a ~log2(occupancy) bucketed search.
+    # 4*128 B/peer: 5.1 GB on one chip at 10M, 1/D per shard beyond.
+    t0 = time.perf_counter()
+    state_m = materialize_converged_fingers(state)
+    _sync(state_m.fingers)
+    materialize_total_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    state_m = materialize_converged_fingers(state)
+    _sync(state_m.fingers)
+    materialize_ms = (time.perf_counter() - t0) * 1e3  # compile-free
+    state = state_m
 
     # Sharded lookups over all local devices (explicit shard_map kernel).
     sstate = shard_ring(state, mesh)
@@ -424,8 +448,8 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
     return _emit({
         "config": "sweep_10m",
         "metric": f"sharded lookups/sec/chip ({n_peers}-node ring, "
-                  f"computed fingers, {d} device(s), churn "
-                  f"{3 * churn_k} peers + sweep)",
+                  f"churn+sweep computed / serve materialized, "
+                  f"{d} device(s), churn {3 * churn_k} peers + sweep)",
         "value": round(lps, 1),
         "unit": "lookups/sec",
         "vs_baseline": round(lps / NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP, 4),
@@ -433,6 +457,9 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
         "churn_ms": round(churn_ms, 1),
         "churn_compile_ms": round(churn_compile_ms, 1),
         "sweep_ms": round(sweep_t * 1e3, 1),
+        "materialize_ms": round(materialize_ms, 1),
+        "materialize_compile_ms": round(
+            max(materialize_total_ms - materialize_ms, 0.0), 1),
         "mean_hops": round(float(hops_np.mean()), 3),
         "hop_parity": parity,
     })

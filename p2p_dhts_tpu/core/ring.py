@@ -147,6 +147,33 @@ def _materialize_fingers(ids: jax.Array, n_valid: jax.Array,
     return fingers_for_ids(ids, n_valid, ids, num_fingers, chunk=chunk)
 
 
+@functools.partial(jax.jit, static_argnames=("num_fingers",))
+def materialize_converged_fingers(state: RingState,
+                                  num_fingers: int = 128) -> RingState:
+    """Post-hoc converged finger blocks for a swept ring — the
+    materialized-mode state a computed-mode ring would have if every
+    peer re-ran PopulateFingerTable against the current alive set
+    (abstract_chord_peer.cpp:564-613, post-repair targets via the
+    next-alive map).
+
+    The at-scale lookup accelerator: a computed-mode hop pays a
+    ~log2(occupancy) bucketed binary search per lane; a materialized hop
+    is ONE row gather. The [N, F] i32 matrix costs 4*F bytes/peer
+    (5.1 GB at 10M/F=128 — fits one v5e chip; 1/D of that per shard
+    under shard_ring), so the intended pattern at 10M is: churn and
+    sweep in computed mode, materialize once, then serve lookups.
+
+    num_fingers defaults to 128 = the full binary key length, the only
+    geometry the device stack supports (build_ring rejects key_bits !=
+    128; the u128 lane math is hardwired to it) — matching what
+    build_ring(finger_mode="materialized") would produce.
+    """
+    na = next_alive_map(state)
+    fingers = fingers_for_ids(state.ids, state.n_valid, state.ids,
+                              num_fingers, na=na)
+    return state._replace(fingers=fingers)
+
+
 def _lanes_add1(x: np.ndarray) -> np.ndarray:
     """(x + 1) mod 2^128 on [N, 4] u32 lanes — vectorized carry chain."""
     out = x.copy()
@@ -253,14 +280,13 @@ def ring_genesis(lanes: jax.Array, cfg: RingConfig = DEFAULT_CONFIG,
     program — sort, dedup, neighbor derivation, optional finger
     materialization all on device.
 
-    Exists because the host path's `jnp.asarray` uploads are the
-    dominant cost at scale: a 10M-peer state is ~0.5 GB of arrays, which
-    the axon tunnel moves at ~300 KB/s — tens of MINUTES of wall clock
-    for data the device can derive from ids in milliseconds (this was
-    round 3's mysterious 30-minute "churn compile": the first sync after
-    build_ring waited out the queued uploads). Duplicate ids compact to
-    padding exactly like build_ring's host-side `sorted(set(ids))`, so
-    `n_valid` is traced, not `K`.
+    Exists because the host path's cost at scale is pure overhead: a
+    10M-peer state is ~12 s of host rand+lexsort plus ~0.5 GB of
+    `jnp.asarray` uploads at the tunnel's ~20 MB/s — the better part of
+    a minute for data the device derives from the id draw in
+    milliseconds. Duplicate ids compact to padding exactly like
+    build_ring's host-side `sorted(set(ids))`, so `n_valid` is traced,
+    not `K`.
     """
     k = lanes.shape[0]
     if k == 0:
@@ -330,9 +356,10 @@ def build_ring_random(prng_key: jax.Array, n_peers: int,
     """Genesis of an n-peer ring with uniform random ids, entirely on
     device — the at-scale construction path (zero bulk host->device
     transfer; see ring_genesis). The id draw is `jax.random.bits` under
-    threefry, so a host CPU backend REPLAYS the identical ids from the
-    same key — how the bench's hop-parity oracle gets the id table
-    without a 160 MB device->host download."""
+    threefry, deterministic across backends: a host CPU process can
+    replay the identical ids from the same key when it needs the table
+    without a device->host download (parity tests pin this replay
+    property in tests/test_ring.py)."""
     lanes = jax.random.bits(prng_key, (n_peers, LANES), jnp.uint32)
     return ring_genesis(lanes, cfg=cfg, capacity=capacity)
 

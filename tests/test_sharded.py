@@ -158,3 +158,42 @@ def test_sharded_lookup_unconverged_fails_loudly(rng, mesh):
     owner2, _ = find_successor_sharded(swept, keys, starts, mesh)
     assert bool(jnp.all(owner2 >= 0))
     assert bool(jnp.all(swept.alive[owner2]))
+
+
+def test_sharded_materialize_after_churn_matches_computed(rng, mesh):
+    """The at-scale serving pattern: churn+sweep in computed mode, then
+    materialize_converged_fingers, shard, and serve lookups in
+    materialized mode. Owners and hop counts must match the computed-mode
+    sharded kernel AND the single-device kernel lane for lane."""
+    from p2p_dhts_tpu.core.ring import materialize_converged_fingers
+
+    n, b = 256, 96
+    state = build_ring(_rand_ids(rng, n), RingConfig(finger_mode="computed"),
+                       capacity=n + 64)
+    state = churn.fail(state, jnp.asarray(
+        rng.choice(n, size=17, replace=False), jnp.int32))
+    survivors = np.flatnonzero(np.asarray(state.alive))
+    state = churn.leave(state, jnp.asarray(
+        rng.choice(survivors, size=16, replace=False), jnp.int32))
+    state, _ = churn.join(
+        state, jnp.asarray(np.frombuffer(rng.bytes(16 * 32), dtype="<u4")
+                           .reshape(-1, 4)))
+    state = churn.stabilize_sweep(state)
+
+    mstate = materialize_converged_fingers(state)
+    s_comp = shard_ring(state, mesh)
+    s_mat = shard_ring(mstate, mesh)
+
+    keys = keys_from_ints(_rand_ids(rng, b))
+    alive_rows = np.flatnonzero(np.asarray(state.alive))
+    starts = jnp.asarray(rng.choice(alive_rows, size=b), jnp.int32)
+
+    o_comp, h_comp = find_successor_sharded(s_comp, keys, starts, mesh)
+    o_mat, h_mat = find_successor_sharded(s_mat, keys, starts, mesh)
+    o_single, h_single = find_successor(state, keys, starts)
+
+    np.testing.assert_array_equal(np.asarray(o_mat), np.asarray(o_comp))
+    np.testing.assert_array_equal(np.asarray(h_mat), np.asarray(h_comp))
+    np.testing.assert_array_equal(np.asarray(o_mat), np.asarray(o_single))
+    np.testing.assert_array_equal(np.asarray(h_mat), np.asarray(h_single))
+    assert bool(jnp.all(o_mat >= 0))
