@@ -59,9 +59,11 @@ import jax.numpy as jnp
 # host-feature flags differ — XLA warns about potential SIGILL).
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.environ.get("CHORDAX_COMPILE_CACHE",
-                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".jax_cache", jax.default_backend())))
+    os.path.join(
+        os.environ.get("CHORDAX_COMPILE_CACHE",
+                       os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    ".jax_cache")),
+        jax.default_backend()))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -404,6 +406,10 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
     state_m = materialize_converged_fingers(state)
     _sync(state_m.fingers)
     materialize_total_ms = (time.perf_counter() - t0) * 1e3
+    # Drop the first matrix before re-timing: two live [N,128] buffers
+    # would be ~10 GB at 10M — more than a v5e leaves free.
+    state_m = None
+    gc.collect()
     t0 = time.perf_counter()
     state_m = materialize_converged_fingers(state)
     _sync(state_m.fingers)

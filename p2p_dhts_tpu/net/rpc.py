@@ -224,6 +224,48 @@ class Server:
                 pass
         self._pool.shutdown(wait=False)
 
+    def install_signal_handlers(self) -> Callable[[], None]:
+        """Kill this server gracefully on SIGINT/SIGTERM/SIGQUIT, then
+        re-deliver the signal to the previous handler.
+
+        The reference registers exactly these three signals on an asio
+        signal_set at construction "so threads shut down gracefully"
+        (server.h:244-248,278-280) — but never arms async_wait, so its
+        registration only SWALLOWS the signals and nothing shuts down:
+        dead code with a live comment. This implements the comment's
+        intent instead, as a documented fix. Opt-in and main-thread-only
+        (CPython restricts signal.signal to the main thread; peers in
+        tests run dozens of servers per process, so constructor-time
+        registration would be wrong here anyway). Returns a restore()
+        callable that reinstates the previous handlers."""
+        import signal as _signal
+
+        prev = {}
+
+        def _on_signal(signum, frame):
+            self.kill()
+            handler = prev.get(signum)
+            if callable(handler):
+                handler(signum, frame)
+            elif handler != _signal.SIG_IGN:
+                # SIG_DFL — or None, a C-level handler signal.signal
+                # can neither call nor reinstall: fall through to the
+                # default action so the signal is never swallowed.
+                _signal.signal(signum, _signal.SIG_DFL)
+                _signal.raise_signal(signum)
+
+        for sig in (_signal.SIGINT, _signal.SIGTERM, _signal.SIGQUIT):
+            prev[sig] = _signal.signal(sig, _on_signal)
+
+        def restore() -> None:
+            for sig, handler in prev.items():
+                # None = C-level handler, not expressible to
+                # signal.signal; SIG_DFL is the closest restorable state.
+                _signal.signal(
+                    sig, handler if handler is not None else _signal.SIG_DFL)
+
+        return restore
+
     def is_alive(self) -> bool:
         return self._alive
 

@@ -416,3 +416,32 @@ def test_dhash_upload_download_file(dhash_ring, tmp_path):
     peers[0].upload_file(str(src))
     peers[1].download_file(str(src), str(dst))
     assert dst.read_text() == "file payload over the overlay"
+
+
+def test_server_signal_handler_kills_gracefully():
+    """SIGTERM kills the server (the intent of the reference's dead
+    signal_set registration, server.h:244-248 — see
+    Server.install_signal_handlers) without taking down the process."""
+    import os
+    import signal
+
+    srv = Server(0, {"PING": lambda req: {"PONG": True}})
+    srv.run_in_background()
+    # Park a no-op as the pre-existing handler so the chain's re-delivery
+    # lands there instead of SIG_DFL terminating the test process.
+    seen = []
+    orig = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    restore = srv.install_signal_handlers()
+    try:
+        assert srv.is_alive()
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Handler runs synchronously on the main thread at the next
+        # bytecode boundary; by here the server must be dead.
+        assert not srv.is_alive()
+        assert seen == [signal.SIGTERM]  # chained to the previous handler
+        with pytest.raises(RpcError):
+            Client.make_request("127.0.0.1", srv.port, {"COMMAND": "PING"})
+    finally:
+        restore()
+        signal.signal(signal.SIGTERM, orig)
+        srv.kill()
