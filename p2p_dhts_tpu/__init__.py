@@ -26,4 +26,44 @@ __version__ = "0.1.0"
 
 from p2p_dhts_tpu.config import RingConfig, IdaParams  # noqa: F401
 from p2p_dhts_tpu.keyspace import Key  # noqa: F401
-from p2p_dhts_tpu.ida import IDA, DataBlock, DataFragment  # noqa: F401
+
+# Everything that would pull in jax (or socket machinery) resolves
+# lazily (PEP 562): `from p2p_dhts_tpu import build_ring` still works,
+# but `import p2p_dhts_tpu` alone imports neither jax nor the overlay.
+# (Under the axon sitecustomize jax is already in sys.modules before any
+# user import runs, so the jax half only matters in plain environments;
+# what ALWAYS matters is that nothing here initializes a backend —
+# __graft_entry__ depends on importing with zero device side effects.)
+_LAZY = {
+    "IDA": ("p2p_dhts_tpu.ida", "IDA"),
+    "DataBlock": ("p2p_dhts_tpu.ida", "DataBlock"),
+    "DataFragment": ("p2p_dhts_tpu.ida", "DataFragment"),
+    "build_ring": ("p2p_dhts_tpu.core.ring", "build_ring"),
+    "build_ring_random": ("p2p_dhts_tpu.core.ring", "build_ring_random"),
+    "ring_genesis": ("p2p_dhts_tpu.core.ring", "ring_genesis"),
+    "RingState": ("p2p_dhts_tpu.core.ring", "RingState"),
+    "find_successor": ("p2p_dhts_tpu.core.ring", "find_successor"),
+    "get_n_successors": ("p2p_dhts_tpu.core.ring", "get_n_successors"),
+    "keys_from_ints": ("p2p_dhts_tpu.core.ring", "keys_from_ints"),
+    "materialize_converged_fingers":
+        ("p2p_dhts_tpu.core.ring", "materialize_converged_fingers"),
+    "owner_of": ("p2p_dhts_tpu.core.ring", "owner_of"),
+    "ChordPeer": ("p2p_dhts_tpu.overlay.chord_peer", "ChordPeer"),
+    "DHashPeer": ("p2p_dhts_tpu.overlay.dhash_peer", "DHashPeer"),
+    "save_checkpoint": ("p2p_dhts_tpu.checkpoint", "save_checkpoint"),
+    "load_checkpoint": ("p2p_dhts_tpu.checkpoint", "load_checkpoint"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
