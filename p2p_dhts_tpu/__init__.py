@@ -67,3 +67,9 @@ def __getattr__(name: str):
 
 def __dir__():
     return sorted(list(globals()) + list(_LAZY))
+
+
+# Star-import surface: without __all__, `from p2p_dhts_tpu import *`
+# would copy only real globals and never consult __getattr__, silently
+# dropping the lazy names that used to be eager exports.
+__all__ = ["RingConfig", "IdaParams", "Key"] + sorted(_LAZY)
