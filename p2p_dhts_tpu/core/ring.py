@@ -118,7 +118,8 @@ def fingers_for_ids(table_ids: jax.Array, n_valid: jax.Array,
     # (u128.bucket_starts) — the bulk of a 1M+-ring materialization.
     big = n >= (1 << u128.DEFAULT_BUCKET_BITS)
     if big:
-        bstarts = u128.bucket_starts(table_ids, u128.DEFAULT_BUCKET_BITS)
+        bbits = u128.bucket_bits_for(n)  # size-scaled: ~2^3 occupancy
+        bstarts = u128.bucket_starts(table_ids, bbits)
     cols = []
     for f0 in range(0, num_fingers, chunk):
         fs = jnp.arange(f0, min(f0 + chunk, num_fingers), dtype=jnp.int32)
@@ -128,8 +129,7 @@ def fingers_for_ids(table_ids: jax.Array, n_valid: jax.Array,
             # Padding-safe without the n_valid bound: padding rows are
             # all-0xFF and sort last, so both searches agree everywhere
             # (see u128.ring_successor_bucketed).
-            j = u128.searchsorted_bucketed(table_ids, q, bstarts,
-                                           u128.DEFAULT_BUCKET_BITS)
+            j = u128.searchsorted_bucketed(table_ids, q, bstarts, bbits)
         else:
             j = u128.searchsorted(table_ids, q, n_valid)
         if na is None:
@@ -547,11 +547,12 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
     # computed-finger mode.
     big = ids.shape[0] >= (1 << u128.DEFAULT_BUCKET_BITS)
     if big:
-        bstarts = u128.bucket_starts(ids, u128.DEFAULT_BUCKET_BITS)
+        bbits = u128.bucket_bits_for(ids.shape[0])
+        bstarts = u128.bucket_starts(ids, bbits)
 
         def ring_succ(q):
             return u128.ring_successor_bucketed(
-                ids, q, bstarts, u128.DEFAULT_BUCKET_BITS, state.n_valid)
+                ids, q, bstarts, bbits, state.n_valid)
     else:
         def ring_succ(q):
             return u128.ring_successor(ids, q, state.n_valid)

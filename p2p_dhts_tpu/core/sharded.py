@@ -89,7 +89,8 @@ def shard_ring(state: RingState, mesh: Mesh, axis: str = "peer"
     )
 
 
-BUCKET_BITS = 16  # top-id-bits bucket table: 256 KiB/shard, exact search
+# Top-id-bits bucket table: size-scaled per shard block via
+# u128.bucket_bits_for (~2^3 ids/bucket, <= 4 MiB of starts), exact search.
 
 
 def routing_converged(state: RingState) -> jax.Array:
@@ -188,7 +189,13 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
                                 off + suffix[0])
         global_first = jax.lax.pmin(first_alive, axis)
 
-        bstarts = u128.bucket_starts(ids_blk, BUCKET_BITS)
+        # Bits sized on the GLOBAL id count: buckets key on global top
+        # bits while this block holds a contiguous 1/d slice of the
+        # sorted table, so ids-per-OCCUPIED-bucket is n/2^bits
+        # regardless of d — block-based sizing would inflate occupancy
+        # by a factor of d.
+        bbits = u128.bucket_bits_for(n)
+        bstarts = u128.bucket_starts(ids_blk, bbits)
 
         def ring_succ(q):
             """Global alive ring-successor row of q: bucketed local
@@ -197,7 +204,7 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
             min id); no candidate anywhere wraps to the globally-first
             alive row."""
             j = u128.searchsorted_bucketed(ids_blk, q, bstarts,
-                                           BUCKET_BITS)  # [B] in [0, block]
+                                           bbits)  # [B] in [0, block]
             jj = suffix_ext[j]                           # alive slot >= j
             cand = jnp.where(jj == _INT_MAX, _INT_MAX, off + jj)
             best = jax.lax.pmin(cand, axis)

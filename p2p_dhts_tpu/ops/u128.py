@@ -226,6 +226,21 @@ def ring_successor(sorted_ids: jax.Array, q: jax.Array, n_valid=None) -> jax.Arr
 #: search is already as cheap as the table build).
 DEFAULT_BUCKET_BITS = 16
 
+#: Cap for size-scaled tables: 2^20 buckets = 4 MiB of i32 starts.
+MAX_BUCKET_BITS = 20
+
+
+def bucket_bits_for(n: int) -> int:
+    """Table bits sized to the id count: expected bucket occupancy ~2^3
+    ids (so each bucketed search converges in ~3-4 bisect steps instead
+    of log2(n)). n is a static shape, so this is trace-time arithmetic.
+    At 10M ids: 20 bits -> occupancy ~10 vs 152 at the flat default.
+    Sharded callers pass the GLOBAL id count: a shard's contiguous slice
+    occupies ~1/d of the (globally-keyed) buckets, so ids per occupied
+    bucket is n_global/2^bits independent of the shard count."""
+    return min(MAX_BUCKET_BITS, max(DEFAULT_BUCKET_BITS,
+                                    (max(n, 2) - 1).bit_length() - 3))
+
 def bucket_starts(sorted_ids: jax.Array, bits: int) -> jax.Array:
     """[2^bits + 1] i32 bucket table over the top `bits` id bits.
 

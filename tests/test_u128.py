@@ -211,3 +211,32 @@ def test_bucketed_searchsorted_matches_plain(rng):
         got = u.searchsorted_bucketed(ids, q, u.bucket_starts(ids, bits),
                                       bits)
         assert bool(jnp.all(want == got)), (n, bits)
+
+
+def test_bucket_bits_scale_with_table_size(rng):
+    """bucket_bits_for keeps ~2^3 occupancy under the 20-bit cap, and
+    searchsorted_bucketed stays exact at the scaled bit widths."""
+    from p2p_dhts_tpu.ops import u128 as u
+    import numpy as np
+    import jax.numpy as jnp
+
+    assert u.bucket_bits_for(1000) == u.DEFAULT_BUCKET_BITS
+    assert u.bucket_bits_for(1 << 16) == 16
+    assert u.bucket_bits_for(600_000) == 17
+    assert u.bucket_bits_for(10_000_000) == 20
+    assert u.bucket_bits_for(1 << 30) == u.MAX_BUCKET_BITS
+
+    # Exactness at a high bit width (sparse buckets: most empty).
+    n, bits = 8192, 18
+    lanes = np.frombuffer(rng.bytes(16 * n), dtype="<u4").reshape(-1, 4).copy()
+    lanes = lanes[np.lexsort((lanes[:, 0], lanes[:, 1], lanes[:, 2],
+                              lanes[:, 3]))]
+    ids = jnp.asarray(lanes)
+    q = jnp.asarray(np.frombuffer(rng.bytes(16 * 512),
+                                  dtype="<u4").reshape(-1, 4).copy())
+    q = jnp.concatenate([q, ids[:3], ids[-2:],
+                         jnp.zeros((1, 4), jnp.uint32),
+                         jnp.full((1, 4), 0xFFFFFFFF, jnp.uint32)])
+    want = u.searchsorted(ids, q)
+    got = u.searchsorted_bucketed(ids, q, u.bucket_starts(ids, bits), bits)
+    assert bool(jnp.all(want == got))
