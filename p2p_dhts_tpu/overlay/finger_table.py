@@ -173,3 +173,30 @@ class FingerTable:
     def get_entries(self) -> List[Finger]:
         with self._lock:
             return list(self._table)
+
+    def __str__(self) -> str:
+        """Condensed table pretty-print (the reference's string cast,
+        finger_table.h:194-241): consecutive ranges with the same
+        successor collate into one display row."""
+        with self._lock:
+            rows: List[List[str]] = []
+            for f in self._table:
+                succ = f.successor
+                if rows and rows[-1][2] == str(succ.id):
+                    rows[-1][1] = str(f.upper_bound)
+                else:
+                    rows.append([str(f.lower_bound), str(f.upper_bound),
+                                 str(succ.id),
+                                 f"{succ.ip_addr}:{succ.port}"])
+        header = ["LOWER BOUND", "UPPER BOUND", "SUCC ID", "SUCC IP:PORT"]
+        widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+                  for i, h in enumerate(header)]
+        border = "-" * (sum(widths) + 3 * len(widths) + 1)
+        out = [border,
+               "| " + " | ".join(h.ljust(w) for h, w in zip(header, widths))
+               + " |", border]
+        for r in rows:
+            out.append("| " + " | ".join(c.ljust(w)
+                                         for c, w in zip(r, widths)) + " |")
+        out.append(border)
+        return "\n".join(out)

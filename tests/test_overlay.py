@@ -445,3 +445,23 @@ def test_server_signal_handler_kills_gracefully():
         restore()
         signal.signal(signal.SIGTERM, orig)
         srv.kill()
+
+
+def test_finger_table_pretty_print_collates_ranges():
+    """The string cast collates consecutive same-successor ranges into
+    one row (finger_table.h:194-217)."""
+    p1 = ChordPeer("127.0.0.1", 18950, 3, maintenance_interval=None)
+    p2 = ChordPeer("127.0.0.1", 18951, 3, maintenance_interval=None)
+    try:
+        p1.start_chord()
+        p2.join("127.0.0.1", 18950)
+        text = str(p1.finger_table)
+        lines = text.splitlines()
+        assert "LOWER BOUND" in lines[1] and "SUCC IP:PORT" in lines[1]
+        body = [l for l in lines[3:-1] if l.startswith("|")]
+        # 128 fingers over a 2-peer ring collapse to at most a handful of
+        # display rows (2 distinct successors, ranges collated).
+        assert 1 <= len(body) <= 4, text
+    finally:
+        p1.fail()
+        p2.fail()
