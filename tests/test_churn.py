@@ -255,3 +255,68 @@ def test_leave_empty_batch_is_identity(rng):
                                   np.asarray(state.succs))
     np.testing.assert_array_equal(np.asarray(out.alive),
                                   np.asarray(state.alive))
+
+
+@pytest.mark.parametrize("seed", [11, 29, 47])
+def test_random_churn_program_soak(seed):
+    """Randomized multi-round churn program: interleaved fail/leave/join
+    batches, each round swept, each round checked against the fixpoint a
+    fresh build of the surviving id set would give — the property
+    underlying every scenario test above, over arbitrary op orders.
+    Seeded, so failures reproduce exactly."""
+    rng = np.random.RandomState(seed)
+    n0, cap = 96, 256
+    live_ids = set(_random_ids(rng, n0))
+    state = build_ring(sorted(live_ids), RingConfig(num_succs=3),
+                       capacity=cap)
+
+    for rnd in range(6):
+        # Row indices are into the CURRENT sorted live layout.
+        n_valid = int(state.n_valid)
+        alive = np.asarray(state.alive[:n_valid])
+        live_rows = np.flatnonzero(alive)
+
+        k_fail = rng.randint(0, 6)
+        k_leave = rng.randint(0, 6)
+        k_join = rng.randint(0, 8)
+        churn_rows = rng.choice(live_rows, size=min(k_fail + k_leave,
+                                                    len(live_rows) - 4),
+                                replace=False)
+        fail_rows = churn_rows[:k_fail]
+        leave_rows = churn_rows[k_fail:]
+        join_ids = _random_ids(rng, k_join)
+
+        # Map rows back to ids BEFORE mutating (rows shift on join).
+        ids_now = keyspace.lanes_to_ints(np.asarray(state.ids[:n_valid]))
+        for r in churn_rows:
+            live_ids.discard(ids_now[r])
+        live_ids.update(join_ids)
+
+        if len(fail_rows):
+            state = churn.fail(state, jnp.asarray(fail_rows, jnp.int32))
+        if len(leave_rows):
+            state = churn.leave(state, jnp.asarray(leave_rows, jnp.int32))
+        if k_join:
+            state, _ = churn.join(
+                state, jnp.asarray(keyspace.ints_to_lanes(join_ids)))
+        state = churn.stabilize_sweep(state)
+
+        want = build_ring(sorted(live_ids), RingConfig(num_succs=3),
+                          capacity=cap)
+        assert canonical(state) == canonical(want), f"round {rnd} diverged"
+
+        # Routing spot-check vs the oracle on the surviving ring.
+        oracle = OracleRing(sorted(live_ids))
+        keys = _random_ids(rng, 16)
+        n_valid = int(state.n_valid)
+        alive = np.asarray(state.alive[:n_valid])
+        start_row = int(np.flatnonzero(alive)[0])
+        ids_now = keyspace.lanes_to_ints(np.asarray(state.ids[:n_valid]))
+        owners, hops = find_successor(
+            state, keys_from_ints(keys),
+            jnp.full((16,), start_row, jnp.int32))
+        for j in range(16):
+            want_owner, want_hops = oracle.find_successor(
+                ids_now[start_row], keys[j])
+            assert ids_now[int(owners[j])] == want_owner, f"round {rnd}"
+            assert int(hops[j]) == want_hops, f"round {rnd} hop parity"
