@@ -318,5 +318,7 @@ def test_random_churn_program_soak(seed):
         for j in range(16):
             want_owner, want_hops = oracle.find_successor(
                 ids_now[start_row], keys[j])
-            assert ids_now[int(owners[j])] == want_owner, f"round {rnd}"
+            row = int(owners[j])
+            assert row >= 0, f"round {rnd} lane {j}: lookup failed"
+            assert ids_now[row] == want_owner, f"round {rnd}"
             assert int(hops[j]) == want_hops, f"round {rnd} hop parity"
