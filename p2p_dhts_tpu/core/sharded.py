@@ -89,8 +89,9 @@ def shard_ring(state: RingState, mesh: Mesh, axis: str = "peer"
     )
 
 
-# Top-id-bits bucket table: size-scaled per shard block via
-# u128.bucket_bits_for (~2^3 ids/bucket, <= 4 MiB of starts), exact search.
+# Top-id-bits bucket tables are sized on the GLOBAL id count via
+# u128.bucket_bits_for (~2^3 ids per occupied bucket, <= 4 MiB of starts
+# per shard), exact search; see the note at the kernel's bucket build.
 
 
 def routing_converged(state: RingState) -> jax.Array:
