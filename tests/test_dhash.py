@@ -321,3 +321,40 @@ def test_placement_fast_path_matches_walk(rng):
     want3, _ = get_n_successors(broken, keys, starts3, n)
     got3 = placement_owners(broken, keys, starts3, n)
     np.testing.assert_array_equal(np.asarray(got3), np.asarray(want3))
+
+
+@pytest.mark.parametrize("seed", [5, 23])
+def test_dhash_store_soak_medium_scale(seed):
+    """Storage-layer soak at medium scale (the device twin of the churn
+    soak): 2000 peers, 512 blocks, three rounds of (fail a batch of
+    holders within tolerance -> sweep -> global+local maintenance),
+    full readback after every round."""
+    rng = np.random.RandomState(seed)
+    n_peers, b = 2000, 512
+    ring = build_ring(_random_ids(rng, n_peers), RingConfig(num_succs=3))
+    store = empty_store(b * N_IDA * 2, SMAX)
+    keys = keys_from_ints(_random_ids(rng, b))
+    starts = jnp.asarray(rng.randint(0, n_peers, size=b), jnp.int32)
+    vals, segs, lengths = _make_blocks(rng, b)
+    store, ok = create_batch(ring, store, keys, segs, lengths, starts,
+                             N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(ok))
+
+    for rnd in range(3):
+        alive_rows = np.flatnonzero(np.asarray(ring.alive))
+        # n - m = 4 failures per round: within one round's tolerance for
+        # any single block even if all four hold its fragments.
+        victims = jnp.asarray(rng.choice(alive_rows, size=N_IDA - M_IDA,
+                                         replace=False), jnp.int32)
+        ring = churn.fail(ring, victims)
+        ring = churn.stabilize_sweep(ring)
+        any_alive = jnp.argmax(ring.alive).astype(jnp.int32)
+        starts_c = jnp.full((store.capacity,), any_alive, jnp.int32)
+        store = global_maintenance(ring, store, starts_c, N_IDA)
+        store, _ = local_maintenance(ring, store, starts_c,
+                                     N_IDA, M_IDA, P_IDA)
+        # Full replication restored and every block readable.
+        b_starts = jnp.full((b,), any_alive, jnp.int32)
+        pres = presence_matrix(ring, store, keys, b_starts, N_IDA)
+        assert bool(jnp.all(pres)), f"round {rnd}: replication not restored"
+        _check_read(ring, store, keys, segs, lengths)
