@@ -331,19 +331,14 @@ def test_dhash_store_soak_medium_scale(seed):
     full readback after every round."""
     rng = np.random.RandomState(seed)
     n_peers, b = 2000, 512
-    ring = build_ring(_random_ids(rng, n_peers), RingConfig(num_succs=3))
-    store = empty_store(b * N_IDA * 2, SMAX)
-    keys = keys_from_ints(_random_ids(rng, b))
-    starts = jnp.asarray(rng.randint(0, n_peers, size=b), jnp.int32)
-    vals, segs, lengths = _make_blocks(rng, b)
-    store, ok = create_batch(ring, store, keys, segs, lengths, starts,
-                             N_IDA, M_IDA, P_IDA)
+    ring, store, keys, starts, vals, segs, lengths, ok = _setup(
+        rng, n_peers=n_peers, b=b, capacity=b * N_IDA * 2)
     assert bool(jnp.all(ok))
 
     for rnd in range(3):
         alive_rows = np.flatnonzero(np.asarray(ring.alive))
-        # n - m = 4 failures per round: within one round's tolerance for
-        # any single block even if all four hold its fragments.
+        # n - m failures per round: within one round's tolerance for any
+        # single block even if every victim holds one of its fragments.
         victims = jnp.asarray(rng.choice(alive_rows, size=N_IDA - M_IDA,
                                          replace=False), jnp.int32)
         ring = churn.fail(ring, victims)
