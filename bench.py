@@ -127,6 +127,7 @@ from p2p_dhts_tpu.core.ring import (
 from p2p_dhts_tpu.core.sharded import (
     find_successor_sharded,
     peer_mesh,
+    routing_converged,
     shard_ring,
 )
 from p2p_dhts_tpu.dhash.store import create_batch, empty_store, read_batch
@@ -461,7 +462,11 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
     state = state_m
 
     # Sharded lookups over all local devices (explicit shard_map kernel).
+    # The convergence guard runs ONCE per swept state here; the serving
+    # loop then passes check_converged=False — its O(N/D) passes are
+    # per-state work, not per-lookup work (find_successor_sharded doc).
     sstate = shard_ring(state, mesh)
+    assert bool(routing_converged(sstate)), "post-sweep state unconverged"
     alive_np = np.asarray(sstate.alive)
     alive_rows = np.flatnonzero(alive_np)
     key_ints = _rand_ids(rng, n_keys)
@@ -470,9 +475,11 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
     starts = jnp.asarray(starts_np)
 
     best = _time(
-        lambda: find_successor_sharded(sstate, keys, starts, mesh),
+        lambda: find_successor_sharded(sstate, keys, starts, mesh,
+                                       check_converged=False),
         repeats=1)
-    owner, hops = find_successor_sharded(sstate, keys, starts, mesh)
+    owner, hops = find_successor_sharded(sstate, keys, starts, mesh,
+                                         check_converged=False)
     owner_np, hops_np = np.asarray(owner), np.asarray(hops)
     assert bool(np.all(hops_np >= 0)), "unresolved lookups"
     assert bool(np.all(alive_np[owner_np])), "dead owner"

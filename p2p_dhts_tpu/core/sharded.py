@@ -100,11 +100,16 @@ def routing_converged(state: RingState) -> jax.Array:
     Delegates to `ring.placement_converged` (live rows carry their alive
     ring predecessor — the self-hit correction target,
     chord_peer.cpp:194-196 — and the matching custody boundary);
-    fail()/sweep-pending states violate it, leave()/join() repair it
-    inline. For materialized fingers it additionally spot-checks the head
-    finger (finger 0 == next alive row), a cheap necessary condition for
-    a swept table; higher fingers are trusted as the sweep's output.
-    Plain GSPMD ops, one O(N/D) elementwise pass per shard.
+    fail()/sweep-pending states violate it; leave()/join() repair
+    placement inline in COMPUTED mode only. For materialized fingers it
+    additionally spot-checks the head finger (finger 0 == next alive
+    row), a cheap necessary condition for a swept table — and leave()
+    deliberately keeps stale finger entries (quirk parity with the
+    reference's no-op LeaveHandler finger adjustment), so a
+    materialized-mode state needs a stabilize_sweep after leave() before
+    sharded serving; until then this guard rejects it. Higher fingers
+    are trusted as the sweep's output. Plain GSPMD ops, one O(N/D)
+    elementwise pass per shard.
     """
     ok = placement_converged(state)
     if state.fingers is not None:
@@ -116,11 +121,13 @@ def routing_converged(state: RingState) -> jax.Array:
     return ok
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "max_hops"))
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "max_hops",
+                                              "check_converged"))
 def find_successor_sharded(state: RingState, keys: jax.Array,
                            start: jax.Array, mesh: Mesh,
                            axis: str = "peer",
-                           max_hops: Optional[int] = None
+                           max_hops: Optional[int] = None,
+                           check_converged: bool = True
                            ) -> Tuple[jax.Array, jax.Array]:
     """Batched GetSuccessor over a peer-axis-sharded converged ring.
 
@@ -146,9 +153,14 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
     Converged rings only (run the sweep first after churn): dead rows are
     skipped by the successor search exactly as computed fingers skip them
     (`ring.py`: always-converged finger targets), so post-sweep routing
-    matches the general single-device loop. The precondition is GUARDED:
-    `routing_converged` runs first and an un-swept state fails every
-    lane loudly (all -1) instead of returning silently wrong routes.
+    matches the general single-device loop. The precondition is GUARDED
+    by default: `routing_converged` runs first and an un-swept state
+    fails every lane loudly (all -1) instead of returning silently wrong
+    routes. The guard costs a handful of O(N/D) passes PER CALL — at 10M
+    peers that is real serve-path work for an invariant that cannot
+    change between lookups on the same state, so a serving loop should
+    verify ONCE per swept state (`assert bool(routing_converged(s))`)
+    and then pass check_converged=False (static: retraces once).
     keys [B,4] u32, start [B] i32 -> (owner [B] i32, hops [B] i32, -1 on
     hop-budget exhaustion or an unconverged ring).
     """
@@ -269,6 +281,8 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
     # an un-swept state fails every lane with one O(N/D) predicate pass
     # instead of spinning the full hop loop just to discard it.
     starts_i = jnp.asarray(start, jnp.int32)
+    if not check_converged:
+        return kernel(tables, state.n_valid, keys, starts_i)
 
     def fail_all():
         neg = jnp.full((keys.shape[0],), -1, jnp.int32)

@@ -197,3 +197,22 @@ def test_sharded_materialize_after_churn_matches_computed(rng, mesh):
     np.testing.assert_array_equal(np.asarray(o_mat), np.asarray(o_single))
     np.testing.assert_array_equal(np.asarray(h_mat), np.asarray(h_single))
     assert bool(jnp.all(o_mat >= 0))
+
+
+def test_check_converged_optout_matches_guarded(rng, mesh):
+    """The serving pattern's static guard opt-out: identical owners and
+    hops to the guarded call on a converged state (the bench verifies
+    routing_converged once, then serves with check_converged=False)."""
+    from p2p_dhts_tpu.core.sharded import routing_converged
+
+    n, b = 256, 64
+    state = build_ring(_rand_ids(rng, n), RingConfig(finger_mode="computed"))
+    sstate = shard_ring(state, mesh)
+    assert bool(routing_converged(sstate))
+    keys = keys_from_ints(_rand_ids(rng, b))
+    starts = jnp.asarray(rng.randint(0, n, size=b), jnp.int32)
+    o1, h1 = find_successor_sharded(sstate, keys, starts, mesh)
+    o2, h2 = find_successor_sharded(sstate, keys, starts, mesh,
+                                    check_converged=False)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
