@@ -426,9 +426,28 @@ def placement_converged(state: RingState) -> jax.Array:
     n = state.ids.shape[0]
     rows = jnp.arange(n, dtype=jnp.int32)
     pa = prev_alive_map(state)
-    want_pred = jnp.where(rows > 0, pa[jnp.maximum(rows - 1, 0)], pa[n - 1])
+    # pa[rows - 1] with ring wrap at row 0 is a pure shift of pa.
+    want_pred = jnp.roll(pa[:n], 1)
     preds_ok = ~jnp.any(live & (state.preds != want_pred))
-    pred_ids = state.ids[jnp.maximum(want_pred, 0)]
+    # ids[want_pred] WITHOUT the [N]-index gather (the XLA TPU
+    # shape-sensitive compile-cliff op class, see churn.leave): carry
+    # "last live id so far" with a log-depth associative scan, shift by
+    # one, and wrap row positions before the first live row to the
+    # globally-last live id (one scalar-row gather).
+    carried = jax.lax.associative_scan(
+        lambda a, b: (a[0] | b[0],
+                      jnp.where(b[0][:, None], b[1], a[1])),
+        (live, state.ids))[1]
+    last_live_id = state.ids[jnp.maximum(pa[n - 1], 0)]  # scalar-row gather
+    # Strictly-before shift; rows at or before the first live row wrap
+    # to the globally-last live id. "A live row exists before i" is
+    # already encoded in want_pred: with one, pa[i-1] <= i-1 < i; with
+    # none, pa wraps to a live row >= i (the all-dead -1 case is masked
+    # by `live &` below either way).
+    has_prev = (want_pred < rows) & (rows > 0)
+    pred_ids = jnp.where(has_prev[:, None],
+                         jnp.roll(carried, 1, axis=0),
+                         last_live_id[None, :])
     want_min = u128.add_scalar(pred_ids, 1)
     mk_ok = ~jnp.any(live & ~u128.eq(state.min_key, want_min))
     return preds_ok & mk_ok
@@ -469,10 +488,19 @@ def _converged_all_alive(state: RingState) -> jax.Array:
     per-hop cost.
     """
     n = state.ids.shape[0]
-    valid = jnp.arange(n, dtype=jnp.int32) < state.n_valid
+    rows = jnp.arange(n, dtype=jnp.int32)
+    valid = rows < state.n_valid
     all_alive = ~jnp.any(valid & ~state.alive)
-    preds_ok = ~jnp.any(valid & (state.preds < 0))
-    pred_ids = state.ids[jnp.maximum(state.preds, 0)]
+    # On a fully-alive converged SORTED ring preds is exactly the shift
+    # (i - 1) % n_valid; checking that form lets pred ids come from a
+    # structured roll instead of an [N]-index gather from the id table
+    # (the XLA TPU shape-sensitive compile-cliff op class — churn.leave).
+    want_pred = jnp.where(rows > 0, rows - 1, state.n_valid - 1)
+    preds_ok = ~jnp.any(valid & (state.preds != want_pred))
+    last_id = jax.lax.dynamic_slice_in_dim(
+        state.ids, jnp.maximum(state.n_valid - 1, 0), 1, axis=0)[0]
+    pred_ids = jnp.where((rows > 0)[:, None],
+                         jnp.roll(state.ids, 1, axis=0), last_id[None, :])
     want_min = u128.add_scalar(pred_ids, 1)
     mk_ok = ~jnp.any(valid & ~u128.eq(state.min_key, want_min))
     return all_alive & preds_ok & mk_ok

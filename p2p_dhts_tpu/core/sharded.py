@@ -101,13 +101,14 @@ def routing_converged(state: RingState) -> jax.Array:
     ring predecessor — the self-hit correction target,
     chord_peer.cpp:194-196 — and the matching custody boundary);
     fail()/sweep-pending states violate it; leave()/join() repair
-    placement inline in COMPUTED mode only. For materialized fingers it
-    additionally spot-checks the head finger (finger 0 == next alive
-    row), a cheap necessary condition for a swept table — and leave()
-    deliberately keeps stale finger entries (quirk parity with the
-    reference's no-op LeaveHandler finger adjustment), so a
-    materialized-mode state needs a stabilize_sweep after leave() before
-    sharded serving; until then this guard rejects it. Higher fingers
+    placement inline (both finger modes — preds/min_key handover is
+    unconditional in churn.leave/join). For materialized fingers this
+    guard additionally spot-checks the head finger (finger 0 == next
+    alive row), a cheap necessary condition for a swept table — and
+    leave() deliberately keeps stale FINGER entries (quirk parity with
+    the reference's no-op LeaveHandler finger adjustment), so it is the
+    finger spot-check, not placement, that rejects a materialized-mode
+    state between a leave() and the next stabilize_sweep. Higher fingers
     are trusted as the sweep's output. Plain GSPMD ops, one O(N/D)
     elementwise pass per shard.
     """
