@@ -256,8 +256,8 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
 
     dec_t = _time(lambda: (decode_kernel(rows, idx, p),))
     decoded = decode_kernel(rows, idx, p)             # [B, S, m]
-    assert bool(jnp.all(decoded == jnp.moveaxis(segments, 1, 1))), \
-        "IDA round-trip mismatch"
+    assert bool(jnp.all(decoded == segments)), \
+        "IDA round-trip mismatch"  # decode returns [B, S, m] like segments
 
     return _emit({
         "config": "ida",
@@ -530,6 +530,10 @@ def main() -> None:
     ap.add_argument("--config", default=None,
                     choices=["chord16", "ida", "dhash", "lookup_1m",
                              "sweep_10m"])
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace per config "
+                         "into DIR/<config> (VERDICT r3 #4: evidence-based "
+                         "profiling of the serve path)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -557,7 +561,12 @@ def main() -> None:
         # must not cost the run the other configs' records: emit the
         # failure as that config's record and keep going.
         try:
-            results.append(fn())
+            if args.trace:
+                from p2p_dhts_tpu.metrics import device_trace
+                with device_trace(os.path.join(args.trace, name)):
+                    results.append(fn())
+            else:
+                results.append(fn())
         except Exception as exc:  # noqa: BLE001 — deliberate firewall
             import traceback
             traceback.print_exc()

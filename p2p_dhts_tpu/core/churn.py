@@ -208,15 +208,26 @@ def join(state: RingState, new_ids: jax.Array
     """Batched join of K new peers (ref Join + JoinHandler + Notify,
     abstract_chord_peer.cpp:83-190).
 
-    new_ids: [K, 4] u32, assumed distinct from existing ids and from each
-    other. Requires n_valid + K <= capacity.
+    new_ids: [K, 4] u32. Requires n_valid + K <= capacity.
 
-    Returns (new state, rows of the joined peers). Each new peer receives
-    its converged pred / min_key / succ list / fingers (the outcome of
-    Join's PopulateFingerTable(true)); its alive successor applies the
-    HandleNotifyFromPred custody handover (pred <- new peer, min_key <-
-    new id + 1, AdjustFingers). Remaining peers' fingers stay stale until
-    stabilize_sweep — as in the reference between maintenance cycles.
+    The distinct-id precondition is ENFORCED, not assumed: a lane whose id
+    equals an ALIVE table row, or an earlier lane of the same batch, is
+    rejected (its returned row is -1, the state untouched by it) — a
+    silent duplicate insert would corrupt the sorted-table invariant every
+    searchsorted kernel depends on. A lane matching a DEAD table row is a
+    REJOIN: the row is resurrected in place, the device analog of the
+    reference's restarted process joining again under the same
+    SHA1(ip:port) id (abstract_chord_peer.cpp:13-28 — the id is a pure
+    function of the address, so rejoin-with-same-id is its normal mode).
+
+    Returns (new state, rows [K] i32: the joined/resurrected peer's row,
+    -1 for rejected lanes, aligned to the SORTED batch). Each admitted
+    peer receives its converged pred / min_key / succ list / fingers (the
+    outcome of Join's PopulateFingerTable(true)); its alive successor
+    applies the HandleNotifyFromPred custody handover (pred <- new peer,
+    min_key <- new id + 1, AdjustFingers). Remaining peers' fingers stay
+    stale until stabilize_sweep — as in the reference between maintenance
+    cycles.
     """
     n = state.ids.shape[0]
     k = new_ids.shape[0]
@@ -227,16 +238,32 @@ def join(state: RingState, new_ids: jax.Array
     *_, perm = jax.lax.sort(sort_ops, num_keys=4)
     new_sorted = new_ids[perm]
 
-    # Merge positions: old row r moves to r + (# new ids < id_r); new id j
-    # lands at searchsorted(old, new_j) + j. Rows >= n_valid (padding) are
-    # routed to index n, which is out of bounds and DROPPED by the
-    # mode="drop" scatters below (never clamped).
-    shift = u128.searchsorted(new_sorted, state.ids)          # [N] in [0, K]
+    # Lane triage: insert (fresh id) / resurrect (matches a dead table
+    # row) / reject (matches an alive row or an earlier lane). The table
+    # probe is a searchsorted + one K-sized gather — never a
+    # capacity-sized gather (the TPU compile cliff, see leave()).
+    intra_dup = jnp.concatenate(
+        [jnp.zeros((1,), bool), u128.eq(new_sorted[1:], new_sorted[:-1])])
+    pos = u128.searchsorted(state.ids, new_sorted, state.n_valid)  # [K]
+    pos_c = jnp.minimum(pos, n - 1)
+    in_table = (pos < state.n_valid) & u128.eq(state.ids[pos_c], new_sorted)
+    resurrect = in_table & ~state.alive[pos_c] & ~intra_dup
+    insert = ~in_table & ~intra_dup
+
+    # Merge positions: old row r moves to r + (# INSERTED new ids < id_r);
+    # inserted id j lands at searchsorted(old, new_j) + (# inserted lanes
+    # before j). Rows >= n_valid (padding) and non-insert lanes are routed
+    # to index n, which is out of bounds and DROPPED by the mode="drop"
+    # scatters below (never clamped).
+    q = u128.searchsorted(new_sorted, state.ids)              # [N] in [0, K]
+    ins_cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(insert.astype(jnp.int32))])
+    shift = ins_cum[q]  # # inserted ids < id_r (K+1-sized table: VMEM)
     valid_row = jnp.arange(n, dtype=jnp.int32) < state.n_valid
     old_dest = jnp.where(valid_row,
                          jnp.arange(n, dtype=jnp.int32) + shift, n)
-    new_dest = u128.searchsorted(state.ids, new_sorted, state.n_valid) \
-        + jnp.arange(k, dtype=jnp.int32)
+    rank = jnp.cumsum(insert.astype(jnp.int32)) - 1           # [K]
+    new_dest = jnp.where(insert, pos + rank, n)
 
     remap = jnp.full((n + 1,), -1, jnp.int32)  # old row -> new row
     remap = remap.at[jnp.arange(n)].set(old_dest, mode="drop")
@@ -246,11 +273,11 @@ def join(state: RingState, new_ids: jax.Array
 
     ids = jnp.full_like(state.ids, 0xFFFFFFFF)
     ids = ids.at[old_dest].set(state.ids, mode="drop")
-    ids = ids.at[new_dest].set(new_sorted)
+    ids = ids.at[new_dest].set(new_sorted, mode="drop")
 
     alive = jnp.zeros_like(state.alive)
     alive = alive.at[old_dest].set(state.alive, mode="drop")
-    alive = alive.at[new_dest].set(True)
+    alive = alive.at[new_dest].set(True, mode="drop")
 
     min_key = jnp.zeros_like(state.min_key)
     min_key = min_key.at[old_dest].set(state.min_key, mode="drop")
@@ -267,44 +294,60 @@ def join(state: RingState, new_ids: jax.Array
         fingers = fingers.at[old_dest].set(remap_idx(state.fingers),
                                            mode="drop")
 
-    mid = state._replace(ids=ids, alive=alive, n_valid=state.n_valid + k,
+    # Resurrected rows (merged coordinates) come back alive here so the
+    # alive-neighbor maps below see every admitted peer at once.
+    res_rows = jnp.where(resurrect, old_dest[pos_c], n)
+    alive = alive.at[res_rows].set(True, mode="drop")
+
+    n_ins = insert.astype(jnp.int32).sum()
+    mid = state._replace(ids=ids, alive=alive, n_valid=state.n_valid + n_ins,
                          min_key=min_key, preds=preds, succs=succs,
                          fingers=fingers)
 
-    # -- converged state for the new peers + notify handover ---------------
+    # -- converged state for the admitted peers + notify handover ----------
     na = next_alive_map(mid)
     pa = prev_alive_map(mid)
-    rows = new_dest
+    rows = jnp.where(insert, new_dest, res_rows)  # n for rejected lanes
+    admitted = rows < n
 
-    new_pred = _alive_pred_of_row(pa, rows, n)
-    preds = mid.preds.at[rows].set(new_pred)
+    new_pred = _alive_pred_of_row(pa, jnp.minimum(rows, n - 1), n)
+    preds = mid.preds.at[rows].set(new_pred, mode="drop")
     new_min = u128.add_scalar(mid.ids[new_pred], 1)
-    min_key = mid.min_key.at[rows].set(new_min)
+    min_key = mid.min_key.at[rows].set(new_min, mode="drop")
 
     succs = mid.succs.at[rows].set(
-        _succ_chain(na, rows, mid.succs.shape[1], n))
+        _succ_chain(na, jnp.minimum(rows, n - 1), mid.succs.shape[1], n),
+        mode="drop")
 
     # Notify the successor: custody handover (HandleNotifyFromPred).
-    succ_rows = _alive_succ_of_row(na, rows, n)
-    preds = preds.at[succ_rows].set(rows)
-    min_key = min_key.at[succ_rows].set(u128.add_scalar(mid.ids[rows], 1))
+    # Rejected lanes mask their successor to n so the scatters drop —
+    # without the mask they would corrupt a live peer's pred with n.
+    succ_rows = jnp.where(admitted,
+                          _alive_succ_of_row(na, jnp.minimum(rows, n - 1), n),
+                          n)
+    preds = preds.at[succ_rows].set(rows, mode="drop")
+    min_key = min_key.at[succ_rows].set(
+        u128.add_scalar(mid.ids[jnp.minimum(rows, n - 1)], 1), mode="drop")
 
     fingers = mid.fingers
     if fingers is not None:
         f = fingers.shape[1]
+        rows_c = jnp.minimum(rows, n - 1)       # gather-safe lane rows
+        succ_c = jnp.minimum(succ_rows, n - 1)
         # New peers: converged fingers (PopulateFingerTable(true)).
         fingers = fingers.at[rows].set(
-            fingers_for_ids(mid.ids, mid.n_valid, mid.ids[rows], f, na=na))
+            fingers_for_ids(mid.ids, mid.n_valid, mid.ids[rows_c], f, na=na),
+            mode="drop")
         # Notified successors: AdjustFingers — entries whose range start
         # lands in [new_min, new_id] now point at the new peer.
         fs = jnp.arange(f, dtype=jnp.int32)
-        starts = u128.add(mid.ids[succ_rows][:, None, :],
+        starts = u128.add(mid.ids[succ_c][:, None, :],
                           u128.pow2(fs)[None, :, :])          # [K, F, 4]
         hit = u128.in_between(starts, new_min[:, None, :],
-                              mid.ids[rows][:, None, :], True)
-        cur_entries = fingers[succ_rows]
+                              mid.ids[rows_c][:, None, :], True)
+        cur_entries = fingers[succ_c]
         fingers = fingers.at[succ_rows].set(
-            jnp.where(hit, rows[:, None], cur_entries))
+            jnp.where(hit, rows[:, None], cur_entries), mode="drop")
 
         # FixOtherFingers (abstract_chord_peer.cpp:615-645): the peers
         # whose finger ranges cover the new ranges are the ring
@@ -315,11 +358,15 @@ def join(state: RingState, new_ids: jax.Array
         # same fixpoint. Without this, keys in a fresh peer's range are
         # unroutable from distant starts until a sweep — in the reference
         # such lookups would recurse between two stale peers and time out.
-        targets = u128.sub(mid.ids[rows][:, None, :],
+        targets = u128.sub(mid.ids[rows_c][:, None, :],
                            u128.pow2(fs)[None, :, :])         # [K, F, 4]
         jt = u128.searchsorted(mid.ids, targets.reshape(-1, u128.LANES),
                                mid.n_valid)
         notified = jnp.where(jt > 0, pa[jnp.maximum(jt - 1, 0)], pa[n - 1])
+        # Rejected lanes notify NOBODY — their clamped-garbage targets
+        # would otherwise refresh real peers' fingers, making a rejected
+        # join observably mutate state (the docstring promises a no-op).
+        notified = jnp.where(jnp.repeat(admitted, f), notified, n)
         # Sort-based dedup (jnp.unique lowers to a much heavier program):
         # duplicates become -1, which the scatter below drops.
         notified = jnp.sort(notified)
@@ -336,4 +383,4 @@ def join(state: RingState, new_ids: jax.Array
 
     out = mid._replace(preds=preds, min_key=min_key, succs=succs,
                        fingers=fingers)
-    return out, rows
+    return out, jnp.where(admitted, rows, -1)

@@ -171,6 +171,10 @@ def materialize_converged_fingers(state: RingState,
     na = next_alive_map(state)
     fingers = fingers_for_ids(state.ids, state.n_valid, state.ids,
                               num_fingers, na=na)
+    # Dead/padding rows hold -1 like build_ring/ring_genesis materialized
+    # mode, so the two construction paths stay bit-identical (routing
+    # never reads them — lookups start at alive rows).
+    fingers = jnp.where(live_mask(state)[:, None], fingers, -1)
     return state._replace(fingers=fingers)
 
 
@@ -301,12 +305,16 @@ def ring_genesis(lanes: jax.Array, cfg: RingConfig = DEFAULT_CONFIG,
     l3, l2, l1, l0 = jax.lax.sort((l3, l2, l1, l0), num_keys=4)
     srt = jnp.stack([l0, l1, l2, l3], axis=1)
     # Dedup: push duplicate rows to the end (stable sort on the dup
-    # flag keeps the id order among survivors), pad them out.
+    # flag keeps the id order among survivors), pad them out. The lanes
+    # ride the sort as values — sorting indices and gathering srt[perm]
+    # would be a K-at-K gather, the shape-sensitive TPU compile cliff
+    # churn.leave was rewritten to avoid.
     dup = jnp.concatenate(
         [jnp.zeros((1,), bool), jnp.all(srt[1:] == srt[:-1], axis=1)])
-    dup_i, perm = jax.lax.sort(
-        (dup.astype(jnp.int32), jnp.arange(k, dtype=jnp.int32)), num_keys=1)
-    srt = jnp.where(dup_i[:, None].astype(bool), _u32_max(), srt[perm])
+    dup_i, s0, s1, s2, s3 = jax.lax.sort(
+        (dup.astype(jnp.int32), l0, l1, l2, l3), num_keys=1)
+    srt = jnp.where(dup_i[:, None].astype(bool), _u32_max(),
+                    jnp.stack([s0, s1, s2, s3], axis=1))
     n_valid = jnp.int32(k) - dup.sum().astype(jnp.int32)
 
     ids = jnp.full((capacity, LANES), 0xFFFFFFFF, jnp.uint32)
@@ -328,7 +336,13 @@ def ring_genesis(lanes: jax.Array, cfg: RingConfig = DEFAULT_CONFIG,
         succ_cols.append(col)
     succs = jnp.stack(succ_cols, axis=1)
 
-    prev_ids = ids[jnp.where(valid, preds, 0)]
+    # preds at genesis is the pure (row - 1) % n_valid shift, so prev_ids
+    # is structurally a roll — NOT ids[preds], a capacity-at-capacity
+    # gather (the TPU compile-cliff op class; see churn.leave).
+    wrap_id = jax.lax.dynamic_slice(
+        ids, (n_valid - 1, 0), (1, LANES))              # ids[n_valid-1]
+    prev_ids = jnp.where((rows > 0)[:, None],
+                         jnp.roll(ids, 1, axis=0), wrap_id)
     min_key = jnp.where(valid[:, None],
                         u128.add_scalar(prev_ids, 1),
                         jnp.zeros((1, LANES), jnp.uint32))

@@ -22,3 +22,12 @@ from p2p_dhts_tpu.dhash.merkle import (  # noqa: F401
     build_index,
     diff_indices,
 )
+from p2p_dhts_tpu.dhash.sharded import (  # noqa: F401
+    ShardedFragmentStore,
+    create_batch_sharded,
+    global_maintenance_sharded,
+    local_maintenance_sharded,
+    read_batch_sharded,
+    shard_store,
+    unshard_store,
+)

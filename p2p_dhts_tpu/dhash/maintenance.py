@@ -34,7 +34,8 @@ import jax.numpy as jnp
 
 from p2p_dhts_tpu.core.ring import RingState
 from p2p_dhts_tpu.dhash.store import (
-    FragmentStore, _key_window, _sort_store, placement_owners)
+    FragmentStore, _append_rows, _key_window, _sort_store,
+    holder_alive_mask, placement_owners)
 from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
 from p2p_dhts_tpu.ops import u128
 
@@ -55,8 +56,7 @@ def global_maintenance(ring: RingState, store: FragmentStore,
     # Only fragments on ALIVE holders can be pushed — a dead peer's store
     # is gone with its process; re-placing its rows would resurrect lost
     # data. Dead-held rows stay for local_maintenance to purge+regenerate.
-    holder_alive = ring.alive[jnp.maximum(store.holder, 0)] \
-        & (store.holder >= 0)
+    holder_alive = holder_alive_mask(store, ring.alive)
     new_holder = jnp.where(store.used & holder_alive & (target >= 0),
                            target, store.holder)
     return store._replace(holder=new_holder)
@@ -94,8 +94,7 @@ def local_maintenance(ring: RingState, store: FragmentStore,
     regenerated fragment would coexist with the stale dead-held row of
     the same (key, index), breaking the n-row-per-key window invariant.
     """
-    dead_held = store.used & ~(ring.alive[jnp.maximum(store.holder, 0)]
-                               & (store.holder >= 0))
+    dead_held = store.used & ~holder_alive_mask(store, ring.alive)
     store = _sort_store(store._replace(used=store.used & ~dead_held))
 
     c = store.capacity
@@ -104,7 +103,7 @@ def local_maintenance(ring: RingState, store: FragmentStore,
     lead_rows = jnp.arange(c, dtype=jnp.int32)
 
     # Window of up to n rows per leader (shared scan, dedup included).
-    win_c, w_valid, w_fidx = _key_window(store, ring, lead_rows,
+    win_c, w_valid, w_fidx = _key_window(store, ring.alive, lead_rows,
                                          store.keys, n)
     w_valid = w_valid & leaders[:, None]
 
@@ -133,10 +132,6 @@ def local_maintenance(ring: RingState, store: FragmentStore,
 
     # Append the needed rows.
     flat_need = need.reshape(-1)
-    dest = store.n_used + jnp.cumsum(flat_need.astype(jnp.int32)) - 1
-    dest = jnp.where(flat_need & (dest < c), dest, c)
-    stored = flat_need & (dest < c)
-
     rep_keys = jnp.broadcast_to(store.keys[:, None, :], (c, n, 4)).reshape(-1, 4)
     rep_fidx = jnp.broadcast_to(idx_grid[None, :], (c, n)).reshape(-1)
     rep_holder = owners.reshape(-1)
@@ -145,15 +140,8 @@ def local_maintenance(ring: RingState, store: FragmentStore,
                        ).reshape(c * n, smax)
     rep_len = jnp.broadcast_to(store.length[:, None], (c, n)).reshape(-1)
 
-    out = FragmentStore(
-        keys=store.keys.at[dest].set(rep_keys, mode="drop"),
-        frag_idx=store.frag_idx.at[dest].set(rep_fidx, mode="drop"),
-        holder=store.holder.at[dest].set(rep_holder, mode="drop"),
-        values=store.values.at[dest].set(rep_vals, mode="drop"),
-        length=store.length.at[dest].set(rep_len, mode="drop"),
-        used=store.used.at[dest].set(True, mode="drop"),
-        n_used=store.n_used + stored.astype(jnp.int32).sum(),
-    )
+    out, stored = _append_rows(store, rep_keys, rep_fidx, rep_holder,
+                               rep_vals, rep_len, flat_need)
     return _sort_store(out), stored.astype(jnp.int32).sum()
 
 
@@ -165,7 +153,7 @@ def presence_matrix(ring: RingState, store: FragmentStore,
     holder? The batched analog of the Merkle-sync IsMissing check
     (dhash_peer.cpp:416-447) for known keys."""
     pos = u128.searchsorted(store.keys, keys, store.n_used)
-    _, valid, fidx = _key_window(store, ring, pos, keys, n)
+    _, valid, fidx = _key_window(store, ring.alive, pos, keys, n)
     idx_grid = jnp.arange(1, n + 1, dtype=jnp.int32)
     return ((fidx[:, :, None] == idx_grid[None, None, :])
             & valid[:, :, None]).any(axis=1)

@@ -1,0 +1,602 @@
+"""Peer-axis-sharded fragment storage: the DHash layer at scale.
+
+The reference's defining property is storage SCATTERED across peers —
+each DHashPeer process owns a FragmentDb holding just the fragments
+whose designated holder it is (dhash_peer.cpp:89-197); reads and
+maintenance cross process boundaries over RPC. The single-device
+`dhash.store.FragmentStore` collapses all of that into one sorted table,
+which is exact but caps out at one chip's HBM. This module is the
+scale-out twin (SURVEY.md §5.8, VERDICT r3 #2): fragment rows are
+partitioned by HOLDER ring-shard over a `jax.sharding.Mesh`, each shard's
+slice is itself a valid sorted FragmentStore, and the cross-shard
+traffic of the reference's CREATE_KEY / READ_KEY / key-push RPCs becomes
+explicit XLA collectives over ICI:
+
+  * `create_batch_sharded` — placement + encode are computed replicated
+    (every device runs the same cheap program on the same inputs); each
+    shard APPENDS only the fragment rows whose holder lives in its ring
+    block; one [B] psum reconciles per-lane ack counts (the >= m ack
+    rule, dhash_peer.cpp:126-128).
+  * `read_batch_sharded` — each shard contributes its local matching
+    fragment rows into a one-hot [B, n, S+1] accumulator; one psum
+    assembles the global fragment matrix (each (key, idx) row exists on
+    exactly one shard — the READ_KEY fan-in); decode happens replicated.
+  * `global_maintenance_sharded` — per shard: recompute designated
+    holders for local rows (replicated ring tables, no collective);
+    misplaced rows bound for another shard are packed into a fixed-size
+    outbox, `all_gather`ed, and ingested by their new shard — the
+    device analog of global maintenance's key push + local delete
+    (dhash_peer.cpp:298-348).
+  * `local_maintenance_sharded` — each shard purges rows held by dead
+    peers, nominates up to R of its block-leader keys, `all_gather`s the
+    candidate list, and one [DR, n, S+2] psum assembles presence +
+    lengths + values; blocks with >= m survivors are decoded and
+    re-encoded replicated and every shard appends the regenerated
+    fragments it is the designated holder shard for (RetrieveMissing's
+    regeneration, dhash_peer.cpp:350-379, batched).
+
+Sharding stance (scaling-book recipe): only the HEAVY array shards — the
+fragment values table, O(capacity * S). The ring's id/alive/next-alive
+tables are passed REPLICATED (40-200 MB at 10M peers — cheap next to a
+5 GB finger matrix or a multi-GB store), which makes placement a local
+computation and keeps the collective schedule down to the three shapes
+above (append-psum, read-psum, outbox all_gather). The RingState handed
+to these ops must be placement-converged (run `churn.stabilize_sweep`
+first — same precondition as the sharded serve path, and it is enforced
+with a masked no-op + all-lanes-failed result, never silent corruption).
+
+Invariant (the sharded twin of the store's n-row window invariant):
+every live (key, frag_idx) row exists on AT MOST ONE shard — create
+routes a row to its holder's shard, migration clears the source exactly
+when the destination's accept comes back (transactional — a full
+destination leaves the row at the source as pending work, never data
+loss), and repair appends only globally-absent indices on exactly the
+designated holder's shard. The read psum's one-hot correctness rests on
+it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_dhts_tpu.core.ring import (
+    RingState,
+    n_successors_converged,
+    placement_converged,
+)
+from p2p_dhts_tpu.dhash.store import (
+    FragmentStore,
+    _append_rows,
+    _key_window,
+    _last_writer_lanes,
+    _purge_keys,
+    _sort_store,
+    empty_store,
+    holder_alive_mask,
+)
+from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+from p2p_dhts_tpu.ops import u128
+
+
+class ShardedFragmentStore(NamedTuple):
+    """[D, Cl, ...] blocks, row-sharded over the mesh's peer axis; block
+    d is a valid sorted FragmentStore holding exactly the rows whose
+    holder lies in ring block d."""
+    keys: jax.Array      # [D, Cl, 4] u32
+    frag_idx: jax.Array  # [D, Cl] i32
+    holder: jax.Array    # [D, Cl] i32
+    values: jax.Array    # [D, Cl, S] i32
+    length: jax.Array    # [D, Cl] i32
+    used: jax.Array      # [D, Cl] bool
+    n_used: jax.Array    # [D] i32
+
+    @property
+    def n_shards(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def shard_capacity(self) -> int:
+        return self.keys.shape[1]
+
+    @property
+    def max_segments(self) -> int:
+        return self.values.shape[2]
+
+
+def _rblock(ring: RingState, mesh: Mesh, axis: str) -> int:
+    """Ring rows per shard. The capacity must divide evenly — otherwise
+    the tail rows belong to NO shard and any row routed to them would be
+    silently dropped (holder-ownership is `row // rblock`)."""
+    d = mesh.shape[axis]
+    if ring.ids.shape[0] % d:
+        raise ValueError(f"ring capacity {ring.ids.shape[0]} not divisible "
+                         f"by {d} shards — tail rows would be unowned")
+    return ring.ids.shape[0] // d
+
+
+def _store_specs(axis: str) -> ShardedFragmentStore:
+    """in/out_specs pytree for a ShardedFragmentStore operand."""
+    return ShardedFragmentStore(
+        keys=P(axis, None, None), frag_idx=P(axis, None),
+        holder=P(axis, None), values=P(axis, None, None),
+        length=P(axis, None), used=P(axis, None), n_used=P(axis))
+
+
+def _ring_specs(state: RingState):
+    """Replicated specs for every RingState data leaf."""
+    return jax.tree.map(lambda _: P(), state)
+
+
+def _strip_fingers(state: RingState) -> RingState:
+    """Store ops never touch fingers; dropping them keeps a multi-GB
+    materialized matrix from riding along as a replicated operand."""
+    return state._replace(fingers=None)
+
+
+def _local(sstore: ShardedFragmentStore) -> FragmentStore:
+    """The per-shard FragmentStore view inside a shard_map body (blocks
+    arrive as [1, Cl, ...]; squeeze the unit shard axis)."""
+    return FragmentStore(
+        keys=sstore.keys[0], frag_idx=sstore.frag_idx[0],
+        holder=sstore.holder[0], values=sstore.values[0],
+        length=sstore.length[0], used=sstore.used[0],
+        n_used=sstore.n_used[0])
+
+
+def _pack(local: FragmentStore) -> ShardedFragmentStore:
+    """Inverse of `_local`: re-add the unit shard axis for out_specs."""
+    return ShardedFragmentStore(
+        keys=local.keys[None], frag_idx=local.frag_idx[None],
+        holder=local.holder[None], values=local.values[None],
+        length=local.length[None], used=local.used[None],
+        n_used=local.n_used[None])
+
+
+def shard_store(store: FragmentStore, mesh: Mesh, ring_capacity: int,
+                axis: str = "peer",
+                shard_capacity: Optional[int] = None
+                ) -> ShardedFragmentStore:
+    """Partition a single-device store by holder ring-block (host-side;
+    a build/restore-time op, not a hot path). Rows with holder < 0 are
+    dropped (they are unreachable to reads anyway)."""
+    d = mesh.shape[axis]
+    if ring_capacity % d != 0:
+        raise ValueError(f"ring capacity {ring_capacity} not divisible by "
+                         f"{d} shards")
+    rblock = ring_capacity // d
+    cl = (shard_capacity if shard_capacity is not None
+          else -(-store.capacity // d))
+    smax = store.max_segments
+
+    keys = np.asarray(store.keys)
+    fidx = np.asarray(store.frag_idx)
+    holder = np.asarray(store.holder)
+    values = np.asarray(store.values)
+    length = np.asarray(store.length)
+    used = np.asarray(store.used) & (holder >= 0)
+
+    blocks = []
+    for s in range(d):
+        mine = used & (holder // rblock == s)
+        cnt = int(mine.sum())
+        if cnt > cl:
+            raise ValueError(f"shard {s} needs {cnt} rows > shard "
+                             f"capacity {cl}")
+        sel = np.flatnonzero(mine)
+        blk = empty_store(cl, smax)
+        blk = FragmentStore(
+            keys=np.asarray(blk.keys).copy(),
+            frag_idx=np.asarray(blk.frag_idx).copy(),
+            holder=np.asarray(blk.holder).copy(),
+            values=np.asarray(blk.values).copy(),
+            length=np.asarray(blk.length).copy(),
+            used=np.asarray(blk.used).copy(),
+            n_used=np.int32(cnt))
+        blk.keys[:cnt] = keys[sel]
+        blk.frag_idx[:cnt] = fidx[sel]
+        blk.holder[:cnt] = holder[sel]
+        blk.values[:cnt] = values[sel]
+        blk.length[:cnt] = length[sel]
+        blk.used[:cnt] = True
+        # Local sort by (key, frag_idx): lexsort, least-significant last.
+        order = np.lexsort((blk.frag_idx[:cnt], blk.keys[:cnt, 0],
+                            blk.keys[:cnt, 1], blk.keys[:cnt, 2],
+                            blk.keys[:cnt, 3]))
+        for f in ("keys", "frag_idx", "holder", "values", "length"):
+            arr = getattr(blk, f)
+            arr[:cnt] = arr[:cnt][order]
+        blocks.append(blk)
+
+    def put(field, spec):
+        stacked = np.stack([getattr(b, field) for b in blocks])
+        return jax.device_put(stacked, NamedSharding(mesh, spec))
+
+    return ShardedFragmentStore(
+        keys=put("keys", P(axis, None, None)),
+        frag_idx=put("frag_idx", P(axis, None)),
+        holder=put("holder", P(axis, None)),
+        values=put("values", P(axis, None, None)),
+        length=put("length", P(axis, None)),
+        used=put("used", P(axis, None)),
+        n_used=jax.device_put(
+            np.asarray([b.n_used for b in blocks], np.int32),
+            NamedSharding(mesh, P(axis))))
+
+
+def unshard_store(sstore: ShardedFragmentStore) -> FragmentStore:
+    """Merge the shard blocks back into one sorted single-device store
+    (test/checkpoint utility)."""
+    d, cl = sstore.n_shards, sstore.shard_capacity
+    flat = FragmentStore(
+        keys=jnp.asarray(np.asarray(sstore.keys).reshape(d * cl, 4)),
+        frag_idx=jnp.asarray(np.asarray(sstore.frag_idx).reshape(-1)),
+        holder=jnp.asarray(np.asarray(sstore.holder).reshape(-1)),
+        values=jnp.asarray(np.asarray(sstore.values).reshape(d * cl, -1)),
+        length=jnp.asarray(np.asarray(sstore.length).reshape(-1)),
+        used=jnp.asarray(np.asarray(sstore.used).reshape(-1)),
+        n_used=jnp.int32(int(np.asarray(sstore.n_used).sum())))
+    return _sort_store(flat)
+
+
+# ---------------------------------------------------------------------------
+# create / read
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p", "mesh", "axis"))
+def create_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
+                         keys: jax.Array, segments: jax.Array,
+                         lengths: jax.Array, n: int = 14, m: int = 10,
+                         p: int = 257, mesh: Mesh = None, axis: str = "peer"
+                         ) -> Tuple[ShardedFragmentStore, jax.Array]:
+    """Batched DHash Create over the sharded store (module doc). Same
+    lane semantics as `store.create_batch` (>= m acks, last-writer-wins
+    in-batch, per-shard overflow fails the lane); placement uses the
+    converged fast path only — an unconverged ring makes the whole batch
+    a no-op with every lane failed."""
+    b = keys.shape[0]
+    d = mesh.shape[axis]
+    rblock = _rblock(ring, mesh, axis)
+    smax = sstore.max_segments
+    ring = _strip_fingers(ring)
+
+    guard = placement_converged(ring)
+    owners = n_successors_converged(ring, keys, n)                # [B, n]
+    placed = owners >= 0
+    okp = (placed.sum(axis=1) >= m) & guard
+    superseded, winner_of = _last_writer_lanes(keys)
+
+    frags = encode_kernel(segments, n, m, p)                      # [B, n, S]
+    frags = jnp.pad(frags, ((0, 0), (0, 0), (0, smax - frags.shape[2])))
+
+    rows_keys = jnp.broadcast_to(keys[:, None, :], (b, n, 4)).reshape(-1, 4)
+    rows_fidx = jnp.broadcast_to(
+        jnp.arange(1, n + 1, dtype=jnp.int32)[None, :], (b, n)).reshape(-1)
+    rows_holder = owners.reshape(-1)
+    rows_vals = frags.reshape(b * n, smax)
+    rows_len = jnp.broadcast_to(lengths[:, None], (b, n)).reshape(-1)
+    rows_ok = (placed & okp[:, None] & ~superseded[:, None]).reshape(-1)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(_store_specs(axis), P(None, None), P(None, None), P(None),
+                  P(None), P(None, None), P(None), P(None), P()),
+        out_specs=(_store_specs(axis), P(None)),
+        check_vma=False)
+    def kernel(sstore, keys, rows_keys, rows_fidx, rows_holder, rows_vals,
+               rows_len, rows_ok, guard):
+        local = _local(sstore)
+        # Overwrite semantics: purge re-created keys locally first (a
+        # key's old rows may live on any shard). Masked by the guard so
+        # an unconverged ring leaves the store bit-identical.
+        local = jax.lax.cond(guard, lambda: _purge_keys(local, keys),
+                             lambda: local)
+        off = jax.lax.axis_index(axis).astype(jnp.int32) * rblock
+        mine = rows_ok & (rows_holder >= off) & (rows_holder < off + rblock)
+        local, stored = _append_rows(local, rows_keys, rows_fidx,
+                                     rows_holder, rows_vals, rows_len, mine)
+        local = _sort_store(local)
+        lane_stored = jax.lax.psum(
+            stored.reshape(b, n).astype(jnp.int32).sum(axis=1), axis)
+        return _pack(local), lane_stored
+
+    sstore, lane_stored = kernel(sstore, keys, rows_keys, rows_fidx,
+                                 rows_holder, rows_vals, rows_len, rows_ok,
+                                 guard)
+    ok_stored = okp & (lane_stored >= jnp.minimum(m, placed.sum(axis=1)))
+    ok = jnp.where(superseded, ok_stored[winner_of], ok_stored)
+    return sstore, ok & guard
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p", "mesh", "axis"))
+def read_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
+                       keys: jax.Array, n: int = 14, m: int = 10,
+                       p: int = 257, mesh: Mesh = None, axis: str = "peer"
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Batched DHash Read over the sharded store: one [B, n, S+1] psum
+    assembles presence + fragment values from every shard (each live
+    (key, idx) row exists on exactly one — module invariant), then the
+    first m present distinct indices decode replicated. Same semantics
+    as `store.read_batch` (alive holders only; < m reachable fragments
+    fails the lane with zeros)."""
+    b = keys.shape[0]
+    smax = sstore.max_segments
+    alive = ring.alive
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(_store_specs(axis), P(None), P(None, None)),
+        out_specs=P(None, None, None),
+        check_vma=False)
+    def gather_kernel(sstore, alive, keys):
+        local = _local(sstore)
+        pos = u128.searchsorted(local.keys, keys, local.n_used)
+        win_c, valid, fidx = _key_window(local, alive, pos, keys, n)
+        contrib = jnp.zeros((b, n, smax + 1), jnp.int32)
+        lanes_b = jnp.arange(b, dtype=jnp.int32)
+        for j in range(n):                       # static window width
+            f = jnp.clip(fidx[:, j] - 1, 0, n - 1)
+            entry = jnp.concatenate(
+                [jnp.ones((b, 1), jnp.int32), local.values[win_c[:, j]]],
+                axis=1)
+            entry = jnp.where(valid[:, j, None], entry, 0)
+            contrib = contrib.at[lanes_b, f].add(entry)
+        return jax.lax.psum(contrib, axis)
+
+    out = gather_kernel(sstore, alive, keys)
+    present = out[:, :, 0] > 0                                    # [B, n]
+    values = out[:, :, 1:]                                        # [B, n, S]
+    ok = present.sum(axis=1) >= m
+
+    order = jnp.argsort(~present, axis=1, stable=True)[:, :m]     # [B, m]
+    rows = jnp.take_along_axis(values, order[:, :, None], axis=1)  # [B, m, S]
+    idx = jnp.where(ok[:, None], order + 1,
+                    jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
+    segments = decode_kernel(rows, idx, p)                        # [B, S, m]
+    return jnp.where(ok[:, None, None], segments, 0), ok
+
+
+# ---------------------------------------------------------------------------
+# maintenance
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n", "outbox", "mesh", "axis"))
+def global_maintenance_sharded(ring: RingState, sstore: ShardedFragmentStore,
+                               n: int = 14, outbox: int = 1024,
+                               mesh: Mesh = None, axis: str = "peer"
+                               ) -> Tuple[ShardedFragmentStore, jax.Array,
+                                          jax.Array]:
+    """Re-place every fragment on the frag_idx-th successor of its key,
+    MOVING rows between shards when the designated holder changed blocks
+    (the reference's global maintenance: push misplaced keys to their
+    true successors, delete locally — dhash_peer.cpp:298-348).
+
+    Up to `outbox` rows emigrate per shard per call; the rest keep their
+    stale holder until a later round (the reference's 5 s cycles are
+    equally incremental). Returns (store, moved, pending): `moved`
+    counts rows ingested by their new shard this round, `pending` the
+    emigrants left waiting (including any dropped by a full destination
+    block — provision shard capacity for occupancy + migration burst,
+    the sharded analog of create_batch's overflow-drop contract).
+    Dead-held rows stay untouched, as in `maintenance.global_maintenance`
+    (a dead peer's fragments are local_maintenance's to regenerate)."""
+    d = mesh.shape[axis]
+    rblock = _rblock(ring, mesh, axis)
+    ring = _strip_fingers(ring)
+    guard = placement_converged(ring)
+    cl = sstore.shard_capacity
+    outbox = min(outbox, cl)  # can't pack more rows than a block holds
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(_store_specs(axis), _ring_specs(ring), P()),
+        out_specs=(_store_specs(axis), P(None), P(None)),
+        check_vma=False)
+    def kernel(sstore, ring, guard):
+        local = _local(sstore)
+        off = jax.lax.axis_index(axis).astype(jnp.int32) * rblock
+        ha = holder_alive_mask(local, ring.alive)
+        owners = n_successors_converged(ring, local.keys, n)     # [Cl, n]
+        target = jnp.take_along_axis(
+            owners, jnp.clip(local.frag_idx - 1, 0, n - 1)[:, None],
+            axis=1)[:, 0]
+        act = local.used & ha & (target >= 0) & guard
+        inb = act & (target >= off) & (target < off + rblock)
+        holder = jnp.where(inb, target, local.holder)
+        emigrate = act & ~inb
+
+        # Pack up to `outbox` emigrants. The outbox FIELDS are captured
+        # at the pre-compaction row positions `sel` indexes (the final
+        # sort would permute them). The move is TRANSACTIONAL: the
+        # source clears a packed row only after the destination's accept
+        # comes back in the psum below — a destination block too full to
+        # ingest leaves the row at the source for a later round, so a
+        # full shard degrades to pending work, never to data loss.
+        sel = jnp.argsort(~emigrate, stable=True)[:outbox]       # [E]
+        sel_valid = emigrate[sel]
+        out_keys = local.keys[sel]
+        out_fidx = local.frag_idx[sel]
+        out_target = target[sel]
+        out_vals = local.values[sel]
+        out_len = local.length[sel]
+
+        g_keys = jax.lax.all_gather(out_keys, axis)              # [D, E, 4]
+        g_fidx = jax.lax.all_gather(out_fidx, axis)
+        g_target = jax.lax.all_gather(out_target, axis)
+        g_vals = jax.lax.all_gather(out_vals, axis)
+        g_len = jax.lax.all_gather(out_len, axis)
+        g_valid = jax.lax.all_gather(sel_valid, axis)
+
+        e = d * outbox
+        mine = (g_valid.reshape(e)
+                & (g_target.reshape(e) >= off)
+                & (g_target.reshape(e) < off + rblock))
+        # Capacity note: appends are sized against the PRE-clear n_used
+        # (the source's own departing rows still occupy their slots), so
+        # acceptance is conservative — a block can reject a row this
+        # round and take it the next, after its own emigrants left.
+        local, stored = _append_rows(
+            local._replace(holder=holder),
+            g_keys.reshape(e, 4), g_fidx.reshape(e),
+            g_target.reshape(e), g_vals.reshape(e, -1), g_len.reshape(e),
+            mine)
+
+        # Accept mask back to every source: each packed row is ingested
+        # by at most one shard, so a psum over the flattened [D*E] mask
+        # is exact; shard s's slice covers its own outbox.
+        accepted = jax.lax.psum(stored.astype(jnp.int32), axis)  # [D*E]
+        my_accepted = jax.lax.dynamic_slice(
+            accepted, (jax.lax.axis_index(axis) * outbox,),
+            (outbox,)).astype(bool)
+        cleared = jnp.zeros((cl,), bool).at[sel].set(
+            sel_valid & my_accepted)
+        local = _sort_store(local._replace(used=local.used & ~cleared))
+
+        moved = jax.lax.psum(stored.astype(jnp.int32).sum(), axis)
+        waiting = jax.lax.psum(
+            (emigrate & ~cleared).astype(jnp.int32).sum(), axis)
+        return _pack(local), moved[None], waiting[None]
+
+    sstore, moved, pending = kernel(sstore, ring, guard)
+    return sstore, moved[0], pending[0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "m", "p", "cands", "mesh", "axis"))
+def local_maintenance_sharded(ring: RingState, sstore: ShardedFragmentStore,
+                              cand_start: jax.Array, n: int = 14,
+                              m: int = 10, p: int = 257, cands: int = 256,
+                              mesh: Mesh = None, axis: str = "peer"
+                              ) -> Tuple[ShardedFragmentStore, jax.Array]:
+    """Regenerate missing fragments of blocks with >= m survivors, over
+    the sharded store (the reference's Merkle-sync'd RetrieveMissing,
+    dhash_peer.cpp:350-379, as a batched collective program).
+
+    Each shard first PURGES rows held by dead peers (their process died
+    with them — maintenance.local_maintenance's contract), then
+    nominates up to `cands` of its local block-leader keys starting at
+    leader offset `cand_start` (advance it across calls to sweep a store
+    wider than D*cands keys per round); the candidate list is
+    all_gather'ed, deduplicated replicated, and one [D*cands, n, S+2]
+    psum assembles presence + lengths + values. Decode/re-encode run
+    replicated; each shard appends exactly the regenerated (key, idx)
+    rows whose designated holder lives in its block and which are absent
+    everywhere (keeping the at-most-one-shard invariant).
+
+    Returns (store, repaired_count)."""
+    d = mesh.shape[axis]
+    rblock = _rblock(ring, mesh, axis)
+    ring = _strip_fingers(ring)
+    guard = placement_converged(ring)
+    cl = sstore.shard_capacity
+    smax = sstore.max_segments
+    if cands > cl:
+        raise ValueError(f"cands {cands} > shard capacity {cl}")
+    r = cands
+    dr = d * r
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(_store_specs(axis), _ring_specs(ring), P(), P()),
+        out_specs=(_store_specs(axis), P(None)),
+        check_vma=False)
+    def kernel(sstore, ring, cand_start, guard):
+        local = _local(sstore)
+        off = jax.lax.axis_index(axis).astype(jnp.int32) * rblock
+
+        # Purge dead-held rows (sharded twin of local_maintenance's
+        # purge: a regenerated fragment must not coexist with the stale
+        # dead-held row of the same (key, idx)). Guarded like the
+        # create purge — an unconverged ring must be a full no-op, not
+        # a redundancy-reducing partial pass.
+        def _purge_dead(s):
+            dead_held = s.used & ~holder_alive_mask(s, ring.alive)
+            return _sort_store(s._replace(used=s.used & ~dead_held))
+        local = jax.lax.cond(guard, _purge_dead, lambda s: s, local)
+
+        # Nominate r local leader keys from leader offset cand_start.
+        rows_l = jnp.arange(cl, dtype=jnp.int32)
+        prev_same = jnp.concatenate([
+            jnp.zeros((1,), bool),
+            u128.eq(local.keys[1:], local.keys[:-1])])
+        leaders = local.used & (rows_l < local.n_used) & ~prev_same
+        lead_pos = jnp.sort(jnp.where(leaders, rows_l, cl))
+        n_lead = leaders.astype(jnp.int32).sum()
+        start = jnp.clip(jnp.minimum(cand_start, n_lead - r), 0, cl - r)
+        sel = jax.lax.dynamic_slice(lead_pos, (start,), (r,))    # [r]
+        sel_ok = sel < cl
+        sel_c = jnp.minimum(sel, cl - 1)
+        cand = jnp.where(sel_ok[:, None], local.keys[sel_c],
+                         jnp.uint32(0xFFFFFFFF))
+
+        cand_all = jax.lax.all_gather(cand, axis).reshape(dr, 4)
+        # Replicated dedup: sort by key; non-first-of-run and sentinel
+        # lanes go inert.
+        c3, c2, c1, c0 = jax.lax.sort(
+            (cand_all[:, 3], cand_all[:, 2], cand_all[:, 1], cand_all[:, 0]),
+            num_keys=4)
+        cand_s = jnp.stack([c0, c1, c2, c3], axis=1)
+        dup = jnp.concatenate([
+            jnp.zeros((1,), bool), u128.eq(cand_s[1:], cand_s[:-1])])
+        sentinel = jnp.all(cand_s == jnp.uint32(0xFFFFFFFF), axis=1)
+        cand_ok = ~dup & ~sentinel & guard
+
+        # Presence + length + values psum over shards (read-kernel scan).
+        pos = u128.searchsorted(local.keys, cand_s, local.n_used)
+        win_c, valid, fidx = _key_window(local, ring.alive, pos, cand_s, n)
+        contrib = jnp.zeros((dr, n, smax + 2), jnp.int32)
+        lanes = jnp.arange(dr, dtype=jnp.int32)
+        for j in range(n):
+            f = jnp.clip(fidx[:, j] - 1, 0, n - 1)
+            entry = jnp.concatenate(
+                [jnp.ones((dr, 1), jnp.int32),
+                 local.length[win_c[:, j]][:, None],
+                 local.values[win_c[:, j]]], axis=1)
+            entry = jnp.where(valid[:, j, None], entry, 0)
+            contrib = contrib.at[lanes, f].add(entry)
+        agg = jax.lax.psum(contrib, axis)
+        present = agg[:, :, 0] > 0                               # [dr, n]
+        glen = agg[:, :, 1].max(axis=1)                          # [dr]
+        gvals = agg[:, :, 2:]                                    # [dr, n, S]
+        n_present = present.sum(axis=1)
+        can_repair = cand_ok & (n_present >= m) & (n_present < n)
+
+        # Decode from the first m present fragments, re-encode all n
+        # (replicated compute — every shard derives the same matrices).
+        order = jnp.argsort(~present, axis=1, stable=True)[:, :m]
+        rows_v = jnp.take_along_axis(gvals, order[:, :, None], axis=1)
+        idx_safe = jnp.where(can_repair[:, None], order + 1,
+                             jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
+        segs = decode_kernel(rows_v, idx_safe, p)                # [dr, S, m]
+        all_frags = encode_kernel(segs, n, m, p)                 # [dr, n, S']
+        all_frags = jnp.pad(
+            all_frags, ((0, 0), (0, 0), (0, smax - all_frags.shape[2])))
+
+        owners = n_successors_converged(ring, cand_s, n)         # [dr, n]
+        owner_alive = ring.alive[jnp.maximum(owners, 0)] & (owners >= 0)
+        need = can_repair[:, None] & ~present & owner_alive
+        mine = need & (owners >= off) & (owners < off + rblock)
+
+        idx_grid = jnp.arange(1, n + 1, dtype=jnp.int32)
+        rep_keys = jnp.broadcast_to(cand_s[:, None, :],
+                                    (dr, n, 4)).reshape(-1, 4)
+        rep_fidx = jnp.broadcast_to(idx_grid[None, :], (dr, n)).reshape(-1)
+        rep_holder = owners.reshape(-1)
+        rep_vals = all_frags.reshape(dr * n, smax)
+        rep_len = jnp.broadcast_to(glen[:, None], (dr, n)).reshape(-1)
+        local, stored = _append_rows(local, rep_keys, rep_fidx, rep_holder,
+                                     rep_vals, rep_len, mine.reshape(-1))
+        local = _sort_store(local)
+        repaired = jax.lax.psum(stored.astype(jnp.int32).sum(), axis)
+        return _pack(local), repaired[None]
+
+    sstore, repaired = kernel(sstore, ring, jnp.asarray(cand_start,
+                                                        jnp.int32), guard)
+    return sstore, repaired[0]

@@ -105,13 +105,27 @@ def _sort_store(store: FragmentStore) -> FragmentStore:
     )
 
 
-def _key_window(store: FragmentStore, ring: RingState, pos: jax.Array,
-                keys: jax.Array, n: int):
+def holder_alive_mask(store: FragmentStore, alive: jax.Array) -> jax.Array:
+    """[C] bool: is each row's holder an alive ring row? `alive` is the
+    ring's [N] alive vector (replicated in sharded callers — the cheap
+    ring arrays are replicated per-device, only the heavy ones shard)."""
+    return alive[jnp.maximum(store.holder, 0)] & (store.holder >= 0)
+
+
+def _key_window(store: FragmentStore, alive: jax.Array,
+                pos: jax.Array, keys: jax.Array, n: int):
     """THE window scan: up to n candidate rows per key starting at sorted
     position `pos`, validity-masked (in-store, key match, used, alive
     holder) with duplicate fragment indices deduplicated (later duplicate
-    loses). Shared by read_batch / local_maintenance / presence_matrix so
-    the window invariant lives in exactly one place.
+    loses). Shared by read_batch / local_maintenance / presence_matrix /
+    the sharded-store kernels so the window invariant lives in exactly
+    one place.
+
+    alive: the ring's [N] alive vector (replicated in sharded callers).
+    Holder liveness is resolved for the [B, n] WINDOW entries only —
+    never as a store-capacity-sized mask, which on the serve path would
+    be O(C) gather work per read batch (and the capacity-at-capacity
+    gather class is the XLA TPU compile cliff churn.leave documents).
 
     Returns (win_c [B, n] clamped row indices, valid [B, n] bool,
     fidx [B, n] i32).
@@ -119,17 +133,63 @@ def _key_window(store: FragmentStore, ring: RingState, pos: jax.Array,
     w = jnp.arange(n, dtype=jnp.int32)[None, :]
     win = pos[:, None] + w
     win_c = jnp.minimum(win, store.capacity - 1)
+    h = store.holder[win_c]                                        # [B, n]
     valid = (win < store.n_used) \
         & u128.eq(store.keys[win_c], keys[:, None, :]) \
         & store.used[win_c] \
-        & ring.alive[jnp.maximum(store.holder[win_c], 0)] \
-        & (store.holder[win_c] >= 0)
+        & alive[jnp.maximum(h, 0)] & (h >= 0)
     fidx = store.frag_idx[win_c]
     dup = (fidx[:, :, None] == fidx[:, None, :]) \
         & valid[:, :, None] & valid[:, None, :]
     earlier = jnp.tril(jnp.ones((n, n), bool), k=-1)[None]
     valid = valid & ~(dup & earlier).any(axis=2)
     return win_c, valid, fidx
+
+
+def _append_rows(store: FragmentStore, keys: jax.Array, fidx: jax.Array,
+                 holder: jax.Array, values: jax.Array, length: jax.Array,
+                 take: jax.Array) -> Tuple[FragmentStore, jax.Array]:
+    """Append the rows marked by `take` ([R] bool) after the used prefix,
+    dropping those that would overflow capacity. Returns (store — NOT yet
+    re-sorted, stored [R] bool). Shared by create_batch, repair, and the
+    sharded kernels; callers _sort_store afterwards."""
+    dest = store.n_used + jnp.cumsum(take.astype(jnp.int32)) - 1
+    dest = jnp.where(take & (dest < store.capacity), dest, store.capacity)
+    stored = take & (dest < store.capacity)
+    out = FragmentStore(
+        keys=store.keys.at[dest].set(keys, mode="drop"),
+        frag_idx=store.frag_idx.at[dest].set(fidx, mode="drop"),
+        holder=store.holder.at[dest].set(holder, mode="drop"),
+        values=store.values.at[dest].set(values, mode="drop"),
+        length=store.length.at[dest].set(length, mode="drop"),
+        used=store.used.at[dest].set(True, mode="drop"),
+        n_used=store.n_used + stored.astype(jnp.int32).sum(),
+    )
+    return out, stored
+
+
+def _last_writer_lanes(keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Duplicate keys WITHIN one batch follow the sequential reference's
+    last-writer-wins. Returns (superseded [B] bool — a later lane bears
+    the same key; winner_of [B] i32 — the last lane bearing each lane's
+    key). Sort by (key, lane); a sorted position followed by an equal key
+    is not the last writer; the winner of a key group is the last sorted
+    position of the group (suffix-min of winner positions, mapped back).
+    Shared by create_batch and its sharded twin."""
+    b = keys.shape[0]
+    lane = jnp.arange(b, dtype=jnp.int32)
+    sort_ops = [keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0], lane]
+    *_, perm = jax.lax.sort(sort_ops, num_keys=5)
+    skeys = keys[perm]
+    next_same = jnp.concatenate(
+        [u128.eq(skeys[1:], skeys[:-1]), jnp.zeros((1,), bool)])
+    superseded = jnp.zeros(b, bool).at[perm].set(next_same)
+    pos_b = jnp.arange(b, dtype=jnp.int32)
+    winner_pos = jnp.where(~next_same, pos_b, b)          # sorted coords
+    winner_pos = jnp.flip(jax.lax.cummin(jnp.flip(winner_pos)))
+    winner_lane = perm[jnp.minimum(winner_pos, b - 1)]    # [B] sorted
+    winner_of = jnp.zeros(b, jnp.int32).at[perm].set(winner_lane)
+    return superseded, winner_of
 
 
 def _purge_keys(store: FragmentStore, keys: jax.Array) -> FragmentStore:
@@ -179,16 +239,7 @@ def create_batch(ring: RingState, store: FragmentStore,
     smax = store.max_segments
     store = _purge_keys(store, keys)  # overwrite semantics on re-create
 
-    # Mark lanes superseded by a later lane with the same key: sort by
-    # (key, lane); a sorted position followed by an equal key is not the
-    # last writer.
-    lane = jnp.arange(b, dtype=jnp.int32)
-    sort_ops = [keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0], lane]
-    *_, perm = jax.lax.sort(sort_ops, num_keys=5)
-    skeys = keys[perm]
-    next_same = jnp.concatenate(
-        [u128.eq(skeys[1:], skeys[:-1]), jnp.zeros((1,), bool)])
-    superseded = jnp.zeros(b, bool).at[perm].set(next_same)
+    superseded, winner_of = _last_writer_lanes(keys)
 
     owners = placement_owners(ring, keys, start, n, max_hops)      # [B, n]
     placed = owners >= 0
@@ -206,33 +257,14 @@ def create_batch(ring: RingState, store: FragmentStore,
     rows_len = jnp.broadcast_to(lengths[:, None], (b, n)).reshape(-1)
     rows_ok = (placed & ok[:, None] & ~superseded[:, None]).reshape(-1)
 
-    dest = store.n_used + jnp.cumsum(rows_ok.astype(jnp.int32)) - 1
-    dest = jnp.where(rows_ok & (dest < store.capacity), dest,
-                     store.capacity)  # dropped by mode="drop"
-    stored = rows_ok & (dest < store.capacity)
-
-    new = FragmentStore(
-        keys=store.keys.at[dest].set(rows_keys, mode="drop"),
-        frag_idx=store.frag_idx.at[dest].set(rows_fidx, mode="drop"),
-        holder=store.holder.at[dest].set(rows_holder, mode="drop"),
-        values=store.values.at[dest].set(rows_vals, mode="drop"),
-        length=store.length.at[dest].set(rows_len, mode="drop"),
-        used=store.used.at[dest].set(True, mode="drop"),
-        n_used=store.n_used + stored.astype(jnp.int32).sum(),
-    )
+    new, stored = _append_rows(store, rows_keys, rows_fidx, rows_holder,
+                               rows_vals, rows_len, rows_ok)
     # Lanes whose rows overflowed the store are failures. A superseded
     # duplicate lane reports its WINNER's verdict: its own data was
     # (logically) overwritten, so "success" is only true if the key is
     # actually in the store afterwards — i.e. the last writer stored.
     lane_stored = stored.reshape(b, n).sum(axis=1)
     ok_stored = ok & (lane_stored >= jnp.minimum(m, placed.sum(axis=1)))
-    # winner (last sorted position of each key group) for every lane:
-    # suffix-min of winner positions over the sorted order, mapped back.
-    pos_b = jnp.arange(b, dtype=jnp.int32)
-    winner_pos = jnp.where(~next_same, pos_b, b)          # sorted coords
-    winner_pos = jnp.flip(jax.lax.cummin(jnp.flip(winner_pos)))
-    winner_lane = perm[jnp.minimum(winner_pos, b - 1)]    # [B] sorted
-    winner_of = jnp.zeros(b, jnp.int32).at[perm].set(winner_lane)
     ok = jnp.where(superseded, ok_stored[winner_of], ok_stored)
     return _sort_store(new), ok
 
@@ -254,7 +286,7 @@ def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
     zeros.
     """
     pos = u128.searchsorted(store.keys, keys, store.n_used)        # [B]
-    win_c, w_valid, _ = _key_window(store, ring, pos, keys, n)
+    win_c, w_valid, _ = _key_window(store, ring.alive, pos, keys, n)
 
     ok = w_valid.sum(axis=1) >= m
 

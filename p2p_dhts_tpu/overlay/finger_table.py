@@ -9,19 +9,18 @@ entry naming a dead peer (finger_table.h:159-168).
 
 The jax backend is the BASELINE.json north star hook: the table's ranges
 are fixed, so "which entry contains key k" is bit_length((k - start) mod
-2^128) - 1 — the O(1) closed form of the linear scan — and a BATCH of
-keys resolves as one vectorized device op (lookup_batch) instead of B
-scans of 128 InBetween evaluations on wide ints.
+2^128) - 1 — the O(1) closed form of the linear scan. Batched lookup
+lives in the device core (core/ring.find_successor), not here: the host
+overlay is the per-request wire-parity layer and resolves one key per
+RPC exactly like the reference.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Tuple
 
-import numpy as np
-
-from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key, ints_to_lanes
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key
 from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
 
 
@@ -114,30 +113,6 @@ class FingerTable:
                                        finger.upper_bound, True):
                     return finger.successor
             raise LookupError("ChordKey not found")
-
-    def lookup_batch(self, keys: Sequence[Key]) -> List[RemotePeer]:
-        """Resolve a batch of keys in one vectorized op (jax backend) —
-        the device analog of B linear scans."""
-        with self._lock:
-            if len(self._table) != self.NUM_ENTRIES:
-                return [self.lookup(k) for k in keys]
-            start = int(self.starting_key)
-            if self.backend == "jax":
-                from p2p_dhts_tpu.ops import u128
-                import jax.numpy as jnp
-                q = jnp.asarray(ints_to_lanes([int(k) for k in keys]))
-                s = jnp.asarray(ints_to_lanes([start] * len(keys)))
-                d = u128.sub(q, s)
-                idx = np.asarray(u128.bit_length(d)) - 1
-            else:
-                idx = [((int(k) - start) % KEYS_IN_RING).bit_length() - 1
-                       for k in keys]
-            out = []
-            for i in idx:
-                if i < 0:
-                    raise LookupError("ChordKey not found")
-                out.append(self._table[int(i)].successor)
-            return out
 
     # -- repairs -----------------------------------------------------------
     def adjust_fingers(self, new_peer: RemotePeer) -> None:

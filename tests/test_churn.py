@@ -208,6 +208,89 @@ def test_join_after_fail_reuses_ring(rng):
     assert canonical(swept) == canonical(want)
 
 
+def test_join_rejects_existing_alive_id(rng):
+    """A lane whose id is already an ALIVE peer is rejected (-1 row) and
+    the state is as if only the fresh lanes joined — a silent duplicate
+    insert would corrupt the sorted-table invariant."""
+    ids = _random_ids(rng, 12)
+    fresh = _random_ids(rng, 1)[0]
+    dup = ids[4]
+    state = build_ring(ids, RingConfig(num_succs=3), capacity=16)
+    batch = [dup, fresh]
+    state, rows = churn.join(state, jnp.asarray(keyspace.ints_to_lanes(batch)))
+    rows = np.asarray(rows)
+    # rows are aligned to the sorted batch.
+    order = sorted(range(2), key=lambda i: batch[i])
+    assert rows[order.index(0)] == -1, "alive duplicate must be rejected"
+    assert rows[order.index(1)] >= 0
+    assert int(state.n_valid) == 13
+    swept = churn.stabilize_sweep(state)
+    want = build_ring(ids + [fresh], RingConfig(num_succs=3), capacity=16)
+    assert canonical(swept) == canonical(want)
+
+
+def test_join_all_rejected_is_bit_identical_noop(rng):
+    """A join whose every lane is rejected must leave the state
+    BIT-identical — including fingers (a rejected lane's clamped-garbage
+    FixOtherFingers targets must not refresh anyone)."""
+    ids = _random_ids(rng, 12)
+    state = build_ring(ids, RingConfig(num_succs=3), capacity=16)
+    # Un-swept stale fingers make an accidental refresh observable.
+    state = churn.fail(state, jnp.asarray([0], jnp.int32))
+    out, rows = churn.join(
+        state, jnp.asarray(keyspace.ints_to_lanes([ids[4]])))
+    assert int(rows[0]) == -1
+    for name in ("ids", "alive", "min_key", "preds", "succs", "fingers"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out, name)), np.asarray(getattr(state, name)),
+            err_msg=name)
+    assert int(out.n_valid) == int(state.n_valid)
+
+
+def test_join_rejects_intra_batch_duplicate(rng):
+    """Two lanes with the same fresh id: exactly one wins, the other
+    reports -1; the table gains the id once."""
+    ids = _random_ids(rng, 10)
+    fresh = _random_ids(rng, 1)[0]
+    state = build_ring(ids, RingConfig(num_succs=3), capacity=16)
+    state, rows = churn.join(
+        state, jnp.asarray(keyspace.ints_to_lanes([fresh, fresh])))
+    rows = np.asarray(rows)
+    assert sorted(rows >= 0) == [False, True]
+    assert int(state.n_valid) == 11
+    swept = churn.stabilize_sweep(state)
+    want = build_ring(ids + [fresh], RingConfig(num_succs=3), capacity=16)
+    assert canonical(swept) == canonical(want)
+
+
+def test_join_resurrects_failed_id(rng):
+    """Joining the id of a FAILED peer resurrects its row in place (the
+    reference's restarted process rejoins under the same SHA1(ip:port)
+    id) — converged immediately, no table growth."""
+    ids = _random_ids(rng, 12)
+    sorted_ids = sorted(ids)
+    state = build_ring(ids, RingConfig(num_succs=3))
+    victim = 5
+    state = churn.fail(state, jnp.asarray([victim], jnp.int32))
+    state = churn.stabilize_sweep(state)
+
+    state, rows = churn.join(
+        state, jnp.asarray(keyspace.ints_to_lanes([sorted_ids[victim]])))
+    assert int(rows[0]) == victim, "rejoin must reuse the dead row"
+    assert int(state.n_valid) == 12, "resurrection must not grow the table"
+    assert bool(state.alive[victim])
+
+    # The rejoined peer and its notified successor are converged
+    # immediately; one sweep converges everyone to the full original ring.
+    want = build_ring(ids, RingConfig(num_succs=3))
+    canon = canonical(state)
+    want_canon = canonical(want)
+    rid = sorted_ids[victim]
+    assert canon[rid] == want_canon[rid]
+    swept = churn.stabilize_sweep(state)
+    assert canonical(swept) == want_canon
+
+
 def test_sweep_computed_mode_no_fingers(rng):
     ids = _random_ids(rng, 12)
     cfg = RingConfig(num_succs=3, finger_mode="computed")
@@ -257,6 +340,7 @@ def test_leave_empty_batch_is_identity(rng):
                                   np.asarray(state.alive))
 
 
+@pytest.mark.soak
 @pytest.mark.parametrize("seed", [11, 29, 47])
 def test_random_churn_program_soak(seed):
     """Randomized multi-round churn program: interleaved fail/leave/join

@@ -323,6 +323,7 @@ def test_placement_fast_path_matches_walk(rng):
     np.testing.assert_array_equal(np.asarray(got3), np.asarray(want3))
 
 
+@pytest.mark.soak
 @pytest.mark.parametrize("seed", [5, 23])
 def test_dhash_store_soak_medium_scale(seed):
     """Storage-layer soak at medium scale (the device twin of the churn

@@ -1,0 +1,17 @@
+#!/bin/bash
+# TPU tunnel watcher: probe gently until the backend comes back, then run
+# the full benchmark immediately (VERDICT r3 #1 — capture hardware numbers
+# the moment the wedged claim clears). Never kills a probe mid-work: each
+# attempt runs to completion (a wedged claim blocks ~25 min then errors).
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "[tpu_watch] attempt $i $(date -u +%H:%M:%S)" >> tpu_watch.log
+  if python -c "import jax; jax.devices()" >> tpu_watch.log 2>&1; then
+    echo "[tpu_watch] BACKEND UP $(date -u +%H:%M:%S) — running bench" >> tpu_watch.log
+    python bench.py > BENCH_ATTEMPT_r04.jsonl 2> BENCH_ATTEMPT_r04.err
+    echo "[tpu_watch] bench rc=$? $(date -u +%H:%M:%S)" >> tpu_watch.log
+    exit 0
+  fi
+  sleep 300
+done
+echo "[tpu_watch] gave up $(date -u +%H:%M:%S)" >> tpu_watch.log
