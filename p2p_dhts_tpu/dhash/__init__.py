@@ -22,6 +22,11 @@ from p2p_dhts_tpu.dhash.merkle import (  # noqa: F401
     build_index,
     diff_indices,
 )
+from p2p_dhts_tpu.dhash.antientropy import (  # noqa: F401
+    ReconcileStats,
+    reconcile,
+    store_index,
+)
 from p2p_dhts_tpu.dhash.sharded import (  # noqa: F401
     ShardedFragmentStore,
     create_batch_sharded,

@@ -1,0 +1,173 @@
+"""Merkle-driven anti-entropy: reconcile two fragment stores by tree
+diff, transferring work proportional to the DIVERGENCE, not the store.
+
+This is the device analog of the reference's XCHNG_NODE recursion
+(DHashPeer::SynchronizeHelper / ExchangeNode, dhash_peer.cpp:381-481):
+two peers walk their keyspace-partitioned Merkle trees top-down,
+exchange one node per RPC, and descend only into children whose hashes
+differ, so a nearly-synced pair touches O(diff * depth) nodes instead of
+O(keys). Here each store summarizes its live rows into a fixed-depth
+`MerkleIndex` (dhash.merkle — level arrays, (key, frag_idx)-salted
+bucket sums), the level-by-level compare is `diff_indices` (one
+vectorized equality per level), and only keys hashing into DIFFERING
+leaf buckets enter the repair batch. `nodes_exchanged` reports the
+bandwidth the reference's recursion would have spent — the parity
+accounting the tests pin.
+
+Use cases (both stores device-resident):
+  * replica pairs — two stores maintained independently (the host
+    overlay's peer-vs-successor sync, `overlay/dhash_peer.py`, is the
+    wire-level twin of this op);
+  * drift repair — a live store against its checkpoint restore
+    (checkpoint.py), catching rows lost or gained since the snapshot.
+
+Repair semantics follow CompareNodes/RetrieveMissing
+(dhash_peer.cpp:367-447) in batched form: a (key, frag_idx) row STORED
+on one side and absent on the other is COPIED to the absent side —
+content-level sync, liveness-agnostic (see store_index; holder-death
+repair belongs to local_maintenance). (Deviation, documented: the reference re-reads the whole
+block and stores one RANDOM fragment; the device op copies the exact
+missing rows — same reachability outcome, deterministic, no decode.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.dhash.merkle import (
+    MerkleIndex, build_index, diff_indices, leaf_bucket)
+from p2p_dhts_tpu.dhash.store import (
+    FragmentStore, _append_rows, _key_window, _sort_store)
+from p2p_dhts_tpu.ops import u128
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "fanout_bits"))
+def store_index(store: FragmentStore, depth: int = 4,
+                fanout_bits: int = 3) -> MerkleIndex:
+    """MerkleIndex over a store's used rows, one (key, frag_idx)-salted
+    term per row. Equal indices <=> equal STORED (key, frag_idx)
+    multisets — the same keys-only sync granularity as the reference's
+    leaf hashes (merkle_tree.h:724-749: values are invisible to sync
+    there too). Deliberately liveness-AGNOSTIC: sync compares what each
+    store *contains* (the reference's IsMissing checks DB content,
+    dhash_peer.cpp:416-447); holder-death repair belongs to
+    local_maintenance. Masking dead-held rows here would (a) never let
+    two stores' indices converge while one still carries a dead-held
+    row, and (b) make reconcile append a fresh copy NEXT TO the stale
+    dead-held row — duplicate (key, idx) rows that break the n-row
+    window invariant."""
+    rows = jnp.arange(store.capacity, dtype=jnp.int32)
+    mask = store.used & (rows < store.n_used)
+    return build_index(store.keys, mask, depth, fanout_bits,
+                       salt=store.frag_idx)
+
+
+class ReconcileStats(NamedTuple):
+    nodes_exchanged: jax.Array   # i32 — the XCHNG_NODE budget equivalent
+    leaf_diffs: jax.Array        # i32 — differing leaf buckets
+    keys_examined: jax.Array     # i32 — candidate keys window-scanned
+    copied_to_a: jax.Array       # i32 — rows appended to store_a
+    copied_to_b: jax.Array       # i32 — rows appended to store_b
+
+
+def _marked_leader_keys(store: FragmentStore,
+                        leaf_diff: jax.Array, depth: int, fanout_bits: int,
+                        max_keys: int) -> jax.Array:
+    """Up to max_keys distinct keys of live rows in differing buckets
+    (sentinel 0xFF..F rows beyond the marked population)."""
+    c = store.capacity
+    rows = jnp.arange(c, dtype=jnp.int32)
+    live = store.used & (rows < store.n_used)
+    bucket = leaf_bucket(store.keys, depth, fanout_bits)
+    marked = live & leaf_diff[bucket]
+    prev_same = jnp.concatenate([
+        jnp.zeros((1,), bool), u128.eq(store.keys[1:], store.keys[:-1])])
+    lead = marked & ~prev_same
+    pos = jnp.sort(jnp.where(lead, rows, c))[:max_keys]
+    ok = pos < c
+    return jnp.where(ok[:, None],
+                     store.keys[jnp.minimum(pos, c - 1)],
+                     jnp.uint32(0xFFFFFFFF))
+
+
+def _copy_missing(dst: FragmentStore, src: FragmentStore,
+                  cand: jax.Array, cand_ok: jax.Array,
+                  n: int) -> Tuple[FragmentStore, jax.Array]:
+    """Append to dst the (key, idx) rows STORED in src and absent from
+    dst, for the candidate keys. Content-level like store_index: a
+    dst row under a dead holder counts as present (no duplicate append;
+    regeneration is local_maintenance's job), and a src dead-held row
+    still transfers (content sync; the holder field rides along for
+    maintenance to fix)."""
+    idx_grid = jnp.arange(1, n + 1, dtype=jnp.int32)
+
+    def presence(store):
+        # Liveness-agnostic window: an all-true "alive" vector (clamped
+        # gathers make any holder index read True).
+        pos = u128.searchsorted(store.keys, cand, store.n_used)
+        win_c, valid, fidx = _key_window(
+            store, jnp.ones_like(store.used), pos, cand, n)
+        onehot = (fidx[:, :, None] == idx_grid[None, None, :]) \
+            & valid[:, :, None]                       # [C2, n_win, n_idx]
+        return win_c, onehot, onehot.any(axis=1)
+
+    win_s, onehot_s, pres_s = presence(src)
+    _, _, pres_d = presence(dst)
+    need = cand_ok[:, None] & pres_s & ~pres_d        # [C2, n]
+
+    # Source row for each (cand, idx): the window slot holding idx.
+    slot = jnp.argmax(onehot_s, axis=1)               # [C2, n]
+    src_row = jnp.take_along_axis(win_s, slot, axis=1)  # [C2, n]
+
+    flat = src_row.reshape(-1)
+    c2 = cand.shape[0]
+    out, stored = _append_rows(
+        dst,
+        jnp.broadcast_to(cand[:, None, :], (c2, n, 4)).reshape(-1, 4),
+        src.frag_idx[flat],
+        src.holder[flat],
+        src.values[flat],
+        src.length[flat],
+        need.reshape(-1))
+    return _sort_store(out), stored.astype(jnp.int32).sum()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "max_keys", "depth", "fanout_bits"))
+def reconcile(store_a: FragmentStore,
+              store_b: FragmentStore, n: int = 14, max_keys: int = 256,
+              depth: int = 4, fanout_bits: int = 3
+              ) -> Tuple[FragmentStore, FragmentStore, ReconcileStats]:
+    """One bidirectional anti-entropy round between two stores.
+
+    Builds both indices, compares level arrays, window-scans ONLY keys
+    in differing leaf buckets (up to max_keys per side per round — call
+    again while leaf_diffs > 0 for larger divergences), and copies
+    missing rows both ways. Identical stores cost the root compare and
+    zero window scans — bandwidth scales with the diff, not the store
+    (the property the reference's tree walk exists for; tests pin it via
+    `nodes_exchanged` / `keys_examined`)."""
+    ia = store_index(store_a, depth, fanout_bits)
+    ib = store_index(store_b, depth, fanout_bits)
+    leaf_diff, nodes = diff_indices(ia, ib)
+
+    ca = _marked_leader_keys(store_a, leaf_diff, depth, fanout_bits,
+                             max_keys)
+    cb = _marked_leader_keys(store_b, leaf_diff, depth, fanout_bits,
+                             max_keys)
+    # Dedup (a key can be marked on both sides).
+    cand, cand_ok = u128.sort_dedup_keys(
+        jnp.concatenate([ca, cb], axis=0))            # [2R, 4]
+
+    store_b, to_b = _copy_missing(store_b, store_a, cand, cand_ok, n)
+    store_a, to_a = _copy_missing(store_a, store_b, cand, cand_ok, n)
+    stats = ReconcileStats(
+        nodes_exchanged=nodes,
+        leaf_diffs=leaf_diff.astype(jnp.int32).sum(),
+        keys_examined=cand_ok.astype(jnp.int32).sum(),
+        copied_to_a=to_a, copied_to_b=to_b)
+    return store_a, store_b, stats

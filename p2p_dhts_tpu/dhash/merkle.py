@@ -91,15 +91,25 @@ def leaf_bucket(keys: jax.Array, depth: int, fanout_bits: int = 3) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("depth", "fanout_bits"))
 def build_index(keys: jax.Array, mask: jax.Array, depth: int = 4,
-                fanout_bits: int = 3) -> MerkleIndex:
+                fanout_bits: int = 3,
+                salt: jax.Array = None) -> MerkleIndex:
     """Build the level arrays for a key set ([K, 4] u32 + [K] bool mask).
 
     One segment-sum per level; 8^4 = 4096 leaf buckets by default.
+
+    `salt` ([K] i32, optional) folds a per-row discriminator into the
+    hash BEFORE mixing, so distinct rows sharing a key (e.g. a fragment
+    store's (key, frag_idx) rows) contribute distinct terms — without it
+    the commutative bucket sum couldn't tell "key k with fragments
+    {1,2}" from "{1,2} twice". Bucket routing still keys on the id bits
+    alone, matching the reference's key-positioned tree.
     """
     fanout = 1 << fanout_bits
     n_leaf = fanout ** depth
     bucket = leaf_bucket(keys, depth, fanout_bits)
-    mixed = jnp.where(mask[..., None], _mix(keys), 0)
+    salted = keys if salt is None else (
+        keys ^ (salt.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))[..., None])
+    mixed = jnp.where(mask[..., None], _mix(salted), 0)
 
     leaf = jnp.zeros((n_leaf, 4), jnp.uint32).at[bucket].add(mixed)
     counts = jnp.zeros((n_leaf,), jnp.int32).at[bucket].add(

@@ -537,16 +537,9 @@ def local_maintenance_sharded(ring: RingState, sstore: ShardedFragmentStore,
                          jnp.uint32(0xFFFFFFFF))
 
         cand_all = jax.lax.all_gather(cand, axis).reshape(dr, 4)
-        # Replicated dedup: sort by key; non-first-of-run and sentinel
-        # lanes go inert.
-        c3, c2, c1, c0 = jax.lax.sort(
-            (cand_all[:, 3], cand_all[:, 2], cand_all[:, 1], cand_all[:, 0]),
-            num_keys=4)
-        cand_s = jnp.stack([c0, c1, c2, c3], axis=1)
-        dup = jnp.concatenate([
-            jnp.zeros((1,), bool), u128.eq(cand_s[1:], cand_s[:-1])])
-        sentinel = jnp.all(cand_s == jnp.uint32(0xFFFFFFFF), axis=1)
-        cand_ok = ~dup & ~sentinel & guard
+        # Replicated dedup: non-first-of-run and sentinel lanes go inert.
+        cand_s, cand_keep = u128.sort_dedup_keys(cand_all)
+        cand_ok = cand_keep & guard
 
         # Presence + length + values psum over shards (read-kernel scan).
         pos = u128.searchsorted(local.keys, cand_s, local.n_used)

@@ -300,3 +300,18 @@ def searchsorted_bucketed(sorted_ids: jax.Array, q: jax.Array,
 
     lo, _ = jax.lax.while_loop(cond, body, (lo, hi))
     return lo
+
+
+def sort_dedup_keys(keys: jax.Array):
+    """Sort [K, 4] u32 keys lexicographically (lanes ride the sort as
+    values — no index gather) and mask repeats + all-0xFFFFFFFF
+    sentinels. Returns (sorted_keys [K, 4], ok [K] bool), ok marking the
+    first instance of each real key. Shared by anti-entropy reconcile
+    and the sharded local-maintenance candidate dedup (identical inline
+    copies drifted before this helper existed)."""
+    k3, k2, k1, k0 = jax.lax.sort(
+        (keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0]), num_keys=4)
+    s = jnp.stack([k0, k1, k2, k3], axis=1)
+    dup = jnp.concatenate([jnp.zeros((1,), bool), eq(s[1:], s[:-1])])
+    sentinel = jnp.all(s == jnp.uint32(0xFFFFFFFF), axis=1)
+    return s, ~dup & ~sentinel

@@ -22,6 +22,30 @@ non-textbook quirks:
 
 Hop counting: one hop per SendRequest, i.e. per transfer of the request to
 another peer; a locally-owned key costs 0 hops.
+
+Adversarial review notes (round 4, VERDICT r3 #9 — oracle re-read line by
+line against chord_peer.cpp:185-211, finger_table.h:110-190,
+abstract_chord_peer.cpp:313-423/720-725, key.h:103-131,
+remote_peer_list.cpp:86-110):
+
+  * GetNthRange computes `uint256((start + 2^(n+1)) % ring) - 1` — the
+    -1 applies AFTER the modulo, so a range whose exclusive end lands
+    exactly on ring-top underflows to 2^256-1 (id = 2^128 - 2^(n+1)).
+    InBetween then takes its `lower < upper` branch and compares the
+    UNMODDED upper bound, degenerating to `v >= lb`. This is
+    behaviorally EQUIVALENT to the oracle's mod-2^128 upper bound,
+    because the affected range [2^128 - 2^n, 2^128 - 1] never wraps —
+    `v >= lb` and `lb <= v <= ring-1` coincide for 128-bit v. Pinned by
+    test_ring.py::test_ring_top_finger_range_edge.
+  * ForwardRequest's fallback is an `else if`: when the self-hit branch
+    fires but the predecessor is DEAD, neither branch replaces
+    key_succ, and the peer forwards the request to ITSELF — a livelock
+    in the reference. The oracle reproduces the same routing choice
+    (returns self) and its hop-budget guard turns the livelock into
+    LookupError, which is the only divergence (termination vs none).
+  * GetSuccessor has NO successor-list shortcut — only GetPredecessor
+    does (abstract_chord_peer.cpp:389-401). The oracle correctly
+    models the GET_SUCC path without it.
 """
 
 from __future__ import annotations
