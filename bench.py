@@ -1,4 +1,4 @@
-"""Benchmark harness — all five BASELINE.json configs, one JSON line each,
+"""Benchmark harness — the BASELINE.json configs, one JSON line each,
 plus a final combined summary line (the driver tails the last line).
 
 Configs (BASELINE.json.configs):
@@ -34,10 +34,10 @@ downstream parsers should read `configs` and treat the flat fields as a
 convenience view of its lookup_1m element.
 
 Usage:
-    python bench.py                 # all five configs
+    python bench.py                 # all configs
     python bench.py --smoke         # scaled-down quick pass
     python bench.py --config NAME   # one config (chord16|ida|dhash|
-                                    #             lookup_1m|sweep_10m)
+                                    #   dhash_sharded|lookup_1m|sweep_10m)
 """
 
 from __future__ import annotations
@@ -571,6 +571,30 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
     assert bool(np.all(hops_np >= 0)), "unresolved lookups"
     assert bool(np.all(alive_np[owner_np])), "dead owner"
 
+    # Variant measurement: SORTED-serve. Late hops gather rows near the
+    # key's owner, so serving the batch in key order improves per-hop
+    # gather locality at the cost of one on-device 4-lane sort and an
+    # inverse-permutation gather (both included in the timed window —
+    # honest end-to-end cost for unsorted arrivals). Reported alongside
+    # the plain number for an evidence-based serving-pattern choice.
+    @jax.jit
+    def sorted_serve(keys, starts):
+        lane = jnp.arange(keys.shape[0], dtype=jnp.int32)
+        s3, s2, s1, s0, ss, perm = jax.lax.sort(
+            (keys[:, 3], keys[:, 2], keys[:, 1], keys[:, 0], starts, lane),
+            num_keys=4)
+        ks = jnp.stack([s0, s1, s2, s3], axis=1)
+        o, h = find_successor_sharded(sstate, ks, ss, mesh,
+                                      check_converged=False)
+        inv = jnp.zeros_like(perm).at[perm].set(lane)
+        return o[inv], h[inv]
+
+    sorted_t = _time(lambda: sorted_serve(keys, starts), repeats=1)
+    o_s, h_s = sorted_serve(keys, starts)
+    assert bool(np.all(np.asarray(o_s) == owner_np)) and \
+        bool(np.all(np.asarray(h_s) == hops_np)), \
+        "sorted-serve diverges from plain serve"
+
     # Post-sweep parity: the converged survivor ring routes exactly like a
     # fresh ring built from the alive ids only (same oracle).
     ids_np = np.asarray(sstate.ids)
@@ -602,6 +626,8 @@ def bench_sweep_10m(n_peers: int = 10_000_000, n_keys: int = 1_000_000,
         "churn_compile_ms": round(churn_compile_ms, 1),
         "sweep_ms": round(sweep_t * 1e3, 1),
         "materialize_ms": round(materialize_ms, 1),
+        "sorted_serve_lookups_s": round(n_keys / sorted_t, 1),
+        "sorted_serve_wall_ms": round(sorted_t * 1e3, 2),
         "materialize_compile_ms": round(
             max(materialize_total_ms - materialize_ms, 0.0), 1),
         "mean_hops": round(float(hops_np.mean()), 3),

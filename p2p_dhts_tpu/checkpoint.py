@@ -10,7 +10,13 @@ device->host gather on save, host->device upload on restore.
 
 Format: a single .npz whose keys are `ring/<field>`, `store/<field>`,
 plus `meta/*` scalars (format version, max_hops). `fingers` may be
-absent (computed-finger mode). Either section may be omitted.
+absent (computed-finger mode). Either section may be omitted. A store
+may be a single-device FragmentStore or a holder-sharded
+ShardedFragmentStore (dhash/sharded.py) — the shard axis is preserved
+in the arrays and flagged in `meta/store_sharded`; pass `mesh=` on load
+to re-place the blocks over a same-width device mesh (restoring onto a
+different mesh width: load without mesh, `unshard_store`, then
+`shard_store` onto the new one).
 """
 
 from __future__ import annotations
@@ -24,8 +30,12 @@ import jax.numpy as jnp
 
 from p2p_dhts_tpu.core.ring import RingState
 from p2p_dhts_tpu.dhash.store import FragmentStore
+from p2p_dhts_tpu.dhash.sharded import ShardedFragmentStore
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 1          # plain payloads
+FORMAT_VERSION_SHARDED = 2  # sharded-store payloads (new array rank —
+                            # pre-sharding loaders must refuse, not
+                            # misparse)
 
 _RING_FIELDS = ("ids", "alive", "n_valid", "min_key", "preds", "succs")
 _STORE_FIELDS = ("keys", "frag_idx", "holder", "values", "length", "used",
@@ -33,11 +43,16 @@ _STORE_FIELDS = ("keys", "frag_idx", "holder", "values", "length", "used",
 
 
 def save_checkpoint(path: str, ring: Optional[RingState] = None,
-                    store: Optional[FragmentStore] = None) -> None:
-    """Write ring and/or store state to `path` (.npz, atomic rename)."""
+                    store=None) -> None:
+    """Write ring and/or store state to `path` (.npz, atomic rename).
+    `store` is a FragmentStore or a ShardedFragmentStore."""
     if ring is None and store is None:
         raise ValueError("nothing to checkpoint")
-    payload = {"meta/version": np.int64(FORMAT_VERSION)}
+    sharded = isinstance(store, ShardedFragmentStore)
+    payload = {"meta/version": np.int64(
+        FORMAT_VERSION_SHARDED if sharded else FORMAT_VERSION)}
+    if store is not None:
+        payload["meta/store_sharded"] = np.bool_(sharded)
     if ring is not None:
         for f in _RING_FIELDS:
             payload[f"ring/{f}"] = np.asarray(getattr(ring, f))
@@ -53,14 +68,20 @@ def save_checkpoint(path: str, ring: Optional[RingState] = None,
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str) -> Tuple[Optional[RingState],
-                                        Optional[FragmentStore]]:
-    """Read a checkpoint; returns (ring or None, store or None)."""
+def load_checkpoint(path: str, mesh=None, axis: str = "peer"
+                    ) -> Tuple[Optional[RingState], object]:
+    """Read a checkpoint; returns (ring or None, store or None). The
+    store comes back as whichever type was saved; for a sharded store,
+    `mesh` (same shard-axis width as at save time) re-places the blocks
+    with their row sharding — without it the blocks load unsharded on
+    the default device (unshard_store/shard_store re-partition onto a
+    different mesh width)."""
     with np.load(path) as z:
         version = int(z["meta/version"])
-        if version != FORMAT_VERSION:
-            raise ValueError(f"checkpoint format {version} != "
-                             f"{FORMAT_VERSION}")
+        if version not in (FORMAT_VERSION, FORMAT_VERSION_SHARDED):
+            raise ValueError(
+                f"checkpoint format {version} not in "
+                f"{(FORMAT_VERSION, FORMAT_VERSION_SHARDED)}")
         ring = None
         if "ring/ids" in z:
             ring = RingState(
@@ -76,13 +97,13 @@ def load_checkpoint(path: str) -> Tuple[Optional[RingState],
             )
         store = None
         if "store/keys" in z:
-            store = FragmentStore(
-                keys=jnp.asarray(z["store/keys"]),
-                frag_idx=jnp.asarray(z["store/frag_idx"]),
-                holder=jnp.asarray(z["store/holder"]),
-                values=jnp.asarray(z["store/values"]),
-                length=jnp.asarray(z["store/length"]),
-                used=jnp.asarray(z["store/used"]),
-                n_used=jnp.asarray(z["store/n_used"]),
-            )
+            sharded = ("meta/store_sharded" in z
+                       and bool(z["meta/store_sharded"]))
+            cls = ShardedFragmentStore if sharded else FragmentStore
+            fields = {f: jnp.asarray(z[f"store/{f}"]) for f in _STORE_FIELDS}
+            store = cls(**fields)
+            if sharded and mesh is not None:
+                # Mesh layout lives in ONE place: dhash/sharded.py.
+                from p2p_dhts_tpu.dhash.sharded import place_store
+                store = place_store(store, mesh, axis)
     return ring, store

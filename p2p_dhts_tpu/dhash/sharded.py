@@ -215,20 +215,33 @@ def shard_store(store: FragmentStore, mesh: Mesh, ring_capacity: int,
             arr[:cnt] = arr[:cnt][order]
         blocks.append(blk)
 
-    def put(field, spec):
-        stacked = np.stack([getattr(b, field) for b in blocks])
-        return jax.device_put(stacked, NamedSharding(mesh, spec))
+    host = ShardedFragmentStore(
+        keys=np.stack([b.keys for b in blocks]),
+        frag_idx=np.stack([b.frag_idx for b in blocks]),
+        holder=np.stack([b.holder for b in blocks]),
+        values=np.stack([b.values for b in blocks]),
+        length=np.stack([b.length for b in blocks]),
+        used=np.stack([b.used for b in blocks]),
+        n_used=np.asarray([b.n_used for b in blocks], np.int32))
+    return place_store(host, mesh, axis)
 
-    return ShardedFragmentStore(
-        keys=put("keys", P(axis, None, None)),
-        frag_idx=put("frag_idx", P(axis, None)),
-        holder=put("holder", P(axis, None)),
-        values=put("values", P(axis, None, None)),
-        length=put("length", P(axis, None)),
-        used=put("used", P(axis, None)),
-        n_used=jax.device_put(
-            np.asarray([b.n_used for b in blocks], np.int32),
-            NamedSharding(mesh, P(axis))))
+
+def place_store(sstore: ShardedFragmentStore, mesh: Mesh,
+                axis: str = "peer") -> ShardedFragmentStore:
+    """Place a (host/unplaced) ShardedFragmentStore's blocks row-sharded
+    over `axis` — THE single source of the store's mesh layout (used by
+    shard_store and checkpoint restore; if a field ever gains a
+    different spec, this is the one place to change)."""
+    d = mesh.shape[axis]
+    if sstore.n_shards != d:
+        raise ValueError(f"store has {sstore.n_shards} shards, mesh axis "
+                         f"{axis!r} is {d} wide — unshard_store, then "
+                         f"shard_store onto the new mesh")
+    def put(v):
+        spec = P(axis, *([None] * (v.ndim - 1)))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+    return ShardedFragmentStore(*(put(jnp.asarray(getattr(sstore, f)))
+                                  for f in ShardedFragmentStore._fields))
 
 
 def unshard_store(sstore: ShardedFragmentStore) -> FragmentStore:

@@ -7,6 +7,7 @@ couldn't provision devices (MULTICHIP_r01.json ok=false).
 """
 
 import jax
+import pytest
 
 import __graft_entry__ as ge
 
@@ -19,6 +20,9 @@ def test_entry_compiles_and_runs():
     assert bool((hops >= 0).all()), "unresolved lookups in entry()"
 
 
+@pytest.mark.soak  # ~60 s on this 1-core host; the driver runs the same
+# dryrun out-of-process every round, so the fast tier keeps only the
+# cheap entry() check
 def test_dryrun_multichip_8_inline():
     # conftest provisions an 8-device virtual CPU platform, so this takes
     # the in-process path (same code the driver's subprocess child runs).
