@@ -52,6 +52,7 @@ _LAZY = {
     "DHashPeer": ("p2p_dhts_tpu.overlay.dhash_peer", "DHashPeer"),
     "save_checkpoint": ("p2p_dhts_tpu.checkpoint", "save_checkpoint"),
     "load_checkpoint": ("p2p_dhts_tpu.checkpoint", "load_checkpoint"),
+    "DeviceDHT": ("p2p_dhts_tpu.simulator", "DeviceDHT"),
 }
 
 

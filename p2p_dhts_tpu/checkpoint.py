@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from p2p_dhts_tpu.core.ring import RingState
 from p2p_dhts_tpu.dhash.store import FragmentStore
-from p2p_dhts_tpu.dhash.sharded import ShardedFragmentStore
+from p2p_dhts_tpu.dhash.sharded import ShardedFragmentStore, place_store
 
 FORMAT_VERSION = 1          # plain payloads
 FORMAT_VERSION_SHARDED = 2  # sharded-store payloads (new array rank —
@@ -43,14 +43,18 @@ _STORE_FIELDS = ("keys", "frag_idx", "holder", "values", "length", "used",
 
 
 def save_checkpoint(path: str, ring: Optional[RingState] = None,
-                    store=None) -> None:
+                    store=None, extra: Optional[dict] = None) -> None:
     """Write ring and/or store state to `path` (.npz, atomic rename).
-    `store` is a FragmentStore or a ShardedFragmentStore."""
+    `store` is a FragmentStore or a ShardedFragmentStore. `extra` maps
+    names to int scalars persisted under `extra/<name>` (e.g. the
+    facade's IDA parameters — state a restore must agree on)."""
     if ring is None and store is None:
         raise ValueError("nothing to checkpoint")
     sharded = isinstance(store, ShardedFragmentStore)
     payload = {"meta/version": np.int64(
         FORMAT_VERSION_SHARDED if sharded else FORMAT_VERSION)}
+    for k, v in (extra or {}).items():
+        payload[f"extra/{k}"] = np.int64(v)
     if store is not None:
         payload["meta/store_sharded"] = np.bool_(sharded)
     if ring is not None:
@@ -68,10 +72,12 @@ def save_checkpoint(path: str, ring: Optional[RingState] = None,
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str, mesh=None, axis: str = "peer"
-                    ) -> Tuple[Optional[RingState], object]:
+def load_checkpoint(path: str, mesh=None, axis: str = "peer",
+                    with_extra: bool = False):
     """Read a checkpoint; returns (ring or None, store or None). The
-    store comes back as whichever type was saved; for a sharded store,
+    store comes back as whichever type was saved (with_extra=True adds
+    a third element: the `extra` int scalars written at save time); for
+    a sharded store,
     `mesh` (same shard-axis width as at save time) re-places the blocks
     with their row sharding — without it the blocks load unsharded on
     the default device (unshard_store/shard_store re-partition onto a
@@ -104,6 +110,9 @@ def load_checkpoint(path: str, mesh=None, axis: str = "peer"
             store = cls(**fields)
             if sharded and mesh is not None:
                 # Mesh layout lives in ONE place: dhash/sharded.py.
-                from p2p_dhts_tpu.dhash.sharded import place_store
                 store = place_store(store, mesh, axis)
+        if with_extra:
+            extra = {k[len("extra/"):]: int(z[k])
+                     for k in z.files if k.startswith("extra/")}
+            return ring, store, extra
     return ring, store

@@ -1,0 +1,284 @@
+"""DeviceDHT — the device core behind the reference's two-class API.
+
+The reference's entire public surface is `ChordPeer` / `DHashPeer`
+(SURVEY.md §1: construct peers, StartChord/Join, Create/Read, background
+maintenance). The host overlay mirrors that per-peer API on the wire
+(`overlay/`); this module is its DEVICE-side counterpart: one object
+owning the whole simulated ring + erasure-coded store as device arrays,
+exposing the same verbs at batch granularity —
+
+    dht = DeviceDHT.random(n_peers=100_000)        # StartChord + Joins
+    ok = dht.create(["a key"], [b"a value"])       # DHashPeer::Create
+    vals = dht.read(["a key"])                     # DHashPeer::Read
+    dht.fail(rows); dht.maintain()                 # Fail + MaintenanceLoop
+    dht.save("ring.npz"); DeviceDHT.restore("ring.npz")
+
+Passing `mesh=` (a 1-D `jax.sharding.Mesh` over the peer axis) switches
+storage to the holder-sharded store and its collective kernels
+(`dhash/sharded.py`) transparently — the same verbs, multi-chip layout.
+
+Semantics notes (all inherited from the layers below, cited there):
+  * text keys hash exactly like the reference's `ChordKey(key, false)`
+    (SHA-1, keyspace.py); pre-hashed 128-bit ints are accepted too.
+  * values round-trip through IDA with the reference's trailing-zero
+    strip (ida.cpp:143-161) — binary payloads ending in 0x00 lose the
+    trailing NULs, faithfully (pass `raw=True` to read() to get the
+    padded segment matrix instead).
+  * `maintain()` = stabilize sweep + global + local maintenance: one
+    deterministic round of what the reference's 5 s threads do
+    (chord_peer.cpp:213-240, dhash_peer.cpp:271-296).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu import keyspace
+from p2p_dhts_tpu.config import RingConfig, DEFAULT_CONFIG
+from p2p_dhts_tpu.core import churn as churn_ops
+from p2p_dhts_tpu.core.ring import (
+    RingState, build_ring, build_ring_random, find_successor,
+    keys_from_ints)
+from p2p_dhts_tpu.dhash import (
+    create_batch, create_batch_sharded, global_maintenance,
+    global_maintenance_sharded, local_maintenance,
+    local_maintenance_sharded, read_batch, read_batch_sharded,
+    shard_store, empty_store)
+from p2p_dhts_tpu.checkpoint import load_checkpoint, save_checkpoint
+from p2p_dhts_tpu.ida import split_to_segments, strip_decoded
+
+KeyLike = Union[str, int]
+
+
+class DeviceDHT:
+    """Whole-ring DHT simulation with DHash storage (module doc)."""
+
+    def __init__(self, state: RingState, store, *,
+                 n: int = 14, m: int = 10, p: int = 257,
+                 mesh=None, axis: str = "peer"):
+        self.state = state
+        self.store = store
+        self.n, self.m, self.p = n, m, p
+        self.mesh = mesh
+        self.axis = axis
+        if n <= m or p <= n:
+            raise ValueError(f"IDA needs n > m and p > n, got {(n, m, p)}")
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_ids(cls, ids: Sequence[int], cfg: RingConfig = DEFAULT_CONFIG,
+                 *, capacity: Optional[int] = None,
+                 store_capacity: int = 1 << 16, max_segments: int = 64,
+                 mesh=None, **ida) -> "DeviceDHT":
+        """Converged ring over explicit 128-bit ids (the post-Join
+        fixpoint every reference test sleeps toward)."""
+        if mesh is not None and capacity is None:
+            d = mesh.shape["peer"]
+            capacity = -(-len(ids) // d) * d
+        state = build_ring(ids, cfg, capacity=capacity)
+        return cls._with_store(state, store_capacity, max_segments, mesh,
+                               **ida)
+
+    @classmethod
+    def from_seeds(cls, seeds: Sequence, cfg: RingConfig = DEFAULT_CONFIG,
+                   **kw) -> "DeviceDHT":
+        """(ip, port) seeds, hashed like peer construction
+        (abstract_chord_peer.cpp:13-28)."""
+        ids = [int(keyspace.Key.for_peer(ip, port)) for ip, port in seeds]
+        return cls.from_ids(ids, cfg, **kw)
+
+    @classmethod
+    def random(cls, n_peers: int, seed: int = 0,
+               cfg: RingConfig = DEFAULT_CONFIG, *,
+               capacity: Optional[int] = None,
+               store_capacity: int = 1 << 16, max_segments: int = 64,
+               mesh=None, **ida) -> "DeviceDHT":
+        """Device-genesis ring with uniform random ids (the at-scale
+        construction path — no host build/upload; core/ring.ring_genesis)."""
+        if mesh is not None and capacity is None:
+            d = mesh.shape["peer"]
+            capacity = -(-n_peers // d) * d
+        state = build_ring_random(jax.random.PRNGKey(seed), n_peers, cfg,
+                                  capacity=capacity)
+        return cls._with_store(state, store_capacity, max_segments, mesh,
+                               **ida)
+
+    @classmethod
+    def _with_store(cls, state, store_capacity, max_segments, mesh, **ida):
+        store = empty_store(store_capacity, max_segments)
+        if mesh is not None:
+            store = shard_store(store, mesh, state.ids.shape[0])
+        return cls(state, store, mesh=mesh, **ida)
+
+    # -- key/value plumbing ------------------------------------------------
+
+    def _keys(self, keys: Sequence[KeyLike]) -> jax.Array:
+        ints = [int(keyspace.Key.from_plaintext(k)) if isinstance(k, str)
+                else int(k) for k in keys]
+        return keys_from_ints(ints)
+
+    @property
+    def max_segments(self) -> int:
+        return self.store.max_segments
+
+    # -- the reference verbs ----------------------------------------------
+
+    def create(self, keys: Sequence[KeyLike], values: Sequence[bytes],
+               starts: Optional[Sequence[int]] = None) -> np.ndarray:
+        """Batched DHashPeer::Create: encode each value into n fragments
+        striped over the key's n successors; >= m stored acks per lane.
+        Returns ok [B] bool."""
+        b = len(keys)
+        if len(values) != b:
+            raise ValueError("keys/values length mismatch")
+        if starts is not None and self.mesh is not None:
+            raise ValueError(
+                "starts is a single-device concept (the originating peer "
+                "of the placement walk); the sharded store places on the "
+                "converged fast path only — omit it")
+        smax = self.max_segments
+        segs = np.zeros((b, smax, self.m), np.int32)
+        lengths = np.zeros(b, np.int32)
+        for i, v in enumerate(values):
+            s = split_to_segments(v, self.m)
+            if s.shape[0] > smax:
+                raise ValueError(
+                    f"value {i} needs {s.shape[0]} segments > "
+                    f"max_segments {smax}")
+            segs[i, : s.shape[0]] = s
+            lengths[i] = s.shape[0]
+        kb = self._keys(keys)
+        if self.mesh is not None:
+            self.store, ok = create_batch_sharded(
+                self.state, self.store, kb, jnp.asarray(segs),
+                jnp.asarray(lengths), self.n, self.m, self.p,
+                mesh=self.mesh, axis=self.axis)
+        else:
+            if starts is None:
+                starts = np.zeros(b, np.int32)
+            self.store, ok = create_batch(
+                self.state, self.store, kb, jnp.asarray(segs),
+                jnp.asarray(lengths), jnp.asarray(starts, jnp.int32),
+                self.n, self.m, self.p)
+        return np.asarray(ok)
+
+    def read(self, keys: Sequence[KeyLike], raw: bool = False
+             ) -> List[Optional[bytes]]:
+        """Batched DHashPeer::Read: collect >= m distinct reachable
+        fragments per key and decode. Unreadable keys (the reference
+        throws) return None."""
+        kb = self._keys(keys)
+        if self.mesh is not None:
+            segs, ok = read_batch_sharded(self.state, self.store, kb,
+                                          self.n, self.m, self.p,
+                                          mesh=self.mesh, axis=self.axis)
+        else:
+            segs, ok = read_batch(self.state, self.store, kb,
+                                  self.n, self.m, self.p)
+        segs = np.asarray(segs)
+        ok = np.asarray(ok)
+        if raw:
+            return [segs[i] if ok[i] else None for i in range(len(keys))]
+        return [strip_decoded(segs[i]) if ok[i] else None
+                for i in range(len(keys))]
+
+    def lookup(self, keys: Sequence[KeyLike],
+               starts: Optional[Sequence[int]] = None) -> np.ndarray:
+        """Batched GetSuccessor -> owner peer ids (python ints)."""
+        kb = self._keys(keys)
+        b = kb.shape[0]
+        if starts is None:
+            starts = np.zeros(b, np.int32)
+        owner, _ = find_successor(self.state, kb,
+                                  jnp.asarray(starts, jnp.int32))
+        rows = np.asarray(owner)
+        ids = np.asarray(self.state.ids)
+        owner_ids = keyspace.lanes_to_ints(ids[np.maximum(rows, 0)])
+        out = np.empty(b, object)
+        out[:] = owner_ids
+        out[rows < 0] = None
+        return out
+
+    # -- churn + maintenance ----------------------------------------------
+
+    def fail(self, rows: Sequence[int]) -> None:
+        """Silent process kill (ChordPeer::Fail)."""
+        self.state = churn_ops.fail(self.state,
+                                    jnp.asarray(rows, jnp.int32))
+
+    def leave(self, rows: Sequence[int]) -> None:
+        """Graceful Leave with immediate custody handover."""
+        self.state = churn_ops.leave(self.state,
+                                     jnp.asarray(rows, jnp.int32))
+
+    def join(self, ids: Sequence[int]) -> np.ndarray:
+        """Batched Join; returns each lane's row (-1 = rejected
+        duplicate). Rejoining a failed peer's id resurrects it."""
+        lanes = jnp.asarray(keyspace.ints_to_lanes([int(i) for i in ids]))
+        self.state, rows = churn_ops.join(self.state, lanes)
+        return np.asarray(rows)
+
+    def maintain(self, cand_start: int = 0) -> dict:
+        """One deterministic maintenance round: stabilize sweep +
+        global re-placement + local replica regeneration (the
+        reference's MaintenanceLoop body, minus the sleeps)."""
+        self.state = churn_ops.stabilize_sweep(self.state)
+        if self.mesh is not None:
+            self.store, moved, pending = global_maintenance_sharded(
+                self.state, self.store, self.n,
+                outbox=min(4096, self.store.shard_capacity),
+                mesh=self.mesh, axis=self.axis)
+            self.store, repaired = local_maintenance_sharded(
+                self.state, self.store, jnp.int32(cand_start),
+                self.n, self.m, self.p,
+                cands=min(1024, self.store.shard_capacity),
+                mesh=self.mesh, axis=self.axis)
+            return {"moved": int(moved), "pending": int(pending),
+                    "repaired": int(repaired)}
+        start = jnp.zeros((self.store.capacity,), jnp.int32)
+        self.store = global_maintenance(self.state, self.store, start,
+                                        self.n)
+        self.store, repaired = local_maintenance(
+            self.state, self.store, start, self.n, self.m, self.p)
+        return {"repaired": int(repaired)}
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Whole-simulation snapshot incl. the IDA geometry — restore
+        refuses params that disagree with what the data was striped
+        with (a silent mismatch would fail every read)."""
+        save_checkpoint(path, ring=self.state, store=self.store,
+                        extra={"ida_n": self.n, "ida_m": self.m,
+                               "ida_p": self.p})
+
+    @classmethod
+    def restore(cls, path: str, mesh=None, **ida) -> "DeviceDHT":
+        from p2p_dhts_tpu.dhash.sharded import ShardedFragmentStore
+        ring, store, extra = load_checkpoint(path, mesh=mesh,
+                                             with_extra=True)
+        if ring is None or store is None:
+            raise ValueError("checkpoint must hold both ring and store")
+        sharded = isinstance(store, ShardedFragmentStore)
+        if sharded and mesh is None:
+            raise ValueError("checkpoint holds a sharded store — pass "
+                             "mesh= (same width as at save time)")
+        if not sharded and mesh is not None:
+            raise ValueError("checkpoint holds a single-device store; "
+                             "restore without mesh, then shard_store")
+        saved = {k[4:]: v for k, v in extra.items()
+                 if k.startswith("ida_")}
+        for name, v in saved.items():
+            if name in ida and ida[name] != v:
+                raise ValueError(
+                    f"checkpoint was striped with {name}={v}, "
+                    f"restore asked for {ida[name]}")
+        merged = {**saved, **{k: v for k, v in ida.items()
+                              if k not in saved}}
+        return cls(ring, store, mesh=mesh, **merged)

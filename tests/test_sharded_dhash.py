@@ -295,3 +295,67 @@ def test_local_maintenance_cand_window_sweeps(rng):
         total += int(rep)
     assert total == int(rep_ref)
     assert canonical_rows(unshard_store(sstore2)) == canonical_rows(ref2)
+
+
+@pytest.mark.soak
+@pytest.mark.parametrize("seed", [13, 37])
+def test_sharded_store_random_program_soak(seed):
+    """Lockstep soak: drive the single-device store and the sharded
+    store through IDENTICAL randomized op programs (creates incl.
+    overwrites, fails within tolerance, sweeps, global+local
+    maintenance) and assert canonical row-set equality plus lane-exact
+    read parity after every round — any divergence in the collective
+    kernels' semantics surfaces here."""
+    rng = np.random.RandomState(seed)
+    mesh = peer_mesh()
+    ids = [int.from_bytes(rng.bytes(16), "little") for _ in range(N_PEERS)]
+    ring = build_ring(ids, RingConfig(num_succs=3))
+    ref = empty_store(4096, SMAX)
+    sstore = shard_store(empty_store(4096, SMAX), mesh, N_PEERS)
+
+    all_keys = []
+    for rnd in range(3):
+        # Create a batch; every other round re-creates some known keys
+        # (the purge/overwrite path).
+        fresh = [int.from_bytes(rng.bytes(16), "little") for _ in range(8)]
+        batch = fresh + ([all_keys[0], all_keys[1]]
+                         if rnd % 2 and len(all_keys) >= 2 else [])
+        all_keys.extend(fresh)
+        keys = keys_from_ints(batch)
+        segs, lengths = _make_blocks(rng, len(batch))
+        starts = jnp.asarray(rng.randint(0, N_PEERS, size=len(batch)),
+                             jnp.int32)
+        ref, ok_r = create_batch(ring, ref, keys, segs, lengths, starts,
+                                 N_IDA, M_IDA, P_IDA)
+        sstore, ok_s = create_batch_sharded(ring, sstore, keys, segs,
+                                            lengths, N_IDA, M_IDA, P_IDA,
+                                            mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(ok_r), np.asarray(ok_s))
+
+        # Fail a couple of peers (within IDA tolerance), sweep.
+        alive_rows = np.flatnonzero(np.asarray(ring.alive))
+        victims = rng.choice(alive_rows, size=2, replace=False)
+        ring = churn.stabilize_sweep(
+            churn.fail(ring, jnp.asarray(victims, jnp.int32)))
+
+        # Maintenance on both stores.
+        ref = _sort_store(global_maintenance(
+            ring, ref, jnp.zeros((ref.capacity,), jnp.int32), N_IDA))
+        sstore, _, pending = global_maintenance_sharded(
+            ring, sstore, N_IDA, outbox=512, mesh=mesh)
+        assert int(pending) == 0
+        ref, _ = local_maintenance(
+            ring, ref, jnp.zeros((ref.capacity,), jnp.int32),
+            N_IDA, M_IDA, P_IDA)
+        sstore, _ = local_maintenance_sharded(
+            ring, sstore, jnp.int32(0), N_IDA, M_IDA, P_IDA,
+            cands=64, mesh=mesh)
+
+        assert canonical_rows(unshard_store(sstore)) == canonical_rows(ref), \
+            f"round {rnd}: stores diverged"
+        qk = keys_from_ints(all_keys[-12:])
+        got_r, okq_r = read_batch(ring, ref, qk, N_IDA, M_IDA, P_IDA)
+        got_s, okq_s = read_batch_sharded(ring, sstore, qk,
+                                          N_IDA, M_IDA, P_IDA, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(okq_r), np.asarray(okq_s))
+        np.testing.assert_array_equal(np.asarray(got_r), np.asarray(got_s))

@@ -1,0 +1,126 @@
+"""DeviceDHT facade tests: the reference's user workflow (construct,
+create, read, churn, maintain, persist) end-to-end through one object,
+in both single-device and sharded-store modes."""
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.sharded import peer_mesh
+from p2p_dhts_tpu.simulator import DeviceDHT
+
+IDA = dict(n=5, m=3, p=257)
+
+
+def _dht(rng, mesh=None, n_peers=64):
+    ids = [int.from_bytes(rng.bytes(16), "little") for _ in range(n_peers)]
+    return DeviceDHT.from_ids(ids, RingConfig(num_succs=3),
+                              store_capacity=2048, max_segments=8,
+                              mesh=mesh, **IDA)
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_create_read_churn_maintain_roundtrip(rng, sharded):
+    mesh = peer_mesh() if sharded else None
+    dht = _dht(rng, mesh)
+    keys = [f"key-{i}" for i in range(12)]
+    vals = [bytes(rng.randint(1, 256, size=rng.randint(1, 20)).tolist())
+            for i in range(12)]
+    ok = dht.create(keys, vals)
+    assert ok.all()
+    assert dht.read(keys) == vals
+
+    # Fail two peers (within n-m tolerance), maintain, read again.
+    dht.fail([3, 40])
+    stats = dht.maintain()
+    assert stats["repaired"] >= 0
+    assert dht.read(keys) == vals
+
+
+def test_text_keys_hash_like_reference(rng):
+    """A text key resolves to the same owner as its SHA-1 int form
+    (ChordKey(key, false) semantics)."""
+    dht = _dht(rng)
+    from p2p_dhts_tpu.keyspace import Key
+    owner_text = dht.lookup(["hello"])[0]
+    owner_int = dht.lookup([int(Key.from_plaintext("hello"))])[0]
+    assert owner_text == owner_int
+
+
+def test_trailing_nul_strip_quirk(rng):
+    """Binary payloads ending in 0x00 lose the trailing NULs — the
+    reference's documented decode quirk (ida.cpp:143-161); raw=True
+    exposes the unstripped segments."""
+    dht = _dht(rng)
+    ok = dht.create(["k"], [b"\x01\x02\x00\x00"])
+    assert ok.all()
+    assert dht.read(["k"]) == [b"\x01\x02"]
+    raw = dht.read(["k"], raw=True)[0]
+    assert raw is not None and raw.shape[1] == IDA["m"]
+
+
+def test_unreadable_key_returns_none(rng):
+    dht = _dht(rng)
+    assert dht.read(["never stored"]) == [None]
+
+
+def test_join_and_rejoin(rng):
+    dht = _dht(rng)
+    new_id = int.from_bytes(rng.bytes(16), "little")
+    rows = dht.join([new_id])
+    assert rows[0] >= 0
+    assert dht.join([new_id])[0] == -1          # alive duplicate rejected
+    dht.fail([int(rows[0])])
+    dht.maintain()
+    assert dht.join([new_id])[0] >= 0           # rejoin resurrects
+
+
+@pytest.mark.parametrize("sharded", [False, True])
+def test_save_restore_roundtrip(rng, tmp_path, sharded):
+    mesh = peer_mesh() if sharded else None
+    dht = _dht(rng, mesh)
+    keys = ["a", "b", "c"]
+    vals = [b"one", b"two", b"three"]
+    assert dht.create(keys, vals).all()
+    path = str(tmp_path / "dht.npz")
+    dht.save(path)
+    back = DeviceDHT.restore(path, mesh=mesh, **IDA)
+    assert back.read(keys) == vals
+
+
+def test_restore_guards(rng, tmp_path):
+    """Restore refuses IDA params that disagree with the stripe geometry
+    and mesh arguments that disagree with the stored layout — silent
+    mismatches would fail every read."""
+    dht = _dht(rng)
+    assert dht.create(["x"], [b"v"]).all()
+    path = str(tmp_path / "g.npz")
+    dht.save(path)
+    back = DeviceDHT.restore(path)          # params come from the file
+    assert (back.n, back.m, back.p) == (IDA["n"], IDA["m"], IDA["p"])
+    assert back.read(["x"]) == [b"v"]
+    with pytest.raises(ValueError):
+        DeviceDHT.restore(path, m=9)        # contradicts stripe geometry
+    with pytest.raises(ValueError):
+        DeviceDHT.restore(path, mesh=peer_mesh())  # plain store + mesh
+
+    sdht = _dht(rng, peer_mesh())
+    assert sdht.create(["y"], [b"w"]).all()
+    spath = str(tmp_path / "gs.npz")
+    sdht.save(spath)
+    with pytest.raises(ValueError):
+        DeviceDHT.restore(spath)            # sharded store needs mesh
+
+
+def test_from_seeds_matches_reference_hashing(rng):
+    """Seed construction uses SHA1(ip:port) ids — the pinned fixture
+    hash shows up as a real ring member."""
+    dht = DeviceDHT.from_seeds([("127.0.0.1", 7000 + i) for i in range(8)],
+                               RingConfig(num_succs=3),
+                               store_capacity=512, max_segments=8, **IDA)
+    from p2p_dhts_tpu.keyspace import Key
+    want = int(Key.for_peer("127.0.0.1", 7002))
+    ids = [int(x) for x in
+           __import__("p2p_dhts_tpu.keyspace", fromlist=["lanes_to_ints"]
+                      ).lanes_to_ints(np.asarray(dht.state.ids[:8]))]
+    assert want in ids
