@@ -14,6 +14,7 @@ from p2p_dhts_tpu.dhash.store import (  # noqa: F401
 )
 from p2p_dhts_tpu.dhash.maintenance import (  # noqa: F401
     global_maintenance,
+    leave_handover,
     local_maintenance,
     presence_matrix,
 )
@@ -31,6 +32,7 @@ from p2p_dhts_tpu.dhash.sharded import (  # noqa: F401
     ShardedFragmentStore,
     create_batch_sharded,
     global_maintenance_sharded,
+    leave_handover_sharded,
     local_maintenance_sharded,
     read_batch_sharded,
     shard_store,

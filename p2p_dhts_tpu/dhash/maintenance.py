@@ -32,7 +32,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from p2p_dhts_tpu.core.ring import RingState
+from p2p_dhts_tpu.core.ring import RingState, next_alive_map
 from p2p_dhts_tpu.dhash.store import (
     FragmentStore, _append_rows, _key_window, _sort_store,
     holder_alive_mask, placement_owners)
@@ -143,6 +143,44 @@ def local_maintenance(ring: RingState, store: FragmentStore,
     out, stored = _append_rows(store, rep_keys, rep_fidx, rep_holder,
                                rep_vals, rep_len, flat_need)
     return _sort_store(out), stored.astype(jnp.int32).sum()
+
+
+def _handover_holders(holder: jax.Array, used: jax.Array,
+                      na: jax.Array, srt_left: jax.Array,
+                      nn: int) -> jax.Array:
+    """Shared handover core: holders in the sorted leaver set move to
+    their alive ring successor (single-device and sharded callers must
+    not drift — parity tests compare them row-for-row)."""
+    pos = jnp.searchsorted(srt_left, holder, side="left")
+    hit = (srt_left[jnp.minimum(pos, srt_left.shape[0] - 1)] == holder) \
+        & (holder >= 0) & used
+    succ = na[jnp.minimum(jnp.maximum(holder, 0) + 1, nn)]
+    return jnp.where(hit & (succ >= 0), succ, holder)
+
+
+@jax.jit
+def leave_handover(ring: RingState, store: FragmentStore,
+                   left_rows: jax.Array) -> FragmentStore:
+    """Hand a graceful leaver's fragments to its alive ring successor —
+    the store half of Leave (the reference's LeaveHandler carries the
+    leaver's keys to the successor, AbsorbKeys,
+    abstract_chord_peer.cpp:192-260), which is what keeps availability
+    through leaves beyond IDA tolerance (a FAILED peer's fragments die
+    with it; a LEAVING peer's do not).
+
+    Call with the post-leave ring (leavers already not alive) and the
+    leaver rows. Membership is a searchsorted probe into the small
+    sorted leaver set (never a capacity-sized gather — the TPU compile
+    cliff, see churn.leave); the receiving successor may no longer be
+    in the key's successor set, exactly like the reference's handover —
+    global maintenance re-places later."""
+    if left_rows.shape[0] == 0:
+        return store
+    new_holder = _handover_holders(store.holder, store.used,
+                                   next_alive_map(ring),
+                                   jnp.sort(left_rows),
+                                   ring.ids.shape[0])
+    return store._replace(holder=new_holder)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "max_hops"))

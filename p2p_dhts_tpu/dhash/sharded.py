@@ -70,6 +70,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from p2p_dhts_tpu.core.ring import (
     RingState,
     n_successors_converged,
+    next_alive_map,
     placement_converged,
 )
 from p2p_dhts_tpu.dhash.store import (
@@ -479,6 +480,35 @@ def global_maintenance_sharded(ring: RingState, sstore: ShardedFragmentStore,
 
     sstore, moved, pending = kernel(sstore, ring, guard)
     return sstore, moved[0], pending[0]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def leave_handover_sharded(ring: RingState, sstore: ShardedFragmentStore,
+                           left_rows: jax.Array, mesh: Mesh = None,
+                           axis: str = "peer") -> ShardedFragmentStore:
+    """Sharded twin of `maintenance.leave_handover`: each shard points
+    its locally-held leaver fragments at the leaver's alive ring
+    successor. Only the holder FIELD changes — the row stays on its
+    current shard (reads scan every shard, so reachability is immediate)
+    until the next `global_maintenance_sharded` migrates it to the new
+    holder's block; the at-most-one-shard invariant is untouched."""
+    if left_rows.shape[0] == 0:
+        return sstore
+    from p2p_dhts_tpu.dhash.maintenance import _handover_holders
+    nn = ring.ids.shape[0]
+    na = next_alive_map(_strip_fingers(ring))
+    srt = jnp.sort(left_rows)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(_store_specs(axis), P(None), P(None)),
+        out_specs=_store_specs(axis), check_vma=False)
+    def kernel(sstore, na, srt):
+        local = _local(sstore)
+        holder = _handover_holders(local.holder, local.used, na, srt, nn)
+        return _pack(local._replace(holder=holder))
+
+    return kernel(sstore, na, srt)
 
 
 @functools.partial(jax.jit,

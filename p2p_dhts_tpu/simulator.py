@@ -46,9 +46,9 @@ from p2p_dhts_tpu.core.ring import (
     keys_from_ints)
 from p2p_dhts_tpu.dhash import (
     create_batch, create_batch_sharded, global_maintenance,
-    global_maintenance_sharded, local_maintenance,
-    local_maintenance_sharded, read_batch, read_batch_sharded,
-    shard_store, empty_store)
+    global_maintenance_sharded, leave_handover, leave_handover_sharded,
+    local_maintenance, local_maintenance_sharded, read_batch,
+    read_batch_sharded, shard_store, empty_store)
 from p2p_dhts_tpu.checkpoint import load_checkpoint, save_checkpoint
 from p2p_dhts_tpu.ida import split_to_segments, strip_decoded
 
@@ -213,9 +213,17 @@ class DeviceDHT:
                                     jnp.asarray(rows, jnp.int32))
 
     def leave(self, rows: Sequence[int]) -> None:
-        """Graceful Leave with immediate custody handover."""
-        self.state = churn_ops.leave(self.state,
-                                     jnp.asarray(rows, jnp.int32))
+        """Graceful Leave: ring custody handover plus fragment
+        handover to each leaver's successor (LeaveHandler/AbsorbKeys —
+        unlike fail(), a leave never costs availability)."""
+        r = jnp.asarray(rows, jnp.int32)
+        self.state = churn_ops.leave(self.state, r)
+        if self.mesh is not None:
+            self.store = leave_handover_sharded(self.state, self.store, r,
+                                                mesh=self.mesh,
+                                                axis=self.axis)
+        else:
+            self.store = leave_handover(self.state, self.store, r)
 
     def join(self, ids: Sequence[int]) -> np.ndarray:
         """Batched Join; returns each lane's row (-1 = rejected

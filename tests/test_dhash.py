@@ -354,3 +354,30 @@ def test_dhash_store_soak_medium_scale(seed):
         pres = presence_matrix(ring, store, keys, b_starts, N_IDA)
         assert bool(jnp.all(pres)), f"round {rnd}: replication not restored"
         _check_read(ring, store, keys, segs, lengths)
+
+
+def test_leave_handover_preserves_availability(rng):
+    """Graceful leaves beyond IDA tolerance: with the LeaveHandler
+    fragment handover the block stays readable (the successor absorbed
+    the leavers' fragments); a FAIL of the same rows loses it."""
+    from p2p_dhts_tpu.dhash import leave_handover
+
+    ring, store, keys, starts, vals, segs, lengths, _ = _setup(rng, b=4)
+    owners, _ = get_n_successors(ring, keys, starts, N_IDA)
+    owners = np.asarray(owners)
+    victims = jnp.asarray(owners[0, : N_IDA - M_IDA + 1], jnp.int32)
+
+    # Fail: below m reachable fragments -> lane 0 unreadable.
+    ring_f = churn.stabilize_sweep(churn.fail(ring, victims))
+    _, ok_f = read_batch(ring_f, store, keys, N_IDA, M_IDA, P_IDA)
+    assert not bool(ok_f[0])
+
+    # Leave + handover: every fragment reaches an alive holder.
+    ring_l = churn.leave(ring, victims)
+    store_l = leave_handover(ring_l, store, victims)
+    ring_l = churn.stabilize_sweep(ring_l)
+    got, ok_l = read_batch(ring_l, store_l, keys, N_IDA, M_IDA, P_IDA)
+    assert bool(ok_l[0]), "graceful leave must not cost availability"
+    np.testing.assert_array_equal(
+        np.asarray(got)[0, : int(lengths[0])],
+        np.asarray(segs)[0, : int(lengths[0])])

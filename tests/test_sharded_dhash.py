@@ -359,3 +359,45 @@ def test_sharded_store_random_program_soak(seed):
                                           N_IDA, M_IDA, P_IDA, mesh=mesh)
         np.testing.assert_array_equal(np.asarray(okq_r), np.asarray(okq_s))
         np.testing.assert_array_equal(np.asarray(got_r), np.asarray(got_s))
+
+
+def test_leave_handover_sharded_parity(rng):
+    """Sharded leave handover matches the single-device op row-for-row
+    and keeps blocks readable through leaves beyond tolerance; the next
+    global maintenance migrates the handed-over rows onto their new
+    holders' shards."""
+    from p2p_dhts_tpu.dhash import leave_handover, leave_handover_sharded
+
+    mesh, ring, store, keys, starts, segs, lengths = _setup(rng, b=6)
+    ref, _ = create_batch(ring, store, keys, segs, lengths, starts,
+                          N_IDA, M_IDA, P_IDA)
+    sstore = shard_store(ref, mesh, N_PEERS)
+    holders = np.asarray(ref.holder[: int(ref.n_used)])
+    kview = np.asarray(ref.keys[: int(ref.n_used)])
+    k0 = np.asarray(keys)[0]
+    rows0 = np.where((kview == k0).all(axis=1))[0]
+    victims = jnp.asarray(holders[rows0][: N_IDA - M_IDA + 1], jnp.int32)
+
+    ring_l = churn.leave(ring, victims)
+    ref_l = _sort_store(leave_handover(ring_l, ref, victims))
+    sstore_l = leave_handover_sharded(ring_l, sstore, victims, mesh=mesh)
+    ring_l = churn.stabilize_sweep(ring_l)
+    assert canonical_rows(unshard_store(sstore_l)) == canonical_rows(ref_l)
+
+    got_r, ok_r = read_batch(ring_l, ref_l, keys, N_IDA, M_IDA, P_IDA)
+    got_s, ok_s = read_batch_sharded(ring_l, sstore_l, keys,
+                                     N_IDA, M_IDA, P_IDA, mesh=mesh)
+    assert bool(ok_s[0]), "graceful leave must not cost availability"
+    np.testing.assert_array_equal(np.asarray(ok_r), np.asarray(ok_s))
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(got_s))
+
+    # Migration then restores the holder-shard placement invariant.
+    sstore_m, _, pending = global_maintenance_sharded(
+        ring_l, sstore_l, N_IDA, outbox=256, mesh=mesh)
+    assert int(pending) == 0
+    rblock = N_PEERS // sstore_m.n_shards
+    holder = np.asarray(sstore_m.holder)
+    used = np.asarray(sstore_m.used)
+    for s in range(sstore_m.n_shards):
+        h = holder[s][used[s]]
+        assert ((h // rblock) == s).all()

@@ -124,3 +124,16 @@ def test_from_seeds_matches_reference_hashing(rng):
            __import__("p2p_dhts_tpu.keyspace", fromlist=["lanes_to_ints"]
                       ).lanes_to_ints(np.asarray(dht.state.ids[:8]))]
     assert want in ids
+
+
+def test_facade_leave_preserves_availability(rng):
+    """dht.leave() beyond IDA tolerance keeps values readable (fragment
+    handover); dht.fail() of the same rows would not."""
+    dht = _dht(rng)
+    assert dht.create(["k"], [b"payload"]).all()
+    n_used = int(dht.store.n_used)
+    holders = [int(dht.store.holder[i]) for i in range(n_used)]
+    victims = sorted(set(holders))[: IDA["n"] - IDA["m"] + 1]
+    dht.leave(victims)
+    dht.maintain()
+    assert dht.read(["k"]) == [b"payload"]
