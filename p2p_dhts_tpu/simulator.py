@@ -66,6 +66,7 @@ class DeviceDHT:
         self.n, self.m, self.p = n, m, p
         self.mesh = mesh
         self.axis = axis
+        self._cand_cursor = 0  # sharded local-maintenance sweep position
         if n <= m or p <= n:
             raise ValueError(f"IDA needs n > m and p > n, got {(n, m, p)}")
 
@@ -232,23 +233,35 @@ class DeviceDHT:
         self.state, rows = churn_ops.join(self.state, lanes)
         return np.asarray(rows)
 
-    def maintain(self, cand_start: int = 0) -> dict:
+    def maintain(self, cand_start: Optional[int] = None) -> dict:
         """One deterministic maintenance round: stabilize sweep +
         global re-placement + local replica regeneration (the
-        reference's MaintenanceLoop body, minus the sleeps)."""
+        reference's MaintenanceLoop body, minus the sleeps). In sharded
+        mode, each round's regeneration examines a window of candidate
+        keys per shard; successive maintain() calls advance the window
+        automatically so repeated rounds sweep the whole store
+        (pass cand_start to position it explicitly)."""
         self.state = churn_ops.stabilize_sweep(self.state)
         if self.mesh is not None:
+            cands = min(1024, self.store.shard_capacity)
+            if cand_start is None:
+                cand_start = self._cand_cursor
+                # Wrap within the shard capacity so the window returns
+                # to the front after covering the deepest possible
+                # leader list (the kernel clamps past the actual count).
+                self._cand_cursor = ((self._cand_cursor + cands)
+                                     % self.store.shard_capacity)
             self.store, moved, pending = global_maintenance_sharded(
                 self.state, self.store, self.n,
                 outbox=min(4096, self.store.shard_capacity),
                 mesh=self.mesh, axis=self.axis)
             self.store, repaired = local_maintenance_sharded(
                 self.state, self.store, jnp.int32(cand_start),
-                self.n, self.m, self.p,
-                cands=min(1024, self.store.shard_capacity),
+                self.n, self.m, self.p, cands=cands,
                 mesh=self.mesh, axis=self.axis)
             return {"moved": int(moved), "pending": int(pending),
                     "repaired": int(repaired)}
+        del cand_start  # single-device repair scans every block
         start = jnp.zeros((self.store.capacity,), jnp.int32)
         self.store = global_maintenance(self.state, self.store, start,
                                         self.n)
