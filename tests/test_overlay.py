@@ -465,3 +465,47 @@ def test_finger_table_pretty_print_collates_ranges():
     finally:
         p1.fail()
         p2.fail()
+
+
+def test_host_device_placement_parity(dhash_ring):
+    """Cross-LAYER parity: the wire-parity host overlay (real TCP
+    peers) and the device placement kernel must stripe a key's
+    fragments onto the SAME peers with the same 1-based indices — the
+    two implementations of DHashPeer::Create's placement
+    (dhash_peer.cpp:106-123) agree end to end."""
+    import numpy as np
+    import jax.numpy as jnp
+    from p2p_dhts_tpu.config import RingConfig
+    from p2p_dhts_tpu.core.ring import build_ring, keys_from_ints
+    from p2p_dhts_tpu.dhash.store import placement_owners
+
+    n_ida = 3
+    peers = dhash_ring(6, ida=(n_ida, 2, 257))
+    text_keys = [f"parity-key-{i}" for i in range(5)]
+    for i, tk in enumerate(text_keys):
+        peers[i % 6].create(tk, f"value {i}")
+
+    # Host truth: which peer ids hold which fragment index per key.
+    host = {}
+    for p in peers:
+        for key_int, frag in p.db.get_entries():
+            host.setdefault(int(key_int), {})[frag.index] = int(p.id)
+
+    # Device twin: converged ring over the same SHA1(ip:port) ids.
+    ids = [int(p.id) for p in peers]
+    state = build_ring(ids, RingConfig(num_succs=3))
+    sorted_ids = sorted(ids)
+    kb = keys_from_ints([int(Key.from_plaintext(tk)) for tk in text_keys])
+    owners = np.asarray(placement_owners(
+        state, kb, jnp.zeros(len(text_keys), jnp.int32), n_ida))
+
+    for j, tk in enumerate(text_keys):
+        kint = int(Key.from_plaintext(tk))
+        assert kint in host, f"host ring lost {tk}"
+        assert len(host[kint]) == n_ida, \
+            f"host stored only {len(host[kint])}/{n_ida} fragments of {tk}"
+        for idx, holder_id in host[kint].items():
+            want = sorted_ids[owners[j, idx - 1]]
+            assert holder_id == want, (
+                f"{tk} fragment {idx}: host holder {holder_id:#x} != "
+                f"device placement {want:#x}")
