@@ -34,6 +34,9 @@ explicit XLA collectives over ICI:
     re-encoded replicated and every shard appends the regenerated
     fragments it is the designated holder shard for (RetrieveMissing's
     regeneration, dhash_peer.cpp:350-379, batched).
+  * `leave_handover_sharded` — collective-free holder rewrite pointing a
+    graceful leaver's fragments at its successor (LeaveHandler's key
+    transfer; the next global round migrates the rows physically).
 
 Sharding stance (scaling-book recipe): only the HEAVY array shards — the
 fragment values table, O(capacity * S). The ring's id/alive/next-alive
