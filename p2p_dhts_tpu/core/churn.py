@@ -208,14 +208,16 @@ def join(state: RingState, new_ids: jax.Array
     """Batched join of K new peers (ref Join + JoinHandler + Notify,
     abstract_chord_peer.cpp:83-190).
 
-    new_ids: [K, 4] u32. Requires n_valid + K <= capacity.
+    new_ids: [K, 4] u32.
 
-    The distinct-id precondition is ENFORCED, not assumed: a lane whose id
+    Preconditions are ENFORCED, not assumed: a lane whose id
     equals an ALIVE table row, or an earlier lane of the same batch, is
     rejected (its returned row is -1, the state untouched by it) — a
     silent duplicate insert would corrupt the sorted-table invariant every
-    searchsorted kernel depends on. A lane matching a DEAD table row is a
-    REJOIN: the row is resurrected in place, the device analog of the
+    searchsorted kernel depends on. Inserts beyond the table's remaining
+    capacity are likewise rejected lane-by-lane in sorted order (a full
+    table must refuse peers, not evict them). A lane matching a DEAD
+    table row is a REJOIN: the row is resurrected in place, the device analog of the
     reference's restarted process joining again under the same
     SHA1(ip:port) id (abstract_chord_peer.cpp:13-28 — the id is a pure
     function of the address, so rejoin-with-same-id is its normal mode).
@@ -249,6 +251,12 @@ def join(state: RingState, new_ids: jax.Array
     in_table = (pos < state.n_valid) & u128.eq(state.ids[pos_c], new_sorted)
     resurrect = in_table & ~state.alive[pos_c] & ~intra_dup
     insert = ~in_table & ~intra_dup
+    # Capacity guard: only as many inserts as the table has padding rows
+    # are admitted (in sorted order); the rest are rejected (-1) like
+    # duplicates. Without this, a full table EVICTS its highest-id
+    # peers through the dropped scatters — silent ring corruption.
+    room = jnp.int32(n) - state.n_valid
+    insert = insert & (jnp.cumsum(insert.astype(jnp.int32)) <= room)
 
     # Merge positions: old row r moves to r + (# INSERTED new ids < id_r);
     # inserted id j lands at searchsorted(old, new_j) + (# inserted lanes

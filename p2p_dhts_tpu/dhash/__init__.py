@@ -17,6 +17,7 @@ from p2p_dhts_tpu.dhash.maintenance import (  # noqa: F401
     leave_handover,
     local_maintenance,
     presence_matrix,
+    remap_holders,
 )
 from p2p_dhts_tpu.dhash.merkle import (  # noqa: F401
     MerkleIndex,
@@ -34,6 +35,7 @@ from p2p_dhts_tpu.dhash.sharded import (  # noqa: F401
     global_maintenance_sharded,
     leave_handover_sharded,
     local_maintenance_sharded,
+    remap_holders_sharded,
     read_batch_sharded,
     shard_store,
     unshard_store,

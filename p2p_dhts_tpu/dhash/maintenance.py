@@ -145,6 +145,51 @@ def local_maintenance(ring: RingState, store: FragmentStore,
     return _sort_store(out), stored.astype(jnp.int32).sum()
 
 
+def _remapped_holders(holder: jax.Array, old_ids: jax.Array,
+                      ring: RingState) -> jax.Array:
+    """Shared remap core: each holder row index is re-resolved through
+    its peer ID — old table row -> id -> new table row. A holder whose
+    id vanished from the table (cannot happen for a pure join) maps to
+    -1 (unreachable, repairable).
+
+    Deliberately NOT built on churn.join's internal old->new remap
+    table: deriving the mapping from the two id tables keeps this op
+    correct for ANY row-shifting event (future compaction, a restored
+    checkpoint against a rebuilt ring) and independent of join's merge
+    bookkeeping; the -1 branch is the price of that generality.
+
+    Scale note: `old_ids[holder]` is a store-capacity-sized gather from
+    the ring table — at 10M-by-10M shapes that is the XLA TPU
+    compile-cliff op class (see churn.leave). At facade/store scales it
+    is fine; a 10M-scale deployment that joins without remapping instead
+    converges through global+local maintenance, which re-derives
+    placement from keys and never reads stale holders beyond liveness.
+    """
+    hid = old_ids[jnp.maximum(holder, 0)]                      # [C, 4]
+    pos = u128.searchsorted(ring.ids, hid, ring.n_valid)
+    pos_c = jnp.minimum(pos, ring.ids.shape[0] - 1)
+    okh = (pos < ring.n_valid) & u128.eq(ring.ids[pos_c], hid) \
+        & (holder >= 0)
+    return jnp.where(okh, pos, jnp.where(holder >= 0, -1, holder))
+
+
+@jax.jit
+def remap_holders(old_ids: jax.Array, ring: RingState,
+                  store: FragmentStore) -> FragmentStore:
+    """Repoint every store row's holder after a churn.join shifted the
+    ring's row layout (join merges new ids into the sorted table, so
+    existing peers' ROW INDICES move; a peer process in the reference
+    needs no such fixup — row indirection is this rebuild's artifact,
+    and this op is its inverse).
+
+    old_ids: the pre-join `state.ids` table. Call right after
+    `churn.join`; without it, reads stay value-correct but treat a
+    fragment as unreachable whenever its stale holder index lands on a
+    dead row, until maintenance re-places everything."""
+    return store._replace(
+        holder=_remapped_holders(store.holder, old_ids, ring))
+
+
 def _handover_holders(holder: jax.Array, used: jax.Array,
                       na: jax.Array, srt_left: jax.Array,
                       nn: int) -> jax.Array:

@@ -486,6 +486,31 @@ def global_maintenance_sharded(ring: RingState, sstore: ShardedFragmentStore,
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def remap_holders_sharded(old_ids: jax.Array, ring: RingState,
+                          sstore: ShardedFragmentStore, mesh: Mesh = None,
+                          axis: str = "peer") -> ShardedFragmentStore:
+    """Sharded twin of `maintenance.remap_holders` (post-join row-shift
+    fixup): per shard, re-resolve local holder indices through their
+    peer ids against the replicated new table. Rows whose holder moved
+    ring blocks stay physically put (reads scan all shards) until the
+    next global maintenance migrates them — same transitional contract
+    as leave_handover_sharded."""
+    from p2p_dhts_tpu.dhash.maintenance import _remapped_holders
+    ring = _strip_fingers(ring)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(_store_specs(axis), P(None, None), _ring_specs(ring)),
+        out_specs=_store_specs(axis), check_vma=False)
+    def kernel(sstore, old_ids, ring):
+        local = _local(sstore)
+        holder = _remapped_holders(local.holder, old_ids, ring)
+        return _pack(local._replace(holder=holder))
+
+    return kernel(sstore, old_ids, ring)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
 def leave_handover_sharded(ring: RingState, sstore: ShardedFragmentStore,
                            left_rows: jax.Array, mesh: Mesh = None,
                            axis: str = "peer") -> ShardedFragmentStore:

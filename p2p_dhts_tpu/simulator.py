@@ -48,7 +48,8 @@ from p2p_dhts_tpu.dhash import (
     create_batch, create_batch_sharded, global_maintenance,
     global_maintenance_sharded, leave_handover, leave_handover_sharded,
     local_maintenance, local_maintenance_sharded, read_batch,
-    read_batch_sharded, shard_store, empty_store)
+    read_batch_sharded, remap_holders, remap_holders_sharded,
+    shard_store, empty_store)
 from p2p_dhts_tpu.checkpoint import load_checkpoint, save_checkpoint
 from p2p_dhts_tpu.ida import split_to_segments, strip_decoded
 
@@ -227,10 +228,23 @@ class DeviceDHT:
             self.store = leave_handover(self.state, self.store, r)
 
     def join(self, ids: Sequence[int]) -> np.ndarray:
-        """Batched Join; returns each lane's row (-1 = rejected
-        duplicate). Rejoining a failed peer's id resurrects it."""
+        """Batched Join; returns each lane's row (-1 = rejected).
+        A lane is rejected when its id is already an alive peer, repeats
+        an earlier lane, or the table is full — growing the ring needs
+        build-time headroom (`capacity=` at construction); rejoining a
+        FAILED peer's id resurrects its row and needs no headroom. The
+        store's holder indices are remapped through the shifted row
+        layout, so stored data stays fully reachable with no
+        maintenance round in between."""
         lanes = jnp.asarray(keyspace.ints_to_lanes([int(i) for i in ids]))
+        old_ids = self.state.ids
         self.state, rows = churn_ops.join(self.state, lanes)
+        if self.mesh is not None:
+            self.store = remap_holders_sharded(old_ids, self.state,
+                                               self.store, mesh=self.mesh,
+                                               axis=self.axis)
+        else:
+            self.store = remap_holders(old_ids, self.state, self.store)
         return np.asarray(rows)
 
     def maintain(self, cand_start: Optional[int] = None) -> dict:

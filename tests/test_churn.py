@@ -406,3 +406,25 @@ def test_random_churn_program_soak(seed):
             assert row >= 0, f"round {rnd} lane {j}: lookup failed"
             assert ids_now[row] == want_owner, f"round {rnd}"
             assert int(hops[j]) == want_hops, f"round {rnd} hop parity"
+
+
+def test_join_full_table_rejects_not_evicts(rng):
+    """Joining more peers than the table has padding rows admits exactly
+    the fitting prefix (sorted order) and rejects the rest — never the
+    old silent eviction of the highest-id peers."""
+    ids = _random_ids(rng, 12)
+    state = build_ring(ids, RingConfig(num_succs=3), capacity=14)  # room 2
+    new_ids = sorted(_random_ids(rng, 5))
+    state2, rows = churn.join(
+        state, jnp.asarray(keyspace.ints_to_lanes(new_ids)))
+    rows = np.asarray(rows)
+    assert (rows >= 0).sum() == 2, "exactly the fitting lanes admitted"
+    assert int(state2.n_valid) == 14
+    # Every original peer survived.
+    want = set(ids) | set(new_ids[:2])
+    got = set(keyspace.lanes_to_ints(np.asarray(state2.ids[:14])))
+    assert got == want
+    # The admitted pair is converged; a sweep converges everyone.
+    swept = churn.stabilize_sweep(state2)
+    ref = build_ring(sorted(want), RingConfig(num_succs=3), capacity=14)
+    assert canonical(swept) == canonical(ref)

@@ -381,3 +381,86 @@ def test_leave_handover_preserves_availability(rng):
     np.testing.assert_array_equal(
         np.asarray(got)[0, : int(lengths[0])],
         np.asarray(segs)[0, : int(lengths[0])])
+
+
+def test_remap_holders_after_join(rng):
+    """churn.join shifts row indices; remap_holders re-resolves every
+    store row's holder through its peer ID so reads see the same
+    REACHABILITY as before the join. Without the remap, a stale holder
+    index landing on a dead row silently drops fragments."""
+    from p2p_dhts_tpu.dhash import remap_holders
+
+    n_peers = 32
+    ring = build_ring(_random_ids(rng, n_peers), RingConfig(num_succs=3),
+                      capacity=40)  # headroom: joins must be real inserts
+    store = empty_store(4096, SMAX)
+    keys = keys_from_ints(_random_ids(rng, 6))
+    _, segs, lengths = _make_blocks(rng, 6)
+    store, okc = create_batch(ring, store, keys, segs, lengths,
+                              jnp.zeros(6, jnp.int32), N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(okc))
+    old_ids = ring.ids
+    id_ints = keyspace.lanes_to_ints(np.asarray(ring.ids[: int(ring.n_valid)]))
+    holder_ids_before = {
+        i: id_ints[int(store.holder[i])] for i in range(int(store.n_used))}
+
+    # Join peers whose ids sort BELOW existing rows (guaranteed shifts).
+    new_ids = [int.from_bytes(rng.bytes(15), "little") for _ in range(4)]
+    ring2, jrows = churn.join(
+        ring, jnp.asarray(keyspace.ints_to_lanes(new_ids)))
+    assert (np.asarray(jrows) >= 0).all()
+    store2 = remap_holders(old_ids, ring2, store)
+
+    # Every row's holder still names the same PEER (by id).
+    id_ints2 = keyspace.lanes_to_ints(
+        np.asarray(ring2.ids[: int(ring2.n_valid)]))
+    for i in range(int(store2.n_used)):
+        assert id_ints2[int(store2.holder[i])] == holder_ids_before[i], i
+
+    # Reads are fully intact immediately, no maintenance in between.
+    got, ok = read_batch(ring2, store2, keys, N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(ok))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(segs))
+
+
+def test_stale_holders_without_remap_degrade_reads(rng):
+    """The discriminating case for the remap: join K low-sorting ids so
+    every old row shifts by K, then fail the rows that STALE holder
+    indices now point at (none of which are true holders). The
+    un-remapped store loses fragments below the decode threshold; the
+    remapped store keeps full presence — an identity remap fails this
+    test."""
+    from p2p_dhts_tpu.dhash import remap_holders
+
+    # Deterministic ring: evenly spaced ids, one key owned mid-ring.
+    n_peers = 16
+    ids = [(i + 1) << 120 for i in range(n_peers)]
+    ring = build_ring(ids, RingConfig(num_succs=3), capacity=24)
+    store = empty_store(256, SMAX)
+    key_int = (ids[8] - 1) % (1 << 128)          # owner row 8
+    keys = keys_from_ints([key_int])
+    _, segs, lengths = _make_blocks(rng, 1)
+    store, ok = create_batch(ring, store, keys, segs, lengths,
+                             jnp.zeros(1, jnp.int32), N_IDA, M_IDA, P_IDA)
+    assert bool(ok[0])
+    holders_old = sorted(int(h) for h in
+                         store.holder[: int(store.n_used)])   # rows 8..12
+    assert holders_old == list(range(8, 8 + N_IDA))
+
+    k_join = 4
+    old_ids = ring.ids
+    new_ids = list(range(1, k_join + 1))          # sort below everything
+    ring2, jr = churn.join(ring, jnp.asarray(keyspace.ints_to_lanes(new_ids)))
+    assert (np.asarray(jr) >= 0).all()
+    # True holders are now rows 12..16; stale indices 8..12 point at
+    # other peers. Kill the stale-only rows 8..11.
+    ring2 = churn.fail(ring2, jnp.asarray([8, 9, 10, 11], jnp.int32))
+
+    start1 = jnp.zeros(1, jnp.int32)
+    pres_stale = presence_matrix(ring2, store, keys, start1, N_IDA)
+    pres_fixed = presence_matrix(ring2, remap_holders(old_ids, ring2, store),
+                                 keys, start1, N_IDA)
+    assert int(np.asarray(pres_stale).sum()) == 1, \
+        "stale holders must lose the 4 fragments pointing at dead rows"
+    assert int(np.asarray(pres_fixed).sum()) == N_IDA, \
+        "remapped holders must keep every fragment reachable"

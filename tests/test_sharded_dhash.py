@@ -309,9 +309,15 @@ def test_sharded_store_random_program_soak(seed):
     rng = np.random.RandomState(seed)
     mesh = peer_mesh()
     ids = [int.from_bytes(rng.bytes(16), "little") for _ in range(N_PEERS)]
-    ring = build_ring(ids, RingConfig(num_succs=3))
+    # Headroom above N_PEERS so the mid-program joins are real inserts
+    # (a full table REJECTS joins — test_join_full_table_rejects).
+    cap = N_PEERS + 16
+    ring = build_ring(ids, RingConfig(num_succs=3), capacity=cap)
     ref = empty_store(4096, SMAX)
-    sstore = shard_store(empty_store(4096, SMAX), mesh, N_PEERS)
+    sstore = shard_store(empty_store(4096, SMAX), mesh, cap)
+
+    from p2p_dhts_tpu import keyspace
+    from p2p_dhts_tpu.dhash import leave_handover, leave_handover_sharded
 
     all_keys = []
     for rnd in range(3):
@@ -332,11 +338,31 @@ def test_sharded_store_random_program_soak(seed):
                                             mesh=mesh)
         np.testing.assert_array_equal(np.asarray(ok_r), np.asarray(ok_s))
 
-        # Fail a couple of peers (within IDA tolerance), sweep.
+        # Full churn mix: fail 2, gracefully leave 2 (with fragment
+        # handover on both stores), rejoin the previous round's leavers
+        # under fresh ids, sweep.
         alive_rows = np.flatnonzero(np.asarray(ring.alive))
-        victims = rng.choice(alive_rows, size=2, replace=False)
-        ring = churn.stabilize_sweep(
-            churn.fail(ring, jnp.asarray(victims, jnp.int32)))
+        pick = rng.choice(alive_rows, size=4, replace=False)
+        victims, leavers = pick[:2], pick[2:]
+        ring = churn.fail(ring, jnp.asarray(victims, jnp.int32))
+        lv = jnp.asarray(leavers, jnp.int32)
+        ring = churn.leave(ring, lv)
+        ref = leave_handover(ring, ref, lv)
+        sstore = leave_handover_sharded(ring, sstore, lv, mesh=mesh)
+        ring = churn.stabilize_sweep(ring)
+        if rnd:
+            from p2p_dhts_tpu.dhash import (remap_holders,
+                                            remap_holders_sharded)
+            rejoin = [int.from_bytes(rng.bytes(16), "little")
+                      for _ in range(2)]
+            old_ids = ring.ids
+            ring, jrows = churn.join(
+                ring, jnp.asarray(keyspace.ints_to_lanes(rejoin)))
+            assert (np.asarray(jrows) >= 0).all()
+            ref = remap_holders(old_ids, ring, ref)
+            sstore = remap_holders_sharded(old_ids, ring, sstore,
+                                           mesh=mesh)
+            ring = churn.stabilize_sweep(ring)
 
         # Maintenance on both stores.
         ref = _sort_store(global_maintenance(
