@@ -240,3 +240,25 @@ def test_bucket_bits_scale_with_table_size(rng):
     want = u.searchsorted(ids, q)
     got = u.searchsorted_bucketed(ids, q, u.bucket_starts(ids, bits), bits)
     assert bool(jnp.all(want == got))
+
+
+def test_sort_dedup_keys(rng):
+    """Direct contract test for the shared candidate-dedup helper
+    (reconcile + sharded local maintenance): lexicographic sort, first
+    instance of each real key marked, repeats and all-0xFF sentinels
+    inert."""
+    import numpy as np
+    import jax.numpy as jnp
+    from p2p_dhts_tpu.ops import u128
+    from p2p_dhts_tpu import keyspace
+
+    ints = [int.from_bytes(rng.bytes(16), "little") for _ in range(6)]
+    batch = ints + [ints[0], ints[3], (1 << 128) - 1]  # dups + sentinel
+    lanes = jnp.asarray(keyspace.ints_to_lanes(batch))
+    s, ok = u128.sort_dedup_keys(lanes)
+    got_sorted = keyspace.lanes_to_ints(np.asarray(s))
+    assert got_sorted == sorted(batch)
+    kept = {got_sorted[i] for i in np.flatnonzero(np.asarray(ok))}
+    assert kept == set(ints), "exactly the distinct real keys survive"
+    # First-instance marking: every dup lane is inert.
+    assert int(np.asarray(ok).sum()) == len(set(ints))
