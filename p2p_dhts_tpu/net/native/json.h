@@ -1,0 +1,440 @@
+// Minimal order-preserving JSON value, parser, and minified writer.
+//
+// The reference leans on JsonCpp (src/networking/server.h, client.cpp); this
+// is the framework's own native JSON engine, shaped by what actually crosses
+// the DHT wire: objects / arrays / strings (128-bit ids travel as hex
+// strings, remote_peer.py:38-41) / int64 / bool / null, plus doubles for
+// completeness. Two deliberate behaviors mirror the Python layer so the two
+// servers are byte-interchangeable:
+//   * the writer emits Python json.dumps(separators=(",",":")) bytes —
+//     minified, ensure_ascii (non-ASCII escaped as \uXXXX, astral plane as
+//     surrogate pairs), no trailing-zero float games on the wire;
+//   * object member order is insertion order (Python dict semantics), so
+//     envelopes serialize with handler fields first, SUCCESS last.
+// Parsing ignores nothing: trailing garbage is the CALLER's concern (the
+// client sanitizes to the final '}' then parses a prefix, client.cpp:36-49 /
+// rpc.py sanitize_json), so parse_prefix() returns how much it consumed.
+#pragma once
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ns {
+
+struct Jv {
+  enum class T { Null, Bool, Int, Dbl, Str, Arr, Obj };
+  T t = T::Null;
+  bool b = false;
+  long long i = 0;
+  double d = 0;
+  std::string s;
+  std::vector<Jv> arr;
+  std::vector<std::pair<std::string, Jv>> obj;
+
+  static Jv null() { return Jv{}; }
+  static Jv of(bool v) { Jv j; j.t = T::Bool; j.b = v; return j; }
+  static Jv of(long long v) { Jv j; j.t = T::Int; j.i = v; return j; }
+  static Jv of(double v) { Jv j; j.t = T::Dbl; j.d = v; return j; }
+  static Jv of(std::string v) { Jv j; j.t = T::Str; j.s = std::move(v); return j; }
+  static Jv object() { Jv j; j.t = T::Obj; return j; }
+  static Jv array() { Jv j; j.t = T::Arr; return j; }
+
+  const Jv* find(const std::string& key) const {
+    if (t != T::Obj) return nullptr;
+    for (const auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+
+  // Insert-or-assign preserving first-insertion position (dict semantics).
+  void set(const std::string& key, Jv v) {
+    if (t != T::Obj) { t = T::Obj; obj.clear(); }
+    for (auto& kv : obj)
+      if (kv.first == key) { kv.second = std::move(v); return; }
+    obj.emplace_back(key, std::move(v));
+  }
+};
+
+// ---------------------------------------------------------------------------
+// writer
+// ---------------------------------------------------------------------------
+
+inline void dump_string(const std::string& s, std::string& out) {
+  out += '"';
+  size_t i = 0, n = s.size();
+  char tmp[16];
+  while (i < n) {
+    unsigned char c = s[i];
+    if (c == '"') { out += "\\\""; i++; }
+    else if (c == '\\') { out += "\\\\"; i++; }
+    else if (c == '\n') { out += "\\n"; i++; }
+    else if (c == '\r') { out += "\\r"; i++; }
+    else if (c == '\t') { out += "\\t"; i++; }
+    else if (c == '\b') { out += "\\b"; i++; }
+    else if (c == '\f') { out += "\\f"; i++; }
+    else if (c < 0x20) {
+      std::snprintf(tmp, sizeof tmp, "\\u%04x", c);
+      out += tmp; i++;
+    } else if (c < 0x80) {
+      out += char(c); i++;
+    } else {
+      // Decode one UTF-8 sequence -> codepoint -> \uXXXX (ensure_ascii).
+      uint32_t cp = 0xFFFD;
+      size_t len = 1;
+      if ((c & 0xE0) == 0xC0 && i + 1 < n) {
+        cp = (uint32_t(c & 0x1F) << 6) | uint32_t(s[i + 1] & 0x3F);
+        len = 2;
+      } else if ((c & 0xF0) == 0xE0 && i + 2 < n) {
+        cp = (uint32_t(c & 0x0F) << 12) | (uint32_t(s[i + 1] & 0x3F) << 6) |
+             uint32_t(s[i + 2] & 0x3F);
+        len = 3;
+      } else if ((c & 0xF8) == 0xF0 && i + 3 < n) {
+        cp = (uint32_t(c & 0x07) << 18) | (uint32_t(s[i + 1] & 0x3F) << 12) |
+             (uint32_t(s[i + 2] & 0x3F) << 6) | uint32_t(s[i + 3] & 0x3F);
+        len = 4;
+      }
+      if (cp >= 0x10000) {
+        uint32_t v = cp - 0x10000;
+        std::snprintf(tmp, sizeof tmp, "\\u%04x", 0xD800 + (v >> 10));
+        out += tmp;
+        std::snprintf(tmp, sizeof tmp, "\\u%04x", 0xDC00 + (v & 0x3FF));
+        out += tmp;
+      } else {
+        std::snprintf(tmp, sizeof tmp, "\\u%04x", cp);
+        out += tmp;
+      }
+      i += len;
+    }
+  }
+  out += '"';
+}
+
+inline void dump(const Jv& v, std::string& out) {
+  char tmp[32];
+  switch (v.t) {
+    case Jv::T::Null: out += "null"; break;
+    case Jv::T::Bool: out += v.b ? "true" : "false"; break;
+    case Jv::T::Int:
+      std::snprintf(tmp, sizeof tmp, "%lld", v.i);
+      out += tmp;
+      break;
+    case Jv::T::Dbl: {
+      // Shortest round-trip like Python repr: try increasing precision.
+      for (int prec = 1; prec <= 17; prec++) {
+        std::snprintf(tmp, sizeof tmp, "%.*g", prec, v.d);
+        if (std::strtod(tmp, nullptr) == v.d) break;
+      }
+      out += tmp;
+      // Python emits a ".0" for integral floats; %g drops it.
+      if (!std::strpbrk(tmp, ".eEnN")) out += ".0";
+      break;
+    }
+    case Jv::T::Str: dump_string(v.s, out); break;
+    case Jv::T::Arr: {
+      out += '[';
+      for (size_t i = 0; i < v.arr.size(); i++) {
+        if (i) out += ',';
+        dump(v.arr[i], out);
+      }
+      out += ']';
+      break;
+    }
+    case Jv::T::Obj: {
+      out += '{';
+      for (size_t i = 0; i < v.obj.size(); i++) {
+        if (i) out += ',';
+        dump_string(v.obj[i].first, out);
+        out += ':';
+        dump(v.obj[i].second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+inline std::string dumps(const Jv& v) {
+  std::string out;
+  dump(v, out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// parser (recursive descent)
+// ---------------------------------------------------------------------------
+
+class Parser {
+ public:
+  Parser(const char* p, size_t n) : p_(p), n_(n) {}
+
+  // Parses one JSON value from the front; on success sets *consumed to the
+  // index one past the value (trailing bytes left for the caller, like
+  // json.JSONDecoder.raw_decode). Returns false with err_ set on failure.
+  bool parse_prefix(Jv& out, size_t* consumed) {
+    i_ = 0; err_.clear(); depth_ = 0;
+    skip_ws();
+    if (!value(out)) return false;
+    if (consumed) *consumed = i_;
+    return true;
+  }
+
+  const std::string& error() const { return err_; }
+
+ private:
+  static constexpr int kMaxDepth = 256;
+
+  void skip_ws() {
+    while (i_ < n_ && (p_[i_] == ' ' || p_[i_] == '\t' || p_[i_] == '\n' ||
+                       p_[i_] == '\r'))
+      i_++;
+  }
+
+  bool fail(const char* msg) {
+    char tmp[96];
+    std::snprintf(tmp, sizeof tmp, "%s at offset %zu", msg, i_);
+    err_ = tmp;
+    return false;
+  }
+
+  bool value(Jv& out) {
+    if (depth_ > kMaxDepth) return fail("nesting too deep");
+    if (i_ >= n_) return fail("unexpected end of input");
+    char c = p_[i_];
+    if (c == '{') return object(out);
+    if (c == '[') return array(out);
+    if (c == '"') {
+      out.t = Jv::T::Str;
+      return string(out.s);
+    }
+    if (c == 't' || c == 'f') return boolean(out);
+    if (c == 'n') return null_(out);
+    if (c == '-' || (c >= '0' && c <= '9')) return number(out);
+    return fail("unexpected character");
+  }
+
+  bool literal(const char* lit) {
+    size_t len = std::strlen(lit);
+    if (i_ + len > n_ || std::memcmp(p_ + i_, lit, len) != 0)
+      return fail("invalid literal");
+    i_ += len;
+    return true;
+  }
+
+  bool boolean(Jv& out) {
+    out.t = Jv::T::Bool;
+    if (p_[i_] == 't') { out.b = true; return literal("true"); }
+    out.b = false;
+    return literal("false");
+  }
+
+  bool null_(Jv& out) {
+    out.t = Jv::T::Null;
+    return literal("null");
+  }
+
+  bool number(Jv& out) {
+    size_t start = i_;
+    if (i_ < n_ && p_[i_] == '-') i_++;
+    while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
+    bool is_dbl = false;
+    if (i_ < n_ && p_[i_] == '.') {
+      is_dbl = true;
+      i_++;
+      while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
+    }
+    if (i_ < n_ && (p_[i_] == 'e' || p_[i_] == 'E')) {
+      is_dbl = true;
+      i_++;
+      if (i_ < n_ && (p_[i_] == '+' || p_[i_] == '-')) i_++;
+      while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
+    }
+    std::string tok(p_ + start, i_ - start);
+    if (tok.empty() || tok == "-") return fail("invalid number");
+    if (!is_dbl) {
+      errno = 0;
+      char* end = nullptr;
+      long long v = std::strtoll(tok.c_str(), &end, 10);
+      if (errno != ERANGE && end && *end == '\0') {
+        out.t = Jv::T::Int;
+        out.i = v;
+        return true;
+      }
+      // Out of int64 range: fall through to double (ids never do this —
+      // they are hex strings on the wire).
+    }
+    out.t = Jv::T::Dbl;
+    out.d = std::strtod(tok.c_str(), nullptr);
+    return true;
+  }
+
+  void append_utf8(uint32_t cp, std::string& s) {
+    if (cp < 0x80) {
+      s += char(cp);
+    } else if (cp < 0x800) {
+      s += char(0xC0 | (cp >> 6));
+      s += char(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      s += char(0xE0 | (cp >> 12));
+      s += char(0x80 | ((cp >> 6) & 0x3F));
+      s += char(0x80 | (cp & 0x3F));
+    } else {
+      s += char(0xF0 | (cp >> 18));
+      s += char(0x80 | ((cp >> 12) & 0x3F));
+      s += char(0x80 | ((cp >> 6) & 0x3F));
+      s += char(0x80 | (cp & 0x3F));
+    }
+  }
+
+  bool hex4(uint32_t& v) {
+    if (i_ + 4 > n_) return fail("bad \\u escape");
+    v = 0;
+    for (int k = 0; k < 4; k++) {
+      char c = p_[i_ + k];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= uint32_t(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= uint32_t(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= uint32_t(c - 'A' + 10);
+      else return fail("bad \\u escape");
+    }
+    i_ += 4;
+    return true;
+  }
+
+  bool string(std::string& out) {
+    out.clear();
+    i_++;  // opening quote
+    while (true) {
+      if (i_ >= n_) return fail("unterminated string");
+      unsigned char c = p_[i_];
+      if (c == '"') { i_++; return true; }
+      if (c == '\\') {
+        i_++;
+        if (i_ >= n_) return fail("bad escape");
+        char e = p_[i_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            uint32_t hi;
+            if (!hex4(hi)) return false;
+            if (hi >= 0xD800 && hi < 0xDC00 && i_ + 1 < n_ &&
+                p_[i_] == '\\' && p_[i_ + 1] == 'u') {
+              i_ += 2;
+              uint32_t lo;
+              if (!hex4(lo)) return false;
+              if (lo >= 0xDC00 && lo < 0xE000) {
+                hi = 0x10000 + ((hi - 0xD800) << 10) + (lo - 0xDC00);
+              } else {
+                // Unpaired: emit both codepoints independently.
+                append_utf8(hi, out);
+                hi = lo;
+              }
+            }
+            append_utf8(hi, out);
+            break;
+          }
+          default:
+            return fail("bad escape");
+        }
+      } else if (c < 0x20) {
+        return fail("control character in string");
+      } else {
+        out += char(c);
+        i_++;
+      }
+    }
+  }
+
+  bool array(Jv& out) {
+    out.t = Jv::T::Arr;
+    out.arr.clear();
+    depth_++;
+    i_++;  // [
+    skip_ws();
+    if (i_ < n_ && p_[i_] == ']') { i_++; depth_--; return true; }
+    while (true) {
+      Jv elem;
+      if (!value(elem)) return false;
+      out.arr.push_back(std::move(elem));
+      skip_ws();
+      if (i_ >= n_) return fail("unterminated array");
+      if (p_[i_] == ',') { i_++; skip_ws(); continue; }
+      if (p_[i_] == ']') { i_++; depth_--; return true; }
+      return fail("expected ',' or ']'");
+    }
+  }
+
+  bool object(Jv& out) {
+    out.t = Jv::T::Obj;
+    out.obj.clear();
+    depth_++;
+    i_++;  // {
+    skip_ws();
+    if (i_ < n_ && p_[i_] == '}') { i_++; depth_--; return true; }
+    while (true) {
+      skip_ws();
+      if (i_ >= n_ || p_[i_] != '"') return fail("expected object key");
+      std::string key;
+      if (!string(key)) return false;
+      skip_ws();
+      if (i_ >= n_ || p_[i_] != ':') return fail("expected ':'");
+      i_++;
+      skip_ws();
+      Jv val;
+      if (!value(val)) return false;
+      out.obj.emplace_back(std::move(key), std::move(val));
+      skip_ws();
+      if (i_ >= n_) return fail("unterminated object");
+      if (p_[i_] == ',') { i_++; continue; }
+      if (p_[i_] == '}') { i_++; depth_--; return true; }
+      return fail("expected ',' or '}'");
+    }
+  }
+
+  const char* p_;
+  size_t n_;
+  size_t i_ = 0;
+  int depth_ = 0;
+  std::string err_;
+};
+
+inline bool parse_prefix(const std::string& text, Jv& out, size_t* consumed,
+                         std::string* err) {
+  Parser p(text.data(), text.size());
+  bool ok = p.parse_prefix(out, consumed);
+  if (!ok && err) *err = p.error();
+  return ok;
+}
+
+// Strict parse: the whole text must be one JSON value plus whitespace
+// (what the server applies to a request body, rpc.py:306).
+inline bool parse_all(const std::string& text, Jv& out, std::string* err) {
+  Parser p(text.data(), text.size());
+  size_t consumed = 0;
+  if (!p.parse_prefix(out, &consumed)) {
+    if (err) *err = p.error();
+    return false;
+  }
+  for (size_t i = consumed; i < text.size(); i++) {
+    char c = text[i];
+    if (c != ' ' && c != '\t' && c != '\n' && c != '\r') {
+      if (err) *err = "trailing data after JSON value";
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace ns

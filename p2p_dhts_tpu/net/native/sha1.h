@@ -1,0 +1,106 @@
+// SHA-1 and RFC 4122 UUIDv5 — the native id-derivation kernel.
+//
+// The reference derives every peer/key id by SHA-1 of plaintext through
+// boost::uuids::name_generator (key.h:29-33, abstract_chord_peer.cpp:13-28),
+// which is exactly RFC 4122 UUIDv5 over the DNS namespace. The Python layer
+// mirrors it with uuid.uuid5 (keyspace.py); this header is the native twin,
+// pinned bit-identical by tests/test_native_rpc.py.
+//
+// Self-contained SHA-1 (FIPS 180-1) — no OpenSSL in this environment.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace ns {
+
+class Sha1 {
+ public:
+  Sha1() { reset(); }
+
+  void reset() {
+    h_[0] = 0x67452301u; h_[1] = 0xEFCDAB89u; h_[2] = 0x98BADCFEu;
+    h_[3] = 0x10325476u; h_[4] = 0xC3D2E1F0u;
+    len_ = 0; buf_used_ = 0;
+  }
+
+  void update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len_ += n;
+    while (n) {
+      size_t take = 64 - buf_used_;
+      if (take > n) take = n;
+      std::memcpy(buf_ + buf_used_, p, take);
+      buf_used_ += take; p += take; n -= take;
+      if (buf_used_ == 64) { block(buf_); buf_used_ = 0; }
+    }
+  }
+
+  // Writes the 20-byte digest.
+  void final(uint8_t out[20]) {
+    uint64_t bit_len = len_ * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_used_ != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bit_len >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 5; i++)
+      for (int j = 0; j < 4; j++)
+        out[4 * i + j] = uint8_t(h_[i] >> (24 - 8 * j));
+  }
+
+ private:
+  static uint32_t rol(uint32_t x, int s) { return (x << s) | (x >> (32 - s)); }
+
+  void block(const uint8_t* p) {
+    uint32_t w[80];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 80; i++)
+      w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+    uint32_t a = h_[0], b = h_[1], c = h_[2], d = h_[3], e = h_[4];
+    for (int i = 0; i < 80; i++) {
+      uint32_t f, k;
+      if (i < 20)      { f = (b & c) | (~b & d);          k = 0x5A827999u; }
+      else if (i < 40) { f = b ^ c ^ d;                   k = 0x6ED9EBA1u; }
+      else if (i < 60) { f = (b & c) | (b & d) | (c & d); k = 0x8F1BBCDCu; }
+      else             { f = b ^ c ^ d;                   k = 0xCA62C1D6u; }
+      uint32_t t = rol(a, 5) + f + e + k + w[i];
+      e = d; d = c; c = rol(b, 30); b = a; a = t;
+    }
+    h_[0] += a; h_[1] += b; h_[2] += c; h_[3] += d; h_[4] += e;
+  }
+
+  uint32_t h_[5];
+  uint64_t len_;
+  uint8_t buf_[64];
+  size_t buf_used_;
+};
+
+// RFC 4122 namespace UUID for DNS: 6ba7b810-9dad-11d1-80b4-00c04fd430c8.
+inline const uint8_t* uuid5_dns_namespace() {
+  static const uint8_t ns[16] = {0x6b, 0xa7, 0xb8, 0x10, 0x9d, 0xad, 0x11,
+                                 0xd1, 0x80, 0xb4, 0x00, 0xc0, 0x4f, 0xd4,
+                                 0x30, 0xc8};
+  return ns;
+}
+
+// UUIDv5(DNS, name) -> 16 big-endian bytes. Matches uuid.uuid5 /
+// boost::uuids::name_generator: sha1(namespace || name)[0:16] with the
+// version nibble forced to 5 and the variant bits to 10.
+inline void uuid5_dns(const std::string& name, uint8_t out[16]) {
+  Sha1 h;
+  h.update(uuid5_dns_namespace(), 16);
+  h.update(name.data(), name.size());
+  uint8_t digest[20];
+  h.final(digest);
+  std::memcpy(out, digest, 16);
+  out[6] = uint8_t((out[6] & 0x0F) | 0x50);
+  out[8] = uint8_t((out[8] & 0x3F) | 0x80);
+}
+
+}  // namespace ns

@@ -54,6 +54,18 @@ def sanitize_json(payload: str) -> str:
     return payload[: end + 1] if end >= 0 else payload
 
 
+def parse_reply(raw: str) -> JsonObj:
+    """Reply-path parse: sanitize, then take the first JSON value ignoring
+    trailing bytes (JsonCpp failIfExtra=false behavior). The single home of
+    this rule — rpc.Client and native_rpc.NativeClient both route through
+    it, so the wire-parity contract cannot silently fork."""
+    try:
+        obj, _ = json.JSONDecoder().raw_decode(sanitize_json(raw))
+        return obj
+    except json.JSONDecodeError as exc:
+        raise RpcError(f"Error parsing response: {exc}") from exc
+
+
 class RequestLog:
     """Fixed-size FIFO of parsed requests (ref ThreadSafeQueue<Json::Value>,
     thread_safe_queue.h:23-148): PushBack evicts the oldest when full."""
@@ -137,14 +149,7 @@ class Client:
             raise
         except OSError as exc:
             raise RpcError(f"RPC transport failure: {exc}") from exc
-        raw = b"".join(chunks).decode("utf-8", errors="replace")
-        try:
-            # raw_decode parses the first complete JSON value and ignores
-            # trailing bytes — JsonCpp's failIfExtra=false behavior.
-            obj, _ = json.JSONDecoder().raw_decode(sanitize_json(raw))
-            return obj
-        except json.JSONDecodeError as exc:
-            raise RpcError(f"Error parsing response: {exc}") from exc
+        return parse_reply(b"".join(chunks).decode("utf-8", errors="replace"))
 
     @staticmethod
     def is_alive(ip_addr: str, port: int, timeout: float = 1.0) -> bool:
@@ -268,6 +273,11 @@ class Server:
 
     def is_alive(self) -> bool:
         return self._alive
+
+    def update_handlers(self, handlers: Dict[str, Handler]) -> None:
+        """Register additional command handlers (peers construct the server
+        first — the bound port feeds their id — then attach handlers)."""
+        self.handlers.update(handlers)
 
     def get_log(self) -> List[JsonObj]:
         """ref Server::GetLog (server.h:399-402)."""

@@ -52,7 +52,8 @@ class AbstractChordPeer:
     def __init__(self, ip_addr: str, port: int, num_succs: int,
                  backend: str = "python",
                  maintenance_interval: Optional[float] = 5.0,
-                 num_server_threads: int = 3):
+                 num_server_threads: int = 3,
+                 server_backend: str = "python"):
         # num_server_threads defaults to the reference's 3 io workers
         # (chord_peer.cpp:42). Deep recursive handler chains right after
         # mass churn can exhaust 3 workers and wedge until the client
@@ -63,9 +64,20 @@ class AbstractChordPeer:
         self.backend = backend
         self.maintenance_interval = maintenance_interval
 
-        self.server = Server(port, {}, num_threads=num_server_threads)
+        # server_backend="native" serves this peer's RPCs from the C++
+        # engine (net/native/rpc_engine.cc) — the rebuild's counterpart of
+        # the reference's native asio runtime; "python" is net/rpc.py.
+        # Both speak the same wire bytes (tests/test_native_rpc.py).
+        if server_backend == "native":
+            from p2p_dhts_tpu.net.native_rpc import NativeServer
+            self.server = NativeServer(port, {},
+                                       num_threads=num_server_threads)
+        elif server_backend == "python":
+            self.server = Server(port, {}, num_threads=num_server_threads)
+        else:
+            raise ValueError(f"unknown server_backend {server_backend!r}")
         self.port = self.server.port
-        self.server.handlers.update(self.handlers())
+        self.server.update_handlers(self.handlers())
 
         # id = SHA1("ip:port") (abstract_chord_peer.cpp:13-28)
         self.id = Key.from_plaintext(f"{self.ip_addr}:{self.port}")
@@ -486,10 +498,12 @@ class ChordPeer(AbstractChordPeer):
     def __init__(self, ip_addr: str, port: int, num_succs: int,
                  backend: str = "python",
                  maintenance_interval: Optional[float] = 5.0,
-                 num_server_threads: int = 3):
+                 num_server_threads: int = 3,
+                 server_backend: str = "python"):
         self.db = TextDb()
         super().__init__(ip_addr, port, num_succs, backend,
-                         maintenance_interval, num_server_threads)
+                         maintenance_interval, num_server_threads,
+                         server_backend)
 
     def handlers(self):
         return {

@@ -53,11 +53,13 @@ class DHashPeer(AbstractChordPeer):
     def __init__(self, ip_addr: str, port: int, num_replicas: int,
                  backend: str = "python",
                  maintenance_interval: Optional[float] = 5.0,
-                 num_server_threads: int = 3):
+                 num_server_threads: int = 3,
+                 server_backend: str = "python"):
         self.db = FragmentDb()
         self.n, self.m, self.p = 14, 10, 257
         super().__init__(ip_addr, port, num_replicas, backend,
-                         maintenance_interval, num_server_threads)
+                         maintenance_interval, num_server_threads,
+                         server_backend)
 
     def handlers(self):
         return {
