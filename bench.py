@@ -271,6 +271,20 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
     assert bool(jnp.all(decoded == segments)), \
         "IDA round-trip mismatch"  # decode returns [B, S, m] like segments
 
+    # The fused Pallas decode tile (ops/modp_pallas.py) — measured against
+    # the XLA path so the default can follow the hardware's verdict.
+    pal_t = None
+    pal = None
+    try:  # import/lowering failure degrades; a WRONG RESULT must hard-fail
+        from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
+        pal = decode_kernel_pallas(rows, idx, p)
+        _sync(pal)  # compile/lowering errors surface at the sync
+    except Exception as exc:
+        print(f"# pallas decode unavailable: {exc}", file=sys.stderr)
+    if pal is not None:
+        assert bool(jnp.all(pal == segments)), "pallas decode mismatch"
+        pal_t = _time(lambda: (decode_kernel_pallas(rows, idx, p),))
+
     return _emit({
         "config": "ida",
         "metric": f"IDA encode/decode MB/s (n={n} m={m} p={p}, "
@@ -278,6 +292,8 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
         "value": round(payload_mb / enc_t, 1),
         "unit": "MB/s encode",
         "decode_mb_s": round(payload_mb / dec_t, 1),
+        "decode_pallas_mb_s":
+            round(payload_mb / pal_t, 1) if pal_t else None,
         "vs_baseline": None,
         "round_trip": "ok",
     })

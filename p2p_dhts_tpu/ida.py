@@ -108,7 +108,9 @@ def decode_kernel(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
     heterogeneous index sets batch together.
     """
     inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
-    out = modp.mod_matmul(inv, rows, p)                  # [..., m, S]
+    # Per-batch inverses make this a genuinely batched tiny matmul — the
+    # MXU-padding cliff shape — so it takes the VPU broadcast-reduce path.
+    out = modp.mod_matmul_batched_tiny(inv, rows, p)     # [..., m, S]
     return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
 
 

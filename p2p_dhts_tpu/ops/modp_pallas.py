@@ -1,0 +1,134 @@
+"""Pallas TPU kernel for batched IDA decode — inverse + matmul fused in VMEM.
+
+The decode hot path (ida.py decode_kernel; ref ida.cpp:120-141 +
+matrix_math.cpp:103-168) computes, per block, a mod-p inverse Vandermonde
+from that block's fragment indices and applies it to the fragment rows.
+Through XLA this is several kernels (the unrolled Lagrange chain, then a
+broadcast-multiply-reduce) with [B, m, S]-sized intermediates round-tripping
+HBM. Here the whole per-block pipeline — Lagrange synthetic division,
+Fermat inverse of the denominators, coefficient scaling, and the m x m
+matmul — runs fused in one Pallas program per batch tile, entirely in VMEM.
+
+Kernel-shape choices (see /opt/skills/guides/pallas_guide.md):
+  * every tensor op is >= 2-D with the segment axis (S, a multiple of 128
+    in practice) last, so the VPU lanes stay full; the m-sized axes are
+    tiny and ride the sublane dim;
+  * the m-degree recurrences unroll at trace time (m is static), operating
+    on [TB, 1] / [TB, m] tiles — no minor-dim transpose, stack, or gather;
+  * the matmul is m^2 unrolled outer-product accumulations onto [TB, S]
+    f32 tiles (exact: m * (p-1)^2 < 2^24, the same bound ops/modp.py
+    enforces for its MXU path).
+
+Parity with ops/modp.py's vandermonde_inverse + mod_matmul_batched_tiny is
+pinned by tests/test_ida.py (interpret mode on CPU, compiled on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# f32 sublane height — one tile of blocks per program.
+_TILE_B = 8
+
+
+def _decode_tile_kernel(idx_ref, rows_ref, out_ref, *, m: int, p: int):
+    """One batch tile: idx [TB, m] int32, rows [TB, m, S] int32 ->
+    out [TB, m, S] int32 (segments transposed back by the caller)."""
+    basis = idx_ref[:] % p                                   # [TB, m]
+
+    # Master polynomial P(x) = prod_t (x - b_t), coefficients ascending,
+    # kept as m+1 separate [TB, 1] columns so the recurrence never needs a
+    # lane-axis shift/concat.
+    tb = basis.shape[0]
+    zero = jnp.zeros((tb, 1), jnp.int32)
+    coeffs = [zero] * (m + 1)
+    coeffs[0] = jnp.ones((tb, 1), jnp.int32)
+    for t in range(m):
+        b_t = basis[:, t:t + 1]                              # [TB, 1]
+        new = [zero] * (m + 1)
+        for j in range(m + 1):
+            shifted = coeffs[j - 1] if j > 0 else zero
+            new[j] = (shifted - b_t * coeffs[j]) % p
+        coeffs = new
+
+    # Synthetic division of P by (x - b_i) for all i at once, descending:
+    # qs[k][b, i] = coeff of x^(m-1-k) in l_i's numerator.
+    qs = [jnp.ones((tb, m), jnp.int32)]
+    for k in range(1, m):
+        qs.append((coeffs[m - k] + basis * qs[-1]) % p)
+
+    # Denominators d_i = prod_{t != i} (b_i - b_t), then Fermat inverse.
+    col = jax.lax.broadcasted_iota(jnp.int32, (tb, m), 1)
+    denom = jnp.ones((tb, m), jnp.int32)
+    for t in range(m):
+        d = (basis - basis[:, t:t + 1]) % p
+        d = jnp.where(col == t, 1, d)
+        denom = (denom * d) % p
+    inv_denom = jnp.ones((tb, m), jnp.int32)
+    sq = denom
+    e = p - 2
+    while e > 0:
+        if e & 1:
+            inv_denom = (inv_denom * sq) % p
+        sq = (sq * sq) % p
+        e >>= 1
+
+    # out[b, r, s] = sum_i inv[b, r, i] * rows[b, i, s] mod p, with
+    # inv[b, r, i] = (qs[m-1-r][b, i] * inv_denom[b, i]) mod p. Unrolled
+    # m^2 outer products accumulating f32 [TB, S] tiles.
+    for r in range(m):
+        acc = None
+        for i in range(m):
+            c = (qs[m - 1 - r][:, i:i + 1] * inv_denom[:, i:i + 1]) % p
+            term = c.astype(jnp.float32) * rows_ref[:, i, :].astype(
+                jnp.float32)
+            acc = term if acc is None else acc + term
+        out_ref[:, r, :] = acc.astype(jnp.int32) % p
+
+
+@functools.partial(jax.jit, static_argnames=("p", "interpret"))
+def decode_kernel_pallas(rows: jax.Array, indices: jax.Array, p: int,
+                         interpret: bool = False) -> jax.Array:
+    """Pallas twin of ida.decode_kernel: [B, m, S] rows + [B, m] 1-based
+    indices -> [B, S, m] segments. `interpret=True` runs the kernel in the
+    Pallas interpreter (CPU tests)."""
+    b, m, s = rows.shape
+    # Same exactness bound mod_matmul enforces: the kernel accumulates in
+    # f32 and squares int32 residues, both of which overflow silently for
+    # large p. The practical IDA modulus is 257.
+    if m * (p - 1) * (p - 1) >= (1 << 24) or (p - 1) * (p - 1) > 2**31 - 1:
+        raise ValueError(
+            f"decode_kernel_pallas requires m*(p-1)^2 < 2^24 (exact f32 "
+            f"accumulation), got m={m} p={p}; use ida.decode_kernel")
+    if b == 0:
+        return jnp.zeros((0, s, m), jnp.int32)
+    pad = (-b) % _TILE_B
+    if pad:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((pad, m, s), rows.dtype)], axis=0)
+        # Padding rows still need DISTINCT indices: a singular Vandermonde
+        # would divide by zero mod p. 1..m is always valid.
+        indices = jnp.concatenate(
+            [indices,
+             jnp.broadcast_to(jnp.arange(1, m + 1, dtype=jnp.int32),
+                              (pad, m))], axis=0)
+    bp = rows.shape[0]
+
+    out = pl.pallas_call(
+        functools.partial(_decode_tile_kernel, m=m, p=p),
+        grid=(bp // _TILE_B,),
+        in_specs=[
+            pl.BlockSpec((_TILE_B, m), lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_B, m, s), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((_TILE_B, m, s), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, m, s), jnp.int32),
+        interpret=interpret,
+    )(indices.astype(jnp.int32), rows.astype(jnp.int32))
+
+    return jnp.swapaxes(out[:b], -1, -2)

@@ -47,6 +47,55 @@ def test_mod_matmul_exact(rng, p):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("p", [257, 1009, 45007])
+def test_mod_matmul_batched_tiny_matches_dot_path(rng, p):
+    """The decode-shape VPU path (batched tiny matrices) must agree with
+    the MXU dot path bit-for-bit — incl. p large enough to force the
+    chunked wide fallback."""
+    a = rng.randint(0, p, size=(17, 10, 10)).astype(np.int32)
+    b = rng.randint(0, p, size=(17, 10, 64)).astype(np.int32)
+    got = np.asarray(
+        modp.mod_matmul_batched_tiny(jnp.asarray(a), jnp.asarray(b), p))
+    want = np.asarray(modp.mod_matmul(jnp.asarray(a), jnp.asarray(b), p))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_decode_matches_xla_path(rng):
+    """The fused Pallas decode tile (ops/modp_pallas.py) must reproduce
+    decode_kernel exactly — interpret mode here (CPU); the TPU lowering is
+    exercised by bench.py's ida config. Small n/m keeps the interpreter's
+    unrolled graph cheap; the full n=14/m=10 shape runs in the soak tier."""
+    from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+    from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
+    n, m, p, s, b = 6, 4, 257, 128, 11      # b deliberately not 8-aligned
+    segs = jnp.asarray(rng.randint(0, 256, size=(b, s, m)), jnp.int32)
+    frags = encode_kernel(segs, n, m, p)
+    sel = np.stack([rng.choice(n, size=m, replace=False) for _ in range(b)])
+    rows = jnp.take_along_axis(frags, jnp.asarray(sel)[:, :, None], axis=1)
+    idx = jnp.asarray(sel + 1, jnp.int32)
+    want = decode_kernel(rows, idx, p)
+    got = decode_kernel_pallas(rows, idx, p, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(segs))
+
+
+@pytest.mark.soak
+def test_pallas_decode_full_shape(rng):
+    """Full reference shape (n=14, m=10) through the Pallas tile."""
+    from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+    from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
+    n, m, p, s, b = 14, 10, 257, 128, 16
+    segs = jnp.asarray(rng.randint(0, 256, size=(b, s, m)), jnp.int32)
+    frags = encode_kernel(segs, n, m, p)
+    sel = np.stack([rng.choice(n, size=m, replace=False) for _ in range(b)])
+    rows = jnp.take_along_axis(frags, jnp.asarray(sel)[:, :, None], axis=1)
+    idx = jnp.asarray(sel + 1, jnp.int32)
+    got = decode_kernel_pallas(rows, idx, p, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(segs))
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(decode_kernel(rows, idx, p)))
+
+
 def test_mod_inverse_fermat():
     p = 257
     xs = jnp.arange(1, p, dtype=jnp.int32)
