@@ -1,0 +1,1058 @@
+// Native C++ Chord peer — full protocol logic in native code.
+//
+// The reference's peers ARE native C++ objects (ChordPeer,
+// src/chord/chord_peer.{h,cpp} + abstract_chord_peer.{h,cpp}); this is the
+// rebuild's native peer on top of engine.h's client/server. It speaks the
+// same wire protocol and protocol semantics as overlay/chord_peer.py —
+// join/notify/leave/stabilize/rectify/get_succ/get_pred/create/read, the
+// linear-scan finger table, the ring-sorted bounded successor list, key
+// transfer on notify-from-pred — so native and Python peers interleave
+// freely in one ring (pinned by tests/test_native_rpc.py's mixed-ring
+// integration tests). Exported through the same C ABI .so via ctypes
+// (overlay/native_peer.py).
+//
+// Concurrency mirrors the Python/reference discipline: one recursive mutex
+// per structure (finger table, successor list, db, predecessor cell), never
+// held across an outbound RPC — two peers mid-stabilize calling into each
+// other must not deadlock (the reference gets this from per-structure
+// ThreadSafe locks, thread_safe.h:7-19).
+//
+// Keys are unsigned __int128 (ids travel as lowercase minimal hex, exactly
+// keyspace.Key's str form / IntToHexStr, key.h:41-47).
+
+#include <algorithm>
+#include <chrono>
+#include <map>
+#include <memory>
+#include <optional>
+#include <stdexcept>
+
+#include "engine.h"
+
+namespace nc {
+
+using ns::Jv;
+using u128 = unsigned __int128;
+
+constexpr int kNumFingers = 128;  // finger_table.h:44 (binary key length)
+
+// ---------------------------------------------------------------------------
+// key helpers (keyspace.Key twins)
+// ---------------------------------------------------------------------------
+
+std::string hex_of(u128 v) {
+  if (v == 0) return "0";
+  char buf[33];
+  int i = 32;
+  buf[32] = '\0';
+  while (v) {
+    buf[--i] = "0123456789abcdef"[int(v & 0xF)];
+    v >>= 4;
+  }
+  return std::string(buf + i);
+}
+
+u128 parse_hex(const std::string& s) {
+  if (s.empty()) throw std::runtime_error("bad hex key: empty");
+  u128 v = 0;
+  for (char c : s) {
+    v <<= 4;
+    if (c >= '0' && c <= '9') v |= u128(c - '0');
+    else if (c >= 'a' && c <= 'f') v |= u128(c - 'a' + 10);
+    else if (c >= 'A' && c <= 'F') v |= u128(c - 'A' + 10);
+    else throw std::runtime_error("bad hex key: " + s);
+  }
+  return v;
+}
+
+// Clockwise range membership, quirk-faithful to key.h:103-131 /
+// keyspace.Key.in_between.
+bool in_between(u128 v, u128 lb, u128 ub, bool inclusive) {
+  if (lb == ub) return v == ub;
+  if (lb < ub) return inclusive ? (lb <= v && v <= ub) : (lb < v && v < ub);
+  // Wrapped: complement of the un-wrapped (ub, lb) interval.
+  return !(inclusive ? (ub < v && v < lb) : (ub <= v && v <= lb));
+}
+
+u128 id_for(const std::string& ip, int port) {
+  uint8_t raw[16];
+  ns::uuid5_dns(ip + ":" + std::to_string(port), raw);
+  u128 v = 0;
+  for (int i = 0; i < 16; i++) v = (v << 8) | u128(raw[i]);
+  return v;
+}
+
+// ---------------------------------------------------------------------------
+// remote peer stub (overlay/remote_peer.py RemotePeer twin)
+// ---------------------------------------------------------------------------
+
+// One-shot JSON RPC (connect/send/parse/free in one place); throws on
+// transport or parse failure. Used by NPeer::send_request and join().
+Jv rpc_json(const std::string& ip, int port, const Jv& req) {
+  char* out = nullptr;
+  int rc = ns::make_request(ip.c_str(), port, ns::dumps(req).c_str(),
+                            ns::kDefaultTimeoutS, &out);
+  std::string text = out ? out : "";
+  std::free(out);
+  if (rc != 0) throw std::runtime_error("RPC failed: " + text);
+  Jv resp;
+  std::string err;
+  if (!ns::parse_all(text, resp, &err))
+    throw std::runtime_error("Error parsing response: " + err);
+  return resp;
+}
+
+struct NPeer {
+  u128 id = 0;
+  u128 min_key = 0;
+  std::string ip;
+  int port = 0;
+
+  Jv to_json() const {
+    Jv o = Jv::object();
+    o.set("IP_ADDR", Jv::of(ip));
+    o.set("PORT", Jv::of((long long)port));
+    o.set("ID", Jv::of(hex_of(id)));
+    o.set("MIN_KEY", Jv::of(hex_of(min_key)));
+    return o;
+  }
+
+  static NPeer from_json(const Jv& o) {
+    const Jv* port = o.find("PORT");
+    if (!port || port->t != Jv::T::Int || port->i == 0)
+      throw std::runtime_error("Corrupted JSON");
+    const Jv* id = o.find("ID");
+    const Jv* mk = o.find("MIN_KEY");
+    const Jv* ip = o.find("IP_ADDR");
+    if (!id || id->t != Jv::T::Str || !mk || mk->t != Jv::T::Str ||
+        !ip || ip->t != Jv::T::Str)
+      throw std::runtime_error("Corrupted JSON");
+    NPeer p;
+    p.id = parse_hex(id->s);
+    p.min_key = parse_hex(mk->s);
+    p.ip = ip->s;
+    p.port = int(port->i);
+    return p;
+  }
+
+  bool is_alive() const { return ns::is_alive(ip.c_str(), port, 1.0) != 0; }
+
+  // ref SendRequest (remote_peer.cpp:28-41): liveness gate, throw on
+  // SUCCESS=false.
+  Jv send_request(const Jv& req) const {
+    if (!is_alive()) throw std::runtime_error("Peer is down.");
+    Jv resp = rpc_json(ip, port, req);
+    const Jv* ok = resp.find("SUCCESS");
+    if (ok && ok->t == Jv::T::Bool && ok->b) return resp;
+    throw std::runtime_error("Failed request: " + ns::dumps(resp));
+  }
+
+  NPeer get_succ() const {  // GET_SUCC(id + 1) (remote_peer.cpp:48-57)
+    Jv r = Jv::object();
+    r.set("COMMAND", Jv::of(std::string("GET_SUCC")));
+    r.set("KEY", Jv::of(hex_of(id + 1)));
+    return from_json(send_request(r));
+  }
+
+  NPeer get_pred() const {  // GET_PRED(id) (remote_peer.cpp:59-68)
+    Jv r = Jv::object();
+    r.set("COMMAND", Jv::of(std::string("GET_PRED")));
+    r.set("KEY", Jv::of(hex_of(id)));
+    return from_json(send_request(r));
+  }
+
+  bool same_as(const NPeer& o) const {
+    return id == o.id && min_key == o.min_key && ip == o.ip && port == o.port;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// finger table (overlay/finger_table.py twin; ref finger_table.h)
+// ---------------------------------------------------------------------------
+
+struct FingerN {
+  u128 lb, ub;
+  NPeer succ;
+};
+
+class FingerTableN {
+ public:
+  explicit FingerTableN(u128 starting_key) : start_(starting_key) {}
+
+  // [start + 2^n, start + 2^(n+1) - 1] mod ring (finger_table.h:177-188).
+  // 2^(n+1) = 2^n + 2^n avoids the n=127 shift-overflow.
+  void nth_range(int n, u128& lb, u128& ub) const {
+    u128 step = u128(1) << n;
+    lb = start_ + step;
+    ub = lb + (step - 1);
+  }
+
+  // The owning peer learns its id only after the server binds (port 0
+  // support); mutexes make the class non-assignable, so re-seed in place.
+  void set_start(u128 s) { start_ = s; }
+
+  void add(const FingerN& f) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    table_.push_back(f);
+  }
+
+  NPeer nth_entry(int n) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    check(n);
+    return table_[n].succ;
+  }
+
+  void edit_nth(int n, const NPeer& succ) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    check(n);
+    table_[n].succ = succ;
+  }
+
+  bool empty() const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return table_.empty();
+  }
+
+  size_t size() const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return table_.size();
+  }
+
+  // Linear scan returning the successor of the containing range
+  // (finger_table.h:115-130) — throws when no range matches, like the
+  // Python LookupError path.
+  NPeer lookup(u128 key) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    for (const auto& f : table_)
+      if (in_between(key, f.lb, f.ub, true)) return f.succ;
+    throw std::runtime_error("ChordKey not found");
+  }
+
+  // Point entries whose range start lies in [new.min_key, new.id] at the
+  // new peer (finger_table.h:148-157).
+  void adjust(const NPeer& np) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    for (auto& f : table_)
+      if (in_between(f.lb, np.min_key, np.id, true)) f.succ = np;
+  }
+
+  void replace_dead(const NPeer& dead, const NPeer& repl) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    for (auto& f : table_)
+      if (f.succ.id == dead.id) f.succ = repl;
+  }
+
+ private:
+  void check(int n) const {
+    if (n < 0 || size_t(n) >= table_.size())
+      throw std::runtime_error("finger table index out of range");
+  }
+
+  u128 start_;
+  mutable std::recursive_mutex mu_;
+  std::vector<FingerN> table_;
+};
+
+// ---------------------------------------------------------------------------
+// successor list (overlay/remote_peer.py RemotePeerList twin)
+// ---------------------------------------------------------------------------
+
+class PeerListN {
+ public:
+  PeerListN(int max_entries, u128 starting_key)
+      : max_(max_entries), start_(starting_key) {}
+
+  void set_start(u128 s) { start_ = s; }
+
+  void populate(const std::vector<NPeer>& peers) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    peers_ = peers;
+  }
+
+  // Clockwise insert relative to starting_key (remote_peer_list.cpp:31-84).
+  bool insert(const NPeer& np) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    if (np.port == 0) throw std::runtime_error("Corrupted JSON");
+    if (peers_.empty()) {
+      peers_.push_back(np);
+      return true;
+    }
+    u128 prev = start_;
+    for (size_t i = 0; i < peers_.size(); i++) {
+      if (np.id == peers_[i].id) return false;
+      if (in_between(np.id, prev, peers_[i].id, true)) {
+        peers_.insert(peers_.begin() + i, np);
+        if (int(peers_.size()) > max_) peers_.pop_back();
+        return true;
+      }
+      prev = peers_[i].id;
+    }
+    if (int(peers_.size()) < max_) {
+      peers_.push_back(np);
+      return true;
+    }
+    return false;
+  }
+
+  // Owning entry of key (remote_peer_list.cpp:86-110).
+  std::optional<NPeer> lookup(u128 key) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    u128 prev = start_;
+    for (const auto& p : peers_) {
+      if (in_between(key, prev, p.id, true)) return p;
+      prev = p.id;
+    }
+    return std::nullopt;
+  }
+
+  void del(u128 id) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    for (size_t i = 0; i < peers_.size(); i++)
+      if (peers_[i].id == id) {
+        peers_.erase(peers_.begin() + i);
+        return;
+      }
+  }
+
+  int size() const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return int(peers_.size());
+  }
+
+  NPeer nth(int n) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return peers_.at(size_t(n));
+  }
+
+  std::vector<NPeer> entries() const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return peers_;
+  }
+
+ private:
+  int max_;
+  u128 start_;
+  mutable std::recursive_mutex mu_;
+  std::vector<NPeer> peers_;
+};
+
+// ---------------------------------------------------------------------------
+// text db (GenericDB<string> twin, database.h:28-201; ring-aware ranges)
+// ---------------------------------------------------------------------------
+
+class TextDbN {
+ public:
+  void insert(u128 k, const std::string& v) {
+    std::lock_guard<std::mutex> g(mu_);
+    map_[k] = v;
+  }
+
+  std::string lookup(u128 k) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = map_.find(k);
+    if (it == map_.end()) throw std::runtime_error("Key not found.");
+    return it->second;
+  }
+
+  void del(u128 k) {
+    std::lock_guard<std::mutex> g(mu_);
+    map_.erase(k);
+  }
+
+  // Ring-aware [lb, ub] (MerkleTree::ReadRange splits wrapped ranges,
+  // merkle_tree.h:168-219).
+  std::map<u128, std::string> read_range(u128 lb, u128 ub) const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::map<u128, std::string> out;
+    if (lb <= ub) {
+      for (auto it = map_.lower_bound(lb);
+           it != map_.end() && it->first <= ub; ++it)
+        out.insert(*it);
+    } else {
+      for (auto it = map_.lower_bound(lb); it != map_.end(); ++it)
+        out.insert(*it);
+      for (auto it = map_.begin();
+           it != map_.end() && it->first <= ub; ++it)
+        out.insert(*it);
+    }
+    return out;
+  }
+
+  std::map<u128, std::string> entries() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return map_;
+  }
+
+  size_t size() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return map_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::map<u128, std::string> map_;
+};
+
+// ---------------------------------------------------------------------------
+// the peer
+// ---------------------------------------------------------------------------
+
+Jv cmd(const char* name) {
+  Jv r = Jv::object();
+  r.set("COMMAND", Jv::of(std::string(name)));
+  return r;
+}
+
+class ChordPeerN {
+ public:
+  ChordPeerN(const std::string& ip, int port, int num_succs,
+             double maintenance_interval_s)
+      : ip_(ip),
+        num_succs_(num_succs),
+        maint_interval_(maintenance_interval_s),
+        fingers_(0),          // re-seeded below once the port is known
+        succs_(num_succs, 0) {
+    server_ = ns::server_create(port, 3, 0, nullptr, nullptr);
+    if (!server_) throw std::runtime_error("could not bind server");
+    port_ = server_->port;
+    id_ = id_for(ip_, port_);
+    min_key_ = id_;
+    fingers_.set_start(id_);
+    succs_.set_start(id_);
+    server_->native_cb = [this](const std::string& command, const Jv& req,
+                                Jv& result) { dispatch(command, req, result); };
+    for (const char* c : {"JOIN", "NOTIFY", "LEAVE", "GET_SUCC", "GET_PRED",
+                          "CREATE_KEY", "READ_KEY", "RECTIFY"})
+      server_->commands.insert(c);
+    ns::server_run(server_);
+  }
+
+  ~ChordPeerN() { fail(); delete server_; }
+
+  int port() const { return port_; }
+  u128 id() const { return id_; }
+  u128 min_key() const {
+    std::lock_guard<std::recursive_mutex> g(pred_mu_);
+    return min_key_;
+  }
+  std::optional<NPeer> predecessor() const {
+    std::lock_guard<std::recursive_mutex> g(pred_mu_);
+    return pred_;
+  }
+  size_t db_size() const { return db_.size(); }
+
+  NPeer self() const {
+    NPeer p;
+    p.id = id_;
+    p.min_key = min_key();
+    p.ip = ip_;
+    p.port = port_;
+    return p;
+  }
+
+  // -- lifecycle (abstract_chord_peer.cpp:66-117) -------------------------
+  void start_chord() {
+    set_min_key(id_ + 1);
+    start_maintenance();
+  }
+
+  void join(const std::string& gw_ip, int gw_port) {
+    Jv r = cmd("JOIN");
+    r.set("NEW_PEER", self().to_json());
+    Jv resp = rpc_json(gw_ip, gw_port, r);
+    const Jv* pred = resp.find("PREDECESSOR");
+    if (!pred)
+      throw std::runtime_error("join failed: " + ns::dumps(resp));
+    // Local copy: the server is already live, so a concurrent NOTIFY may
+    // set_pred under the lock — reading pred_ unlocked here would race.
+    NPeer joined_pred = NPeer::from_json(*pred);
+    set_pred(joined_pred);
+    set_min_key(joined_pred.id + 1);
+
+    populate_finger_table(true);
+    notify(fingers_.nth_entry(0));
+    // Arbitrary cutoff kept for parity (abstract_chord_peer.cpp:103-110).
+    if (num_succs_ > 10) {
+      for (const auto& p : get_n_predecessors(id_, num_succs_)) notify(p);
+      succs_.populate(get_n_successors(id_ + 1, num_succs_));
+    }
+    fix_other_fingers(id_);
+    start_maintenance();
+  }
+
+  // ref Leave (abstract_chord_peer.cpp:192-226).
+  void leave() {
+    Jv note = cmd("LEAVE");
+    note.set("LEAVING_ID", Jv::of(hex_of(id_)));
+    {
+      auto p = predecessor();
+      if (!p) throw std::runtime_error("no predecessor to leave to");
+      note.set("NEW_PRED", p->to_json());
+    }
+    note.set("NEW_MIN", Jv::of(hex_of(min_key())));
+    note.set("KEYS_TO_ABSORB", keys_as_json());
+    for (const auto& p : get_n_predecessors(id_, num_succs_)) {
+      try {
+        p.send_request(note);
+      } catch (const std::exception&) {
+      }
+    }
+    NPeer succ = fingers_.nth_entry(0);
+    bool condones = true;
+    if (succ.is_alive()) {
+      try {
+        succ.send_request(note);
+      } catch (const std::exception&) {
+        condones = false;
+      }
+    }
+    if (!condones) throw std::runtime_error("Not ready to leave");
+    fail();
+  }
+
+  // Silent exit for fault injection (chord_peer.cpp:293-300).
+  void fail() {
+    stop_maintenance();
+    if (server_ && server_->alive.load()) ns::server_kill(server_);
+  }
+
+  // -- create/read (chord_peer.cpp:77-177) --------------------------------
+  void create_text(u128 key, const std::string& val) {
+    if (stored_locally(key)) {
+      db_.insert(key, val);
+      return;
+    }
+    NPeer succ = get_successor(key);
+    Jv r = cmd("CREATE_KEY");
+    r.set("KEY", Jv::of(hex_of(key)));
+    r.set("VALUE", Jv::of(val));
+    succ.send_request(r);  // throws on SUCCESS=false
+  }
+
+  std::string read_text(u128 key) {
+    if (stored_locally(key)) return db_.lookup(key);
+    NPeer succ = get_successor(key);
+    Jv r = cmd("READ_KEY");
+    r.set("KEY", Jv::of(hex_of(key)));
+    Jv resp = succ.send_request(r);
+    const Jv* v = resp.find("VALUE");
+    if (!v) throw std::runtime_error("Key not stored on peer.");
+    return v->s;
+  }
+
+  // -- stabilize (abstract_chord_peer.cpp:460-505) ------------------------
+  void stabilize() {
+    {
+      auto p = predecessor();
+      if (p && !p->is_alive()) handle_pred_failure(*p);
+    }
+    if (succs_.size() == 0) {
+      succs_.populate(get_n_successors(id_ + 1, num_succs_));
+      populate_finger_table(false);
+      return;
+    }
+    NPeer immediate = succs_.nth(0);
+    while (!immediate.is_alive()) {
+      succs_.del(immediate.id);
+      if (succs_.size() == 0) {
+        succs_.populate(get_n_successors(id_ + 1, num_succs_));
+        populate_finger_table(false);
+        return;
+      }
+      immediate = succs_.nth(0);
+    }
+    NPeer pred_of_succ = immediate.get_pred();
+    bool incorrect = in_between(id_, pred_of_succ.id, immediate.id, true);
+    if (incorrect || !pred_of_succ.is_alive()) notify(immediate);
+    update_succ_list();
+    populate_finger_table(false);
+  }
+
+ private:
+  // -- dispatch -----------------------------------------------------------
+  void dispatch(const std::string& command, const Jv& req, Jv& result) {
+    if (command == "JOIN") result = join_handler(req);
+    else if (command == "NOTIFY") result = notify_handler(req);
+    else if (command == "LEAVE") result = leave_handler(req);
+    else if (command == "GET_SUCC") result = get_succ_handler(req);
+    else if (command == "GET_PRED") result = get_pred_handler(req);
+    else if (command == "CREATE_KEY") result = create_key_handler(req);
+    else if (command == "READ_KEY") result = read_key_handler(req);
+    else if (command == "RECTIFY") result = rectify_handler(req);
+    else throw std::runtime_error("Invalid command.");
+  }
+
+  static u128 key_arg(const Jv& req, const char* field) {
+    const Jv* k = req.find(field);
+    if (!k || k->t != Jv::T::Str)
+      throw std::runtime_error(std::string("missing ") + field);
+    return parse_hex(k->s);
+  }
+
+  // ref JoinHandler (abstract_chord_peer.cpp:119-136).
+  Jv join_handler(const Jv& req) {
+    const Jv* np = req.find("NEW_PEER");
+    if (!np) throw std::runtime_error("missing NEW_PEER");
+    NPeer new_peer = NPeer::from_json(*np);
+    NPeer new_peer_pred = get_predecessor(new_peer.id);
+    fingers_.adjust(new_peer);
+    succs_.insert(new_peer);
+    Jv out = Jv::object();
+    out.set("PREDECESSOR", new_peer_pred.to_json());
+    return out;
+  }
+
+  // ref NotifyHandler (abstract_chord_peer.cpp:150-190).
+  Jv notify_handler(const Jv& req) {
+    const Jv* npj = req.find("NEW_PEER");
+    if (!npj) throw std::runtime_error("missing NEW_PEER");
+    NPeer new_peer = NPeer::from_json(*npj);
+
+    {
+      auto p = predecessor();
+      if (p && !p->is_alive()) {
+        NPeer old_pred = *p;
+        Jv resp = handle_notify_from_pred(new_peer);
+        handle_pred_failure(old_pred);
+        return resp;
+      }
+    }
+    fingers_.adjust(new_peer);
+    succs_.insert(new_peer);
+
+    bool peer_is_pred;
+    {
+      auto p = predecessor();
+      peer_is_pred = !p || in_between(new_peer.id, p->id, id_, false);
+    }
+    if (peer_is_pred) return handle_notify_from_pred(new_peer);
+    if (fingers_.empty()) populate_finger_table(true);
+    return Jv::object();
+  }
+
+  // ref LeaveHandler (abstract_chord_peer.cpp:228-260; NEW_SUCC quirk
+  // skipped, same as the Python twin).
+  Jv leave_handler(const Jv& req) {
+    u128 leaving_id = key_arg(req, "LEAVING_ID");
+    auto p = predecessor();
+    if (p && leaving_id == p->id) {
+      u128 old_pred_id = p->id;
+      const Jv* new_pred = req.find("NEW_PRED");
+      if (!new_pred) throw std::runtime_error("missing NEW_PRED");
+      set_pred(NPeer::from_json(*new_pred));
+      set_min_key(key_arg(req, "NEW_MIN"));
+      fix_other_fingers(old_pred_id);
+      const Jv* keys = req.find("KEYS_TO_ABSORB");
+      if (keys) absorb_keys(*keys);
+    }
+    succs_.del(leaving_id);
+    if (succs_.size() == 0)
+      succs_.populate(get_n_successors(id_ + 1, num_succs_));
+    return Jv::object();
+  }
+
+  Jv get_succ_handler(const Jv& req) {
+    return get_successor(key_arg(req, "KEY")).to_json();
+  }
+
+  Jv get_pred_handler(const Jv& req) {
+    return get_predecessor(key_arg(req, "KEY")).to_json();
+  }
+
+  Jv create_key_handler(const Jv& req) {
+    u128 key = key_arg(req, "KEY");
+    if (!stored_locally(key)) throw std::runtime_error("Key not in range.");
+    const Jv* v = req.find("VALUE");
+    if (!v) throw std::runtime_error("missing VALUE");
+    db_.insert(key, v->s);
+    return Jv::object();
+  }
+
+  Jv read_key_handler(const Jv& req) {
+    u128 key = key_arg(req, "KEY");
+    if (!stored_locally(key))
+      throw std::runtime_error("Key not stored locally.");
+    Jv out = Jv::object();
+    out.set("VALUE", Jv::of(db_.lookup(key)));
+    return out;
+  }
+
+  // ref RectifyHandler (abstract_chord_peer.cpp:684-698).
+  Jv rectify_handler(const Jv& req) {
+    const Jv* oj = req.find("ORIGINATOR");
+    if (!oj) throw std::runtime_error("missing ORIGINATOR");
+    NPeer originator = NPeer::from_json(*oj);
+    if (originator.id == id_) return Jv::object();
+    const Jv* fj = req.find("FAILED_NODE");
+    if (!fj) throw std::runtime_error("missing FAILED_NODE");
+    NPeer failed = NPeer::from_json(*fj);
+    succs_.del(failed.id);
+    fingers_.replace_dead(failed, originator);
+    notify(originator);
+    return Jv::object();
+  }
+
+  // -- notify / key transfer (chord_peer.cpp:242-310) ---------------------
+  void notify(const NPeer& target) {
+    Jv r = cmd("NOTIFY");
+    r.set("NEW_PEER", self().to_json());
+    Jv resp = target.send_request(r);
+    const Jv* keys = resp.find("KEYS_TO_ABSORB");
+    if (keys) absorb_keys(*keys);
+  }
+
+  Jv handle_notify_from_pred(const NPeer& new_pred) {
+    std::map<u128, std::string> to_transfer =
+        db_.read_range(min_key(), new_pred.id);
+    Jv data = Jv::object();
+    for (const auto& kv : to_transfer) {
+      data.set(hex_of(kv.first), Jv::of(kv.second));
+      db_.del(kv.first);
+    }
+    fingers_.adjust(new_pred);
+    set_pred(new_pred);
+    set_min_key(new_pred.id + 1);
+    Jv out = Jv::object();
+    out.set("KEYS_TO_ABSORB", data);
+    return out;
+  }
+
+  void handle_pred_failure(const NPeer& old_pred) {
+    fingers_.adjust(self());
+    rectify(old_pred);
+  }
+
+  void absorb_keys(const Jv& kv_pairs) {
+    if (kv_pairs.t != Jv::T::Obj) return;
+    for (const auto& kv : kv_pairs.obj)
+      db_.insert(parse_hex(kv.first), kv.second.s);
+  }
+
+  Jv keys_as_json() const {
+    Jv out = Jv::object();
+    for (const auto& kv : db_.entries())
+      out.set(hex_of(kv.first), Jv::of(kv.second));
+    return out;
+  }
+
+  // -- resolution (abstract_chord_peer.cpp:313-449) ------------------------
+  bool stored_locally(u128 key) const {
+    return in_between(key, min_key(), id_, true);
+  }
+
+  NPeer get_successor(u128 key) {
+    if (stored_locally(key)) return self();
+    Jv r = cmd("GET_SUCC");
+    r.set("KEY", Jv::of(hex_of(key)));
+    return NPeer::from_json(forward_request(key, r));
+  }
+
+  std::vector<NPeer> get_n_successors(u128 key, int n) {
+    std::vector<NPeer> out;
+    std::vector<u128> seen;
+    u128 prev = key - 1;
+    for (int i = 0; i < n; i++) {
+      NPeer ith = get_successor(prev + 1);
+      if (std::find(seen.begin(), seen.end(), ith.id) != seen.end()) break;
+      out.push_back(ith);
+      seen.push_back(ith.id);
+      prev = ith.id;
+    }
+    return out;
+  }
+
+  // GetPredecessor with the succ-list shortcut
+  // (abstract_chord_peer.cpp:380-416).
+  NPeer get_predecessor(u128 key) {
+    auto p = predecessor();
+    if (!p) return self();
+    if (stored_locally(key)) return *p;
+    auto succ_of_key = succs_.lookup(key);
+    if (succ_of_key) {
+      try {
+        NPeer pred_of_succ = succ_of_key->get_pred();
+        if (in_between(key, pred_of_succ.id, succ_of_key->id, true))
+          return pred_of_succ;
+      } catch (const std::exception&) {
+      }
+    }
+    Jv r = cmd("GET_PRED");
+    r.set("KEY", Jv::of(hex_of(key)));
+    return NPeer::from_json(forward_request(key, r));
+  }
+
+  std::vector<NPeer> get_n_predecessors(u128 key, int n) {
+    std::vector<NPeer> out;
+    u128 prev = key;
+    for (int i = 0; i < n; i++) {
+      NPeer ith = get_predecessor(prev - 1);
+      out.push_back(ith);
+      if (prev == key && i != 0) break;
+      prev = ith.id;
+    }
+    return out;
+  }
+
+  // ref ForwardRequest (chord_peer.cpp:185-211).
+  Jv forward_request(u128 key, const Jv& request) {
+    NPeer key_succ = fingers_.lookup(key);
+    auto p = predecessor();
+    if (key_succ.id == id_ && p && p->is_alive()) {
+      key_succ = *p;
+    } else if (!key_succ.is_alive()) {
+      auto fallback = succs_.lookup(key);
+      if (fallback && fallback->is_alive()) key_succ = *fallback;
+      else throw std::runtime_error("Lookup failed");
+    }
+    return key_succ.send_request(request);
+  }
+
+  // -- repairs (abstract_chord_peer.cpp:507-698) ---------------------------
+  void update_succ_list() {
+    std::vector<NPeer> old_list = succs_.entries();
+    u128 previous_succ_id = id_;
+    for (const auto& nth : old_list) {
+      NPeer last = nth;
+      while (true) {
+        NPeer pred_of_last;
+        try {
+          pred_of_last = last.get_pred();
+        } catch (const std::exception&) {
+          break;
+        }
+        if (pred_of_last.id == previous_succ_id || pred_of_last.id == id_)
+          break;
+        if (pred_of_last.is_alive()) succs_.insert(pred_of_last);
+        last = pred_of_last;
+      }
+      previous_succ_id = nth.id;
+    }
+    if (succs_.size() < num_succs_) {
+      int size = succs_.size();
+      int discrepancy = num_succs_ - size;
+      if (size > 0) {
+        NPeer last_succ = succs_.nth(size - 1);
+        for (const auto& peer :
+             get_n_successors(last_succ.id + 1, discrepancy))
+          if (peer.id != id_) succs_.insert(peer);
+      }
+    }
+  }
+
+  // ref PopulateFingerTable (abstract_chord_peer.cpp:564-613).
+  void populate_finger_table(bool initialize) {
+    for (int i = 0; i < kNumFingers; i++) {
+      u128 lb, ub;
+      fingers_.nth_range(i, lb, ub);
+      Jv succ_req = cmd("GET_SUCC");
+      succ_req.set("KEY", Jv::of(hex_of(lb)));
+      if (initialize) {
+        if (stored_locally(lb)) {
+          fingers_.add(FingerN{lb, ub, self()});
+        } else {
+          NPeer to_query;
+          if (i == 0) {
+            auto p = predecessor();
+            if (!p) throw std::runtime_error("no predecessor");
+            to_query = *p;
+          } else {
+            to_query = fingers_.nth_entry(i - 1);
+          }
+          fingers_.add(
+              FingerN{lb, ub, NPeer::from_json(to_query.send_request(succ_req))});
+        }
+      } else {
+        if (i == 0) {
+          fingers_.edit_nth(0, get_successor(lb));
+        } else {
+          NPeer to_query = fingers_.nth_entry(i - 1);
+          fingers_.edit_nth(
+              i, NPeer::from_json(to_query.send_request(succ_req)));
+        }
+      }
+    }
+  }
+
+  // ref FixOtherFingers (abstract_chord_peer.cpp:615-645).
+  void fix_other_fingers(u128 starting_key) {
+    std::optional<NPeer> former;
+    for (int i = 1; i <= kNumFingers; i++) {
+      NPeer p = get_predecessor(starting_key - (u128(1) << (i - 1)));
+      if (former && former->same_as(p)) continue;
+      former = p;
+      if (p.id == id_) break;
+      if (p.is_alive()) notify(p);
+    }
+  }
+
+  // ref Rectify — Zave's repair broadcast (abstract_chord_peer.cpp:647-682).
+  void rectify(const NPeer& failed) {
+    if (failed.is_alive()) return;
+    Jv req = cmd("RECTIFY");
+    req.set("FAILED_NODE", failed.to_json());
+    req.set("ORIGINATOR", self().to_json());
+    std::optional<NPeer> former;
+    for (int i = 1; i <= kNumFingers; i++) {
+      NPeer p = get_predecessor(failed.id - (u128(1) << (i - 1)));
+      if (former && former->same_as(p)) continue;
+      former = p;
+      if (p.id == id_) break;
+      if (p.is_alive()) {
+        try {
+          p.send_request(req);
+        } catch (const std::exception&) {
+        }
+      }
+    }
+  }
+
+  // -- state cells ---------------------------------------------------------
+  void set_pred(const NPeer& p) {
+    std::lock_guard<std::recursive_mutex> g(pred_mu_);
+    pred_ = p;
+  }
+
+  void set_min_key(u128 mk) {
+    std::lock_guard<std::recursive_mutex> g(pred_mu_);
+    min_key_ = mk;
+  }
+
+  // -- maintenance thread (chord_peer.cpp:213-240) -------------------------
+  void start_maintenance() {
+    if (maint_interval_ <= 0 || maint_thread_.joinable()) return;
+    maint_stop_.store(false);
+    maint_thread_ = std::thread([this] {
+      auto last = std::chrono::steady_clock::now();
+      while (!maint_stop_.load()) {
+        auto now = std::chrono::steady_clock::now();
+        if (std::chrono::duration<double>(now - last).count() <
+            maint_interval_) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(10));
+          continue;
+        }
+        try {
+          stabilize();
+        } catch (const std::exception&) {
+          // catch-and-continue (chord_peer.cpp:225-238)
+        }
+        last = std::chrono::steady_clock::now();
+      }
+    });
+  }
+
+  void stop_maintenance() {
+    maint_stop_.store(true);
+    if (maint_thread_.joinable()) maint_thread_.join();
+  }
+
+  std::string ip_;
+  int port_ = 0;
+  int num_succs_;
+  double maint_interval_;
+  u128 id_ = 0;
+  u128 min_key_ = 0;
+  std::optional<NPeer> pred_;
+  mutable std::recursive_mutex pred_mu_;
+  FingerTableN fingers_;
+  PeerListN succs_;
+  TextDbN db_;
+  ns::Server* server_ = nullptr;
+  std::thread maint_thread_;
+  std::atomic<bool> maint_stop_{false};
+};
+
+thread_local std::string g_last_error;
+
+template <typename F>
+int guarded(F&& f) {
+  try {
+    f();
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return 1;
+  } catch (...) {
+    g_last_error = "unknown native error";
+    return 1;
+  }
+}
+
+}  // namespace nc
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* nc_peer_create(const char* ip, int port, int num_succs,
+                     double maintenance_interval_s) {
+  try {
+    return new nc::ChordPeerN(ip, port, num_succs, maintenance_interval_s);
+  } catch (const std::exception& e) {
+    nc::g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+const char* nc_last_error() { return nc::g_last_error.c_str(); }
+
+int nc_peer_port(void* h) { return static_cast<nc::ChordPeerN*>(h)->port(); }
+
+char* nc_peer_id_hex(void* h) {
+  return ns::dup_cstr(nc::hex_of(static_cast<nc::ChordPeerN*>(h)->id()));
+}
+
+char* nc_peer_min_key_hex(void* h) {
+  return ns::dup_cstr(nc::hex_of(static_cast<nc::ChordPeerN*>(h)->min_key()));
+}
+
+// Predecessor as a JSON object string, or "null" when unset.
+char* nc_peer_pred_json(void* h) {
+  auto p = static_cast<nc::ChordPeerN*>(h)->predecessor();
+  return ns::dup_cstr(p ? ns::dumps(p->to_json()) : std::string("null"));
+}
+
+long long nc_peer_db_size(void* h) {
+  return (long long)static_cast<nc::ChordPeerN*>(h)->db_size();
+}
+
+int nc_peer_start_chord(void* h) {
+  return nc::guarded(
+      [&] { static_cast<nc::ChordPeerN*>(h)->start_chord(); });
+}
+
+int nc_peer_join(void* h, const char* gw_ip, int gw_port) {
+  return nc::guarded(
+      [&] { static_cast<nc::ChordPeerN*>(h)->join(gw_ip, gw_port); });
+}
+
+int nc_peer_stabilize(void* h) {
+  return nc::guarded([&] { static_cast<nc::ChordPeerN*>(h)->stabilize(); });
+}
+
+int nc_peer_leave(void* h) {
+  return nc::guarded([&] { static_cast<nc::ChordPeerN*>(h)->leave(); });
+}
+
+void nc_peer_fail(void* h) { static_cast<nc::ChordPeerN*>(h)->fail(); }
+
+// key_hex: lowercase hex ring key (callers hash plaintext on their side,
+// exactly like the Python peer's Key.from_plaintext path).
+int nc_peer_create_key(void* h, const char* key_hex, const char* val) {
+  return nc::guarded([&] {
+    static_cast<nc::ChordPeerN*>(h)->create_text(nc::parse_hex(key_hex), val);
+  });
+}
+
+int nc_peer_read_key(void* h, const char* key_hex, char** out) {
+  *out = nullptr;
+  return nc::guarded([&] {
+    *out = ns::dup_cstr(
+        static_cast<nc::ChordPeerN*>(h)->read_text(nc::parse_hex(key_hex)));
+  });
+}
+
+void nc_peer_destroy(void* h) { delete static_cast<nc::ChordPeerN*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,432 @@
+// Shared native-engine internals: sockets, client, server, dispatch.
+//
+// Split out of rpc_engine.cc so higher native layers (chord_peer.cc — the
+// full C++ protocol peer) link against the same client/server machinery the
+// C ABI exports. Everything here mirrors net/rpc.py; see rpc_engine.cc for
+// the protocol contract and reference citations.
+#pragma once
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"
+#include "sha1.h"
+
+namespace ns {
+
+
+using ns::Jv;
+
+// ---------------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------------
+
+inline int timeout_ms(double seconds) {
+  if (seconds <= 0) return 0;
+  double ms = seconds * 1000.0;
+  if (ms > double(1 << 30)) return 1 << 30;
+  return int(ms);
+}
+
+inline void set_nonblocking(int fd, bool nb) {
+  // Avoids fcntl headers churn: ioctl-style via fcntl is fine.
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0) return;
+  if (nb) flags |= O_NONBLOCK; else flags &= ~O_NONBLOCK;
+  fcntl(fd, F_SETFL, flags);
+}
+
+// Connect with timeout. Returns fd >= 0 or -1.
+inline int connect_to(const char* ip, int port, double timeout_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) { ::close(fd); return -1; }
+  set_nonblocking(fd, true);
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr);
+  if (rc != 0) {
+    if (errno != EINPROGRESS) { ::close(fd); return -1; }
+    pollfd pfd{fd, POLLOUT, 0};
+    rc = ::poll(&pfd, 1, timeout_ms(timeout_s));
+    if (rc <= 0) { ::close(fd); return -1; }
+    int err = 0;
+    socklen_t len = sizeof err;
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  set_nonblocking(fd, false);
+  return fd;
+}
+
+// Send all bytes; every poll gets the full per-operation timeout, matching
+// the Python layer's socket.settimeout semantics (a PER-OP budget, not a
+// shared whole-exchange deadline). Returns true on success.
+inline bool send_all(int fd, const std::string& data, double timeout_s) {
+  size_t off = 0;
+  while (off < data.size()) {
+    pollfd pfd{fd, POLLOUT, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms(timeout_s));
+    if (rc <= 0) return false;
+    ssize_t n = ::send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    off += size_t(n);
+  }
+  return true;
+}
+
+// Read to EOF; each recv waits up to the full timeout (per-chunk budget,
+// like sock.settimeout + recv loops in rpc.py — progress resets the clock).
+// Returns 0 on EOF, -1 on error, -2 on timeout.
+inline int recv_to_eof(int fd, std::string& out, double timeout_s,
+                size_t max_bytes = size_t(256) << 20) {
+  char buf[65536];
+  while (true) {
+    pollfd pfd{fd, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms(timeout_s));
+    if (rc == 0) return -2;
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    ssize_t n = ::recv(fd, buf, sizeof buf, 0);
+    if (n == 0) return 0;
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    out.append(buf, size_t(n));
+    if (out.size() > max_bytes) return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// client
+// ---------------------------------------------------------------------------
+
+constexpr double kDefaultTimeoutS = 5.0;  // client.cpp:68
+
+// Drop garbage after the final '}' (ref SanitizeJson, client.cpp:36-49).
+inline std::string sanitize_json(const std::string& payload) {
+  size_t end = payload.rfind('}');
+  if (end == std::string::npos) return payload;
+  return payload.substr(0, end + 1);
+}
+
+inline char* dup_cstr(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+// Status codes for ns_make_request.
+enum { NS_OK = 0, NS_TRANSPORT = 1, NS_TIMEOUT = 2, NS_PARSE = 3 };
+
+inline int make_request(const char* ip, int port, const char* request_json,
+                 double timeout_s, char** out) {
+  // Phase budgets mirror rpc.Client: create_connection(timeout) for the
+  // connect, then settimeout(timeout) giving send and every recv chunk a
+  // fresh full budget — NOT one deadline across the whole exchange.
+  int fd = connect_to(ip, port, timeout_s);
+  if (fd < 0) {
+    *out = dup_cstr("RPC transport failure: connect failed");
+    return NS_TRANSPORT;
+  }
+  std::string req(request_json);
+  if (!send_all(fd, req, timeout_s)) {
+    ::close(fd);
+    *out = dup_cstr("RPC transport failure: send failed");
+    return NS_TRANSPORT;
+  }
+  ::shutdown(fd, SHUT_WR);  // half-close: server reads to EOF
+  std::string raw;
+  int rc = recv_to_eof(fd, raw, timeout_s);
+  ::close(fd);
+  if (rc == -2) {
+    *out = dup_cstr("RPC reply timed out");
+    return NS_TIMEOUT;
+  }
+  if (rc < 0) {
+    *out = dup_cstr("RPC transport failure: recv failed");
+    return NS_TRANSPORT;
+  }
+  Jv resp;
+  std::string err;
+  if (!ns::parse_prefix(sanitize_json(raw), resp, nullptr, &err)) {
+    *out = dup_cstr("Error parsing response: " + err);
+    return NS_PARSE;
+  }
+  *out = dup_cstr(ns::dumps(resp));
+  return NS_OK;
+}
+
+inline int is_alive(const char* ip, int port, double timeout_s) {
+  int fd = connect_to(ip, port, timeout_s);
+  if (fd < 0) return 0;
+  ::close(fd);
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// server
+// ---------------------------------------------------------------------------
+
+// The callback contract: engine calls cb(ctx, command, request_json, slot)
+// on a worker thread; the callback must call ns_respond(slot, json) exactly
+// once for success or ns_respond_error(slot, message) for a handler error.
+// No call at all counts as an error (defensive: a crashed callback must not
+// hang the session).
+struct ResponseSlot {
+  bool responded = false;
+  bool ok = false;
+  std::string body;  // result JSON (ok) or error message (!ok)
+};
+
+typedef void (*HandlerCb)(void* ctx, const char* command,
+                          const char* request_json, void* slot);
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> alive{true};
+  HandlerCb cb = nullptr;
+  // In-process native handlers (chord_peer.cc): called with (command,
+  // parsed request, result-to-fill); throwing maps to the error envelope.
+  // Takes precedence over the C-callback path when set.
+  std::function<void(const std::string&, const Jv&, Jv&)> native_cb;
+  void* cb_ctx = nullptr;
+  bool logging_enabled = false;
+  int num_threads = 3;  // server.h:294-307
+
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+
+  std::mutex queue_mu;
+  std::condition_variable queue_cv;
+  std::deque<int> pending;  // accepted connections awaiting a worker
+
+  std::mutex conns_mu;
+  std::set<int> open_conns;
+
+  std::mutex log_mu;
+  std::deque<std::string> request_log;  // minified parsed requests, max 32
+  static constexpr size_t kLogSize = 32;  // server.h:242
+
+  std::mutex cmds_mu;
+  std::set<std::string> commands;
+};
+
+inline void track_conn(Server* s, int fd, bool add) {
+  std::lock_guard<std::mutex> g(s->conns_mu);
+  if (add) s->open_conns.insert(fd);
+  else s->open_conns.erase(fd);
+}
+
+// Dispatch + envelope (ref Session::HandleRead/ProcessRequest,
+// server.h:128-210), matching rpc.py Server._process byte-for-byte on the
+// envelope fields.
+inline std::string process_request(Server* s, const std::string& raw) {
+  Jv req;
+  std::string err;
+  Jv resp = Jv::object();
+  if (!ns::parse_all(raw, req, &err)) {
+    resp.set("SUCCESS", Jv::of(false));
+    resp.set("ERRORS", Jv::of(err));
+    return ns::dumps(resp);
+  }
+  if (s->logging_enabled) {
+    std::lock_guard<std::mutex> g(s->log_mu);
+    s->request_log.push_back(ns::dumps(req));
+    while (s->request_log.size() > Server::kLogSize)
+      s->request_log.pop_front();
+  }
+  // COMMAND lookup. Non-object bodies and unknown commands take the same
+  // error envelope the Python server produces via its exception path.
+  const Jv* cmd = req.find("COMMAND");
+  std::string command =
+      (cmd && cmd->t == Jv::T::Str) ? cmd->s : std::string();
+  bool known;
+  {
+    std::lock_guard<std::mutex> g(s->cmds_mu);
+    known = s->commands.count(command) > 0;
+  }
+  if (!known || (s->cb == nullptr && !s->native_cb)) {
+    resp.set("SUCCESS", Jv::of(false));
+    resp.set("ERRORS", Jv::of(std::string("Invalid command.")));
+    return ns::dumps(resp);
+  }
+  if (s->native_cb) {
+    try {
+      Jv result = Jv::object();
+      s->native_cb(command, req, result);
+      result.set("SUCCESS", Jv::of(true));
+      return ns::dumps(result);
+    } catch (const std::exception& e) {
+      resp.set("SUCCESS", Jv::of(false));
+      resp.set("ERRORS", Jv::of(std::string(e.what())));
+      return ns::dumps(resp);
+    }
+  }
+  ResponseSlot slot;
+  std::string req_min = ns::dumps(req);
+  s->cb(s->cb_ctx, command.c_str(), req_min.c_str(), &slot);
+  if (!slot.responded || !slot.ok) {
+    resp.set("SUCCESS", Jv::of(false));
+    resp.set("ERRORS", Jv::of(slot.responded
+                                  ? slot.body
+                                  : std::string("handler did not respond")));
+    return ns::dumps(resp);
+  }
+  Jv result;
+  if (!ns::parse_all(slot.body, result, &err) || result.t != Jv::T::Obj) {
+    resp.set("SUCCESS", Jv::of(false));
+    resp.set("ERRORS", Jv::of(std::string("handler returned invalid JSON")));
+    return ns::dumps(resp);
+  }
+  result.set("SUCCESS", Jv::of(true));
+  return ns::dumps(result);
+}
+
+inline void serve_connection(Server* s, int fd) {
+  std::string raw;
+  int rc = recv_to_eof(fd, raw, kDefaultTimeoutS);
+  if (rc == 0) {
+    std::string resp = process_request(s, raw);
+    send_all(fd, resp, kDefaultTimeoutS);
+    ::shutdown(fd, SHUT_RDWR);
+  }
+  track_conn(s, fd, false);
+  ::close(fd);
+}
+
+inline void worker_loop(Server* s) {
+  while (true) {
+    int fd;
+    {
+      std::unique_lock<std::mutex> lk(s->queue_mu);
+      s->queue_cv.wait(lk,
+                       [s] { return !s->pending.empty() || !s->alive.load(); });
+      if (s->pending.empty()) return;  // killed and drained
+      fd = s->pending.front();
+      s->pending.pop_front();
+    }
+    serve_connection(s, fd);
+  }
+}
+
+inline void accept_loop(Server* s) {
+  while (s->alive.load()) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return;  // killed (listen socket shut down) or fatal
+    }
+    if (!s->alive.load()) { ::close(fd); return; }
+    track_conn(s, fd, true);
+    {
+      std::lock_guard<std::mutex> g(s->queue_mu);
+      s->pending.push_back(fd);
+    }
+    s->queue_cv.notify_one();
+  }
+}
+
+inline Server* server_create(int port, int num_threads, int logging_enabled,
+                      HandlerCb cb, void* ctx) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof bound;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = int(ntohs(bound.sin_port));
+  s->cb = cb;
+  s->cb_ctx = ctx;
+  s->logging_enabled = logging_enabled != 0;
+  s->num_threads = num_threads > 0 ? num_threads : 3;
+  return s;
+}
+
+inline void server_run(Server* s) {
+  if (s->accept_thread.joinable()) return;
+  for (int i = 0; i < s->num_threads; i++)
+    s->workers.emplace_back(worker_loop, s);
+  s->accept_thread = std::thread(accept_loop, s);
+}
+
+// Deterministic kill, same contract as rpc.py Server.kill: after return the
+// acceptor is gone (a connect probe gets refused, not a race) and no socket
+// owned by this server is open.
+inline void server_kill(Server* s) {
+  bool was_alive = s->alive.exchange(false);
+  if (!was_alive) return;
+  ::shutdown(s->listen_fd, SHUT_RDWR);  // wakes a blocked accept(2)
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  ::close(s->listen_fd);
+  // Wake in-flight sessions: shutdown (not close) so the owning worker's
+  // recv returns and it closes its own fd.
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (int fd : s->open_conns) ::shutdown(fd, SHUT_RDWR);
+  }
+  // Synchronize on queue_mu before notifying: without it a worker that has
+  // just evaluated its wait predicate (pending empty, alive true) but not
+  // yet blocked would miss the notify — a lost wakeup that deadlocks the
+  // join below.
+  {
+    std::lock_guard<std::mutex> g(s->queue_mu);
+  }
+  s->queue_cv.notify_all();
+  for (auto& w : s->workers)
+    if (w.joinable()) w.join();
+  s->workers.clear();
+  // Close connections that were queued but never picked up by a worker.
+  std::vector<int> leftover;
+  {
+    std::lock_guard<std::mutex> g(s->queue_mu);
+    leftover.assign(s->pending.begin(), s->pending.end());
+    s->pending.clear();
+  }
+  for (int fd : leftover) {
+    track_conn(s, fd, false);
+    ::close(fd);
+  }
+}
+
+
+}  // namespace ns

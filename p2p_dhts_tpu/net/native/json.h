@@ -327,7 +327,7 @@ class Parser {
           case 'b': out += '\b'; break;
           case 'f': out += '\f'; break;
           case 'u': {
-            uint32_t hi;
+            uint32_t hi = 0;
             if (!hex4(hi)) return false;
             if (hi >= 0xD800 && hi < 0xDC00 && i_ + 1 < n_ &&
                 p_[i_] == '\\' && p_[i_ + 1] == 'u') {

@@ -40,7 +40,8 @@ from p2p_dhts_tpu.net.rpc import (DEFAULT_TIMEOUT_S, JsonObj, RpcError,
                                   parse_reply)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_SOURCES = ("rpc_engine.cc", "json.h", "sha1.h")
+_SOURCES = ("rpc_engine.cc", "chord_peer.cc", "engine.h", "json.h", "sha1.h")
+_COMPILE_UNITS = ("rpc_engine.cc", "chord_peer.cc")
 _LIB_NAME = "_rpc_engine.so"
 
 _lib = None
@@ -62,9 +63,10 @@ def _build_library() -> str:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
     os.close(fd)
     try:
+        units = [os.path.join(_NATIVE_DIR, u) for u in _COMPILE_UNITS]
         subprocess.run(
             ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             srcs[0], "-o", tmp],
+             *units, "-o", tmp],
             check=True, capture_output=True, text=True)
         os.replace(tmp, lib_path)  # atomic: concurrent builders both win
     except subprocess.CalledProcessError as exc:

@@ -1,0 +1,146 @@
+"""ctypes facade over the native C++ Chord peer (net/native/chord_peer.cc).
+
+The reference's peers are native C++ objects; `NativeChordPeer` is the
+rebuild's. All protocol logic — join, notify, leave, stabilize, rectify,
+finger-table routing, key transfer, create/read — runs in native code on the
+native engine's sockets; this class only marshals calls and mirrors enough
+of the Python `ChordPeer` surface (`id`, `min_key`, `predecessor`, `create`,
+`read`, `stabilize`, `join`, `leave`, `fail`) that mixed-implementation
+rings can be built and asserted on by one test harness
+(tests/test_native_rpc.py).
+
+Native and Python peers interoperate in a single ring — the protocol-level
+cross-implementation proof, one level above the transport-level byte
+matrix.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Optional
+
+from p2p_dhts_tpu.keyspace import Key
+from p2p_dhts_tpu.net.native_rpc import _take_cstr, load_library
+from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    if getattr(lib, "_nc_bound", False):
+        return lib
+    lib.nc_peer_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_double]
+    lib.nc_peer_create.restype = ctypes.c_void_p
+    lib.nc_last_error.restype = ctypes.c_char_p
+    lib.nc_peer_port.argtypes = [ctypes.c_void_p]
+    lib.nc_peer_port.restype = ctypes.c_int
+    for fn in (lib.nc_peer_id_hex, lib.nc_peer_min_key_hex,
+               lib.nc_peer_pred_json):
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = ctypes.c_void_p
+    lib.nc_peer_db_size.argtypes = [ctypes.c_void_p]
+    lib.nc_peer_db_size.restype = ctypes.c_longlong
+    lib.nc_peer_start_chord.argtypes = [ctypes.c_void_p]
+    lib.nc_peer_start_chord.restype = ctypes.c_int
+    lib.nc_peer_join.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int]
+    lib.nc_peer_join.restype = ctypes.c_int
+    for fn in (lib.nc_peer_stabilize, lib.nc_peer_leave):
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = ctypes.c_int
+    lib.nc_peer_fail.argtypes = [ctypes.c_void_p]
+    lib.nc_peer_create_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_char_p]
+    lib.nc_peer_create_key.restype = ctypes.c_int
+    lib.nc_peer_read_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+    lib.nc_peer_read_key.restype = ctypes.c_int
+    lib.nc_peer_destroy.argtypes = [ctypes.c_void_p]
+    lib._nc_bound = True
+    return lib
+
+
+class NativeChordPeer:
+    """A Chord peer whose protocol logic runs in C++ (chord_peer.cc)."""
+
+    def __init__(self, ip_addr: str, port: int, num_succs: int,
+                 maintenance_interval: Optional[float] = 5.0):
+        self._lib = _bind(load_library())
+        interval = -1.0 if maintenance_interval is None \
+            else float(maintenance_interval)
+        self._h = self._lib.nc_peer_create(ip_addr.encode(), port,
+                                           num_succs, interval)
+        if not self._h:
+            raise OSError(self._lib.nc_last_error().decode())
+        self.ip_addr = ip_addr
+        self.port = self._lib.nc_peer_port(self._h)
+        self.num_succs = num_succs
+        self._destroyed = False
+
+    # -- state mirrors (for ring-invariant assertions) ----------------------
+    @property
+    def id(self) -> Key:
+        return Key.from_hex(_take_cstr(self._lib,
+                                       self._lib.nc_peer_id_hex(self._h)))
+
+    @property
+    def min_key(self) -> Key:
+        return Key.from_hex(
+            _take_cstr(self._lib, self._lib.nc_peer_min_key_hex(self._h)))
+
+    @property
+    def predecessor(self) -> Optional[RemotePeer]:
+        obj = json.loads(
+            _take_cstr(self._lib, self._lib.nc_peer_pred_json(self._h)))
+        return None if obj is None else RemotePeer.from_json(obj)
+
+    @property
+    def db_size(self) -> int:
+        return int(self._lib.nc_peer_db_size(self._h))
+
+    # -- protocol ----------------------------------------------------------
+    def _check(self, rc: int) -> None:
+        if rc != 0:
+            raise RuntimeError(self._lib.nc_last_error().decode())
+
+    def start_chord(self) -> None:
+        self._check(self._lib.nc_peer_start_chord(self._h))
+
+    def join(self, gateway_ip: str, gateway_port: int) -> None:
+        self._check(self._lib.nc_peer_join(self._h, gateway_ip.encode(),
+                                           gateway_port))
+
+    def stabilize(self) -> None:
+        self._check(self._lib.nc_peer_stabilize(self._h))
+
+    def leave(self) -> None:
+        self._check(self._lib.nc_peer_leave(self._h))
+
+    def fail(self) -> None:
+        self._lib.nc_peer_fail(self._h)
+
+    def create(self, key, val: str) -> None:
+        k = key if isinstance(key, Key) else Key.from_plaintext(key)
+        self._check(self._lib.nc_peer_create_key(
+            self._h, str(k).encode(), val.encode()))
+
+    def read(self, key) -> str:
+        k = key if isinstance(key, Key) else Key.from_plaintext(key)
+        out = ctypes.c_void_p()
+        rc = self._lib.nc_peer_read_key(self._h, str(k).encode(),
+                                        ctypes.byref(out))
+        text = _take_cstr(self._lib, out.value) if out.value else ""
+        if rc != 0:
+            raise RuntimeError(self._lib.nc_last_error().decode())
+        return text
+
+    def close(self) -> None:
+        if not self._destroyed:
+            self._destroyed = True
+            self._lib.nc_peer_destroy(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,140 @@
+"""Protocol-level cross-implementation proof: the C++ Chord peer
+(net/native/chord_peer.cc) in live rings, alone and interleaved with
+Python peers.
+
+One level above test_native_rpc.py's transport byte-matrix: here two
+independent implementations of the full protocol — join, notify, key
+transfer, stabilize, rectify, leave — converge on one ring and serve each
+other's requests, mirroring how the reference's own integration tests
+exercise C++ peers over localhost TCP (chord_test.cpp:645-818, but with
+deterministic stepped convergence instead of sleeps).
+"""
+
+from typing import List
+
+import pytest
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key
+from p2p_dhts_tpu.overlay.chord_peer import ChordPeer
+from p2p_dhts_tpu.overlay.native_peer import NativeChordPeer
+
+
+def _converge(peers, rounds=2):
+    for _ in range(rounds):
+        for p in peers:
+            try:
+                p.stabilize()
+            except RuntimeError:
+                pass
+
+
+def _assert_ring(peers) -> None:
+    """pred/min_key must tile the ring exactly (test_overlay's invariant)."""
+    by_id = sorted(peers, key=lambda p: int(p.id))
+    n = len(by_id)
+    for i, p in enumerate(by_id):
+        want = by_id[(i - 1) % n]
+        assert p.predecessor is not None, f"peer {p.port} has no pred"
+        assert int(p.predecessor.id) == int(want.id), \
+            f"peer {p.port}: pred {p.predecessor.id} != {want.id}"
+        assert int(p.min_key) == (int(want.id) + 1) % KEYS_IN_RING
+
+
+@pytest.fixture
+def ring():
+    peers: List = []
+
+    def build(kinds, base_port):
+        """kinds: sequence of 'py'/'cc'; fixed ports for reproducible
+        layouts (ids are SHA-1 of ip:port, SURVEY §4 determinism trick)."""
+        for i, kind in enumerate(kinds):
+            if kind == "cc":
+                p = NativeChordPeer("127.0.0.1", base_port + i, 3,
+                                    maintenance_interval=None)
+            else:
+                p = ChordPeer("127.0.0.1", base_port + i, 3,
+                              maintenance_interval=None)
+            peers.append(p)
+            if i == 0:
+                p.start_chord()
+            else:
+                gw = peers[1] if len(peers) > 2 else peers[0]
+                p.join(gw.ip_addr, gw.port)
+        _converge(peers)
+        return peers
+
+    yield build
+    for p in peers:
+        p.fail()
+    for p in peers:
+        if hasattr(p, "close"):
+            p.close()
+
+
+def test_all_native_ring(ring):
+    peers = ring(["cc", "cc", "cc", "cc"], 19400)
+    _assert_ring(peers)
+    peers[0].create("nk", "nv")
+    for p in peers:
+        assert p.read("nk") == "nv"
+
+
+def test_mixed_ring_native_gateway(ring):
+    """Python peers join THROUGH a native gateway and vice versa."""
+    peers = ring(["py", "cc", "py", "cc", "py"], 19410)
+    _assert_ring(peers)
+    for k in range(10):
+        peers[k % 5].create(f"mixed-{k}", f"val-{k}")
+    for k in range(10):
+        assert peers[(k + 3) % 5].read(f"mixed-{k}") == f"val-{k}"
+
+
+def test_mixed_ring_key_transfer_on_join(ring):
+    """Keys created before a native peer joins migrate to it when its id
+    takes over the range (notify-from-pred transfer,
+    chord_peer.cpp:256-280 semantics on both implementations)."""
+    peers = ring(["py", "py"], 19420)
+    for k in range(24):
+        peers[0].create(f"xfer-{k}", f"v-{k}")
+    late = NativeChordPeer("127.0.0.1", 19423, 3,
+                           maintenance_interval=None)
+    peers.append(late)
+    late.join(peers[1].ip_addr, peers[1].port)
+    _converge(peers)
+    _assert_ring(peers)
+    assert late.db_size > 0 or all(
+        not Key.from_plaintext(f"xfer-{k}").in_between(
+            late.min_key, late.id, True) for k in range(24)), \
+        "native peer owns part of the keyspace but absorbed nothing"
+    for k in range(24):
+        assert peers[k % 3].read(f"xfer-{k}") == f"v-{k}"
+
+
+def test_mixed_ring_native_leave_hands_keys_over(ring):
+    peers = ring(["py", "cc", "py"], 19430)
+    for k in range(18):
+        peers[0].create(f"lv-{k}", f"w-{k}")
+    native = peers[1]
+    native.leave()
+    remaining = [peers[0], peers[2]]
+    _converge(remaining)
+    _assert_ring(remaining)
+    for k in range(18):
+        assert remaining[k % 2].read(f"lv-{k}") == f"w-{k}", \
+            f"key lv-{k} lost after native leave"
+
+
+def test_mixed_ring_survives_native_failure(ring):
+    """Silent native-peer death; stabilize repairs the ring around it
+    (Fail + rectify path, chord_peer.cpp:293-300 /
+    abstract_chord_peer.cpp:647-698)."""
+    peers = ring(["py", "cc", "py", "py"], 19440)
+    _assert_ring(peers)
+    victim = peers[1]
+    victim.fail()
+    survivors = [peers[0], peers[2], peers[3]]
+    _converge(survivors, rounds=3)
+    _assert_ring(survivors)
+    survivors[0].create("after-fail", "alive")
+    for p in survivors:
+        assert p.read("after-fail") == "alive"
