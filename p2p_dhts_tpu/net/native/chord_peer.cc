@@ -25,9 +25,12 @@
 #include <map>
 #include <memory>
 #include <optional>
+#include <random>
 #include <stdexcept>
 
 #include "engine.h"
+#include "ida.h"
+#include "merkle.h"
 
 namespace nc {
 
@@ -305,6 +308,24 @@ class PeerListN {
     return std::nullopt;
   }
 
+  // First alive entry at-or-after the owning one (remote_peer_list.cpp:
+  // 112-132; scan actually runs here — the reference's fallback loop is
+  // dead code, a documented fix shared with the Python twin).
+  std::optional<NPeer> lookup_living(u128 key) const {
+    std::optional<NPeer> succ = lookup(key);
+    if (!succ) return std::nullopt;
+    if (succ->is_alive()) return succ;
+    std::vector<NPeer> snapshot = entries();
+    size_t start = 0;
+    for (size_t i = 0; i < snapshot.size(); i++)
+      if (snapshot[i].id == succ->id) start = i;
+    for (size_t off = 1; off < snapshot.size(); off++) {
+      const NPeer& p = snapshot[(start + off) % snapshot.size()];
+      if (p.is_alive()) return p;
+    }
+    return std::nullopt;
+  }
+
   void del(u128 id) {
     std::lock_guard<std::recursive_mutex> g(mu_);
     for (size_t i = 0; i < peers_.size(); i++)
@@ -403,34 +424,58 @@ Jv cmd(const char* name) {
   return r;
 }
 
-class ChordPeerN {
+// Protocol core shared by the native Chord and DHash peers — the twin of
+// AbstractChordPeer (abstract_chord_peer.{h,cpp}) / overlay/chord_peer.py's
+// AbstractChordPeer. Storage behavior (create/read handlers, key transfer,
+// maintenance body) is virtual, exactly the reference's pure-virtual split
+// (abstract_chord_peer.h:97-367).
+//
+// Two-phase init: the base constructor binds the server (the port feeds the
+// peer id) but does NOT start serving — derived constructors call
+// finish_init() once their storage exists, so no request ever reaches a
+// half-built object (and no virtual dispatch happens during construction).
+class AbstractPeerN {
  public:
-  ChordPeerN(const std::string& ip, int port, int num_succs,
-             double maintenance_interval_s)
+  AbstractPeerN(const std::string& ip, int port, int num_succs,
+                double maintenance_interval_s, int num_threads = 3)
       : ip_(ip),
         num_succs_(num_succs),
         maint_interval_(maintenance_interval_s),
         fingers_(0),          // re-seeded below once the port is known
         succs_(num_succs, 0) {
-    server_ = ns::server_create(port, 3, 0, nullptr, nullptr);
+    // num_threads defaults to the reference's 3 io workers
+    // (chord_peer.cpp:42); deep recursive maintenance chains can starve 3
+    // workers into 5 s-timeout storms (the reference sleeps these out),
+    // so harnesses may raise it — same escape hatch as rpc.py.
+    server_ = ns::server_create(port, num_threads > 0 ? num_threads : 3, 0,
+                                nullptr, nullptr);
     if (!server_) throw std::runtime_error("could not bind server");
     port_ = server_->port;
     id_ = id_for(ip_, port_);
     min_key_ = id_;
     fingers_.set_start(id_);
     succs_.set_start(id_);
+  }
+
+  virtual ~AbstractPeerN() { fail(); delete server_; }
+
+  // Called at the END of every concrete constructor.
+  void finish_init() {
     server_->native_cb = [this](const std::string& command, const Jv& req,
                                 Jv& result) { dispatch(command, req, result); };
-    for (const char* c : {"JOIN", "NOTIFY", "LEAVE", "GET_SUCC", "GET_PRED",
-                          "CREATE_KEY", "READ_KEY", "RECTIFY"})
-      server_->commands.insert(c);
+    for (const std::string& c : command_names()) server_->commands.insert(c);
     ns::server_run(server_);
   }
 
-  ~ChordPeerN() { fail(); delete server_; }
-
   int port() const { return port_; }
   u128 id() const { return id_; }
+  int num_succs() const { return num_succs_; }
+  int succ_count() const { return succs_.size(); }
+  NPeer succ_nth(int i) const { return succs_.nth(i); }
+  void populate_succs(const std::vector<NPeer>& v) { succs_.populate(v); }
+  std::optional<NPeer> lookup_living_succ(u128 k) const {
+    return succs_.lookup_living(k);
+  }
   u128 min_key() const {
     std::lock_guard<std::recursive_mutex> g(pred_mu_);
     return min_key_;
@@ -439,7 +484,12 @@ class ChordPeerN {
     std::lock_guard<std::recursive_mutex> g(pred_mu_);
     return pred_;
   }
-  size_t db_size() const { return db_.size(); }
+  virtual size_t db_size() const = 0;
+  // Storage surface (pure virtual like the reference's Create/Read,
+  // abstract_chord_peer.h:97-160): chord stores text, dhash stores
+  // erasure-coded fragments.
+  virtual void create_kv(u128 key, const std::string& val) = 0;
+  virtual std::string read_kv(u128 key) = 0;
 
   NPeer self() const {
     NPeer p;
@@ -516,30 +566,6 @@ class ChordPeerN {
     if (server_ && server_->alive.load()) ns::server_kill(server_);
   }
 
-  // -- create/read (chord_peer.cpp:77-177) --------------------------------
-  void create_text(u128 key, const std::string& val) {
-    if (stored_locally(key)) {
-      db_.insert(key, val);
-      return;
-    }
-    NPeer succ = get_successor(key);
-    Jv r = cmd("CREATE_KEY");
-    r.set("KEY", Jv::of(hex_of(key)));
-    r.set("VALUE", Jv::of(val));
-    succ.send_request(r);  // throws on SUCCESS=false
-  }
-
-  std::string read_text(u128 key) {
-    if (stored_locally(key)) return db_.lookup(key);
-    NPeer succ = get_successor(key);
-    Jv r = cmd("READ_KEY");
-    r.set("KEY", Jv::of(hex_of(key)));
-    Jv resp = succ.send_request(r);
-    const Jv* v = resp.find("VALUE");
-    if (!v) throw std::runtime_error("Key not stored on peer.");
-    return v->s;
-  }
-
   // -- stabilize (abstract_chord_peer.cpp:460-505) ------------------------
   void stabilize() {
     {
@@ -568,9 +594,15 @@ class ChordPeerN {
     populate_finger_table(false);
   }
 
- private:
+ protected:
   // -- dispatch -----------------------------------------------------------
-  void dispatch(const std::string& command, const Jv& req, Jv& result) {
+  virtual std::vector<std::string> command_names() const {
+    return {"JOIN",     "NOTIFY",     "LEAVE",    "GET_SUCC",
+            "GET_PRED", "CREATE_KEY", "READ_KEY", "RECTIFY"};
+  }
+
+  virtual void dispatch(const std::string& command, const Jv& req,
+                        Jv& result) {
     if (command == "JOIN") result = join_handler(req);
     else if (command == "NOTIFY") result = notify_handler(req);
     else if (command == "LEAVE") result = leave_handler(req);
@@ -659,23 +691,8 @@ class ChordPeerN {
     return get_predecessor(key_arg(req, "KEY")).to_json();
   }
 
-  Jv create_key_handler(const Jv& req) {
-    u128 key = key_arg(req, "KEY");
-    if (!stored_locally(key)) throw std::runtime_error("Key not in range.");
-    const Jv* v = req.find("VALUE");
-    if (!v) throw std::runtime_error("missing VALUE");
-    db_.insert(key, v->s);
-    return Jv::object();
-  }
-
-  Jv read_key_handler(const Jv& req) {
-    u128 key = key_arg(req, "KEY");
-    if (!stored_locally(key))
-      throw std::runtime_error("Key not stored locally.");
-    Jv out = Jv::object();
-    out.set("VALUE", Jv::of(db_.lookup(key)));
-    return out;
-  }
+  virtual Jv create_key_handler(const Jv& req) = 0;
+  virtual Jv read_key_handler(const Jv& req) = 0;
 
   // ref RectifyHandler (abstract_chord_peer.cpp:684-698).
   Jv rectify_handler(const Jv& req) {
@@ -701,38 +718,13 @@ class ChordPeerN {
     if (keys) absorb_keys(*keys);
   }
 
-  Jv handle_notify_from_pred(const NPeer& new_pred) {
-    std::map<u128, std::string> to_transfer =
-        db_.read_range(min_key(), new_pred.id);
-    Jv data = Jv::object();
-    for (const auto& kv : to_transfer) {
-      data.set(hex_of(kv.first), Jv::of(kv.second));
-      db_.del(kv.first);
-    }
-    fingers_.adjust(new_pred);
-    set_pred(new_pred);
-    set_min_key(new_pred.id + 1);
-    Jv out = Jv::object();
-    out.set("KEYS_TO_ABSORB", data);
-    return out;
-  }
+  virtual Jv handle_notify_from_pred(const NPeer& new_pred) = 0;
+  virtual void absorb_keys(const Jv& kv_pairs) = 0;
+  virtual Jv keys_as_json() const = 0;
 
   void handle_pred_failure(const NPeer& old_pred) {
     fingers_.adjust(self());
     rectify(old_pred);
-  }
-
-  void absorb_keys(const Jv& kv_pairs) {
-    if (kv_pairs.t != Jv::T::Obj) return;
-    for (const auto& kv : kv_pairs.obj)
-      db_.insert(parse_hex(kv.first), kv.second.s);
-  }
-
-  Jv keys_as_json() const {
-    Jv out = Jv::object();
-    for (const auto& kv : db_.entries())
-      out.set(hex_of(kv.first), Jv::of(kv.second));
-    return out;
   }
 
   // -- resolution (abstract_chord_peer.cpp:313-449) ------------------------
@@ -794,7 +786,9 @@ class ChordPeerN {
   }
 
   // ref ForwardRequest (chord_peer.cpp:185-211).
-  Jv forward_request(u128 key, const Jv& request) {
+  // Chord routing (chord_peer.cpp:185-211); the DHash peer overrides with
+  // the lookup_living fallback variant (dhash_peer.cpp:500-529).
+  virtual Jv forward_request(u128 key, const Jv& request) {
     NPeer key_succ = fingers_.lookup(key);
     auto p = predecessor();
     if (key_succ.id == id_ && p && p->is_alive()) {
@@ -931,7 +925,7 @@ class ChordPeerN {
           continue;
         }
         try {
-          stabilize();
+          maintenance_body();
         } catch (const std::exception&) {
           // catch-and-continue (chord_peer.cpp:225-238)
         }
@@ -955,10 +949,545 @@ class ChordPeerN {
   mutable std::recursive_mutex pred_mu_;
   FingerTableN fingers_;
   PeerListN succs_;
-  TextDbN db_;
   ns::Server* server_ = nullptr;
   std::thread maint_thread_;
   std::atomic<bool> maint_stop_{false};
+
+ protected:
+  // ref: DHash maintenance = stabilize + global + local
+  // (dhash_peer.cpp:271-296); chord is stabilize only.
+  virtual void maintenance_body() { stabilize(); }
+};
+
+// ---------------------------------------------------------------------------
+// ChordPeerN — plain text storage (ref ChordPeer, chord_peer.{h,cpp})
+// ---------------------------------------------------------------------------
+
+class ChordPeerN : public AbstractPeerN {
+ public:
+  ChordPeerN(const std::string& ip, int port, int num_succs,
+             double maintenance_interval_s, int num_threads = 3)
+      : AbstractPeerN(ip, port, num_succs, maintenance_interval_s,
+                      num_threads) {
+    finish_init();
+  }
+
+  ~ChordPeerN() override { fail(); }
+
+  size_t db_size() const override { return db_.size(); }
+
+  // -- create/read (chord_peer.cpp:77-177) --------------------------------
+  void create_kv(u128 key, const std::string& val) override {
+    if (stored_locally(key)) {
+      db_.insert(key, val);
+      return;
+    }
+    NPeer succ = get_successor(key);
+    Jv r = cmd("CREATE_KEY");
+    r.set("KEY", Jv::of(hex_of(key)));
+    r.set("VALUE", Jv::of(val));
+    succ.send_request(r);  // throws on SUCCESS=false
+  }
+
+  std::string read_kv(u128 key) override {
+    if (stored_locally(key)) return db_.lookup(key);
+    NPeer succ = get_successor(key);
+    Jv r = cmd("READ_KEY");
+    r.set("KEY", Jv::of(hex_of(key)));
+    Jv resp = succ.send_request(r);
+    const Jv* v = resp.find("VALUE");
+    if (!v) throw std::runtime_error("Key not stored on peer.");
+    return v->s;
+  }
+
+ protected:
+  Jv create_key_handler(const Jv& req) override {
+    u128 key = key_arg(req, "KEY");
+    if (!stored_locally(key)) throw std::runtime_error("Key not in range.");
+    const Jv* v = req.find("VALUE");
+    if (!v) throw std::runtime_error("missing VALUE");
+    db_.insert(key, v->s);
+    return Jv::object();
+  }
+
+  Jv read_key_handler(const Jv& req) override {
+    u128 key = key_arg(req, "KEY");
+    if (!stored_locally(key))
+      throw std::runtime_error("Key not stored locally.");
+    Jv out = Jv::object();
+    out.set("VALUE", Jv::of(db_.lookup(key)));
+    return out;
+  }
+
+  // Key transfer on notify-from-pred (chord_peer.cpp:242-310).
+  Jv handle_notify_from_pred(const NPeer& new_pred) override {
+    std::map<u128, std::string> to_transfer =
+        db_.read_range(min_key(), new_pred.id);
+    Jv data = Jv::object();
+    for (const auto& kv : to_transfer) {
+      data.set(hex_of(kv.first), Jv::of(kv.second));
+      db_.del(kv.first);
+    }
+    fingers_.adjust(new_pred);
+    set_pred(new_pred);
+    set_min_key(new_pred.id + 1);
+    Jv out = Jv::object();
+    out.set("KEYS_TO_ABSORB", data);
+    return out;
+  }
+
+  void absorb_keys(const Jv& kv_pairs) override {
+    if (kv_pairs.t != Jv::T::Obj) return;
+    for (const auto& kv : kv_pairs.obj)
+      db_.insert(parse_hex(kv.first), kv.second.s);
+  }
+
+  Jv keys_as_json() const override {
+    Jv out = Jv::object();
+    for (const auto& kv : db_.entries())
+      out.set(hex_of(kv.first), Jv::of(kv.second));
+    return out;
+  }
+
+ private:
+  TextDbN db_;
+};
+
+// ---------------------------------------------------------------------------
+// DHashPeerN — erasure-coded fragment storage with Merkle anti-entropy
+// (ref DHashPeer, dhash_peer.{h,cpp}; Python twin overlay/dhash_peer.py)
+// ---------------------------------------------------------------------------
+
+// Remote-node view over XCHNG_NODE payloads (Python _RemoteNodeView).
+struct RemoteNodeView {
+  u128 hash = 0;
+  std::vector<int> position;
+  bool leaf = false;
+  std::vector<u128> kv_keys;
+  std::vector<u128> child_hashes;
+
+  explicit RemoteNodeView(const Jv& o) {
+    const Jv* h = o.find("HASH");
+    if (h && h->t == Jv::T::Str) hash = parse_hex(h->s);
+    const Jv* pos = o.find("POSITION");
+    if (pos && pos->t == Jv::T::Arr)
+      for (const auto& e : pos->arr) position.push_back(int(e.i));
+    const Jv* kvs = o.find("KV_PAIRS");
+    if (kvs) {
+      leaf = true;
+      if (kvs->t == Jv::T::Obj)
+        for (const auto& kv : kvs->obj) kv_keys.push_back(parse_hex(kv.first));
+    }
+    const Jv* ch = o.find("CHILDREN");
+    if (ch && ch->t == Jv::T::Arr)
+      for (const auto& c : ch->arr) {
+        const Jv* chh = c.find("HASH");
+        child_hashes.push_back(
+            chh && chh->t == Jv::T::Str ? parse_hex(chh->s) : 0);
+      }
+  }
+};
+
+class DHashPeerN : public AbstractPeerN {
+ public:
+  // num_replicas doubles as the succ-list length AND the replication
+  // factor n (dhash_peer.h:20-81); IDA defaults n=14 m=10 p=257.
+  DHashPeerN(const std::string& ip, int port, int num_replicas,
+             double maintenance_interval_s, int num_threads = 3)
+      : AbstractPeerN(ip, port, num_replicas, maintenance_interval_s,
+                      num_threads),
+        rng_(uint64_t(id()) ^ uint64_t(port)) {  // low id bits seed
+    finish_init();
+  }
+
+  ~DHashPeerN() override { fail(); }
+
+  void set_ida_params(int n, int m, long long p) {
+    std::lock_guard<std::recursive_mutex> g(ida_mu_);
+    IdaC check(n, m, p);  // validates n > m, p > n, p >= 257
+    (void)check;
+    n_ = n; m_ = m; p_ = p;
+  }
+
+  size_t db_size() const override { return db_.size(); }
+
+  // -- create (dhash_peer.cpp:89-154) -------------------------------------
+  void create_kv(u128 key, const std::string& val) override {
+    int n, m;
+    long long p;
+    ida_params(n, m, p);
+    std::vector<DataFragmentC> frags = IdaC(n, m, p).encode(val);
+    std::vector<NPeer> succ_list = get_n_successors(key, n);
+    if (int(succ_list.size()) < m)
+      throw std::runtime_error(
+          "Insufficient succs in list to complete request.");
+    int num_replicas = 0;
+    for (size_t i = 0; i < succ_list.size(); i++) {
+      const DataFragmentC& frag = frags[i];
+      if (succ_list[i].id == id()) {
+        db_.insert(key, frag);
+        num_replicas++;
+      } else if (succ_list[i].is_alive()) {
+        try {
+          if (create_fragment(key, frag, succ_list[i])) num_replicas++;
+        } catch (const std::exception&) {
+        }
+      }
+    }
+    if (num_replicas < m)
+      throw std::runtime_error("Too few succs responded to requests.");
+  }
+
+  // -- read (dhash_peer.cpp:156-217) --------------------------------------
+  std::string read_kv(u128 key) override {
+    int n, m;
+    long long p;
+    ida_params(n, m, p);
+    std::vector<NPeer> succ_list = get_n_successors(key, num_succs());
+    std::map<int, DataFragmentC> fragments;  // distinct by index
+    for (const auto& succ : succ_list) {
+      if (int(fragments.size()) == m) break;
+      if (succ.id == id() && db_.contains(key)) {
+        DataFragmentC f = db_.lookup(key);
+        fragments[f.index] = f;
+      } else {
+        try {
+          DataFragmentC f = read_fragment(key, succ);
+          fragments[f.index] = f;
+        } catch (const std::exception&) {
+          continue;
+        }
+      }
+    }
+    if (int(fragments.size()) < m)
+      throw std::runtime_error("Less than m distinct frags.");
+    std::vector<DataFragmentC> ordered;
+    for (const auto& kv : fragments) ordered.push_back(kv.second);
+    return IdaC(n, m, p).decode(ordered);
+  }
+
+  // -- maintenance (dhash_peer.cpp:265-365) --------------------------------
+  void run_global_maintenance() {
+    // Walk own DB ring-wise; push misplaced keys to their true successors
+    // and delete locally (dhash_peer.cpp:298-348). Same snapshot +
+    // clockwise-watermark structure as the Python twin: a live
+    // next()-driven walk anchored to the first stored key livelocks when
+    // that key is pushed-and-deleted mid-walk (a just-joined successor
+    // triggers exactly this); the snapshot walk performs the same
+    // per-range actions with guaranteed termination.
+    int n, m;
+    long long p;
+    ida_params(n, m, p);  // locked read; set_ida_params may race otherwise
+    auto ring_pos = [this](u128 k) { return k - id() - 1; };  // u128 wrap
+    std::map<u128, DataFragmentC> snapshot = db_.entries();
+    std::vector<u128> ring;
+    for (const auto& kv : snapshot) ring.push_back(kv.first);
+    std::sort(ring.begin(), ring.end(),
+              [&](u128 a, u128 b) { return ring_pos(a) < ring_pos(b); });
+    bool have_wm = false;
+    u128 watermark = 0;
+    for (u128 next_key : ring) {
+      if (have_wm && ring_pos(next_key) <= watermark) continue;
+      std::vector<NPeer> succs = get_n_successors(next_key, n);
+      bool misplaced = true;
+      for (const auto& s : succs)
+        if (s.id == id()) misplaced = false;
+      if (misplaced && !succs.empty()) {
+        for (const auto& succ : succs) {
+          std::map<u128, DataFragmentC> have_remote;
+          try {
+            have_remote = read_range_rpc(succ, next_key, succs[0].id);
+          } catch (const std::exception&) {
+            continue;
+          }
+          std::map<u128, DataFragmentC> local =
+              db_.read_range(next_key, succs[0].id);
+          for (const auto& kv : local) {
+            if (have_remote.count(kv.first)) continue;
+            try {
+              create_fragment(kv.first, kv.second, succ);
+              db_.erase(kv.first);
+            } catch (const std::exception&) {
+            }
+          }
+        }
+      }
+      u128 pos = succs.empty() ? ring_pos(next_key)
+                               : ring_pos(succs[0].id);
+      if (!have_wm || pos > watermark) watermark = pos;
+      have_wm = true;
+    }
+  }
+
+  void run_local_maintenance() {
+    // Merkle-sync own range with every successor (dhash_peer.cpp:350-365).
+    if (db_.size() == 0) return;
+    for (int i = 0; i < succ_count(); i++) {
+      NPeer succ = succ_nth(i);
+      if (succ.id == id()) continue;
+      try {
+        synchronize(succ, min_key(), id());
+      } catch (const std::exception&) {
+        continue;
+      }
+    }
+  }
+
+ protected:
+  std::vector<std::string> command_names() const override {
+    auto base = AbstractPeerN::command_names();
+    base.push_back("READ_RANGE");
+    base.push_back("XCHNG_NODE");
+    return base;
+  }
+
+  void dispatch(const std::string& command, const Jv& req,
+                Jv& result) override {
+    if (command == "READ_RANGE") result = read_range_handler(req);
+    else if (command == "XCHNG_NODE") result = exchange_node_handler(req);
+    else AbstractPeerN::dispatch(command, req, result);
+  }
+
+  void maintenance_body() override {
+    stabilize();
+    run_global_maintenance();
+    run_local_maintenance();
+  }
+
+  Jv create_key_handler(const Jv& req) override {
+    u128 key = key_arg(req, "KEY");
+    if (db_.contains(key))
+      throw std::runtime_error("Key already exists in db.");
+    const Jv* v = req.find("VALUE");
+    if (!v) throw std::runtime_error("missing VALUE");
+    db_.insert(key, DataFragmentC::from_json(*v));
+    return Jv::object();
+  }
+
+  Jv read_key_handler(const Jv& req) override {
+    u128 key = key_arg(req, "KEY");
+    Jv out = Jv::object();
+    out.set("VALUE", db_.lookup(key).to_json());
+    return out;
+  }
+
+  Jv read_range_handler(const Jv& req) {
+    u128 lb = key_arg(req, "LOWER_BOUND");
+    u128 ub = key_arg(req, "UPPER_BOUND");
+    Jv pairs = Jv::array();
+    for (const auto& kv : db_.read_range(lb, ub)) {
+      Jv entry = Jv::object();
+      entry.set("KEY", Jv::of(hex_of(kv.first)));
+      entry.set("VAL", kv.second.to_json());
+      pairs.arr.push_back(entry);
+    }
+    Jv out = Jv::object();
+    out.set("KV_PAIRS", pairs);
+    return out;
+  }
+
+  // Value snapshot of one local node — everything compare_nodes needs,
+  // taken under a short lock so NO db lock is ever held across the
+  // network calls compare/retrieve make (the Python/reference pattern:
+  // per-op locks only; a handler blocking on I/O while holding the tree
+  // lock starves the 3 server workers).
+  struct LocalNodeView {
+    bool leaf = false;
+    u128 min_key = 0, max_key = 0;
+    Jv serialized;
+  };
+
+  LocalNodeView snapshot_node(const std::vector<int>& position) const {
+    std::lock_guard<std::recursive_mutex> g(db_.mutex());
+    const MerkleNodeT<DataFragmentC>* node =
+        db_.root().by_position(position);
+    LocalNodeView v;
+    v.leaf = node->is_leaf();
+    v.min_key = node->min_key();
+    v.max_key = node->max_key();
+    v.serialized = node->serialize(true);
+    return v;
+  }
+
+  // ref ExchangeNodeHandler (dhash_peer.cpp:449-481).
+  Jv exchange_node_handler(const Jv& req) {
+    const Jv* nodej = req.find("NODE");
+    if (!nodej) throw std::runtime_error("missing NODE");
+    RemoteNodeView remote(*nodej);
+    const Jv* reqj = req.find("REQUESTER");
+    if (!reqj) throw std::runtime_error("missing REQUESTER");
+    NPeer requester = NPeer::from_json(*reqj);
+    u128 lb = key_arg(req, "LOWER_BOUND");
+    u128 ub = key_arg(req, "UPPER_BOUND");
+    LocalNodeView local = snapshot_node(remote.position);
+    compare_nodes(remote, local, requester, lb, ub);
+    // Re-snapshot: compare may have inserted retrieved fragments.
+    return snapshot_node(remote.position).serialized;
+  }
+
+  // DHash joins move no keys (dhash_peer.cpp:531-570): replication +
+  // maintenance own placement.
+  Jv handle_notify_from_pred(const NPeer& new_pred) override {
+    fingers_.adjust(new_pred);
+    set_pred(new_pred);
+    set_min_key(new_pred.id + 1);
+    if (succ_count() == 0)
+      populate_succs(get_n_successors(id() + 1, num_succs()));
+    return Jv::object();
+  }
+
+  void absorb_keys(const Jv&) override {}
+
+  Jv keys_as_json() const override { return Jv::object(); }
+
+  // LookupLiving fallback variant (dhash_peer.cpp:500-529).
+  Jv forward_request(u128 key, const Jv& request) override {
+    NPeer key_succ = fingers_.lookup(key);
+    auto p = predecessor();
+    if (key_succ.id == id() && p && p->is_alive()) {
+      key_succ = *p;
+    } else if (!key_succ.is_alive()) {
+      auto living = lookup_living_succ(key);
+      if (living) {
+        key_succ = *living;
+      } else if (succ_count() > 0 && succ_nth(0).is_alive()) {
+        key_succ = succ_nth(0);
+      } else {
+        throw std::runtime_error("Lookup failed");
+      }
+    }
+    return key_succ.send_request(request);
+  }
+
+ private:
+  void ida_params(int& n, int& m, long long& p) const {
+    std::lock_guard<std::recursive_mutex> g(ida_mu_);
+    n = n_; m = m_; p = p_;
+  }
+
+  bool create_fragment(u128 key, const DataFragmentC& frag,
+                       const NPeer& peer) {
+    Jv r = cmd("CREATE_KEY");
+    r.set("KEY", Jv::of(hex_of(key)));
+    r.set("VALUE", frag.to_json());
+    peer.send_request(r);  // throws on SUCCESS=false
+    return true;
+  }
+
+  DataFragmentC read_fragment(u128 key, const NPeer& peer) {
+    Jv r = cmd("READ_KEY");
+    r.set("KEY", Jv::of(hex_of(key)));
+    Jv resp = peer.send_request(r);
+    const Jv* v = resp.find("VALUE");
+    if (!v) throw std::runtime_error("no VALUE in READ_KEY reply");
+    return DataFragmentC::from_json(*v);
+  }
+
+  std::map<u128, DataFragmentC> read_range_rpc(const NPeer& succ, u128 lb,
+                                               u128 ub) {
+    Jv r = cmd("READ_RANGE");
+    r.set("LOWER_BOUND", Jv::of(hex_of(lb)));
+    r.set("UPPER_BOUND", Jv::of(hex_of(ub)));
+    Jv resp = succ.send_request(r);
+    std::map<u128, DataFragmentC> out;
+    const Jv* pairs = resp.find("KV_PAIRS");
+    if (pairs && pairs->t == Jv::T::Arr)
+      for (const auto& kv : pairs->arr) {
+        const Jv* k = kv.find("KEY");
+        const Jv* v = kv.find("VAL");
+        if (k && k->t == Jv::T::Str && v)
+          out.emplace(parse_hex(k->s), DataFragmentC::from_json(*v));
+      }
+    return out;
+  }
+
+  // -- Merkle sync protocol (dhash_peer.cpp:381-481) ----------------------
+  void synchronize(const NPeer& succ, u128 lb, u128 ub) {
+    sync_helper(succ, lb, ub, {});
+  }
+
+  // Recurse by POSITION rather than node pointer: every XCHNG_NODE may
+  // mutate our tree (retrieve_missing inserts can split leaves), so
+  // child pointers from before the RPC may dangle. Positions re-resolve.
+  void sync_helper(const NPeer& succ, u128 lb, u128 ub,
+                   std::vector<int> position) {
+    LocalNodeView local = snapshot_node(position);
+    RemoteNodeView remote(exchange_node(succ, local.serialized, lb, ub));
+    compare_nodes(remote, snapshot_node(position), succ, lb, ub);
+    if (!remote.leaf) {
+      std::vector<u128> local_child_hashes;
+      {
+        std::lock_guard<std::recursive_mutex> g(db_.mutex());
+        const auto* node = db_.root().by_position(position);
+        if (node->is_leaf()) return;
+        for (const auto& c : node->children())
+          local_child_hashes.push_back(c.hash());
+      }
+      for (size_t i = 0; i < local_child_hashes.size() &&
+                         i < remote.child_hashes.size(); i++) {
+        if (remote.child_hashes[i] != local_child_hashes[i]) {
+          std::vector<int> child_pos = position;
+          child_pos.push_back(int(i));
+          sync_helper(succ, lb, ub, child_pos);
+        }
+      }
+    }
+  }
+
+  Jv exchange_node(const NPeer& succ, const Jv& node_json, u128 lb,
+                   u128 ub) {
+    Jv r = cmd("XCHNG_NODE");
+    r.set("NODE", node_json);
+    r.set("REQUESTER", self().to_json());
+    r.set("LOWER_BOUND", Jv::of(hex_of(lb)));
+    r.set("UPPER_BOUND", Jv::of(hex_of(ub)));
+    return succ.send_request(r);
+  }
+
+  // ref CompareNodes (dhash_peer.cpp:416-441). Takes a value snapshot of
+  // the local node: this method does network I/O and must not require
+  // the db lock.
+  void compare_nodes(const RemoteNodeView& remote,
+                     const LocalNodeView& local, const NPeer& succ,
+                     u128 lb, u128 ub) {
+    if (remote.leaf) {
+      for (u128 k : remote.kv_keys)
+        if (is_missing(k, lb, ub)) retrieve_missing(k);
+    } else if (local.leaf) {
+      // Shape mismatch: pull everything the remote has in this range.
+      u128 node_lb = local.min_key;
+      u128 node_ub = local.max_key - 1;  // sentinel 0 wraps to 2^128-1
+      std::map<u128, DataFragmentC> succ_kvs;
+      try {
+        succ_kvs = read_range_rpc(succ, node_lb, node_ub);
+      } catch (const std::exception&) {
+        return;
+      }
+      for (const auto& kv : succ_kvs)
+        if (is_missing(kv.first, lb, ub)) retrieve_missing(kv.first);
+    }
+  }
+
+  bool is_missing(u128 k, u128 lb, u128 ub) const {
+    return in_between(k, lb, ub, true) && !db_.contains(k);
+  }
+
+  // Read the whole block, store ONE RANDOM fragment — the reference's
+  // exact (quirky) behavior (dhash_peer.cpp:367-379).
+  void retrieve_missing(u128 key) {
+    std::string val = read_kv(key);
+    int n, m;
+    long long p;
+    ida_params(n, m, p);
+    std::vector<DataFragmentC> frags = IdaC(n, m, p).encode(val);
+    db_.insert(key, frags[rng_() % frags.size()]);
+  }
+
+  int n_ = 14, m_ = 10;
+  long long p_ = 257;  // dhash_peer.cpp:14-16
+  mutable std::recursive_mutex ida_mu_;
+  MerkleDbT<DataFragmentC> db_;
+  std::mt19937_64 rng_;
 };
 
 thread_local std::string g_last_error;
@@ -986,9 +1515,10 @@ int guarded(F&& f) {
 extern "C" {
 
 void* nc_peer_create(const char* ip, int port, int num_succs,
-                     double maintenance_interval_s) {
+                     double maintenance_interval_s, int num_threads) {
   try {
-    return new nc::ChordPeerN(ip, port, num_succs, maintenance_interval_s);
+    return new nc::ChordPeerN(ip, port, num_succs, maintenance_interval_s,
+                              num_threads);
   } catch (const std::exception& e) {
     nc::g_last_error = e.what();
     return nullptr;
@@ -997,51 +1527,51 @@ void* nc_peer_create(const char* ip, int port, int num_succs,
 
 const char* nc_last_error() { return nc::g_last_error.c_str(); }
 
-int nc_peer_port(void* h) { return static_cast<nc::ChordPeerN*>(h)->port(); }
+int nc_peer_port(void* h) { return static_cast<nc::AbstractPeerN*>(h)->port(); }
 
 char* nc_peer_id_hex(void* h) {
-  return ns::dup_cstr(nc::hex_of(static_cast<nc::ChordPeerN*>(h)->id()));
+  return ns::dup_cstr(nc::hex_of(static_cast<nc::AbstractPeerN*>(h)->id()));
 }
 
 char* nc_peer_min_key_hex(void* h) {
-  return ns::dup_cstr(nc::hex_of(static_cast<nc::ChordPeerN*>(h)->min_key()));
+  return ns::dup_cstr(nc::hex_of(static_cast<nc::AbstractPeerN*>(h)->min_key()));
 }
 
 // Predecessor as a JSON object string, or "null" when unset.
 char* nc_peer_pred_json(void* h) {
-  auto p = static_cast<nc::ChordPeerN*>(h)->predecessor();
+  auto p = static_cast<nc::AbstractPeerN*>(h)->predecessor();
   return ns::dup_cstr(p ? ns::dumps(p->to_json()) : std::string("null"));
 }
 
 long long nc_peer_db_size(void* h) {
-  return (long long)static_cast<nc::ChordPeerN*>(h)->db_size();
+  return (long long)static_cast<nc::AbstractPeerN*>(h)->db_size();
 }
 
 int nc_peer_start_chord(void* h) {
   return nc::guarded(
-      [&] { static_cast<nc::ChordPeerN*>(h)->start_chord(); });
+      [&] { static_cast<nc::AbstractPeerN*>(h)->start_chord(); });
 }
 
 int nc_peer_join(void* h, const char* gw_ip, int gw_port) {
   return nc::guarded(
-      [&] { static_cast<nc::ChordPeerN*>(h)->join(gw_ip, gw_port); });
+      [&] { static_cast<nc::AbstractPeerN*>(h)->join(gw_ip, gw_port); });
 }
 
 int nc_peer_stabilize(void* h) {
-  return nc::guarded([&] { static_cast<nc::ChordPeerN*>(h)->stabilize(); });
+  return nc::guarded([&] { static_cast<nc::AbstractPeerN*>(h)->stabilize(); });
 }
 
 int nc_peer_leave(void* h) {
-  return nc::guarded([&] { static_cast<nc::ChordPeerN*>(h)->leave(); });
+  return nc::guarded([&] { static_cast<nc::AbstractPeerN*>(h)->leave(); });
 }
 
-void nc_peer_fail(void* h) { static_cast<nc::ChordPeerN*>(h)->fail(); }
+void nc_peer_fail(void* h) { static_cast<nc::AbstractPeerN*>(h)->fail(); }
 
 // key_hex: lowercase hex ring key (callers hash plaintext on their side,
 // exactly like the Python peer's Key.from_plaintext path).
 int nc_peer_create_key(void* h, const char* key_hex, const char* val) {
   return nc::guarded([&] {
-    static_cast<nc::ChordPeerN*>(h)->create_text(nc::parse_hex(key_hex), val);
+    static_cast<nc::AbstractPeerN*>(h)->create_kv(nc::parse_hex(key_hex), val);
   });
 }
 
@@ -1049,10 +1579,64 @@ int nc_peer_read_key(void* h, const char* key_hex, char** out) {
   *out = nullptr;
   return nc::guarded([&] {
     *out = ns::dup_cstr(
-        static_cast<nc::ChordPeerN*>(h)->read_text(nc::parse_hex(key_hex)));
+        static_cast<nc::AbstractPeerN*>(h)->read_kv(nc::parse_hex(key_hex)));
   });
 }
 
-void nc_peer_destroy(void* h) { delete static_cast<nc::ChordPeerN*>(h); }
+void nc_peer_destroy(void* h) { delete static_cast<nc::AbstractPeerN*>(h); }
+
+// -- DHash peer -------------------------------------------------------------
+
+void* nc_dhash_create(const char* ip, int port, int num_replicas,
+                      double maintenance_interval_s, int num_threads) {
+  try {
+    return new nc::DHashPeerN(ip, port, num_replicas,
+                              maintenance_interval_s, num_threads);
+  } catch (const std::exception& e) {
+    nc::g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+// Only valid on handles from nc_dhash_create.
+int nc_dhash_set_ida(void* h, int n, int m, long long p) {
+  return nc::guarded([&] {
+    static_cast<nc::DHashPeerN*>(h)->set_ida_params(n, m, p);
+  });
+}
+
+// Merkle parity probe: build a tree from comma-separated hex keys and
+// return its root serialization (HASH + structure) — pinned against the
+// Python MerkleTree in tests so the two XCHNG_NODE implementations are
+// provably hash-compatible, not just behaviorally convergent.
+char* nc_merkle_probe(const char* keys_csv) {
+  try {
+    nc::MerkleDbT<std::string> db;
+    std::string csv(keys_csv);
+    size_t start = 0;
+    while (start < csv.size()) {
+      size_t end = csv.find(',', start);
+      if (end == std::string::npos) end = csv.size();
+      if (end > start)
+        db.insert(nc::parse_hex(csv.substr(start, end - start)), "");
+      start = end + 1;
+    }
+    return ns::dup_cstr(ns::dumps(db.root().serialize(true)));
+  } catch (const std::exception& e) {
+    nc::g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+// One full maintenance round: stabilize + global + local (the stepped
+// deterministic analog of the 5 s loop, dhash_peer.cpp:271-296).
+int nc_dhash_maintain(void* h) {
+  return nc::guarded([&] {
+    auto* p = static_cast<nc::DHashPeerN*>(h);
+    p->stabilize();
+    p->run_global_maintenance();
+    p->run_local_maintenance();
+  });
+}
 
 }  // extern "C"

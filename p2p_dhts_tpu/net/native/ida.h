@@ -1,0 +1,281 @@
+// Native IDA (Rabin information dispersal) + DataFragment wire forms,
+// byte-compatible with ida.py and the reference (src/ida/).
+//
+// Mod-p math follows matrix_math.cpp semantics in int64 (the host-side
+// one-block path; bulk device encode/decode lives in ops/modp.py /
+// ops/modp_pallas.py). The inverse Vandermonde uses the same Lagrange
+// synthetic-division construction as ops/modp.py (same unique result as
+// the reference's elementary-symmetric method, matrix_math.cpp:103-168).
+//
+// Wire parity pinned by tests: DataFragment JSON {M,N,P,INDEX,FRAGMENT}
+// with fixed-width custom base-64 values (SerializeToBase64,
+// data_fragment.cpp:98-115), and the trailing-zero strip on decode
+// (ida.cpp:143-161 — all-zero input yields "", as in ida.py).
+#pragma once
+
+#include <array>
+#include <cmath>
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace nc {
+
+using Vec = std::vector<long long>;
+using Mat = std::vector<Vec>;
+
+inline long long pymod(long long a, long long p) {
+  long long r = a % p;
+  return r < 0 ? r + p : r;
+}
+
+inline long long mod_inverse_ll(long long x, long long p) {
+  // Fermat (p prime, an IDA invariant): x^(p-2) mod p.
+  long long result = 1, base = pymod(x, p), e = p - 2;
+  while (e > 0) {
+    if (e & 1) result = (result * base) % p;
+    base = (base * base) % p;
+    e >>= 1;
+  }
+  return result;
+}
+
+// Row a-1 = [a^0 .. a^(m-1)] mod p for a = 1..n (ConstructEncodingMatrix,
+// matrix_math.cpp:88-101).
+inline Mat vandermonde_matrix(int n, int m, long long p) {
+  Mat out = Mat(size_t(n), Vec(size_t(m)));
+  for (int a = 1; a <= n; a++) {
+    long long v = 1;
+    for (int j = 0; j < m; j++) {
+      out[size_t(a - 1)][size_t(j)] = v;
+      v = (v * a) % p;
+    }
+  }
+  return out;
+}
+
+// Inverse of V[i][j] = basis[i]^j mod p (Lagrange, mirrors
+// ops/modp.py vandermonde_inverse).
+inline Mat vandermonde_inverse(const Vec& basis, long long p) {
+  int m = int(basis.size());
+  // Master polynomial coefficients, ascending.
+  Vec coeffs = Vec(size_t(m) + 1, 0);
+  coeffs[0] = 1;
+  for (int t = 0; t < m; t++) {
+    long long b = pymod(basis[size_t(t)], p);
+    for (int j = m; j >= 0; j--) {
+      long long shifted = j > 0 ? coeffs[size_t(j - 1)] : 0;
+      coeffs[size_t(j)] = pymod(shifted - b * coeffs[size_t(j)], p);
+    }
+  }
+  // qs[k][i] = coeff of x^(m-1-k) in the synthetic division of P by
+  // (x - b_i).
+  Mat qs = Mat(size_t(m), Vec(size_t(m)));
+  for (int i = 0; i < m; i++) qs[0][size_t(i)] = 1;
+  for (int k = 1; k < m; k++)
+    for (int i = 0; i < m; i++)
+      qs[size_t(k)][size_t(i)] = pymod(
+          coeffs[size_t(m - k)] +
+              pymod(basis[size_t(i)], p) * qs[size_t(k - 1)][size_t(i)],
+          p);
+  // Denominators and inverse.
+  Mat inv = Mat(size_t(m), Vec(size_t(m)));
+  for (int i = 0; i < m; i++) {
+    long long denom = 1;
+    for (int t = 0; t < m; t++) {
+      if (t == i) continue;
+      denom = (denom * pymod(basis[size_t(i)] - basis[size_t(t)], p)) % p;
+    }
+    long long inv_denom = mod_inverse_ll(denom, p);
+    // inv[j][i] = coeff of x^j in l_i = qs[m-1-j][i] * inv_denom.
+    for (int j = 0; j < m; j++)
+      inv[size_t(j)][size_t(i)] =
+          (qs[size_t(m - 1 - j)][size_t(i)] * inv_denom) % p;
+  }
+  return inv;
+}
+
+// ---------------------------------------------------------------------------
+// DataFragment (data_fragment.{h,cpp})
+// ---------------------------------------------------------------------------
+
+inline const char* b64_alphabet() {
+  return "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+}
+
+inline int b64_digits_per_val(long long p) {
+  int d = int(std::ceil(std::log(double(p)) / std::log(64.0)));
+  return d < 1 ? 1 : d;
+}
+
+inline std::string serialize_base64(const Vec& values, int num_digits) {
+  long long limit = 1;
+  for (int i = 0; i < num_digits; i++) limit *= 64;
+  std::string out;
+  for (long long val : values) {
+    if (val < 0 || val >= limit)
+      throw std::runtime_error("Cannot encode value outside base64 range");
+    char digits[16];
+    for (int i = num_digits - 1; i >= 0; i--) {
+      digits[i] = b64_alphabet()[val % 64];
+      val /= 64;
+    }
+    out.append(digits, size_t(num_digits));
+  }
+  return out;
+}
+
+inline Vec parse_base64(const std::string& text, int num_digits) {
+  // Magic static: thread-safe lazy init (server workers parse fragments
+  // concurrently; a plain bool flag would be a data race).
+  static const std::array<int, 256> index = [] {
+    std::array<int, 256> t{};
+    t.fill(-1);
+    for (int i = 0; i < 64; i++) t[uint8_t(b64_alphabet()[i])] = i;
+    return t;
+  }();
+  if (text.size() % size_t(num_digits))
+    throw std::runtime_error("bad base64 fragment length");
+  Vec out;
+  for (size_t i = 0; i < text.size(); i += size_t(num_digits)) {
+    long long el = 0;
+    for (int j = 0; j < num_digits; j++) {
+      int d = index[uint8_t(text[i + size_t(j)])];
+      if (d < 0) throw std::runtime_error("bad base64 digit");
+      el = el * 64 + d;
+    }
+    out.push_back(el);
+  }
+  return out;
+}
+
+struct DataFragmentC {
+  Vec values;
+  int index = 0;
+  int n = 14, m = 10;
+  long long p = 257;  // defaults: data_fragment.h:31
+
+  // {M,N,P,INDEX,FRAGMENT} (ToJson, data_fragment.cpp:49-62) — field
+  // order matches ida.py DataFragment.to_json for byte-stable wire tests.
+  ns::Jv to_json() const {
+    ns::Jv o = ns::Jv::object();
+    o.set("M", ns::Jv::of((long long)m));
+    o.set("N", ns::Jv::of((long long)n));
+    o.set("P", ns::Jv::of(p));
+    o.set("INDEX", ns::Jv::of((long long)index));
+    o.set("FRAGMENT",
+          ns::Jv::of(serialize_base64(values, b64_digits_per_val(p))));
+    return o;
+  }
+
+  static DataFragmentC from_json(const ns::Jv& o) {
+    DataFragmentC f;
+    const ns::Jv* pv = o.find("P");
+    const ns::Jv* frag = o.find("FRAGMENT");
+    const ns::Jv* idx = o.find("INDEX");
+    const ns::Jv* nv = o.find("N");
+    const ns::Jv* mv = o.find("M");
+    if (!pv || !frag || !idx || !nv || !mv || frag->t != ns::Jv::T::Str)
+      throw std::runtime_error("corrupted fragment JSON");
+    f.p = pv->i;
+    f.values = parse_base64(frag->s, b64_digits_per_val(f.p));
+    f.index = int(idx->i);
+    f.n = int(nv->i);
+    f.m = int(mv->i);
+    return f;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// IDA encode/decode (ida.{h,cpp})
+// ---------------------------------------------------------------------------
+
+class IdaC {
+ public:
+  IdaC(int n, int m, long long p) : n_(n), m_(m), p_(p) {
+    if (n <= m || p <= n)
+      throw std::runtime_error("IDA requires n > m and p > n");
+    if (p <= 255)
+      throw std::runtime_error("byte-payload IDA requires p >= 257");
+    if (m >= 64) throw std::runtime_error("IDA m must be < 64");
+    enc_ = vandermonde_matrix(n, m, p);
+  }
+
+  int n() const { return n_; }
+  int m() const { return m_; }
+  long long p() const { return p_; }
+
+  // bytes -> n fragments, values per fragment = ceil(len/m)
+  // (SplitToSegments + Encode, ida.cpp:59-73,177-190).
+  std::vector<DataFragmentC> encode(const std::string& data) const {
+    size_t n_seg = data.empty() ? 0 : (data.size() + size_t(m_) - 1) / m_;
+    auto frags = std::vector<DataFragmentC>(size_t(n_));
+    for (int i = 0; i < n_; i++) {
+      frags[size_t(i)].index = i + 1;  // 1-based (data_fragment.cpp:171-179)
+      frags[size_t(i)].n = n_;
+      frags[size_t(i)].m = m_;
+      frags[size_t(i)].p = p_;
+      frags[size_t(i)].values.resize(n_seg);
+    }
+    for (size_t s = 0; s < n_seg; s++) {
+      long long seg[64] = {0};
+      for (int j = 0; j < m_; j++) {
+        size_t at = s * size_t(m_) + size_t(j);
+        seg[j] = at < data.size() ? (long long)(uint8_t)data[at] : 0;
+      }
+      for (int i = 0; i < n_; i++) {
+        long long acc = 0;
+        for (int j = 0; j < m_; j++)
+          acc += enc_[size_t(i)][size_t(j)] * seg[j];
+        frags[size_t(i)].values[s] = acc % p_;
+      }
+    }
+    return frags;
+  }
+
+  // First m fragments passed (ida.cpp:120-141), inverse-Vandermonde
+  // multiply, transpose, strip trailing zeros (ida.cpp:143-161).
+  std::string decode(const std::vector<DataFragmentC>& frags) const {
+    if (int(frags.size()) < m_)
+      throw std::runtime_error("need at least m fragments to decode");
+    Vec basis;
+    for (int i = 0; i < m_; i++)
+      basis.push_back(frags[size_t(i)].index);
+    Mat inv = vandermonde_inverse(basis, p_);
+    size_t n_seg = frags[0].values.size();
+    for (int i = 1; i < m_; i++)
+      if (frags[size_t(i)].values.size() != n_seg)
+        throw std::runtime_error(
+            "ragged fragments: inconsistent value counts");
+    // segments[s][j] = sum_k inv[j][k] * rows[k][s] mod p
+    std::string out;
+    out.reserve(n_seg * size_t(m_));
+    for (size_t s = 0; s < n_seg; s++) {
+      for (int j = 0; j < m_; j++) {
+        long long acc = 0;
+        for (int k = 0; k < m_; k++)
+          acc += inv[size_t(j)][size_t(k)] * frags[size_t(k)].values[s];
+        out.push_back(char(uint8_t(pymod(acc, p_) & 0xFF)));
+      }
+    }
+    // Strip: drop trailing all-zero segments then trailing zeros of the
+    // last remaining segment (strip_decoded parity; all-zero -> "").
+    size_t end = out.size();
+    while (end >= size_t(m_) &&
+           out.find_first_not_of('\0', end - size_t(m_)) >= end)
+      end -= size_t(m_);
+    while (end > 0 && out[end - 1] == '\0') end--;
+    out.resize(end);
+    return out;
+  }
+
+ private:
+  int n_, m_;
+  long long p_;
+  Mat enc_;
+};
+
+}  // namespace nc

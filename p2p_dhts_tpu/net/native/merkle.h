@@ -1,0 +1,309 @@
+// Native 8-ary keyspace-partitioned Merkle tree, hash-compatible with
+// overlay/merkle_tree.py and the reference's MerkleTree
+// (src/data_structures/merkle_tree.h): leaves split at > 8 entries, leaf
+// hashes cover KEYS only (SHA-1/UUIDv5 of concatenated minimal-hex keys),
+// internal hashes cover concatenated child hex hashes, empty nodes hash to
+// 0, keys route by depth-scaled 3-bit shifts (ChildNum,
+// merkle_tree.h:704-722). Byte-compatible NonRecursiveSerialize for the
+// XCHNG_NODE sync protocol (merkle_tree.h:592-620) — a C++ peer and a
+// Python peer must produce identical node JSON for identical key sets.
+//
+// Keyspace subtlety: node ranges are [min, max) with max up to 2^128,
+// which unsigned __int128 cannot hold. Here max==0 is the sentinel for
+// 2^128 (a real 0 upper bound cannot occur: ranges are non-empty). Wire
+// form writes the sentinel as "1" + 32 zeros, exactly like Python's
+// format(2**128, "x").
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "sha1.h"
+
+namespace nc {
+
+using u128 = unsigned __int128;
+
+std::string hex_of(u128 v);        // chord_peer.cc
+u128 parse_hex(const std::string&);
+
+constexpr int kMerkleChildren = 8;   // merkle_tree.h:790-791
+constexpr int kMerkleChildBits = 3;
+constexpr int kMerkleMaxLeaf = 8;    // split at > 8 (merkle_tree.h:126-128)
+constexpr int kMerkleKeyBits = 128;
+
+inline u128 sha1_id_str(const std::string& text) {
+  uint8_t raw[16];
+  ns::uuid5_dns(text, raw);
+  u128 v = 0;
+  for (int i = 0; i < 16; i++) v = (v << 8) | u128(raw[i]);
+  return v;
+}
+
+// max-key helpers honoring the 0 == 2^128 sentinel.
+inline std::string hex_of_max(u128 mx) {
+  if (mx == 0) return "1" + std::string(32, '0');
+  return hex_of(mx);
+}
+
+inline u128 parse_hex_max(const std::string& s) {
+  if (s.size() == 33) {
+    if (s[0] != '1' || s.find_first_not_of('0', 1) != std::string::npos)
+      throw std::runtime_error("bad max key: " + s);
+    return 0;  // 2^128 sentinel
+  }
+  return parse_hex(s);
+}
+
+template <typename V>
+class MerkleNodeT {
+ public:
+  MerkleNodeT(u128 min_key, u128 max_key, std::vector<int> position)
+      : min_(min_key), max_(max_key), position_(std::move(position)) {}
+
+  bool is_leaf() const { return children_.empty(); }
+  u128 hash() const { return hash_; }
+  u128 min_key() const { return min_; }
+  u128 max_key() const { return max_; }  // 0 == 2^128
+  const std::vector<int>& position() const { return position_; }
+  const std::vector<MerkleNodeT>& children() const { return children_; }
+  const std::map<u128, V>& data() const { return data_; }
+
+  // Route a key to a child slot (ChildNum, merkle_tree.h:704-722).
+  int child_num(u128 key) const {
+    if (max_ != 0 && key >= max_) return kMerkleChildren - 1;
+    if (key < min_) return 0;
+    int shift = kMerkleKeyBits - kMerkleChildBits * (int(position_.size()) + 1);
+    return int((key >> shift) & u128(kMerkleChildren - 1));
+  }
+
+  void insert(u128 key, const V& val) {
+    if (is_leaf()) {
+      data_[key] = val;
+      if (int(data_.size()) > kMerkleMaxLeaf) create_children();
+    } else {
+      children_[child_num(key)].insert(key, val);
+    }
+    rehash();
+  }
+
+  const V& lookup(u128 key) const {
+    if (is_leaf()) {
+      auto it = data_.find(key);
+      if (it == data_.end()) throw std::runtime_error("Key nonexistent.");
+      return it->second;
+    }
+    return children_[child_num(key)].lookup(key);
+  }
+
+  bool contains(u128 key) const {
+    if (is_leaf()) return data_.count(key) > 0;
+    return children_[child_num(key)].contains(key);
+  }
+
+  void erase(u128 key) {
+    if (is_leaf()) {
+      if (!data_.erase(key)) throw std::runtime_error("Key nonexistent.");
+    } else {
+      children_[child_num(key)].erase(key);
+    }
+    rehash();
+  }
+
+  // Keys in [lb, ub] inclusive, non-wrapped (read_simple_range).
+  void read_simple_range(u128 lb, u128 ub, std::map<u128, V>& out) const {
+    if (ub < min_ || (max_ != 0 && lb >= max_)) return;
+    if (is_leaf()) {
+      for (auto it = data_.lower_bound(lb);
+           it != data_.end() && it->first <= ub; ++it)
+        out.insert(*it);
+      return;
+    }
+    for (const auto& c : children_) c.read_simple_range(lb, ub, out);
+  }
+
+  size_t count() const {
+    if (is_leaf()) return data_.size();
+    size_t total = 0;
+    for (const auto& c : children_) total += c.count();
+    return total;
+  }
+
+  void entries(std::map<u128, V>& out) const {
+    if (is_leaf()) {
+      out.insert(data_.begin(), data_.end());
+      return;
+    }
+    for (const auto& c : children_) c.entries(out);
+  }
+
+  const MerkleNodeT* by_position(const std::vector<int>& pos) const {
+    const MerkleNodeT* node = this;
+    for (int step : pos) {
+      if (node->is_leaf()) throw std::runtime_error("Position beyond leaf.");
+      // step comes from a REMOTE XCHNG_NODE payload: bounds-check it like
+      // the Python twin's IndexError -> error-envelope path.
+      if (step < 0 || size_t(step) >= node->children_.size())
+        throw std::runtime_error("Position step out of range.");
+      node = &node->children_[size_t(step)];
+    }
+    return node;
+  }
+
+  // ref Rehash (merkle_tree.h:724-749): keys-only leaf hash, child-hash
+  // concat internally, empty -> 0. Byte-identical to the Python tree.
+  void rehash() {
+    std::string concat;
+    if (is_leaf()) {
+      if (data_.empty()) {
+        hash_ = 0;
+        return;
+      }
+      for (const auto& kv : data_) concat += hex_of(kv.first);
+    } else {
+      for (const auto& c : children_) concat += hex_of(c.hash_);
+      if (concat == std::string(kMerkleChildren, '0')) {
+        hash_ = 0;
+        return;
+      }
+    }
+    hash_ = sha1_id_str(concat);
+  }
+
+  // ref NonRecursiveSerialize (merkle_tree.h:592-620), field-for-field
+  // with MerkleTree.serialize_node.
+  ns::Jv serialize(bool with_children = true) const {
+    ns::Jv out = ns::Jv::object();
+    out.set("HASH", ns::Jv::of(hex_of(hash_)));
+    out.set("MIN_KEY", ns::Jv::of(hex_of(min_)));
+    out.set("KEY", ns::Jv::of(hex_of_max(max_)));
+    ns::Jv pos = ns::Jv::array();
+    for (int p : position_) pos.arr.push_back(ns::Jv::of((long long)p));
+    out.set("POSITION", pos);
+    if (is_leaf()) {
+      ns::Jv kvs = ns::Jv::object();
+      for (const auto& kv : data_)
+        kvs.set(hex_of(kv.first), ns::Jv::of(std::string()));
+      out.set("KV_PAIRS", kvs);
+    } else if (with_children) {
+      ns::Jv ch = ns::Jv::array();
+      for (const auto& c : children_) ch.arr.push_back(c.serialize(false));
+      out.set("CHILDREN", ch);
+    }
+    return out;
+  }
+
+ private:
+  // Split into 8 equal slices, distribute data (CreateChildren,
+  // merkle_tree.h:755-779). Slice width (max - min)/8 uses natural u128
+  // wrap for the 2^128 sentinel; the root's full-ring split is 2^125.
+  void create_children() {
+    u128 step;
+    if (min_ == 0 && max_ == 0) step = u128(1) << 125;  // whole ring / 8
+    else step = (max_ - min_) / kMerkleChildren;
+    u128 last = min_;
+    std::map<u128, V> items;
+    items.swap(data_);
+    auto it = items.begin();
+    for (int i = 0; i < kMerkleChildren; i++) {
+      u128 ub = last + step;  // final child's ub wraps to the sentinel
+      std::vector<int> pos = position_;
+      pos.push_back(i);
+      MerkleNodeT child(last, ub, std::move(pos));
+      while (it != items.end() && it->first >= last &&
+             (ub == 0 || it->first <= ub - 1))
+        child.data_.insert(*it), ++it;
+      child.rehash();
+      children_.push_back(std::move(child));
+      last = ub;
+    }
+  }
+
+  u128 min_, max_;
+  u128 hash_ = 0;
+  std::vector<int> position_;
+  std::vector<MerkleNodeT> children_;
+  std::map<u128, V> data_;
+};
+
+// Thread-safe DB facade = tree + lock (GenericDB, database.h:28-201), with
+// the ring-aware reads of MerkleTree (read_range splits wrapped ranges,
+// merkle_tree.h:168-219; wrap-around Next, merkle_tree.h:280-321).
+template <typename V>
+class MerkleDbT {
+ public:
+  MerkleDbT() : root_(0, 0, {}) {}
+
+  void insert(u128 k, const V& v) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    root_.insert(k, v);
+  }
+
+  V lookup(u128 k) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return root_.lookup(k);
+  }
+
+  bool contains(u128 k) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return root_.contains(k);
+  }
+
+  void erase(u128 k) {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    root_.erase(k);
+  }
+
+  size_t size() const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    return root_.count();
+  }
+
+  std::map<u128, V> entries() const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    std::map<u128, V> all;
+    root_.entries(all);
+    return all;
+  }
+
+  // Clockwise [lb, ub] inclusive; wrapped splits in two.
+  std::map<u128, V> read_range(u128 lb, u128 ub) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    std::map<u128, V> out;
+    if (lb <= ub) {
+      root_.read_simple_range(lb, ub, out);
+    } else {
+      root_.read_simple_range(lb, ~u128(0), out);
+      root_.read_simple_range(0, ub, out);
+    }
+    return out;
+  }
+
+  // First kv strictly after key, wrapping; nullopt when empty.
+  std::optional<std::pair<u128, V>> next(u128 key) const {
+    std::lock_guard<std::recursive_mutex> g(mu_);
+    std::map<u128, V> out;
+    if (key != ~u128(0)) {
+      root_.read_simple_range(key + 1, ~u128(0), out);
+      if (!out.empty()) return *out.begin();
+      out.clear();
+    }
+    root_.read_simple_range(0, key, out);
+    if (!out.empty()) return *out.begin();
+    return std::nullopt;
+  }
+
+  const MerkleNodeT<V>& root() const { return root_; }
+  std::recursive_mutex& mutex() const { return mu_; }
+
+ private:
+  mutable std::recursive_mutex mu_;
+  MerkleNodeT<V> root_;
+};
+
+}  // namespace nc

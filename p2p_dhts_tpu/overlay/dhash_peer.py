@@ -13,7 +13,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from p2p_dhts_tpu.ida import DataBlock, DataFragment
-from p2p_dhts_tpu.keyspace import Key
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key
 from p2p_dhts_tpu.net.rpc import JsonObj
 from p2p_dhts_tpu.overlay.chord_peer import AbstractChordPeer
 from p2p_dhts_tpu.overlay.database import FragmentDb
@@ -188,19 +188,24 @@ class DHashPeer(AbstractChordPeer):
 
     def run_global_maintenance(self) -> None:
         """Walk own DB ring-wise; push misplaced keys to their true
-        successors and delete locally (dhash_peer.cpp:298-348)."""
+        successors and delete locally (dhash_peer.cpp:298-348).
+
+        Documented fix of a reference-shaped livelock: a live
+        ``db.next``-driven walk that breaks when it re-enters
+        ``[id, first_stored_key]`` never terminates if that anchor key is
+        itself pushed-and-deleted mid-walk (exactly what a just-joined
+        successor causes). The walk here runs over a ring-ordered SNAPSHOT
+        of the stored keys with a clockwise watermark, performing the same
+        per-range actions, with guaranteed termination."""
         self.log("running global maintenance")
-        current_key = Key(self.id)
-        nxt = self.db.next(int(self.id))
-        starting_key = Key(nxt[0]) if nxt is not None else Key(0)
-        first_iter = True
-        while self.db.next(int(current_key)) is not None:
-            k, _ = self.db.next(int(current_key))
+        ring_pos = lambda k: (int(k) - int(self.id) - 1) % KEYS_IN_RING
+        snapshot = sorted((k for k, _ in self.db.get_entries()),
+                          key=ring_pos)
+        watermark = -1  # ring_pos of the last range already covered
+        for k in snapshot:
+            if ring_pos(k) <= watermark:
+                continue  # absorbed by a processed successor range
             next_key = Key(k)
-            if next_key.in_between(self.id, starting_key, True) \
-                    and not first_iter:
-                break
-            first_iter = False
             succs = self.get_n_successors(next_key, self.n)
             misplaced = all(s.id != self.id for s in succs)
             if misplaced and succs:
@@ -219,7 +224,8 @@ class DHashPeer(AbstractChordPeer):
                                 self.db.delete(key_int)
                             except RuntimeError:
                                 pass
-            current_key = succs[0].id if succs else next_key
+            watermark = max(watermark,
+                            ring_pos(succs[0].id) if succs else ring_pos(k))
         self.log("Global maintenance over")
 
     def run_local_maintenance(self) -> None:

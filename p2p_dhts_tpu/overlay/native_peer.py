@@ -28,8 +28,20 @@ from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     if getattr(lib, "_nc_bound", False):
         return lib
+    lib.nc_dhash_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_double,
+                                    ctypes.c_int]
+    lib.nc_dhash_create.restype = ctypes.c_void_p
+    lib.nc_dhash_set_ida.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_int, ctypes.c_longlong]
+    lib.nc_dhash_set_ida.restype = ctypes.c_int
+    lib.nc_dhash_maintain.argtypes = [ctypes.c_void_p]
+    lib.nc_dhash_maintain.restype = ctypes.c_int
+    lib.nc_merkle_probe.argtypes = [ctypes.c_char_p]
+    lib.nc_merkle_probe.restype = ctypes.c_void_p
     lib.nc_peer_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                   ctypes.c_int, ctypes.c_double]
+                                   ctypes.c_int, ctypes.c_double,
+                                   ctypes.c_int]
     lib.nc_peer_create.restype = ctypes.c_void_p
     lib.nc_last_error.restype = ctypes.c_char_p
     lib.nc_peer_port.argtypes = [ctypes.c_void_p]
@@ -64,12 +76,14 @@ class NativeChordPeer:
     """A Chord peer whose protocol logic runs in C++ (chord_peer.cc)."""
 
     def __init__(self, ip_addr: str, port: int, num_succs: int,
-                 maintenance_interval: Optional[float] = 5.0):
+                 maintenance_interval: Optional[float] = 5.0,
+                 num_server_threads: int = 3):
         self._lib = _bind(load_library())
         interval = -1.0 if maintenance_interval is None \
             else float(maintenance_interval)
         self._h = self._lib.nc_peer_create(ip_addr.encode(), port,
-                                           num_succs, interval)
+                                           num_succs, interval,
+                                           num_server_threads)
         if not self._h:
             raise OSError(self._lib.nc_last_error().decode())
         self.ip_addr = ip_addr
@@ -144,3 +158,50 @@ class NativeChordPeer:
             self.close()
         except Exception:
             pass
+
+
+class NativeDHashPeer(NativeChordPeer):
+    """A DHash peer whose protocol logic — IDA fragment striping, Merkle
+    anti-entropy, global placement maintenance — runs in C++
+    (chord_peer.cc DHashPeerN). Wire- and hash-compatible with the Python
+    DHashPeer, so the two sync against each other."""
+
+    def __init__(self, ip_addr: str, port: int, num_replicas: int,
+                 maintenance_interval: Optional[float] = 5.0,
+                 num_server_threads: int = 3):
+        lib = _bind(load_library())
+        interval = -1.0 if maintenance_interval is None \
+            else float(maintenance_interval)
+        h = lib.nc_dhash_create(ip_addr.encode(), port, num_replicas,
+                                interval, num_server_threads)
+        if not h:
+            raise OSError(lib.nc_last_error().decode())
+        # Bypass NativeChordPeer.__init__ (it would create a chord peer);
+        # install the handle directly.
+        self._lib = lib
+        self._h = h
+        self.ip_addr = ip_addr
+        self.port = lib.nc_peer_port(h)
+        self.num_succs = num_replicas
+        self._destroyed = False
+
+    def set_ida_params(self, n: int, m: int, p: int) -> None:
+        if self._lib.nc_dhash_set_ida(self._h, n, m, p) != 0:
+            raise RuntimeError(self._lib.nc_last_error().decode())
+
+    def maintain(self) -> None:
+        """One stabilize + global + local maintenance round
+        (dhash_peer.cpp:271-296, stepped)."""
+        if self._lib.nc_dhash_maintain(self._h) != 0:
+            raise RuntimeError(self._lib.nc_last_error().decode())
+
+
+def native_merkle_probe(keys) -> dict:
+    """Build a native Merkle tree over int keys and return its root
+    serialization — the hash-parity pin against overlay.MerkleTree."""
+    lib = _bind(load_library())
+    csv = ",".join(format(int(k), "x") for k in keys).encode()
+    ptr = lib.nc_merkle_probe(csv)
+    if not ptr:
+        raise RuntimeError(lib.nc_last_error().decode())
+    return json.loads(_take_cstr(lib, ptr))
