@@ -566,6 +566,10 @@ class AbstractPeerN {
     if (server_ && server_->alive.load()) ns::server_kill(server_);
   }
 
+  // Public resolution entry (GetSuccessor is public API on the
+  // reference, abstract_chord_peer.h:62-160).
+  NPeer resolve_successor(u128 key) { return get_successor(key); }
+
   // -- stabilize (abstract_chord_peer.cpp:460-505) ------------------------
   void stabilize() {
     {
@@ -1584,6 +1588,18 @@ int nc_peer_read_key(void* h, const char* key_hex, char** out) {
 }
 
 void nc_peer_destroy(void* h) { delete static_cast<nc::AbstractPeerN*>(h); }
+
+// Resolve a key's successor through the live ring; returns the peer's
+// JSON (remote_peer wire form) — the fixture-replay hook for pinning the
+// native peer against the reference's GetSuccTest expectations.
+int nc_peer_get_successor(void* h, const char* key_hex, char** out) {
+  *out = nullptr;
+  return nc::guarded([&] {
+    nc::NPeer p = static_cast<nc::AbstractPeerN*>(h)->resolve_successor(
+        nc::parse_hex(key_hex));
+    *out = ns::dup_cstr(ns::dumps(p.to_json()));
+  });
+}
 
 // -- DHash peer -------------------------------------------------------------
 

@@ -178,7 +178,10 @@ struct DataFragmentC {
     const ns::Jv* idx = o.find("INDEX");
     const ns::Jv* nv = o.find("N");
     const ns::Jv* mv = o.find("M");
-    if (!pv || !frag || !idx || !nv || !mv || frag->t != ns::Jv::T::Str)
+    if (!pv || pv->t != ns::Jv::T::Int || !frag ||
+        frag->t != ns::Jv::T::Str || !idx || idx->t != ns::Jv::T::Int ||
+        !nv || nv->t != ns::Jv::T::Int || !mv || mv->t != ns::Jv::T::Int ||
+        pv->i < 2)
       throw std::runtime_error("corrupted fragment JSON");
     f.p = pv->i;
     f.values = parse_base64(frag->s, b64_digits_per_val(f.p));

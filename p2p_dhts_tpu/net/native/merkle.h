@@ -51,15 +51,6 @@ inline std::string hex_of_max(u128 mx) {
   return hex_of(mx);
 }
 
-inline u128 parse_hex_max(const std::string& s) {
-  if (s.size() == 33) {
-    if (s[0] != '1' || s.find_first_not_of('0', 1) != std::string::npos)
-      throw std::runtime_error("bad max key: " + s);
-    return 0;  // 2^128 sentinel
-  }
-  return parse_hex(s);
-}
-
 template <typename V>
 class MerkleNodeT {
  public:

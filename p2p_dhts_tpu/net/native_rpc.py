@@ -40,7 +40,8 @@ from p2p_dhts_tpu.net.rpc import (DEFAULT_TIMEOUT_S, JsonObj, RpcError,
                                   parse_reply)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_SOURCES = ("rpc_engine.cc", "chord_peer.cc", "engine.h", "json.h", "sha1.h")
+_SOURCES = ("rpc_engine.cc", "chord_peer.cc", "engine.h", "ida.h",
+            "json.h", "merkle.h", "sha1.h")
 _COMPILE_UNITS = ("rpc_engine.cc", "chord_peer.cc")
 _LIB_NAME = "_rpc_engine.so"
 

@@ -67,6 +67,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nc_peer_read_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.POINTER(ctypes.c_void_p)]
     lib.nc_peer_read_key.restype = ctypes.c_int
+    lib.nc_peer_get_successor.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_void_p)]
+    lib.nc_peer_get_successor.restype = ctypes.c_int
     lib.nc_peer_destroy.argtypes = [ctypes.c_void_p]
     lib._nc_bound = True
     return lib
@@ -75,15 +78,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 class NativeChordPeer:
     """A Chord peer whose protocol logic runs in C++ (chord_peer.cc)."""
 
+    # Subclasses override to construct a different native peer kind with
+    # the same lifecycle (NativeDHashPeer -> nc_dhash_create).
+    _CREATE_FN = "nc_peer_create"
+
     def __init__(self, ip_addr: str, port: int, num_succs: int,
                  maintenance_interval: Optional[float] = 5.0,
                  num_server_threads: int = 3):
         self._lib = _bind(load_library())
         interval = -1.0 if maintenance_interval is None \
             else float(maintenance_interval)
-        self._h = self._lib.nc_peer_create(ip_addr.encode(), port,
-                                           num_succs, interval,
-                                           num_server_threads)
+        self._h = getattr(self._lib, self._CREATE_FN)(
+            ip_addr.encode(), port, num_succs, interval, num_server_threads)
         if not self._h:
             raise OSError(self._lib.nc_last_error().decode())
         self.ip_addr = ip_addr
@@ -148,6 +154,18 @@ class NativeChordPeer:
             raise RuntimeError(self._lib.nc_last_error().decode())
         return text
 
+    def get_successor(self, key) -> RemotePeer:
+        """Resolve a key's successor through the live ring (the public
+        GetSuccessor surface, abstract_chord_peer.cpp:313-330)."""
+        k = key if isinstance(key, Key) else Key.from_plaintext(key)
+        out = ctypes.c_void_p()
+        rc = self._lib.nc_peer_get_successor(self._h, str(k).encode(),
+                                             ctypes.byref(out))
+        text = _take_cstr(self._lib, out.value) if out.value else ""
+        if rc != 0:
+            raise RuntimeError(self._lib.nc_last_error().decode())
+        return RemotePeer.from_json(json.loads(text))
+
     def close(self) -> None:
         if not self._destroyed:
             self._destroyed = True
@@ -166,24 +184,7 @@ class NativeDHashPeer(NativeChordPeer):
     (chord_peer.cc DHashPeerN). Wire- and hash-compatible with the Python
     DHashPeer, so the two sync against each other."""
 
-    def __init__(self, ip_addr: str, port: int, num_replicas: int,
-                 maintenance_interval: Optional[float] = 5.0,
-                 num_server_threads: int = 3):
-        lib = _bind(load_library())
-        interval = -1.0 if maintenance_interval is None \
-            else float(maintenance_interval)
-        h = lib.nc_dhash_create(ip_addr.encode(), port, num_replicas,
-                                interval, num_server_threads)
-        if not h:
-            raise OSError(lib.nc_last_error().decode())
-        # Bypass NativeChordPeer.__init__ (it would create a chord peer);
-        # install the handle directly.
-        self._lib = lib
-        self._h = h
-        self.ip_addr = ip_addr
-        self.port = lib.nc_peer_port(h)
-        self.num_succs = num_replicas
-        self._destroyed = False
+    _CREATE_FN = "nc_dhash_create"
 
     def set_ida_params(self, n: int, m: int, p: int) -> None:
         if self._lib.nc_dhash_set_ida(self._h, n, m, p) != 0:

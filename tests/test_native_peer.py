@@ -248,6 +248,46 @@ def test_native_dhash_maintenance_rebalances(dhash_ring):
         assert peers[k % 5].read(f"gm-{k}") == f"gv-{k}"
 
 
+def test_native_peer_replays_get_succ_fixture():
+    """The reference's own GetSuccTest.json fixture replayed on C++ peers:
+    pinned ids must reproduce (SHA-1 of ip:port) and the pinned successor
+    lookup must resolve identically — the native peer measured directly
+    against the reference's pinned expectations, not just against the
+    Python twin."""
+    import json as _json
+    import os
+    fx_path = os.path.join("/root/reference/test/test_json",
+                           "chord_tests", "GetSuccTest.json")
+    if not os.path.exists(fx_path):
+        pytest.skip("reference fixtures not mounted")
+    with open(fx_path) as fh:
+        fx = _json.load(fh)
+    sub = fx["GET_SUCC_FROM_FINGER_TABLE"]
+    peers = []
+    try:
+        for i, pj in enumerate(sub["PEERS"]):
+            p = NativeChordPeer(pj["IP"], int(pj["PORT"]),
+                                int(pj["NUM_SUCCS"]),
+                                maintenance_interval=None)
+            peers.append(p)
+            if i == 0:
+                p.start_chord()
+            else:
+                p.join(peers[0].ip_addr, peers[0].port)
+            if "ID" in pj:
+                assert int(p.id) == int(pj["ID"], 16), \
+                    f"native peer {pj['PORT']} id diverges from fixture"
+        _converge(peers)
+        succ = peers[0].get_successor(
+            Key(int(sub["KEY_TO_LOOKUP"], 16)))
+        assert int(succ.id) == int(sub["EXPECTED_SUCC_ID"], 16)
+    finally:
+        for p in peers:
+            p.fail()
+        for p in peers:
+            p.close()
+
+
 def test_mixed_ring_survives_native_failure(ring):
     """Silent native-peer death; stabilize repairs the ring around it
     (Fail + rectify path, chord_peer.cpp:293-300 /
