@@ -580,9 +580,13 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
     round-1 profile's dominant cost), and alive-mask gathers. Termination
     is cur == ring_successor(key), precomputed once per lane; the loop
     itself is the shared straggler-compacted `two_phase_hop_loop`.
-    Per-hop random traffic: ids[cur] 16 B + finger 4 B + pred 4 B.
+    Per-hop random traffic: ids[cur] 16 B + finger 4 B (the pred on
+    self-hit needs NO gather — on the converged sorted layout this path
+    requires, pred(row) IS (row - 1) % n_valid, the exact invariant
+    _converged_all_alive admits states by).
     """
-    ids, preds = state.ids, state.preds
+    ids = state.ids
+    nv = state.n_valid
     materialized = state.fingers is not None
     # Big rings resolve successors through a bucket table (built once per
     # call, amortized over the batch): owner0 always, plus every hop in
@@ -614,8 +618,9 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
                 starts = u128.add(cur_ids, u128.pow2(fi))
                 nxt = ring_succ(starts)
             # Self-hit -> predecessor (always alive here),
-            # chord_peer.cpp:194-196.
-            nxt = jnp.where(nxt == cur, preds[cur], nxt)
+            # chord_peer.cpp:194-196 — structured, not gathered.
+            pred_cur = jnp.where(cur > 0, cur - 1, nv - 1)
+            nxt = jnp.where(nxt == cur, pred_cur, nxt)
             cur = jnp.where(done, cur, nxt)
             hops = jnp.where(done, hops, hops + 1)
             return cur, hops, it + 1

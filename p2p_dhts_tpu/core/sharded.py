@@ -172,6 +172,11 @@ def find_successor_sharded(state: RingState, keys: jax.Array,
     block = n // d
     materialized = state.fingers is not None
 
+    # preds ARE shipped here, unlike ring._fast_lookup's structured
+    # (row - 1) % n_valid: this kernel's guard (routing_converged) admits
+    # swept states with dead rows left in place, where the alive
+    # predecessor of a self-hit row is NOT row - 1 — only the
+    # strictly-all-alive fast path may drop the table.
     tables = (state.ids, state.preds, state.alive) + (
         (state.fingers,) if materialized else ())
 
