@@ -1572,18 +1572,30 @@ int nc_peer_leave(void* h) {
 void nc_peer_fail(void* h) { static_cast<nc::AbstractPeerN*>(h)->fail(); }
 
 // key_hex: lowercase hex ring key (callers hash plaintext on their side,
-// exactly like the Python peer's Key.from_plaintext path).
-int nc_peer_create_key(void* h, const char* key_hex, const char* val) {
+// exactly like the Python peer's Key.from_plaintext path). Values carry an
+// explicit length — they are binary-capable strings (embedded NULs legal;
+// the JSON layer escapes them as backslash-u0000), so a NUL-terminated C string
+// would silently truncate.
+int nc_peer_create_key(void* h, const char* key_hex, const char* val,
+                       long long val_len) {
   return nc::guarded([&] {
-    static_cast<nc::AbstractPeerN*>(h)->create_kv(nc::parse_hex(key_hex), val);
+    static_cast<nc::AbstractPeerN*>(h)->create_kv(
+        nc::parse_hex(key_hex), std::string(val, size_t(val_len)));
   });
 }
 
-int nc_peer_read_key(void* h, const char* key_hex, char** out) {
+int nc_peer_read_key(void* h, const char* key_hex, char** out,
+                     long long* out_len) {
   *out = nullptr;
+  *out_len = 0;
   return nc::guarded([&] {
-    *out = ns::dup_cstr(
-        static_cast<nc::AbstractPeerN*>(h)->read_kv(nc::parse_hex(key_hex)));
+    std::string v =
+        static_cast<nc::AbstractPeerN*>(h)->read_kv(nc::parse_hex(key_hex));
+    char* buf = static_cast<char*>(std::malloc(v.size() + 1));
+    std::memcpy(buf, v.data(), v.size());
+    buf[v.size()] = '\0';
+    *out = buf;
+    *out_len = (long long)v.size();
   });
 }
 

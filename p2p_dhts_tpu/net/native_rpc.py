@@ -132,6 +132,16 @@ def _take_cstr(lib: ctypes.CDLL, ptr: int) -> str:
         lib.ns_free(ptr)
 
 
+def _take_cbytes(lib: ctypes.CDLL, ptr: int, length: int) -> str:
+    """Length-carrying sibling of _take_cstr for binary-capable values
+    (embedded NULs legal): copy `length` bytes, decode, free."""
+    try:
+        return ctypes.string_at(ptr, length).decode("utf-8",
+                                                    errors="replace")
+    finally:
+        lib.ns_free(ptr)
+
+
 # ---------------------------------------------------------------------------
 # hashing
 # ---------------------------------------------------------------------------

@@ -21,7 +21,8 @@ import json
 from typing import Optional
 
 from p2p_dhts_tpu.keyspace import Key
-from p2p_dhts_tpu.net.native_rpc import _take_cstr, load_library
+from p2p_dhts_tpu.net.native_rpc import (_take_cbytes, _take_cstr,
+                                         load_library)
 from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
 
 
@@ -62,10 +63,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn.restype = ctypes.c_int
     lib.nc_peer_fail.argtypes = [ctypes.c_void_p]
     lib.nc_peer_create_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                       ctypes.c_char_p]
+                                       ctypes.c_char_p, ctypes.c_longlong]
     lib.nc_peer_create_key.restype = ctypes.c_int
     lib.nc_peer_read_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                     ctypes.POINTER(ctypes.c_void_p)]
+                                     ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(ctypes.c_longlong)]
     lib.nc_peer_read_key.restype = ctypes.c_int
     lib.nc_peer_get_successor.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                           ctypes.POINTER(ctypes.c_void_p)]
@@ -141,15 +143,21 @@ class NativeChordPeer:
 
     def create(self, key, val: str) -> None:
         k = key if isinstance(key, Key) else Key.from_plaintext(key)
+        raw = val.encode()
+        # Length-carrying call: values may hold embedded NULs (legal in
+        # the protocol; JSON escapes them), which a C string would clip.
         self._check(self._lib.nc_peer_create_key(
-            self._h, str(k).encode(), val.encode()))
+            self._h, str(k).encode(), raw, len(raw)))
 
     def read(self, key) -> str:
         k = key if isinstance(key, Key) else Key.from_plaintext(key)
         out = ctypes.c_void_p()
+        out_len = ctypes.c_longlong()
         rc = self._lib.nc_peer_read_key(self._h, str(k).encode(),
-                                        ctypes.byref(out))
-        text = _take_cstr(self._lib, out.value) if out.value else ""
+                                        ctypes.byref(out),
+                                        ctypes.byref(out_len))
+        text = _take_cbytes(self._lib, out.value, out_len.value) \
+            if out.value else ""
         if rc != 0:
             raise RuntimeError(self._lib.nc_last_error().decode())
         return text

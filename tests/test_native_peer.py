@@ -248,6 +248,21 @@ def test_native_dhash_maintenance_rebalances(dhash_ring):
         assert peers[k % 5].read(f"gm-{k}") == f"gv-{k}"
 
 
+def test_trailing_nul_strip_quirk_parity(dhash_ring):
+    """The reference's IDA decode strips trailing zero bytes (ida.cpp:
+    143-161) — binary values ending in NUL are lossy BY DESIGN. Both
+    implementations must lose exactly the same bytes, whichever stores
+    and whichever reads."""
+    peers = dhash_ring(["py", "cc"], 19490)
+    peers[0].create("nul-key", "payload\x00\x00")
+    for p in peers:
+        assert p.read("nul-key") == "payload", \
+            "trailing-NUL strip quirk diverged between implementations"
+    peers[1].create("nul-key-2", "inner\x00kept\x00\x00")
+    for p in peers:
+        assert p.read("nul-key-2") == "inner\x00kept"
+
+
 def test_native_peer_replays_get_succ_fixture():
     """The reference's own GetSuccTest.json fixture replayed on C++ peers:
     pinned ids must reproduce (SHA-1 of ip:port) and the pinned successor
