@@ -344,7 +344,11 @@ class DataBlock:
         self.ida = IDA(n, m, p, backend=backend)
         if data is not None:
             if isinstance(data, str):
-                data = data.encode("utf-8")
+                # surrogateescape mirrors decode(): binary payloads that
+                # crossed the overlay as lone-surrogate text (upload_file's
+                # round-trip, chord_peer.py:240-250) re-encode to their
+                # original bytes instead of raising.
+                data = data.encode("utf-8", "surrogateescape")
             self.original = data
             self.fragments = frags_from_matrix(self.ida.encode(data), n, m, p)
         elif fragments is not None:

@@ -25,6 +25,7 @@
 #include <map>
 #include <memory>
 #include <optional>
+#include <fstream>
 #include <random>
 #include <stdexcept>
 
@@ -1058,6 +1059,93 @@ class ChordPeerN : public AbstractPeerN {
 };
 
 // ---------------------------------------------------------------------------
+// surrogateescape (PEP 383) — the binary<->text convention shared with the
+// Python layer: bytes that are not valid UTF-8 travel as lone low
+// surrogates U+DC80..U+DCFF (WTF-8 internally, \udcXX on the JSON wire).
+// ---------------------------------------------------------------------------
+
+// bytes -> WTF-8 with surrogateescape semantics.
+std::string surrogate_escape(const std::string& raw) {
+  std::string out;
+  out.reserve(raw.size());
+  size_t i = 0, n = raw.size();
+  auto cont = [&](size_t k) {
+    return i + k < n && (uint8_t(raw[i + k]) & 0xC0) == 0x80;
+  };
+  auto escape_byte = [&](uint8_t b) {  // U+DC00+b as 3-byte WTF-8
+    uint32_t cp = 0xDC00 + b;
+    out += char(0xE0 | (cp >> 12));
+    out += char(0x80 | ((cp >> 6) & 0x3F));
+    out += char(0x80 | (cp & 0x3F));
+  };
+  while (i < n) {
+    uint8_t c = raw[i];
+    if (c < 0x80) {
+      out += char(c);
+      i += 1;
+    } else if ((c & 0xE0) == 0xC0 && c >= 0xC2 && cont(1)) {
+      out.append(raw, i, 2);
+      i += 2;
+    } else if ((c & 0xF0) == 0xE0 && cont(1) && cont(2)) {
+      // Reject overlong and surrogate-range sequences.
+      uint32_t cp = (uint32_t(c & 0x0F) << 12) |
+                    (uint32_t(raw[i + 1] & 0x3F) << 6) |
+                    uint32_t(raw[i + 2] & 0x3F);
+      if (cp >= 0x800 && !(cp >= 0xD800 && cp <= 0xDFFF)) {
+        out.append(raw, i, 3);
+        i += 3;
+      } else {
+        escape_byte(c);
+        i += 1;
+      }
+    } else if ((c & 0xF8) == 0xF0 && c <= 0xF4 && cont(1) && cont(2) &&
+               cont(3)) {
+      // Reject overlong (< U+10000) and out-of-range (> U+10FFFF) forms,
+      // like the 2-/3-byte branches and Python's surrogateescape.
+      uint32_t cp = (uint32_t(c & 0x07) << 18) |
+                    (uint32_t(raw[i + 1] & 0x3F) << 12) |
+                    (uint32_t(raw[i + 2] & 0x3F) << 6) |
+                    uint32_t(raw[i + 3] & 0x3F);
+      if (cp >= 0x10000 && cp <= 0x10FFFF) {
+        out.append(raw, i, 4);
+        i += 4;
+      } else {
+        escape_byte(c);
+        i += 1;
+      }
+    } else {
+      escape_byte(c);
+      i += 1;
+    }
+  }
+  return out;
+}
+
+// WTF-8 with escaped low surrogates -> original bytes.
+std::string surrogate_unescape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    uint8_t c = s[i];
+    if ((c & 0xF0) == 0xE0 && i + 2 < n) {
+      uint32_t cp = (uint32_t(c & 0x0F) << 12) |
+                    (uint32_t(s[i + 1] & 0x3F) << 6) |
+                    uint32_t(s[i + 2] & 0x3F);
+      if (cp >= 0xDC80 && cp <= 0xDCFF) {
+        out += char(uint8_t(cp - 0xDC00));
+        i += 3;
+        continue;
+      }
+    }
+    out += char(c);
+    i += 1;
+  }
+  return out;
+}
+
+
+// ---------------------------------------------------------------------------
 // DHashPeerN — erasure-coded fragment storage with Merkle anti-entropy
 // (ref DHashPeer, dhash_peer.{h,cpp}; Python twin overlay/dhash_peer.py)
 // ---------------------------------------------------------------------------
@@ -1120,7 +1208,12 @@ class DHashPeerN : public AbstractPeerN {
     int n, m;
     long long p;
     ida_params(n, m, p);
-    std::vector<DataFragmentC> frags = IdaC(n, m, p).encode(val);
+    // The value arrives as WTF-8 text (binary bytes as lone surrogates);
+    // the fragments store the ORIGINAL bytes, exactly like the Python
+    // twin's encode("utf-8", "surrogateescape") — so both implementations
+    // produce byte-identical fragments for the same payload.
+    std::vector<DataFragmentC> frags =
+        IdaC(n, m, p).encode(surrogate_unescape(val));
     std::vector<NPeer> succ_list = get_n_successors(key, n);
     if (int(succ_list.size()) < m)
       throw std::runtime_error(
@@ -1167,7 +1260,8 @@ class DHashPeerN : public AbstractPeerN {
       throw std::runtime_error("Less than m distinct frags.");
     std::vector<DataFragmentC> ordered;
     for (const auto& kv : fragments) ordered.push_back(kv.second);
-    return IdaC(n, m, p).decode(ordered);
+    // Decoded bytes -> WTF-8 text (DataBlock.decode's surrogateescape).
+    return surrogate_escape(IdaC(n, m, p).decode(ordered));
   }
 
   // -- maintenance (dhash_peer.cpp:265-365) --------------------------------
@@ -1479,11 +1573,12 @@ class DHashPeerN : public AbstractPeerN {
   // Read the whole block, store ONE RANDOM fragment — the reference's
   // exact (quirky) behavior (dhash_peer.cpp:367-379).
   void retrieve_missing(u128 key) {
-    std::string val = read_kv(key);
+    std::string val = read_kv(key);  // WTF-8 text
     int n, m;
     long long p;
     ida_params(n, m, p);
-    std::vector<DataFragmentC> frags = IdaC(n, m, p).encode(val);
+    std::vector<DataFragmentC> frags =
+        IdaC(n, m, p).encode(surrogate_unescape(val));
     db_.insert(key, frags[rng_() % frags.size()]);
   }
 
@@ -1600,6 +1695,46 @@ int nc_peer_read_key(void* h, const char* key_hex, char** out,
 }
 
 void nc_peer_destroy(void* h) { delete static_cast<nc::AbstractPeerN*>(h); }
+
+// Whole-file transfer through the overlay (UploadFile/DownloadFile,
+// abstract_chord_peer.cpp:268-304): the file's PATH is the key (hashed by
+// the caller to key_hex, like every other key), contents are the value.
+//
+// Binary fidelity matches the Python peer's surrogateescape round-trip
+// (overlay/chord_peer.py upload_file): bytes that are not valid UTF-8 are
+// carried as lone low surrogates U+DC80..U+DCFF (WTF-8 in the internal
+// string, \udcXX on the JSON wire — exactly what Python's json emits for
+// surrogateescape strings), and mapped back to raw bytes on download. The
+// DHash layer's trailing-NUL strip (ida.cpp:143-161) still applies to
+// values stored through a DHash peer — the reference's documented lossy
+// quirk, shared by both implementations.
+
+
+int nc_peer_upload_file(void* h, const char* key_hex, const char* path) {
+  return nc::guarded([&] {
+    std::ifstream in(path, std::ios::binary);
+    if (!in) throw std::runtime_error(std::string("cannot read ") + path);
+    std::string contents((std::istreambuf_iterator<char>(in)),
+                         std::istreambuf_iterator<char>());
+    if (in.bad())
+      throw std::runtime_error(std::string("read failed: ") + path);
+    static_cast<nc::AbstractPeerN*>(h)->create_kv(
+        nc::parse_hex(key_hex), nc::surrogate_escape(contents));
+  });
+}
+
+int nc_peer_download_file(void* h, const char* key_hex, const char* path) {
+  return nc::guarded([&] {
+    std::string contents = nc::surrogate_unescape(
+        static_cast<nc::AbstractPeerN*>(h)->read_kv(nc::parse_hex(key_hex)));
+    std::ofstream out(path, std::ios::binary | std::ios::trunc);
+    if (!out) throw std::runtime_error(std::string("cannot write ") + path);
+    out.write(contents.data(), std::streamsize(contents.size()));
+    out.flush();
+    if (!out.good())
+      throw std::runtime_error(std::string("write failed: ") + path);
+  });
+}
 
 // Resolve a key's successor through the live ring; returns the peer's
 // JSON (remote_peer wire form) — the fixture-replay hook for pinning the

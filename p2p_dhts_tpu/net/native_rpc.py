@@ -134,10 +134,12 @@ def _take_cstr(lib: ctypes.CDLL, ptr: int) -> str:
 
 def _take_cbytes(lib: ctypes.CDLL, ptr: int, length: int) -> str:
     """Length-carrying sibling of _take_cstr for binary-capable values
-    (embedded NULs legal): copy `length` bytes, decode, free."""
+    (embedded NULs legal): copy `length` bytes, decode, free. Value
+    strings cross the ABI as WTF-8 (binary bytes ride as lone
+    surrogates), so surrogatepass is the only lossless decode."""
     try:
         return ctypes.string_at(ptr, length).decode("utf-8",
-                                                    errors="replace")
+                                                    errors="surrogatepass")
     finally:
         lib.ns_free(ptr)
 

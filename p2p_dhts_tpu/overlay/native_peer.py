@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 from typing import Optional
 
 from p2p_dhts_tpu.keyspace import Key
@@ -72,6 +73,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nc_peer_get_successor.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                           ctypes.POINTER(ctypes.c_void_p)]
     lib.nc_peer_get_successor.restype = ctypes.c_int
+    for fn in (lib.nc_peer_upload_file, lib.nc_peer_download_file):
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+        fn.restype = ctypes.c_int
     lib.nc_peer_destroy.argtypes = [ctypes.c_void_p]
     lib._nc_bound = True
     return lib
@@ -143,9 +147,11 @@ class NativeChordPeer:
 
     def create(self, key, val: str) -> None:
         k = key if isinstance(key, Key) else Key.from_plaintext(key)
-        raw = val.encode()
-        # Length-carrying call: values may hold embedded NULs (legal in
-        # the protocol; JSON escapes them), which a C string would clip.
+        # surrogatepass: value strings may carry binary bytes as lone
+        # surrogates (the shared surrogateescape convention); the C side
+        # holds them as WTF-8. Length-carrying call: embedded NULs are
+        # legal and a C string would clip them.
+        raw = val.encode("utf-8", "surrogatepass")
         self._check(self._lib.nc_peer_create_key(
             self._h, str(k).encode(), raw, len(raw)))
 
@@ -161,6 +167,20 @@ class NativeChordPeer:
         if rc != 0:
             raise RuntimeError(self._lib.nc_last_error().decode())
         return text
+
+    def upload_file(self, file_path: str) -> None:
+        """Store a whole file under its path (UploadFile,
+        abstract_chord_peer.cpp:268-283); IO runs natively."""
+        k = Key.from_plaintext(file_path)
+        self._check(self._lib.nc_peer_upload_file(
+            self._h, str(k).encode(), os.fsencode(file_path)))
+
+    def download_file(self, file_name: str, output_path: str) -> None:
+        """Fetch a stored file to output_path (DownloadFile,
+        abstract_chord_peer.cpp:285-304)."""
+        k = Key.from_plaintext(file_name)
+        self._check(self._lib.nc_peer_download_file(
+            self._h, str(k).encode(), os.fsencode(output_path)))
 
     def get_successor(self, key) -> RemotePeer:
         """Resolve a key's successor through the live ring (the public

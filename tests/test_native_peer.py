@@ -248,6 +248,53 @@ def test_native_dhash_maintenance_rebalances(dhash_ring):
         assert peers[k % 5].read(f"gm-{k}") == f"gv-{k}"
 
 
+def test_native_upload_download_file(dhash_ring, tmp_path):
+    """UploadFile/DownloadFile through the native peer, fetched back by a
+    Python peer and vice versa (abstract_chord_peer.cpp:268-304)."""
+    peers = dhash_ring(["cc", "py"], 19495)
+    src = tmp_path / "native-upload.txt"
+    src.write_text("uploaded through the native runtime")
+    peers[0].upload_file(str(src))
+    dst = tmp_path / "fetched-by-python.txt"
+    # Python peer downloads what C++ uploaded — same path-as-key hashing.
+    contents = peers[1].read(str(src))
+    assert contents == "uploaded through the native runtime"
+    peers[0].download_file(str(src), str(dst))
+    assert dst.read_text() == "uploaded through the native runtime"
+
+
+def test_binary_file_round_trip_cross_impl(dhash_ring, tmp_path):
+    """Non-UTF-8 binary content round-trips byte-exactly between the two
+    implementations via the shared surrogateescape convention (PEP 383;
+    the Python peer's upload path, chord_peer.py:240-250). Trailing NULs
+    would be stripped by DHash's documented quirk, so the payload ends in
+    a non-zero byte; everything else — invalid UTF-8, embedded NULs,
+    high bytes — must survive."""
+    # Includes overlong (F0 80 80 80), out-of-range (F4 90 80 80), and
+    # truncated multi-byte forms — every byte must escape identically to
+    # Python's surrogateescape, not pass through as invalid WTF-8.
+    payload = (bytes(range(256)) * 3 + b"\xf0\x80\x80\x80" +
+               b"\xf4\x90\x80\x80" + b"\xed\xa0\x80" + b"\xc0\xaf" +
+               b"\xff\x00\xfe\x01")
+    peers = dhash_ring(["cc", "py"], 19497)
+    src = tmp_path / "blob.bin"
+    src.write_bytes(payload)
+    peers[0].upload_file(str(src))           # C++ reads + stripes
+    dst_c = tmp_path / "via-native.bin"
+    peers[0].download_file(str(src), str(dst_c))
+    assert dst_c.read_bytes() == payload, "native round-trip corrupted"
+    dst_p = tmp_path / "via-python.bin"
+    peers[1].download_file(str(src), str(dst_p))  # python fetch of C++ upload
+    assert dst_p.read_bytes() == payload, "cross-impl fetch corrupted"
+    # And the reverse direction: python upload, native download.
+    src2 = tmp_path / "blob2.bin"
+    src2.write_bytes(payload[::-1] + b"\x07")
+    peers[1].upload_file(str(src2))
+    dst2 = tmp_path / "via-native2.bin"
+    peers[0].download_file(str(src2), str(dst2))
+    assert dst2.read_bytes() == payload[::-1] + b"\x07"
+
+
 def test_trailing_nul_strip_quirk_parity(dhash_ring):
     """The reference's IDA decode strips trailing zero bytes (ida.cpp:
     143-161) — binary values ending in NUL are lossy BY DESIGN. Both
