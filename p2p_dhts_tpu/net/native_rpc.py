@@ -36,6 +36,7 @@ import tempfile
 import threading
 from typing import Callable, Dict, List, Optional
 
+from p2p_dhts_tpu.metrics import METRICS
 from p2p_dhts_tpu.net.rpc import (DEFAULT_TIMEOUT_S, JsonObj, RpcError,
                                   parse_reply)
 
@@ -240,13 +241,20 @@ class NativeServer:
     # -- handler bridge ----------------------------------------------------
     def _dispatch(self, _ctx, command: bytes, request_json: bytes,
                   slot) -> None:
+        # Same observability as rpc.Server._process: per-command counters
+        # + dispatch latency (the engine never calls back for UNKNOWN
+        # commands, so no unbounded-key guard is needed here).
+        cmd = command.decode()
+        METRICS.inc(f"rpc.server.command.{cmd}")
         try:
-            handler = self.handlers[command.decode()]
-            req = json.loads(request_json.decode("utf-8"))
-            resp = handler(req) or {}
+            with METRICS.timed("rpc.server.dispatch"):
+                handler = self.handlers[cmd]
+                req = json.loads(request_json.decode("utf-8"))
+                resp = handler(req) or {}
             body = json.dumps(resp, separators=(",", ":")).encode()
             self._lib.ns_respond(slot, body)
         except Exception as exc:  # -> SUCCESS:false envelope, like rpc.py
+            METRICS.inc("rpc.server.handler_error")
             self._lib.ns_respond_error(slot, str(exc).encode())
 
     def update_handlers(self, handlers: Dict[str, Callable]) -> None:

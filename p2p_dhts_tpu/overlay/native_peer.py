@@ -147,11 +147,20 @@ class NativeChordPeer:
 
     def create(self, key, val: str) -> None:
         k = key if isinstance(key, Key) else Key.from_plaintext(key)
-        # surrogatepass: value strings may carry binary bytes as lone
-        # surrogates (the shared surrogateescape convention); the C side
-        # holds them as WTF-8. Length-carrying call: embedded NULs are
-        # legal and a C string would clip them.
-        raw = val.encode("utf-8", "surrogatepass")
+        # Value strings may carry binary bytes as lone surrogates in the
+        # U+DC80..U+DCFF surrogateescape range (PEP 383); the C side holds
+        # them as WTF-8. Surrogates OUTSIDE that range are rejected loudly
+        # — exactly like the Python twin's encode("utf-8",
+        # "surrogateescape") — instead of being silently mangled.
+        try:
+            raw = val.encode("utf-8")
+        except UnicodeEncodeError:
+            if any(0xD800 <= ord(ch) <= 0xDFFF and not
+                   (0xDC80 <= ord(ch) <= 0xDCFF) for ch in val):
+                raise
+            raw = val.encode("utf-8", "surrogatepass")
+        # Length-carrying call: embedded NULs are legal and a C string
+        # would clip them.
         self._check(self._lib.nc_peer_create_key(
             self._h, str(k).encode(), raw, len(raw)))
 
