@@ -243,10 +243,14 @@ class NativeServer:
                   slot) -> None:
         # Same observability as rpc.Server._process: per-command counters
         # + dispatch latency (the engine never calls back for UNKNOWN
-        # commands, so no unbounded-key guard is needed here).
-        cmd = command.decode()
-        METRICS.inc(f"rpc.server.command.{cmd}")
+        # commands, so no unbounded-key guard is needed here). EVERYTHING
+        # incl. the command decode stays inside the try — an escape from
+        # this ctypes callback would leave the slot unanswered and the
+        # client blocking out its timeout (the same invariant
+        # rpc.Server._process documents).
         try:
+            cmd = command.decode()
+            METRICS.inc(f"rpc.server.command.{cmd}")
             with METRICS.timed("rpc.server.dispatch"):
                 handler = self.handlers[cmd]
                 req = json.loads(request_json.decode("utf-8"))

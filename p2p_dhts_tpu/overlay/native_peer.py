@@ -155,9 +155,9 @@ class NativeChordPeer:
         try:
             raw = val.encode("utf-8")
         except UnicodeEncodeError:
-            if any(0xD800 <= ord(ch) <= 0xDFFF and not
-                   (0xDC80 <= ord(ch) <= 0xDCFF) for ch in val):
-                raise
+            val.encode("utf-8", "surrogateescape")  # the PEP 383 validator:
+            # accepts exactly U+DC80..DCFF, raises (like the Python twin)
+            # on any other lone surrogate.
             raw = val.encode("utf-8", "surrogatepass")
         # Length-carrying call: embedded NULs are legal and a C string
         # would clip them.

@@ -23,6 +23,22 @@ from p2p_dhts_tpu.overlay.native_peer import (NativeChordPeer,
                                               native_merkle_probe)
 
 
+def _run_full_maintenance(peers, rounds=2):
+    """One full DHash maintenance cycle per peer per round — stabilize +
+    global + local on both implementations, catch-and-continue."""
+    for _ in range(rounds):
+        for p in peers:
+            try:
+                if isinstance(p, NativeDHashPeer):
+                    p.maintain()
+                else:
+                    p.stabilize()
+                    p.run_global_maintenance()
+                    p.run_local_maintenance()
+            except RuntimeError:
+                pass
+
+
 def _converge(peers, rounds=2):
     for _ in range(rounds):
         for p in peers:
@@ -231,17 +247,7 @@ def test_native_dhash_maintenance_rebalances(dhash_ring):
     peers.append(late)
     late.join(peers[1].ip_addr, peers[1].port)
     _converge(peers)
-    for _ in range(2):
-        for p in peers:
-            try:
-                if isinstance(p, NativeDHashPeer):
-                    p.maintain()
-                else:
-                    p.stabilize()
-                    p.run_global_maintenance()
-                    p.run_local_maintenance()
-            except RuntimeError:
-                pass
+    _run_full_maintenance(peers)
     assert late.db_size > 0, \
         "no fragments migrated to the late native peer"
     for k in range(16):
@@ -308,6 +314,50 @@ def test_trailing_nul_strip_quirk_parity(dhash_ring):
     peers[1].create("nul-key-2", "inner\x00kept\x00\x00")
     for p in peers:
         assert p.read("nul-key-2") == "inner\x00kept"
+
+
+@pytest.mark.soak
+def test_mixed_impl_churn_soak(dhash_ring):
+    """Randomized multi-round churn program over a mixed C++/Python DHash
+    ring: create, read-from-anywhere, fail, late joins, maintenance —
+    repeated with a seeded RNG. The cross-implementation analog of
+    tests/test_churn.py's device soaks."""
+    import random
+    rng = random.Random(20260731)
+    peers = dhash_ring(["py", "cc", "py", "cc", "py"], 19600)
+    live = list(peers)
+    stored = {}
+    next_port = 19606
+    for rnd in range(4):
+        for _ in range(6):
+            k = f"soak-{rnd}-{rng.randrange(1000)}"
+            v = f"val-{rng.getrandbits(64):x}"
+            rng.choice(live).create(k, v)
+            stored[k] = v
+        if rnd == 1 and len(live) > 3:       # silent failure
+            victim = live.pop(rng.randrange(1, len(live)))
+            victim.fail()
+        if rnd in (2, 3):                     # late joiners, one per impl
+            cls = NativeDHashPeer if rnd == 2 else DHashPeer
+            late = cls("127.0.0.1", next_port, 3,
+                       maintenance_interval=None, num_server_threads=8)
+            late.set_ida_params(3, 2, 257)
+            peers.append(late)
+            live.append(late)
+            late.join(live[0].ip_addr, live[0].port)
+            next_port += 1
+        _run_full_maintenance(live)
+        # Every stored key readable from a random live peer each round.
+        misses = [k for k, v in stored.items()
+                  if _try_read(rng.choice(live), k) != v]
+        assert not misses, f"round {rnd}: unreadable keys {misses[:4]}"
+
+
+def _try_read(peer, key):
+    try:
+        return peer.read(key)
+    except RuntimeError:
+        return None
 
 
 def test_native_peer_replays_get_succ_fixture():
