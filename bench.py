@@ -271,19 +271,28 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
     assert bool(jnp.all(decoded == segments)), \
         "IDA round-trip mismatch"  # decode returns [B, S, m] like segments
 
-    # The fused Pallas decode tile (ops/modp_pallas.py) — measured against
-    # the XLA path so the default can follow the hardware's verdict.
-    pal_t = None
-    pal = None
-    try:  # import/lowering failure degrades; a WRONG RESULT must hard-fail
+    # Candidate decode paths, each firewalled: the VPU broadcast-reduce
+    # kernel and the fused Pallas tile are NEW programs (a dead remote-
+    # compile service must not sink the config's cached dot-path numbers);
+    # a WRONG RESULT still hard-fails.
+    def _try_variant(fn, label):
+        try:
+            got = fn(rows, idx, p)
+            _sync(got)  # compile/lowering errors surface at the sync
+        except Exception as exc:
+            print(f"# {label} decode unavailable: {exc}", file=sys.stderr)
+            return None
+        assert bool(jnp.all(got == segments)), f"{label} decode mismatch"
+        return _time(lambda: (fn(rows, idx, p),))
+
+    from p2p_dhts_tpu.ida import decode_kernel_tiny
+    tiny_t = _try_variant(decode_kernel_tiny, "vpu-tiny")
+    try:
         from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
-        pal = decode_kernel_pallas(rows, idx, p)
-        _sync(pal)  # compile/lowering errors surface at the sync
+        pal_t = _try_variant(decode_kernel_pallas, "pallas")
     except Exception as exc:
         print(f"# pallas decode unavailable: {exc}", file=sys.stderr)
-    if pal is not None:
-        assert bool(jnp.all(pal == segments)), "pallas decode mismatch"
-        pal_t = _time(lambda: (decode_kernel_pallas(rows, idx, p),))
+        pal_t = None
 
     return _emit({
         "config": "ida",
@@ -292,6 +301,8 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
         "value": round(payload_mb / enc_t, 1),
         "unit": "MB/s encode",
         "decode_mb_s": round(payload_mb / dec_t, 1),
+        "decode_tiny_mb_s":
+            round(payload_mb / tiny_t, 1) if tiny_t else None,
         "decode_pallas_mb_s":
             round(payload_mb / pal_t, 1) if pal_t else None,
         "vs_baseline": None,
@@ -474,6 +485,24 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
         sorted_ids, key_ints, [sorted_ids[s] for s in starts_np], hops_np)
     assert parity != "FAIL", "hop parity violation"
 
+    # Structured-pred serve variant (no per-hop preds gather) — a NEW
+    # program, firewalled so a dead compile service can't sink the
+    # cached default's numbers; route parity asserted when it runs.
+    structured_t = None
+    try:
+        from p2p_dhts_tpu.core.ring import find_successor_structured_pred
+        o2, h2 = find_successor_structured_pred(state, keys, starts)
+        _sync(o2, h2)
+        assert bool(jnp.all(o2 == owner)) and bool(jnp.all(h2 == hops)), \
+            "structured-pred serve diverges"
+        structured_t = _time(
+            lambda: find_successor_structured_pred(state, keys, starts))
+    except AssertionError:
+        raise
+    except Exception as exc:
+        print(f"# structured-pred serve unavailable: {exc}",
+              file=sys.stderr)
+
     lps = n_keys / best
     return _emit({
         "config": "lookup_1m",
@@ -483,6 +512,8 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
         "unit": "lookups/sec",
         "vs_baseline": round(lps / NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP, 4),
         "wall_ms": round(best * 1e3, 2),
+        "structured_pred_lookups_s":
+            round(n_keys / structured_t, 1) if structured_t else None,
         "mean_hops": round(float(hops_np.mean()), 3),
         "hop_parity": parity,
         "device": str(jax.devices()[0]),

@@ -572,7 +572,8 @@ def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
 
 
 def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
-                 max_hops: int) -> Tuple[jax.Array, jax.Array]:
+                 max_hops: int,
+                 structured_pred: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Lean hop loop for converged all-alive rings — identical route and
     hop counts to the general loop (the parity obligation), minus
     everything that can't trigger there: per-hop min_key gathers (16 B),
@@ -580,12 +581,18 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
     round-1 profile's dominant cost), and alive-mask gathers. Termination
     is cur == ring_successor(key), precomputed once per lane; the loop
     itself is the shared straggler-compacted `two_phase_hop_loop`.
-    Per-hop random traffic: ids[cur] 16 B + finger 4 B (the pred on
-    self-hit needs NO gather — on the converged sorted layout this path
-    requires, pred(row) IS (row - 1) % n_valid, the exact invariant
-    _converged_all_alive admits states by).
+    Per-hop random traffic: ids[cur] 16 B + finger 4 B + pred 4 B.
+    structured_pred=True drops the pred gather: on the converged sorted
+    layout this path requires, pred(row) IS (row - 1) % n_valid — the
+    exact invariant _converged_all_alive admits states by. It is a
+    SEPARATE traced program (bench.py measures it alongside, firewalled)
+    because the TPU persistent compile cache holds the gathered-pred
+    programs from the round's one successful on-chip run and the remote
+    compile service has been down since: changing the default's HLO would
+    fail the cached-green chord16 config outright instead of serving it
+    from cache. The default flips once the on-chip comparison lands.
     """
-    ids = state.ids
+    ids, preds = state.ids, state.preds
     nv = state.n_valid
     materialized = state.fingers is not None
     # Big rings resolve successors through a bucket table (built once per
@@ -618,8 +625,11 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
                 starts = u128.add(cur_ids, u128.pow2(fi))
                 nxt = ring_succ(starts)
             # Self-hit -> predecessor (always alive here),
-            # chord_peer.cpp:194-196 — structured, not gathered.
-            pred_cur = jnp.where(cur > 0, cur - 1, nv - 1)
+            # chord_peer.cpp:194-196.
+            if structured_pred:
+                pred_cur = jnp.where(cur > 0, cur - 1, nv - 1)
+            else:
+                pred_cur = preds[cur]
             nxt = jnp.where(nxt == cur, pred_cur, nxt)
             cur = jnp.where(done, cur, nxt)
             hops = jnp.where(done, hops, hops + 1)
@@ -773,6 +783,22 @@ def find_successor(state: RingState, keys: jax.Array,
         lambda: _fast_lookup(state, keys, start, max_hops),
         lambda: _general_lookup(state, keys, start, max_hops),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("max_hops",))
+def find_successor_structured_pred(state: RingState, keys: jax.Array,
+                                  start: jax.Array,
+                                  max_hops: Optional[int] = None
+                                  ) -> Tuple[jax.Array, jax.Array]:
+    """The all-alive fast serve loop with the STRUCTURED self-hit
+    predecessor (no per-hop preds gather) — callers must guarantee a
+    converged all-alive ring (the `_converged_all_alive` invariant);
+    there is no runtime dispatch here. Identical routes and hop counts
+    to find_successor on such rings; bench.py measures both so the
+    default can follow the hardware."""
+    if max_hops is None:
+        max_hops = state.max_hops
+    return _fast_lookup(state, keys, start, max_hops, structured_pred=True)
 
 
 @functools.partial(jax.jit, static_argnames=())
