@@ -63,6 +63,28 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 
+def _deadline_call(fn, timeout_s: float):
+    """Run fn() on a daemon side thread with a hard deadline. Returns
+    (finished, out) where out["result"]/out["error"] hold the outcome.
+    The thread is NOT killed on timeout (killing mid-TPU-claim wedges
+    the tunnel); it lingers and out fills in late for callers that want
+    to re-check, as _backend_or_die does."""
+    import threading
+    out = {}
+
+    def _run():
+        try:
+            out["result"] = fn()
+        except Exception as exc:  # noqa: BLE001 — reported to caller
+            out["error"] = f"{type(exc).__name__}: {exc}"
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    out["_thread"] = t
+    return ("result" in out or "error" in out), out
+
+
 def _backend_or_die(timeout_s: float = 180.0) -> str:
     """Resolve the default backend with a hard deadline.
 
@@ -73,20 +95,9 @@ def _backend_or_die(timeout_s: float = 180.0) -> str:
     parseable diagnostic line if the deadline passes — the backend cache
     is process-global, so the main thread reuses the side thread's
     result on success."""
-    import threading
-    out = {}
-
-    def _init():
-        try:
-            out["backend"] = jax.default_backend()
-        except Exception as exc:  # noqa: BLE001 — reported, then fatal
-            out["error"] = f"{type(exc).__name__}: {exc}"
-
-    t = threading.Thread(target=_init, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "backend" in out:
-        return out["backend"]
+    done, out = _deadline_call(jax.default_backend, timeout_s)
+    if "result" in out:
+        return out["result"]
     reason = out.get("error", f"backend init still blocked after "
                               f"{timeout_s:.0f}s (TPU tunnel unavailable?)")
     print(json.dumps({"metric": "bench ABORTED: no usable backend",
@@ -96,14 +107,14 @@ def _backend_or_die(timeout_s: float = 180.0) -> str:
     # killed MID-CLAIM is how the tunnel got wedged in the first place
     # (the terminal-side chip claim has no timeout). The diagnostic line
     # above is already flushed for the driver either way.
-    t.join(1500.0)
-    if "backend" in out:
+    out["_thread"].join(1500.0)
+    if "result" in out:
         # Slow-but-successful init (e.g. a cold multi-host runtime):
         # proceed — later real records supersede the ABORTED line, and
         # the driver tails the LAST line.
         print("bench: backend init recovered after the deadline; "
               "continuing", file=sys.stderr, flush=True)
-        return out["backend"]
+        return out["result"]
     os._exit(3)
 
 
@@ -147,6 +158,39 @@ from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
 from p2p_dhts_tpu import keyspace
 
 NORTH_STAR_LOOKUPS_PER_SEC_PER_CHIP = 10_000_000 / 8
+
+
+_COMPILE_SERVICE_OK = None
+
+
+def compile_service_ok(timeout_s: float = 120.0) -> bool:
+    """Can the backend compile a FRESH program right now?
+
+    The remote compile service can die independently of the chip (round
+    4: connection-refused on the remote_compile port while cached
+    programs kept executing); when it is down, every fresh-shape jit
+    blocks ~25 minutes before failing. The optional variant measurements
+    are new programs, so they are gated on this one cheap probe — a tiny
+    time-salted-shape jit on a side thread with a hard deadline — instead
+    of each eating a 25-minute block. Cached once per process."""
+    global _COMPILE_SERVICE_OK
+    if _COMPILE_SERVICE_OK is not None:
+        return _COMPILE_SERVICE_OK
+    def _probe():
+        # Time-salted shape: a pid-salted one can collide with a
+        # persisted entry from an earlier run and false-positive the
+        # probe straight out of the cache.
+        n = 4099 + (int(time.time() * 1000) % 997)
+        x = jnp.arange(n)
+        _sync(jax.jit(lambda v: (v * 3 + 1).cumsum())(x))
+        return True
+
+    done, out = _deadline_call(_probe, timeout_s)
+    _COMPILE_SERVICE_OK = bool(done and out.get("result"))
+    if not _COMPILE_SERVICE_OK:
+        print("# compile-service probe failed/timed out: skipping "
+              "fresh-program variant measurements", file=sys.stderr)
+    return _COMPILE_SERVICE_OK
 
 
 def _rand_ids(rng: np.random.RandomState, n: int) -> list:
@@ -285,14 +329,15 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
         assert bool(jnp.all(got == segments)), f"{label} decode mismatch"
         return _time(lambda: (fn(rows, idx, p),))
 
-    from p2p_dhts_tpu.ida import decode_kernel_tiny
-    tiny_t = _try_variant(decode_kernel_tiny, "vpu-tiny")
-    try:
-        from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
-        pal_t = _try_variant(decode_kernel_pallas, "pallas")
-    except Exception as exc:
-        print(f"# pallas decode unavailable: {exc}", file=sys.stderr)
-        pal_t = None
+    tiny_t = pal_t = None
+    if compile_service_ok():
+        from p2p_dhts_tpu.ida import decode_kernel_tiny
+        tiny_t = _try_variant(decode_kernel_tiny, "vpu-tiny")
+        try:
+            from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
+            pal_t = _try_variant(decode_kernel_pallas, "pallas")
+        except Exception as exc:
+            print(f"# pallas decode unavailable: {exc}", file=sys.stderr)
 
     return _emit({
         "config": "ida",
@@ -489,19 +534,20 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
     # program, firewalled so a dead compile service can't sink the
     # cached default's numbers; route parity asserted when it runs.
     structured_t = None
-    try:
-        from p2p_dhts_tpu.core.ring import find_successor_structured_pred
-        o2, h2 = find_successor_structured_pred(state, keys, starts)
-        _sync(o2, h2)
-        assert bool(jnp.all(o2 == owner)) and bool(jnp.all(h2 == hops)), \
-            "structured-pred serve diverges"
-        structured_t = _time(
-            lambda: find_successor_structured_pred(state, keys, starts))
-    except AssertionError:
-        raise
-    except Exception as exc:
-        print(f"# structured-pred serve unavailable: {exc}",
-              file=sys.stderr)
+    if compile_service_ok():
+        try:
+            from p2p_dhts_tpu.core.ring import find_successor_structured_pred
+            o2, h2 = find_successor_structured_pred(state, keys, starts)
+            _sync(o2, h2)
+            assert bool(jnp.all(o2 == owner)) and \
+                bool(jnp.all(h2 == hops)), "structured-pred serve diverges"
+            structured_t = _time(
+                lambda: find_successor_structured_pred(state, keys, starts))
+        except AssertionError:
+            raise
+        except Exception as exc:
+            print(f"# structured-pred serve unavailable: {exc}",
+                  file=sys.stderr)
 
     lps = n_keys / best
     return _emit({
