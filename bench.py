@@ -319,20 +319,27 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
     # kernel and the fused Pallas tile are NEW programs (a dead remote-
     # compile service must not sink the config's cached dot-path numbers);
     # a WRONG RESULT still hard-fails.
-    def _try_variant(fn, label):
+    def _try_variant(fn, label, v_rows=None, v_idx=None):
+        v_rows = rows if v_rows is None else v_rows
+        v_idx = idx if v_idx is None else v_idx
         try:
-            got = fn(rows, idx, p)
+            got = fn(v_rows, v_idx, p)
             _sync(got)  # compile/lowering errors surface at the sync
         except Exception as exc:
             print(f"# {label} decode unavailable: {exc}", file=sys.stderr)
             return None
         assert bool(jnp.all(got == segments)), f"{label} decode mismatch"
-        return _time(lambda: (fn(rows, idx, p),))
+        return _time(lambda: (fn(v_rows, v_idx, p),))
 
-    tiny_t = pal_t = None
+    tiny_t = pal_t = uni_t = None
     if compile_service_ok():
-        from p2p_dhts_tpu.ida import decode_kernel_tiny
+        from p2p_dhts_tpu.ida import decode_kernel_tiny, decode_kernel_uniform
         tiny_t = _try_variant(decode_kernel_tiny, "vpu-tiny")
+        # Uniform-index decode (the no-failure read path: every block
+        # shares indices 1..m, one inverse, broadcast-LHS MXU matmul).
+        uni_t = _try_variant(decode_kernel_uniform, "uniform",
+                             v_rows=frags[:, :m, :],
+                             v_idx=jnp.arange(1, m + 1, dtype=jnp.int32))
         try:
             from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
             pal_t = _try_variant(decode_kernel_pallas, "pallas")
@@ -348,6 +355,8 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
         "decode_mb_s": round(payload_mb / dec_t, 1),
         "decode_tiny_mb_s":
             round(payload_mb / tiny_t, 1) if tiny_t else None,
+        "decode_uniform_mb_s":
+            round(payload_mb / uni_t, 1) if uni_t else None,
         "decode_pallas_mb_s":
             round(payload_mb / pal_t, 1) if pal_t else None,
         "vs_baseline": None,

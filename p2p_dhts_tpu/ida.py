@@ -113,6 +113,25 @@ def decode_kernel(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
+def decode_kernel_uniform(rows: jax.Array, indices: jax.Array,
+                          p: int) -> jax.Array:
+    """decode_kernel for a batch sharing ONE index set: [B, m, S] rows +
+    [m] 1-based indices -> [B, S, m] segments.
+
+    The no-failure read path: when the first m fragment holders all
+    respond, every block decodes from indices 1..m, so the inverse
+    Vandermonde is computed ONCE and the matmul has a broadcast LHS —
+    the same shape XLA flattens into a dense MXU matmul for encode
+    (22 GB/s measured) instead of the batched-tiny-matmul padding cliff
+    (93 MB/s). Callers fall back to decode_kernel when index sets differ
+    per block (post-failure reads)."""
+    inv = modp.vandermonde_inverse(indices, p)           # [m, m]
+    out = modp.mod_matmul(
+        jnp.broadcast_to(inv, rows.shape[:-2] + inv.shape), rows, p)
+    return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
 def decode_kernel_tiny(rows: jax.Array, indices: jax.Array,
                        p: int) -> jax.Array:
     """decode_kernel with the VPU broadcast-reduce matmul: per-batch

@@ -82,6 +82,23 @@ def test_pallas_decode_matches_xla_path(rng):
         np.asarray(decode_kernel_tiny(rows, idx, p)), np.asarray(want))
 
 
+def test_uniform_decode_matches_general(rng):
+    """decode_kernel_uniform (shared index set, one inverse, broadcast
+    matmul) must equal decode_kernel on the same inputs — the no-failure
+    read shape."""
+    from p2p_dhts_tpu.ida import (decode_kernel, decode_kernel_uniform,
+                                  encode_kernel)
+    n, m, p, s, b = 14, 10, 257, 64, 9
+    segs = jnp.asarray(rng.randint(0, 256, size=(b, s, m)), jnp.int32)
+    frags = encode_kernel(segs, n, m, p)
+    rows = frags[:, :m, :]
+    idx1 = jnp.arange(1, m + 1, dtype=jnp.int32)
+    got = decode_kernel_uniform(rows, idx1, p)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(segs))
+    want = decode_kernel(rows, jnp.broadcast_to(idx1, (b, m)), p)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 @pytest.mark.soak
 def test_pallas_decode_full_shape(rng):
     """Full reference shape (n=14, m=10) through the Pallas tile."""
