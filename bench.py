@@ -399,6 +399,25 @@ def bench_dhash(n_peers: int = 1024, n_keys: int = 16384) -> dict:
     assert bool(jnp.all(rok)), "gets failed"
     assert bool(jnp.all(out == segments)), "get payload mismatch"
 
+    # Adaptive-decode read variant (one-inverse broadcast matmul when the
+    # whole batch shares an index set — the healthy-store common case).
+    # A new program, so gated + firewalled like the other variants.
+    adaptive_t = None
+    if compile_service_ok():
+        try:
+            out_a, rok_a = read_batch(ring, store, keys, n, m, p,
+                                      adaptive_decode=True)
+            _sync(out_a, rok_a)
+            assert bool(jnp.all(out_a == out)) and \
+                bool(jnp.all(rok_a == rok)), "adaptive read diverges"
+            adaptive_t = _time(
+                lambda: read_batch(ring, store, keys, n, m, p,
+                                   adaptive_decode=True), repeats=2)
+        except AssertionError:
+            raise
+        except Exception as exc:
+            print(f"# adaptive read unavailable: {exc}", file=sys.stderr)
+
     # Recovery: fail n-m = 4 peers; every key still reconstructs (each
     # key's n fragments sit on n distinct successors, so any 4 failures
     # cost at most 4 fragments — dhash_peer.cpp:189-196's guarantee).
@@ -415,6 +434,8 @@ def bench_dhash(n_peers: int = 1024, n_keys: int = 16384) -> dict:
                   f"n={n} m={m})",
         "value": round(n_keys / get_t, 1),
         "unit": "gets/sec",
+        "gets_adaptive_s":
+            round(n_keys / adaptive_t, 1) if adaptive_t else None,
         "put_ops_s": round(n_keys / put_t, 1),
         "vs_baseline": None,
         "recovery_after_4_failures": "ok",

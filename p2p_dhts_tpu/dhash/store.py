@@ -32,7 +32,8 @@ from p2p_dhts_tpu.core.ring import (
     n_successors_converged,
     placement_converged,
 )
-from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+from p2p_dhts_tpu.ida import (decode_kernel, decode_kernel_uniform,
+                             encode_kernel)
 from p2p_dhts_tpu.ops import u128
 
 
@@ -269,9 +270,11 @@ def create_batch(ring: RingState, store: FragmentStore,
     return _sort_store(new), ok
 
 
-@functools.partial(jax.jit, static_argnames=("n", "m", "p"))
+@functools.partial(jax.jit,
+                   static_argnames=("n", "m", "p", "adaptive_decode"))
 def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
-               n: int = 14, m: int = 10, p: int = 257
+               n: int = 14, m: int = 10, p: int = 257,
+               adaptive_decode: bool = False
                ) -> Tuple[jax.Array, jax.Array]:
     """Batched DHash Read (ref dhash_peer.cpp:156-197).
 
@@ -280,6 +283,15 @@ def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
     unreachable, as a READ_KEY to it would fail), pick the first m with
     DISTINCT indices (the reference's distinct-fragment check,
     dhash_peer.cpp:180-186), decode.
+
+    adaptive_decode=True checks at runtime whether the whole batch
+    decodes from the SAME index set (true whenever no holder has failed:
+    create assigns fragment i+1 to holder i, so healthy reads always
+    collect indices 1..m) and routes it through the one-inverse
+    broadcast-matmul decode (ida.decode_kernel_uniform's shape) instead
+    of the per-block batched-tiny-matmul cliff. A static flag — a
+    SEPARATE traced program — so the default read keeps its
+    already-compiled cache entries; flips once measured on chip.
 
     Returns (segments [B, S, m] i32, ok [B] bool). Failed lanes (fewer
     than m reachable distinct fragments — the reference throws) give
@@ -299,6 +311,13 @@ def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
     idx = jnp.where(ok[:, None], store.frag_idx[sel],
                     jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
 
-    segments = decode_kernel(rows, idx, p)                         # [B, S, m]
+    if adaptive_decode:
+        uni_idx = jnp.arange(1, m + 1, dtype=jnp.int32)
+        segments = jax.lax.cond(
+            jnp.all(idx == uni_idx[None, :]),
+            lambda: decode_kernel_uniform(rows, uni_idx, p),
+            lambda: decode_kernel(rows, idx, p))
+    else:
+        segments = decode_kernel(rows, idx, p)                     # [B, S, m]
     segments = jnp.where(ok[:, None, None], segments, 0)
     return segments, ok

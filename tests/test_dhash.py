@@ -464,3 +464,29 @@ def test_stale_holders_without_remap_degrade_reads(rng):
         "stale holders must lose the 4 fragments pointing at dead rows"
     assert int(np.asarray(pres_fixed).sum()) == N_IDA, \
         "remapped holders must keep every fragment reachable"
+
+
+def test_adaptive_decode_read_parity(rng):
+    """read_batch(adaptive_decode=True) must match the default read on a
+    healthy store (uniform index sets -> the one-inverse broadcast path)
+    AND after holder failures (mixed index sets -> the general path via
+    the runtime cond)."""
+    ring, store, keys, starts, vals, segs, lengths, ok = _setup(rng)
+    assert bool(jnp.all(ok))
+    want, wok = read_batch(ring, store, keys, N_IDA, M_IDA, P_IDA)
+    got, gok = read_batch(ring, store, keys, N_IDA, M_IDA, P_IDA,
+                          adaptive_decode=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(gok), np.asarray(wok))
+
+    # Fail n-m holders: reads now select non-uniform fragment sets; the
+    # adaptive cond must fall through to the general decode.
+    victims = jnp.asarray(
+        rng.choice(int(ring.n_valid), size=N_IDA - M_IDA, replace=False),
+        jnp.int32)
+    ring2 = churn.stabilize_sweep(churn.fail(ring, victims))
+    want2, wok2 = read_batch(ring2, store, keys, N_IDA, M_IDA, P_IDA)
+    got2, gok2 = read_batch(ring2, store, keys, N_IDA, M_IDA, P_IDA,
+                            adaptive_decode=True)
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
+    np.testing.assert_array_equal(np.asarray(gok2), np.asarray(wok2))
