@@ -8,6 +8,7 @@
 
 #include <arpa/inet.h>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <poll.h>
 #include <sys/socket.h>
@@ -59,7 +60,26 @@ inline int connect_to(const char* ip, int port, double timeout_s) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(uint16_t(port));
-  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) { ::close(fd); return -1; }
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    // Not a dotted quad: resolve like the Python client's
+    // socket.create_connection does (ADVICE r4 — peers advertise
+    // whatever IP_ADDR string they were constructed with, e.g.
+    // "localhost", and both implementations must reach them).
+    // NOTE: getaddrinfo blocks on the system resolver OUTSIDE
+    // timeout_s (which budgets the connect only) — the same exclusion
+    // Python's create_connection has; hostname peers on a dead DNS
+    // can stall probes for the resolver timeout on either client.
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(ip, nullptr, &hints, &res) != 0 || res == nullptr) {
+      ::close(fd);
+      return -1;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
   set_nonblocking(fd, true);
   int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr);
   if (rc != 0) {

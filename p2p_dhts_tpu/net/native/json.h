@@ -17,10 +17,12 @@
 #pragma once
 
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <string>
 #include <utility>
 #include <vector>
@@ -85,16 +87,24 @@ inline void dump_string(const std::string& s, std::string& out) {
       out += char(c); i++;
     } else {
       // Decode one UTF-8 sequence -> codepoint -> \uXXXX (ensure_ascii).
+      // Every trailing byte must be a 0x80-0xBF continuation (ADVICE
+      // r4): a malformed interior sequence (0xC2 followed by ASCII)
+      // must emit U+FFFD for the lead byte ONLY, not swallow the
+      // byte after it into a wrong escape.
+      auto cont = [&](size_t j) {
+        return j < n && (static_cast<unsigned char>(s[j]) & 0xC0) == 0x80;
+      };
       uint32_t cp = 0xFFFD;
       size_t len = 1;
-      if ((c & 0xE0) == 0xC0 && i + 1 < n) {
+      if ((c & 0xE0) == 0xC0 && cont(i + 1)) {
         cp = (uint32_t(c & 0x1F) << 6) | uint32_t(s[i + 1] & 0x3F);
         len = 2;
-      } else if ((c & 0xF0) == 0xE0 && i + 2 < n) {
+      } else if ((c & 0xF0) == 0xE0 && cont(i + 1) && cont(i + 2)) {
         cp = (uint32_t(c & 0x0F) << 12) | (uint32_t(s[i + 1] & 0x3F) << 6) |
              uint32_t(s[i + 2] & 0x3F);
         len = 3;
-      } else if ((c & 0xF8) == 0xF0 && i + 3 < n) {
+      } else if ((c & 0xF8) == 0xF0 && cont(i + 1) && cont(i + 2) &&
+                 cont(i + 3)) {
         cp = (uint32_t(c & 0x07) << 18) | (uint32_t(s[i + 1] & 0x3F) << 12) |
              (uint32_t(s[i + 2] & 0x3F) << 6) | uint32_t(s[i + 3] & 0x3F);
         len = 4;
@@ -125,14 +135,55 @@ inline void dump(const Jv& v, std::string& out) {
       out += tmp;
       break;
     case Jv::T::Dbl: {
-      // Shortest round-trip like Python repr: try increasing precision.
-      for (int prec = 1; prec <= 17; prec++) {
-        std::snprintf(tmp, sizeof tmp, "%.*g", prec, v.d);
-        if (std::strtod(tmp, nullptr) == v.d) break;
+      double d = v.d;
+      if (!std::isfinite(d)) {
+        // json.dumps emits these non-standard tokens; match its bytes.
+        out += std::isnan(d) ? "NaN" : (d < 0 ? "-Infinity" : "Infinity");
+        break;
       }
-      out += tmp;
-      // Python emits a ".0" for integral floats; %g drops it.
-      if (!std::strpbrk(tmp, ".eEnN")) out += ".0";
+      if (d == 0.0) {
+        out += std::signbit(d) ? "-0.0" : "0.0";
+        break;
+      }
+      // Shortest round-trip digits (via %.*e), rendered with CPython
+      // repr's fixed/scientific split (pystrtod.c format_float_short:
+      // scientific iff the decimal point falls at <= -4 or > 16) — %g's
+      // own split differs ("1e+02" where Python says "100.0"), which
+      // would fork the wire bytes (ADVICE-r4-adjacent parity test).
+      char buf[40];
+      for (int p2 = 1; p2 <= 17; p2++) {
+        std::snprintf(buf, sizeof buf, "%.*e", p2 - 1, d);
+        if (std::strtod(buf, nullptr) == d) break;
+      }
+      std::string digits;
+      bool neg = false;
+      int exp10 = 0;
+      for (const char* q = buf; *q; q++) {
+        if (*q == '-' && digits.empty()) { neg = true; continue; }
+        if (*q == '.') continue;
+        if (*q == 'e' || *q == 'E') { exp10 = std::atoi(q + 1); break; }
+        digits += *q;
+      }
+      while (digits.size() > 1 && digits.back() == '0') digits.pop_back();
+      int k = int(digits.size());
+      std::string s;
+      if (exp10 >= -4 && exp10 < 16) {
+        if (exp10 >= k - 1)
+          s = digits + std::string(size_t(exp10 - (k - 1)), '0') + ".0";
+        else if (exp10 >= 0)
+          s = digits.substr(0, size_t(exp10) + 1) + "." +
+              digits.substr(size_t(exp10) + 1);
+        else
+          s = "0." + std::string(size_t(-exp10 - 1), '0') + digits;
+      } else {
+        s = digits.substr(0, 1);
+        if (k > 1) s += "." + digits.substr(1);
+        char e[8];
+        std::snprintf(e, sizeof e, "e%+03d", exp10);
+        s += e;
+      }
+      if (neg) out += '-';
+      out += s;
       break;
     }
     case Jv::T::Str: dump_string(v.s, out); break;
@@ -214,6 +265,25 @@ class Parser {
     }
     if (c == 't' || c == 'f') return boolean(out);
     if (c == 'n') return null_(out);
+    // json.JSONDecoder's parse_constant defaults: NaN / Infinity /
+    // -Infinity parse as doubles (dump() emits the same tokens, so a
+    // native<->native round-trip of a non-finite value must close).
+    if (c == 'N') {
+      out.t = Jv::T::Dbl;
+      out.d = std::nan("");
+      return literal("NaN");
+    }
+    if (c == 'I') {
+      out.t = Jv::T::Dbl;
+      out.d = std::numeric_limits<double>::infinity();
+      return literal("Infinity");
+    }
+    if (c == '-' && i_ + 1 < n_ && p_[i_ + 1] == 'I') {
+      out.t = Jv::T::Dbl;
+      out.d = -std::numeric_limits<double>::infinity();
+      i_++;
+      return literal("Infinity");
+    }
     if (c == '-' || (c >= '0' && c <= '9')) return number(out);
     return fail("unexpected character");
   }
@@ -239,19 +309,34 @@ class Parser {
   }
 
   bool number(Jv& out) {
+    // Python-json grammar exactly (wire-parity: both servers must fail
+    // identically on malformed numbers — ADVICE r4): integer part is
+    // '0' alone or [1-9][0-9]* (no leading zeros), '.' and 'e' each
+    // require at least one following digit.
     size_t start = i_;
     if (i_ < n_ && p_[i_] == '-') i_++;
-    while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
+    if (i_ >= n_ || p_[i_] < '0' || p_[i_] > '9')
+      return fail("invalid number");
+    if (p_[i_] == '0') {
+      i_++;  // "01" stops here; the stray digit then fails the caller's
+             // delimiter check, as json.JSONDecoder's "Extra data" does
+    } else {
+      while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
+    }
     bool is_dbl = false;
     if (i_ < n_ && p_[i_] == '.') {
       is_dbl = true;
       i_++;
+      if (i_ >= n_ || p_[i_] < '0' || p_[i_] > '9')
+        return fail("invalid number");
       while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
     }
     if (i_ < n_ && (p_[i_] == 'e' || p_[i_] == 'E')) {
       is_dbl = true;
       i_++;
       if (i_ < n_ && (p_[i_] == '+' || p_[i_] == '-')) i_++;
+      if (i_ >= n_ || p_[i_] < '0' || p_[i_] > '9')
+        return fail("invalid number");
       while (i_ < n_ && p_[i_] >= '0' && p_[i_] <= '9') i_++;
     }
     std::string tok(p_ + start, i_ - start);

@@ -48,6 +48,16 @@ class FingerTable:
         self.backend = backend
         self._table: List[Finger] = []
         self._lock = threading.RLock()
+        self._resolver = None  # DeviceFingerResolver, built on first use
+
+    def _device_resolver(self):
+        """Lazy per-table batching bridge (overlay.jax_bridge)."""
+        with self._lock:
+            if self._resolver is None:
+                from p2p_dhts_tpu.overlay.jax_bridge import (
+                    DeviceFingerResolver)
+                self._resolver = DeviceFingerResolver(int(self.starting_key))
+            return self._resolver
 
     # -- structure ---------------------------------------------------------
     def add_finger(self, finger: Finger) -> None:
@@ -99,15 +109,30 @@ class FingerTable:
         """Successor of the range containing key (finger_table.h:115-130).
 
         python backend: the reference's linear scan, verbatim.
-        jax backend: O(1) closed form (the scan's unique hit is entry
-        bit_length(dist) - 1).
+        jax backend: the DEVICE kernel, via the batching bridge —
+        concurrent per-RPC lookups coalesce into one ``u128`` batch
+        (entry index = bit_length((key - start) mod 2^128) - 1, the
+        closed form of the scan). The device resolve runs with the
+        table lock RELEASED so the server's worker threads can share a
+        batch; the entry read re-takes it. Falls back to the host
+        closed form only if jax itself is unavailable.
         """
-        with self._lock:
-            if self.backend == "jax" and len(self._table) == self.NUM_ENTRIES:
-                dist = (int(key) - int(self.starting_key)) % KEYS_IN_RING
-                if dist == 0:
+        if self.backend == "jax":
+            with self._lock:
+                full = len(self._table) == self.NUM_ENTRIES
+            if full:
+                try:
+                    idx = self._device_resolver().lookup_index(int(key))
+                except ImportError:  # jax-less deployment: host closed form
+                    dist = (int(key) - int(self.starting_key)) % KEYS_IN_RING
+                    idx = dist.bit_length() - 1 if dist else -1
+                if idx < 0:
                     raise LookupError("ChordKey not found")
-                return self._table[dist.bit_length() - 1].successor
+                with self._lock:
+                    if len(self._table) == self.NUM_ENTRIES:
+                        return self._table[idx].successor
+                # table shrank mid-flight: fall through to the scan
+        with self._lock:
             for finger in self._table:
                 if Key(key).in_between(finger.lower_bound,
                                        finger.upper_bound, True):

@@ -1,0 +1,152 @@
+"""Batching bridge: per-RPC finger lookups -> device ``u128`` kernel.
+
+BASELINE.json's north star puts ``backend="jax"`` on ChordPeer's lookup
+path (the reference resolves one key per FIND_SUCCESSOR RPC through
+FingerTable::Lookup's 128-entry linear scan, finger_table.h:115-130,
+called from chord_peer.cpp:185-211). A TPU executes that scan as a
+batched kernel — but the wire layer receives keys ONE per RPC, so the
+bridge's job is aggregation: concurrent lookups from the server's worker
+threads coalesce into one device batch per dispatch window, pay one
+kernel launch, and fan the results back out.
+
+Design:
+  * no dedicated dispatcher thread — the first caller into an idle
+    bridge becomes the batch leader, sleeps one window to let
+    concurrent callers pile in, then serves everything pending in a
+    single jitted call (``u128.sub`` + ``u128.bit_length``: entry
+    index = bit_length((key - start) mod 2^128) - 1, the closed form
+    of the reference's scan).
+  * static shapes: batches pad to power-of-two buckets so each bucket
+    size compiles once per process.
+  * jax imports lazily on first use — the overlay layer stays
+    importable (and fast) for pure-wire deployments, and constructing
+    peers never touches the TPU claim (verify-skill tunnel etiquette).
+
+The bulk path for key-dense workloads remains ``DeviceDHT`` /
+``core.ring.find_successor``; this bridge is the honest device wiring
+for the per-request wire protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Tuple
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+
+_kernel_lock = threading.Lock()
+_kernel = None  # populated on first use; holds (jitted_fn, np, keyspace)
+
+
+def _load_kernel():
+    """Build the jitted finger-index kernel (once per process)."""
+    global _kernel
+    with _kernel_lock:
+        if _kernel is None:
+            import numpy as np
+
+            import jax
+
+            from p2p_dhts_tpu import keyspace
+            from p2p_dhts_tpu.ops import u128
+
+            @jax.jit
+            def finger_index(keys, start):
+                # dist==0 -> bit_length 0 -> index -1: the "key is the
+                # table's own starting key" LookupError case.
+                dist = u128.sub(keys, start[None, :])
+                return u128.bit_length(dist) - 1
+
+            _kernel = (finger_index, np, keyspace)
+    return _kernel
+
+
+class DeviceFingerResolver:
+    """Coalesces concurrent single-key lookups into device batches.
+
+    ``lookup_index(key_int)`` blocks until the containing batch is
+    served and returns the finger-table entry index (or -1 for the
+    zero-distance LookupError case). Thread-safe; callers MUST NOT hold
+    the finger table's lock while blocked here, or batching degrades to
+    sequential singles.
+    """
+
+    MAX_BATCH = 1024
+
+    def __init__(self, starting_key: int, window_s: float = 0.001):
+        self._start_int = int(starting_key) % KEYS_IN_RING
+        self._window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[int, dict]] = []
+        self._leader_active = False
+        self._start_lanes = None  # device-resident [4] u32, built lazily
+        # Telemetry for tests/metrics: sizes of recent device batches
+        # (bounded — this sits on the per-RPC hot path) + running totals.
+        from collections import deque
+        self.batch_sizes = deque(maxlen=1024)
+        self.batches_served = 0
+        self.keys_served = 0
+
+    # -- public ------------------------------------------------------------
+    def lookup_index(self, key_int: int) -> int:
+        slot: dict = {"ev": threading.Event()}
+        with self._lock:
+            self._pending.append((int(key_int) % KEYS_IN_RING, slot))
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+        if lead:
+            # Exception-safe leadership: whatever happens during the
+            # coalescing window (KeyboardInterrupt, a SIGALRM-injected
+            # timeout), the flag resets and pending slots are failed
+            # out — a wedged leader would deadlock every later lookup.
+            interrupted = None
+            try:
+                time.sleep(self._window_s)  # coalescing window
+            except BaseException as exc:  # noqa: BLE001
+                interrupted = exc
+            with self._lock:
+                batch, self._pending = self._pending, []
+                self._leader_active = False
+            if interrupted is not None:
+                for _, s in batch:
+                    s["error"] = interrupted
+                    s["ev"].set()
+                raise interrupted
+            self._serve(batch)
+        slot["ev"].wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["index"]
+
+    # -- internals ----------------------------------------------------------
+    def _serve(self, batch: List[Tuple[int, dict]]) -> None:
+        try:
+            fn, np, keyspace = _load_kernel()
+            if self._start_lanes is None:
+                import jax.numpy as jnp
+                self._start_lanes = jnp.asarray(
+                    keyspace.ints_to_lanes([self._start_int])[0])
+            for off in range(0, len(batch), self.MAX_BATCH):
+                chunk = batch[off:off + self.MAX_BATCH]
+                bucket = 1
+                while bucket < len(chunk):
+                    bucket *= 2
+                ints = [k for k, _ in chunk]
+                ints += [self._start_int] * (bucket - len(chunk))  # pad
+                lanes = keyspace.ints_to_lanes(ints)
+                idx = np.asarray(fn(lanes, self._start_lanes))
+                self.batch_sizes.append(len(chunk))
+                self.batches_served += 1
+                self.keys_served += len(chunk)
+                for j, (_, slot) in enumerate(chunk):
+                    slot["index"] = int(idx[j])
+                    slot["ev"].set()
+        except BaseException as exc:  # noqa: BLE001 — fanned out to callers
+            for _, slot in batch:
+                if "index" not in slot:
+                    slot["error"] = exc
+                    slot["ev"].set()
+            if not batch:
+                raise

@@ -42,3 +42,93 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(20260729)
+
+
+# ---------------------------------------------------------------------------
+# Soak hygiene (VERDICT r4 weak #6): every soak runs under a wall-clock
+# budget with a clean exit, and every soak outcome is RECORDED — a soak
+# that burns hours silently (or an orphaned `pytest -m soak` process)
+# produces no evidence and starves this 1-core host.
+# ---------------------------------------------------------------------------
+
+import json as _json
+import signal as _signal
+import subprocess as _subprocess
+import time as _time
+
+_SOAK_SESSION_T0 = _time.time()
+_SOAK_RESULTS = os.path.join(os.path.dirname(__file__), os.pardir,
+                             "SOAK_RESULTS.jsonl")
+
+
+def _repo_commit() -> str:
+    # Same stamp rule as bench.py's _git_commit: a dirty tree means HEAD
+    # is not the code that ran, so the evidence must say so.
+    repo = os.path.dirname(_SOAK_RESULTS)
+    try:
+        out = _subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip() or "unknown"
+        dirty = _subprocess.run(
+            ["git", "-C", repo, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10)
+        return sha + "-dirty" if dirty.stdout.strip() else sha
+    except Exception:
+        return "unknown"
+
+
+@pytest.fixture(autouse=True)
+def _soak_budget(request):
+    """Per-test and per-session wall-clock budgets for soak-marked tests.
+
+    SOAK_TEST_BUDGET_S (default 600) bounds one soak; SOAK_SESSION_BUDGET_S
+    (default 3600) bounds the whole `-m soak` run — once exhausted, the
+    remaining soaks SKIP (a recorded, clean exit) instead of running
+    unbounded. SIGALRM-based: fires at the next Python bytecode after the
+    budget, so a single long XLA compile can overshoot; the budget is a
+    hygiene bound, not a precise timer.
+    """
+    if request.node.get_closest_marker("soak") is None:
+        yield
+        return
+    session_budget = float(os.environ.get("SOAK_SESSION_BUDGET_S", "3600"))
+    if _time.time() - _SOAK_SESSION_T0 > session_budget:
+        pytest.skip(f"session soak budget ({session_budget:.0f}s) exhausted")
+    budget = float(os.environ.get("SOAK_TEST_BUDGET_S", "600"))
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"soak exceeded its {budget:.0f}s wall-clock budget")
+
+    old = _signal.signal(_signal.SIGALRM, _on_alarm)
+    _signal.setitimer(_signal.ITIMER_REAL, budget)
+    try:
+        yield
+    finally:
+        _signal.setitimer(_signal.ITIMER_REAL, 0)
+        _signal.signal(_signal.SIGALRM, old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if item.get_closest_marker("soak") is None:
+        return
+    # Record the call phase, and ALSO setup-phase skips — the session
+    # budget's clean exit must leave evidence that soaks were skipped.
+    if call.when != "call" and not (call.when == "setup"
+                                    and report.outcome == "skipped"):
+        return
+    try:
+        with open(_SOAK_RESULTS, "a") as f:
+            f.write(_json.dumps({
+                "test": item.nodeid,
+                "outcome": report.outcome,
+                "duration_s": round(report.duration, 1),
+                "utc": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
+                "commit": _repo_commit(),
+            }) + "\n")
+    except OSError:
+        pass

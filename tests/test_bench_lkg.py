@@ -1,0 +1,78 @@
+"""bench.py last-known-good evidence chain (VERDICT r4 weak #2 / next #6).
+
+A dead TPU tunnel must not erase hardware evidence: bench.py persists
+every green on-chip config record in BENCH_LKG.json (commit + utc +
+device stamped) and replays them marked ``stale: true`` in its abort
+record and per-config failure records.
+"""
+
+import json
+import os
+
+import bench
+
+
+def _seed(tmp_path, monkeypatch, data):
+    path = tmp_path / "lkg.json"
+    path.write_text(json.dumps(data))
+    monkeypatch.setattr(bench, "_LKG_PATH", str(path))
+    return path
+
+
+def test_stale_records_marked_and_sorted(tmp_path, monkeypatch):
+    _seed(tmp_path, monkeypatch, {
+        "ida": {"config": "ida", "value": 2.0, "commit": "abc",
+                "utc": "2026-07-31T03:45:00Z", "device": "TPU v5 lite0"},
+        "chord16": {"config": "chord16", "value": 1.0, "commit": "abc",
+                    "utc": "2026-07-31T03:45:00Z",
+                    "device": "TPU v5 lite0"},
+    })
+    recs = bench._lkg_stale_records()
+    assert [r["config"] for r in recs] == ["chord16", "ida"]
+    for r in recs:
+        assert r["stale"] is True
+        assert r["value"] is not None
+
+
+def test_live_seed_file_is_valid_and_covers_r4_greens():
+    # The committed artifact only needs to parse and key consistently;
+    # value/format invariants live on fixtures (production on-chip runs
+    # legitimately rewrite this file).
+    with open(bench._LKG_PATH) as f:
+        data = json.load(f)
+    assert {"chord16", "dhash", "ida"} <= set(data)
+    for cfg, rec in data.items():
+        assert rec["config"] == cfg
+        assert "stale" not in rec  # staleness is applied at replay time
+        for stamp in ("commit", "utc", "device"):
+            assert rec[stamp]
+
+
+def test_corrupt_store_parked_not_clobbered(tmp_path, monkeypatch, capsys):
+    path = _seed(tmp_path, monkeypatch, {})
+    path.write_text("{ not json")
+    assert bench._load_lkg() == {}
+    assert not path.exists()  # moved aside, not silently truncated
+    assert (tmp_path / "lkg.json.corrupt").read_text() == "{ not json"
+
+
+def test_record_lkg_refuses_cpu_and_null(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_LKG_PATH", str(tmp_path / "lkg.json"))
+    # Null-value records never persist regardless of backend.
+    bench._record_lkg({"config": "chord16", "value": None})
+    # The suite runs on the forced-CPU platform, which is not in the
+    # hardware allowlist ("tpu"/"axon") — a green record must also be
+    # refused (CPU numbers must not masquerade as chip evidence).
+    bench._record_lkg({"config": "chord16", "value": 1.0})
+    assert not os.path.exists(tmp_path / "lkg.json")
+
+
+def test_git_commit_marks_dirty_tree():
+    # The working tree during this round is routinely dirty mid-edit;
+    # either way the stamp must be a short sha with an optional -dirty
+    # suffix, never "unknown" inside a git checkout.
+    stamp = bench._git_commit()
+    assert stamp != "unknown"
+    sha = stamp.removesuffix("-dirty")
+    assert 6 <= len(sha) <= 16 and all(
+        c in "0123456789abcdef" for c in sha)

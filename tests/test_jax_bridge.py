@@ -1,0 +1,115 @@
+"""backend="jax" on the wire path: the batching bridge (VERDICT r4 #5).
+
+BASELINE.json's north star puts the flag on ChordPeer's per-RPC lookup
+path (chord_peer.cpp:185-211 -> finger_table.h:115-130). These tests pin
+that a ``backend="jax"`` FingerTable demonstrably executes the DEVICE
+kernel (overlay.jax_bridge: ``u128.sub`` + ``u128.bit_length`` under
+jit), that concurrent per-RPC lookups coalesce into shared device
+batches, and that every route matches the ``backend="python"`` linear
+scan exactly.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key
+from p2p_dhts_tpu.overlay.finger_table import Finger, FingerTable
+from p2p_dhts_tpu.overlay.jax_bridge import DeviceFingerResolver
+from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
+
+
+def _full_table(start_int: int, backend: str) -> FingerTable:
+    """128-entry table whose entry i points at a distinct synthetic peer
+    (id = entry's lower bound's successor stand-in) so lookups are
+    distinguishable per entry."""
+    ft = FingerTable(Key(start_int), backend=backend)
+    for i in range(FingerTable.NUM_ENTRIES):
+        lb, ub = ft.get_nth_range(i)
+        peer = RemotePeer(Key(int(ub)), Key(int(lb)), "127.0.0.1",
+                          9000 + i)
+        ft.add_finger(Finger(lb, ub, peer))
+    return ft
+
+
+@pytest.mark.parametrize("start_int", [
+    0, 1, 12345, (1 << 127) + 17, KEYS_IN_RING - 1,
+])
+def test_jax_lookup_matches_python_scan(start_int):
+    rng = np.random.RandomState(start_int % 991)
+    ft_py = _full_table(start_int, "python")
+    ft_jx = _full_table(start_int, "jax")
+    ft_jx._resolver = DeviceFingerResolver(start_int, window_s=0.0)
+
+    keys = [int.from_bytes(rng.bytes(16), "little") for _ in range(64)]
+    keys += [(start_int + (1 << i)) % KEYS_IN_RING for i in (0, 1, 63, 127)]
+    keys += [(start_int + (1 << i) - 1) % KEYS_IN_RING for i in (1, 64)]
+    for k in keys:
+        want = ft_py.lookup(Key(k))
+        got = ft_jx.lookup(Key(k))
+        assert got.port == want.port, f"route diverges for key {k:#x}"
+    # The device kernel actually served these (not a host fallback).
+    assert ft_jx._resolver.batch_sizes, "device kernel never ran"
+    assert sum(ft_jx._resolver.batch_sizes) == len(keys)
+
+
+def test_jax_lookup_zero_distance_raises_like_python():
+    ft_py = _full_table(777, "python")
+    ft_jx = _full_table(777, "jax")
+    ft_jx._resolver = DeviceFingerResolver(777, window_s=0.0)
+    with pytest.raises(LookupError):
+        ft_py.lookup(Key(777))
+    with pytest.raises(LookupError):
+        ft_jx.lookup(Key(777))
+
+
+def test_concurrent_lookups_coalesce_into_one_device_batch():
+    start = 424242
+    ft = _full_table(start, "jax")
+    ft._resolver = DeviceFingerResolver(start, window_s=0.25)
+    rng = np.random.RandomState(3)
+    keys = [int.from_bytes(rng.bytes(16), "little") for _ in range(8)]
+    want = {k: _full_table(start, "python").lookup(Key(k)).port
+            for k in keys}
+
+    got = {}
+    lock = threading.Lock()
+
+    def worker(k):
+        peer = ft.lookup(Key(k))
+        with lock:
+            got[k] = peer.port
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in keys]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert got == want
+    # The 250 ms window must have coalesced the 8 threads into fewer
+    # device dispatches, with at least one genuinely multi-key batch.
+    assert max(ft._resolver.batch_sizes) > 1
+    assert len(ft._resolver.batch_sizes) < len(keys)
+
+
+def test_resolver_pads_to_buckets_and_chunks():
+    r = DeviceFingerResolver(0, window_s=0.0)
+    # 3 sequential singles: every batch size is recorded honestly
+    # (padding to the power-of-two bucket happens inside the kernel
+    # call, not in the telemetry).
+    for k in (1, 2, 3):
+        idx = r.lookup_index(k)
+        assert idx == int(k).bit_length() - 1
+    assert list(r.batch_sizes) == [1, 1, 1]
+    assert r.batches_served == 3 and r.keys_served == 3
+
+
+def test_resolver_index_matches_closed_form_everywhere():
+    r = DeviceFingerResolver(98765, window_s=0.0)
+    rng = np.random.RandomState(11)
+    for k in [int.from_bytes(rng.bytes(16), "little") for _ in range(32)]:
+        dist = (k - 98765) % KEYS_IN_RING
+        want = dist.bit_length() - 1 if dist else -1
+        assert r.lookup_index(k) == want

@@ -96,6 +96,61 @@ def test_json_parse_errors(bad):
         json_roundtrip(bad)
 
 
+@pytest.mark.parametrize("bad", [
+    # ADVICE r4: both engines must fail identically on malformed numbers
+    # (json.JSONDecoder grammar): no leading zeros, '.' and 'e' each need
+    # at least one following digit, no bare sign / leading '.'.
+    "01", "00", '{"a":01}', "1.", "[1.]", "1e", "1e+", '{"a":2e}',
+    "-", "-.5", ".5", "+1", "1.e5",
+])
+def test_json_malformed_number_parity(bad):
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(bad)
+    with pytest.raises(ValueError):
+        json_roundtrip(bad)
+
+
+@pytest.mark.parametrize("num", [
+    "0", "-0", "0.5", "-0.25", "1e2", "1E2", "1e+20", "2e-3", "10.75",
+    '{"a":0,"b":[101,0.125]}',
+    # CPython repr's fixed/scientific split edges (decimal point at -4
+    # and 16): the native writer must pick the same notation.
+    "1e15", "1e16", "1e-4", "1e-5", "1100.0", "3.141592653589793",
+    "123456789.123", "-2.5e-9", "9007199254740993",
+])
+def test_json_valid_number_parity(num):
+    # Valid numbers normalize to exactly Python's minified emission.
+    assert json_roundtrip(num) == json.dumps(
+        json.loads(num), separators=(",", ":"))
+
+
+def test_json_nonfinite_round_trip_parity():
+    # json.dumps emits NaN/Infinity/-Infinity (non-standard tokens) and
+    # json.loads accepts them; the native engine must close the same
+    # loop, or a native peer could emit bytes it cannot itself re-parse.
+    text = json.dumps({"a": float("inf"), "b": float("-inf")},
+                      separators=(",", ":"))
+    assert json_roundtrip(text) == text
+    assert json_roundtrip("NaN") == "NaN"
+    assert json_roundtrip('[Infinity,-Infinity]') == "[Infinity,-Infinity]"
+
+
+def test_json_float_emission_parity_randomized():
+    import random
+    import struct
+    rng = random.Random(20260731)
+    vals = []
+    for _ in range(300):
+        # Random finite doubles across the full exponent range.
+        bits = rng.getrandbits(64)
+        d = struct.unpack("<d", struct.pack("<Q", bits))[0]
+        if d == d and abs(d) != float("inf"):
+            vals.append(d)
+    vals += [0.0, -0.0, 1.0, -1.0, 0.1, 2**53 + 1.0, 1.5e308, 5e-324]
+    text = json.dumps(vals, separators=(",", ":"))
+    assert json_roundtrip(text) == text
+
+
 def test_json_object_order_preserved():
     text = '{"z":1,"a":2,"m":3}'
     assert json_roundtrip(text) == text
@@ -217,6 +272,19 @@ def test_matrix_is_alive_and_kill(server_impl, client_impl):
                             timeout=0.5)
     if hasattr(srv, "close"):
         srv.close()
+
+
+def test_matrix_hostname_resolution(live_server, client_impl):
+    # ADVICE r4: peers may advertise a hostname IP_ADDR (Python stores it
+    # verbatim); both clients must resolve it, not just dotted quads —
+    # the native client falls back to getaddrinfo when inet_pton fails.
+    srv, _ = live_server
+    client = CLIENT_IMPLS[client_impl]
+    assert client.is_alive("localhost", srv.port)
+    resp = client.make_request("localhost", srv.port,
+                               {"COMMAND": "ECHO", "PAYLOAD": "via-name"})
+    assert resp["ECHO"] == "via-name"
+    assert not client.is_alive("no-such-host.invalid", srv.port)
 
 
 def test_matrix_request_log(live_server, client_impl):
@@ -357,3 +425,43 @@ def test_native_server_concurrent_clients():
     finally:
         nat.kill()
         nat.close()
+
+
+def test_dump_string_malformed_utf8_emits_replacement_per_byte(tmp_path):
+    """ADVICE r4: dump_string must verify continuation bytes; a malformed
+    interior sequence (0xC2 followed by ASCII) emits U+FFFD for the bad
+    lead byte ONLY and must not swallow the byte after it. Driven at the
+    C++ level — the Python boundary can't carry raw malformed bytes (all
+    Jv strings cross it through the validating parser or surrogateescape).
+    """
+    import os
+    import subprocess
+    from p2p_dhts_tpu.net import native_rpc
+
+    src = tmp_path / "dump_check.cc"
+    src.write_text(r'''
+#include <cassert>
+#include <string>
+#include "json.h"
+int main() {
+  std::string out;
+  ns::dump_string(std::string("\xC2" "AB"), out);      // bad 2-byte lead
+  assert(out == "\"\\ufffdAB\"");
+  out.clear();
+  ns::dump_string(std::string("\xE2\x82" "X"), out);   // truncated 3-byte
+  assert(out == "\"\\ufffd\\ufffdX\"");
+  out.clear();
+  ns::dump_string(std::string("\xC3\xA9"), out);       // valid: e-acute
+  assert(out == "\"\\u00e9\"");
+  out.clear();
+  ns::dump_string(std::string("\xF0\x9F\x98\x80"), out);  // valid astral
+  assert(out == "\"\\ud83d\\ude00\"");
+  return 0;
+}
+''')
+    exe = tmp_path / "dump_check"
+    subprocess.run(
+        ["g++", "-std=c++17", "-I", native_rpc._NATIVE_DIR,
+         str(src), "-o", str(exe)],
+        check=True, capture_output=True, text=True)
+    subprocess.run([str(exe)], check=True)
