@@ -780,25 +780,32 @@ def find_successor(state: RingState, keys: jax.Array,
         max_hops = state.max_hops
     return jax.lax.cond(
         _converged_all_alive(state),
-        lambda: _fast_lookup(state, keys, start, max_hops),
+        # structured_pred=True (flipped round 5): the fast branch runs
+        # exactly when _converged_all_alive holds — the invariant under
+        # which pred(row) IS (row-1) % n_valid — so the per-hop preds
+        # gather is pure overhead there (+34% serve on the 1M-peer CPU
+        # rehearsal, BENCH_NOTES_r04). The gathered-pred loop survives
+        # as find_successor_gathered_pred; bench.py measures both.
+        lambda: _fast_lookup(state, keys, start, max_hops,
+                             structured_pred=True),
         lambda: _general_lookup(state, keys, start, max_hops),
     )
 
 
 @functools.partial(jax.jit, static_argnames=("max_hops",))
-def find_successor_structured_pred(state: RingState, keys: jax.Array,
-                                  start: jax.Array,
-                                  max_hops: Optional[int] = None
-                                  ) -> Tuple[jax.Array, jax.Array]:
-    """The all-alive fast serve loop with the STRUCTURED self-hit
-    predecessor (no per-hop preds gather) — callers must guarantee a
-    converged all-alive ring (the `_converged_all_alive` invariant);
-    there is no runtime dispatch here. Identical routes and hop counts
-    to find_successor on such rings; bench.py measures both so the
-    default can follow the hardware."""
+def find_successor_gathered_pred(state: RingState, keys: jax.Array,
+                                 start: jax.Array,
+                                 max_hops: Optional[int] = None
+                                 ) -> Tuple[jax.Array, jax.Array]:
+    """The all-alive fast serve loop with the per-hop preds GATHER for
+    the self-hit correction (chord_peer.cpp:194-196) — the pre-round-5
+    default, kept as the measured fallback (bench.py reports it as
+    gathered_pred_lookups_s). Callers must guarantee a converged
+    all-alive ring; there is no runtime dispatch here. Identical routes
+    and hop counts to find_successor on such rings."""
     if max_hops is None:
         max_hops = state.max_hops
-    return _fast_lookup(state, keys, start, max_hops, structured_pred=True)
+    return _fast_lookup(state, keys, start, max_hops, structured_pred=False)
 
 
 @functools.partial(jax.jit, static_argnames=())

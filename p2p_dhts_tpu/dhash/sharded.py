@@ -86,7 +86,8 @@ from p2p_dhts_tpu.dhash.store import (
     empty_store,
     holder_alive_mask,
 )
-from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+from p2p_dhts_tpu.ida import (decode_kernel, decode_kernel_uniform,
+                              encode_kernel)
 from p2p_dhts_tpu.ops import u128
 
 
@@ -312,11 +313,21 @@ def create_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
         local = _local(sstore)
         # Overwrite semantics: purge re-created keys locally first (a
         # key's old rows may live on any shard). Masked by the guard so
-        # an unconverged ring leaves the store bit-identical.
-        local = jax.lax.cond(guard, lambda: _purge_keys(local, keys),
-                             lambda: local)
+        # an unconverged ring leaves the store bit-identical. The purge
+        # is mark-only (round 5); appends land after the stale used
+        # prefix and the closing _sort_store compacts — unless the
+        # stale prefix can't hold THIS SHARD's destined rows
+        # (mine.sum(), not the global b*n, which exceeds a shard's
+        # whole capacity at d > 2 and would compact on every call),
+        # in which case compact now.
         off = jax.lax.axis_index(axis).astype(jnp.int32) * rblock
         mine = rows_ok & (rows_holder >= off) & (rows_holder < off + rblock)
+        local = jax.lax.cond(guard, lambda: _purge_keys(local, keys),
+                             lambda: local)
+        local = jax.lax.cond(
+            local.n_used + mine.astype(jnp.int32).sum() > local.capacity,
+            lambda: _sort_store(local),
+            lambda: local)
         local, stored = _append_rows(local, rows_keys, rows_fidx,
                                      rows_holder, rows_vals, rows_len, mine)
         local = _sort_store(local)
@@ -376,7 +387,14 @@ def read_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
     rows = jnp.take_along_axis(values, order[:, :, None], axis=1)  # [B, m, S]
     idx = jnp.where(ok[:, None], order + 1,
                     jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
-    segments = decode_kernel(rows, idx, p)                        # [B, S, m]
+    # Healthy-store fast path (mirrors read_batch's adaptive default):
+    # when every lane decodes from indices 1..m, one inverse + a
+    # broadcast-LHS MXU matmul replaces the per-block VPU decode.
+    uni_idx = jnp.arange(1, m + 1, dtype=jnp.int32)
+    segments = jax.lax.cond(
+        jnp.all(idx == uni_idx[None, :]),
+        lambda: decode_kernel_uniform(rows, uni_idx, p),
+        lambda: decode_kernel(rows, idx, p))                      # [B, S, m]
     return jnp.where(ok[:, None, None], segments, 0), ok
 
 

@@ -106,7 +106,36 @@ def decode_kernel(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
     Ref: ida.cpp:120-141 (uses the *first m* fragments passed; callers
     slice). The inverse Vandermonde is computed in-graph so decodes with
     heterogeneous index sets batch together.
+
+    DEFAULT PATH is platform-split at trace time (round 5, per
+    measurement on both platforms — the orderings are INVERTED):
+      * TPU: the VPU multiply-accumulate. Lowering the per-block tiny
+        [m, m] @ [m, S] through dot_general pads every batch element to
+        full MXU systolic tiles — measured 93.3 MB/s on v5e against
+        22 GB/s encode (BENCH_ATTEMPT_r04.jsonl).
+      * CPU: dot_general. XLA:CPU has no tile-padding cliff and runs
+        the batched tiny dot at full speed, while the unrolled MAC
+        measured ~250x slower there (BENCH_NOTES_r05: 100.7 vs 0.4
+        MB/s at the bench shape).
+    The dot path stays callable as ``decode_kernel_dot`` and bench.py
+    measures both on whatever platform it runs.
     """
+    inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
+    if jax.default_backend() == "cpu":  # trace-time platform choice
+        out = modp.mod_matmul(inv, rows, p)              # [..., m, S]
+    else:
+        out = modp.mod_matmul_batched_tiny(inv, rows, p)
+    return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def decode_kernel_dot(rows: jax.Array, indices: jax.Array,
+                      p: int) -> jax.Array:
+    """decode_kernel through dot_general — the pre-round-5 default, kept
+    as the measured fallback (bench.py reports it as decode_dot_mb_s).
+    On batched tiny shapes the MXU pads ~99% of each tile (the 93 MB/s
+    cliff); XLA:CPU shows the same ordering, so the VPU path is the
+    default on every platform."""
     inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
     out = modp.mod_matmul(inv, rows, p)                  # [..., m, S]
     return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
@@ -131,19 +160,6 @@ def decode_kernel_uniform(rows: jax.Array, indices: jax.Array,
     return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
 
 
-@functools.partial(jax.jit, static_argnames=("p",))
-def decode_kernel_tiny(rows: jax.Array, indices: jax.Array,
-                       p: int) -> jax.Array:
-    """decode_kernel with the VPU broadcast-reduce matmul: per-batch
-    inverses make decode a genuinely batched tiny matmul — the MXU-padding
-    cliff shape (measured 93 MB/s vs 22 GB/s encode on v5e through the dot
-    path). Kept as a SEPARATE kernel rather than the default so the
-    already-compiled-and-cached dot-path programs (the dhash store reads,
-    the green bench configs) keep their cache hits; bench.py measures both
-    and the default flips once the hardware numbers are in."""
-    inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
-    out = modp.mod_matmul_batched_tiny(inv, rows, p)     # [..., m, S]
-    return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
 
 
 # ---------------------------------------------------------------------------

@@ -123,7 +123,12 @@ class FingerTable:
             if full:
                 try:
                     idx = self._device_resolver().lookup_index(int(key))
-                except ImportError:  # jax-less deployment: host closed form
+                except Exception:
+                    # jax missing OR its backend unusable (dead TPU
+                    # tunnel raises RuntimeError at init — a state this
+                    # host regularly sees): the wire path must keep
+                    # serving, so degrade to the host closed form, which
+                    # is semantics-identical to the device kernel.
                     dist = (int(key) - int(self.starting_key)) % KEYS_IN_RING
                     idx = dist.bit_length() - 1 if dist else -1
                 if idx < 0:

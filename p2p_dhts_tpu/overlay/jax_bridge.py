@@ -97,24 +97,27 @@ class DeviceFingerResolver:
             if lead:
                 self._leader_active = True
         if lead:
-            # Exception-safe leadership: whatever happens during the
-            # coalescing window (KeyboardInterrupt, a SIGALRM-injected
-            # timeout), the flag resets and pending slots are failed
-            # out — a wedged leader would deadlock every later lookup.
-            interrupted = None
+            # Exception-safe leadership: whatever happens from the
+            # coalescing sleep through serving (KeyboardInterrupt, a
+            # SIGALRM-injected timeout landing between the swap and
+            # _serve's own handler), leadership is released and every
+            # unserved slot is failed out — a wedged leader would
+            # deadlock every later lookup.
+            batch: List[Tuple[int, dict]] = []
             try:
-                time.sleep(self._window_s)  # coalescing window
+                try:
+                    time.sleep(self._window_s)  # coalescing window
+                finally:
+                    with self._lock:
+                        batch, self._pending = self._pending, []
+                        self._leader_active = False
+                self._serve(batch)
             except BaseException as exc:  # noqa: BLE001
-                interrupted = exc
-            with self._lock:
-                batch, self._pending = self._pending, []
-                self._leader_active = False
-            if interrupted is not None:
                 for _, s in batch:
-                    s["error"] = interrupted
-                    s["ev"].set()
-                raise interrupted
-            self._serve(batch)
+                    if "index" not in s and "error" not in s:
+                        s["error"] = exc
+                        s["ev"].set()
+                raise
         slot["ev"].wait()
         if "error" in slot:
             raise slot["error"]

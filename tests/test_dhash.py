@@ -171,6 +171,24 @@ def test_recreate_overwrites(rng):
     _check_read(ring, store, keys, segs2, lengths2)
 
 
+def test_recreate_on_exactly_full_store_compacts_first(rng):
+    """The round-5 put path appends after the STALE used prefix (purge is
+    mark-only; one closing sort). When the stale prefix can't hold the
+    batch — an exactly-full store being fully re-created — the overflow
+    guard must compact first or every row would be dropped."""
+    ring, _, keys, starts, vals, segs, lengths, _ = _setup(rng, b=4)
+    store = empty_store(4 * N_IDA, SMAX)        # exactly one batch
+    store, ok = create_batch(ring, store, keys, segs, lengths, starts,
+                             N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(ok)) and int(store.n_used) == 4 * N_IDA
+    vals2, segs2, lengths2 = _make_blocks(rng, 4)
+    store, ok = create_batch(ring, store, keys, segs2, lengths2, starts,
+                             N_IDA, M_IDA, P_IDA)
+    assert bool(jnp.all(ok)), "re-create on a full store dropped rows"
+    assert int(store.n_used) == 4 * N_IDA
+    _check_read(ring, store, keys, segs2, lengths2)
+
+
 def test_create_requires_m_placements(rng):
     """On a 2-peer ring only 2 successors exist: with m=3 required acks the
     create must fail (reference throws after < m acks)."""

@@ -77,9 +77,9 @@ def test_pallas_decode_matches_xla_path(rng):
     got = decode_kernel_pallas(rows, idx, p, interpret=True)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(segs))
-    from p2p_dhts_tpu.ida import decode_kernel_tiny
+    from p2p_dhts_tpu.ida import decode_kernel_dot
     np.testing.assert_array_equal(
-        np.asarray(decode_kernel_tiny(rows, idx, p)), np.asarray(want))
+        np.asarray(decode_kernel_dot(rows, idx, p)), np.asarray(want))
 
 
 def test_uniform_decode_matches_general(rng):

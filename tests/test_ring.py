@@ -415,16 +415,18 @@ def test_ring_genesis_single_and_two_peer_parity(rng):
                                       np.asarray(host.min_key))
 
 
-def test_structured_pred_serve_matches_default():
-    """find_successor_structured_pred (the gather-free serve variant) must
-    route identically to find_successor on converged all-alive rings —
-    including capacities with padding rows past n_valid."""
+def test_gathered_pred_serve_matches_default():
+    """find_successor_gathered_pred (the pre-round-5 default, kept as the
+    measured fallback) must route identically to find_successor — whose
+    fast path now uses the structured predecessor — on converged
+    all-alive rings, including capacities with padding rows past
+    n_valid."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from p2p_dhts_tpu.config import RingConfig
     from p2p_dhts_tpu.core.ring import (build_ring_random, find_successor,
-                                        find_successor_structured_pred,
+                                        find_successor_gathered_pred,
                                         keys_from_ints,
                                         materialize_converged_fingers)
 
@@ -438,6 +440,6 @@ def test_structured_pred_serve_matches_default():
             [int.from_bytes(rng.bytes(16), "little") for _ in range(256)])
         starts = jnp.asarray(rng.randint(0, n, size=256), jnp.int32)
         o1, h1 = find_successor(state, keys, starts)
-        o2, h2 = find_successor_structured_pred(state, keys, starts)
+        o2, h2 = find_successor_gathered_pred(state, keys, starts)
         assert bool(jnp.all(o1 == o2)) and bool(jnp.all(h1 == h2)), \
             f"divergence at n={n} cap={cap}"

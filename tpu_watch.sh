@@ -1,25 +1,23 @@
 #!/bin/bash
-# TPU recovery watcher: wait for the current bench process to exit, then
-# probe the remote-compile service (the component that died mid-run this
-# round: 127.0.0.1:8083 connection-refused while plain executions kept
-# working) and rerun the configs that failed, one at a time, appending to
-# the attempt files. Never kills anything mid-TPU-work; every probe and
-# bench attempt runs to completion.
+# TPU recovery watcher, round 5: the default-flip round changed every
+# config's HLO, so ALL SIX bench configs need fresh on-chip runs. Wait
+# for the chip to be free, probe the remote-compile service (dead since
+# round 4: connection-refused on its port while cached programs kept
+# executing), and when it answers, run the configs without a green
+# record one at a time into BENCH_ATTEMPT_r05.jsonl. Never kills
+# anything mid-TPU-work; every probe and bench attempt runs to
+# completion (a blocked fresh-shape jit takes ~25 min to fail — that is
+# the probe's cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
+log "round-5 watcher start (all configs need fresh compiles)"
 
-# Phase 0: wait out any bench already holding the chip.
-while pgrep -f "python bench.py" > /dev/null; do
-  sleep 60
-done
-log "chip free"
-
-needed() {  # configs without a successful record yet
+needed() {  # configs without a green r05 record yet
   python - <<'EOF'
 import json
 ok = set()
 try:
-    for line in open("BENCH_ATTEMPT_r04.jsonl"):
+    for line in open("BENCH_ATTEMPT_r05.jsonl"):
         try:
             rec = json.loads(line)
         except ValueError:
@@ -28,42 +26,36 @@ try:
             ok.add(rec["config"])
 except FileNotFoundError:
     pass
-# ida re-measures if its record predates the pallas field
-redo_ida = True
-try:
-    for line in open("BENCH_ATTEMPT_r04.jsonl"):
-        rec = json.loads(line)
-        if rec.get("config") == "ida" and "decode_pallas_mb_s" in rec \
-                and rec.get("decode_pallas_mb_s") is not None:
-            redo_ida = False
-except Exception:
-    pass
-want = ["dhash_sharded", "lookup_1m", "sweep_10m"]
-if redo_ida:
-    want.insert(0, "ida")
-print(" ".join(c for c in want if c not in ok or c == "ida"))
+want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
+        "sweep_10m"]
+print(" ".join(c for c in want if c not in ok))
 EOF
 }
 
-for i in $(seq 1 60); do
+for i in $(seq 1 80); do
+  # Phase 0 each cycle: never contend with a bench holding the chip.
+  while pgrep -f "python bench.py" > /dev/null; do
+    sleep 60
+  done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all configs recorded — done"
+    log "all six configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
-  # Gentle compile-service probe: tiny jit with a fresh shape.
+  # Gentle compile-service probe: tiny jit with a fresh shape (a salted
+  # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
 import jax, jax.numpy as jnp, numpy as np
-x = jnp.arange(1000 + $i)          # new shape each try -> forces a compile
-y = jax.jit(lambda v: (v * 3 + 1).sum())(x)
-assert int(np.asarray(y)) == sum(3 * k + 1 for k in range(1000 + $i))
+x = jnp.arange(2000 + $i)          # new shape each try -> forces a compile
+y = jax.jit(lambda v: (v * 3 + 1).cumsum())(x)
+assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
     for c in $CONFIGS; do
       log "running --config $c"
-      python bench.py --config "$c" >> BENCH_ATTEMPT_r04.jsonl 2>> BENCH_ATTEMPT_r04.err
+      python bench.py --config "$c" >> BENCH_ATTEMPT_r05.jsonl 2>> BENCH_ATTEMPT_r05.err
       log "config $c rc=$?"
     done
   else
