@@ -21,6 +21,7 @@
 // keyspace.Key's str form / IntToHexStr, key.h:41-47).
 
 #include <algorithm>
+#include <climits>
 #include <chrono>
 #include <map>
 #include <memory>
@@ -1329,6 +1330,78 @@ class DHashPeerN : public AbstractPeerN {
         continue;
       }
     }
+    // Duplicate-only re-index pass (documented deviation, round 5 —
+    // see the Python twin's run_local_maintenance docstring): joins
+    // shift a holder's position while its stored fragment keeps the
+    // old index; collisions accumulate until fewer than m DISTINCT
+    // indices are reachable and reads fail permanently. Rewrite only
+    // when this peer's index is DUPLICATED within the key's successor
+    // set and some index is MISSING from it — each rewrite strictly
+    // increases the distinct count; the common post-churn state
+    // (distinct but shifted) is untouched. Within a duplicate group
+    // only the lowest MISMATCHED position rewrites per cycle (a
+    // deterministic leader — concurrent holders can't lockstep onto
+    // the same missing index), and a per-key memo of the successor-id
+    // vector skips the census in the permanent shifted-but-distinct
+    // state. A successful whole-block read gates the rewrite, so the
+    // last reachable copy survives.
+    for (const auto& kv : db_.entries()) {
+      try {
+        int n, m;
+        long long p;
+        ida_params(n, m, p);
+        std::vector<NPeer> succs = get_n_successors(kv.first, n);
+        int pos = -1;
+        std::vector<u128> succ_ids;
+        for (size_t j = 0; j < succs.size(); j++) {
+          succ_ids.push_back(succs[j].id);
+          if (succs[j].id == self().id) pos = int(j);
+        }
+        if (pos < 0 || kv.second.index == pos + 1) continue;
+        auto memo = reindex_ok_.find(kv.first);
+        if (memo != reindex_ok_.end() && memo->second == succ_ids)
+          continue;  // verified distinct on this topology
+        std::map<int, int> by_pos;  // position -> fragment index
+        by_pos[pos] = kv.second.index;
+        for (size_t j = 0; j < succs.size(); j++) {
+          if (succs[j].id == self().id) continue;
+          try {
+            by_pos[int(j)] = read_fragment(kv.first, succs[j]).index;
+          } catch (const std::exception&) {
+          }
+        }
+        int dup = 0;
+        std::vector<int> held;
+        for (const auto& pi : by_pos) {
+          held.push_back(pi.second);
+          if (pi.second == kv.second.index) dup++;
+        }
+        std::vector<int> missing;
+        for (int i2 = 1; i2 <= int(succs.size()); i2++)
+          if (std::find(held.begin(), held.end(), i2) == held.end())
+            missing.push_back(i2);
+        if (dup < 2 || missing.empty()) {
+          if (dup < 2) reindex_ok_[kv.first] = succ_ids;
+          continue;
+        }
+        int leader = INT_MAX;
+        for (const auto& pi : by_pos)
+          if (pi.second == kv.second.index && pi.second != pi.first + 1)
+            leader = std::min(leader, pi.first);
+        if (pos != leader) continue;
+        int target = std::find(missing.begin(), missing.end(), pos + 1) !=
+                             missing.end()
+                         ? pos + 1
+                         : missing.front();
+        std::string val = read_kv(kv.first);
+        std::vector<DataFragmentC> frags =
+            IdaC(n, m, p).encode(surrogate_unescape(val));
+        if (target - 1 < int(frags.size()))
+          db_.insert(kv.first, frags[target - 1]);
+      } catch (const std::exception&) {
+        continue;  // unreadable/mid-churn: keep the old fragment
+      }
+    }
   }
 
  protected:
@@ -1579,7 +1652,21 @@ class DHashPeerN : public AbstractPeerN {
     ida_params(n, m, p);
     std::vector<DataFragmentC> frags =
         IdaC(n, m, p).encode(surrogate_unescape(val));
-    db_.insert(key, frags[rng_() % frags.size()]);
+    // Position-matched fragment (documented deviation from the
+    // reference's random pick, dhash_peer.cpp:367-379 — see the Python
+    // twin's retrieve_missing docstring): fragment i belongs on the
+    // i-th successor of the key, the invariant Create establishes.
+    // Random regeneration collides indices across a successor set and
+    // permanently starves reads of m DISTINCT fragments.
+    size_t pick = rng_() % frags.size();
+    std::vector<NPeer> succs = get_n_successors(key, n);
+    for (size_t i = 0; i < succs.size() && i < frags.size(); i++) {
+      if (succs[i].id == self().id) {
+        pick = i;
+        break;
+      }
+    }
+    db_.insert(key, frags[pick]);
   }
 
   int n_ = 14, m_ = 10;
@@ -1587,6 +1674,9 @@ class DHashPeerN : public AbstractPeerN {
   mutable std::recursive_mutex ida_mu_;
   MerkleDbT<DataFragmentC> db_;
   std::mt19937_64 rng_;
+  // Re-index census memo: key -> successor-id vector last verified
+  // duplicate-free (run_local_maintenance's heal pass).
+  std::map<u128, std::vector<u128>> reindex_ok_;
 };
 
 thread_local std::string g_last_error;

@@ -57,6 +57,9 @@ class DHashPeer(AbstractChordPeer):
                  server_backend: str = "python"):
         self.db = FragmentDb()
         self.n, self.m, self.p = 14, 10, 257
+        # Re-index census memo: key -> successor-id tuple last verified
+        # duplicate-free (run_local_maintenance's heal pass).
+        self._reindex_ok: Dict[int, tuple] = {}
         super().__init__(ip_addr, port, num_replicas, backend,
                          maintenance_interval, num_server_threads,
                          server_backend)
@@ -230,7 +233,35 @@ class DHashPeer(AbstractChordPeer):
 
     def run_local_maintenance(self) -> None:
         """Merkle-sync own range with every successor
-        (dhash_peer.cpp:350-365)."""
+        (dhash_peer.cpp:350-365), then re-index held fragments to the
+        Create placement invariant.
+
+        The re-index pass is a DOCUMENTED DEVIATION (round 5), the
+        second half of the retrieve_missing fix: joins shift holders'
+        positions in a key's successor list while stored fragments keep
+        their old indices, so index collisions accumulate (each new
+        position-0 successor regenerates idx 1) until the successor set
+        serves fewer than m DISTINCT indices and reads fail permanently
+        even though distinct fragments survive on misplaced holders.
+        The heal is DUPLICATE-ONLY: a peer rewrites its fragment only
+        when its index is duplicated within the successor set AND some
+        index is missing from it — each rewrite strictly increases the
+        set's distinct count, and the common post-churn state (indices
+        all distinct, merely position-shifted) is left untouched (an
+        unconditional position re-index transiently broke distinctness
+        at n=14/m=10 — the 18-peer fixtures caught it). A successful
+        whole-block read is required before rewriting, so the last
+        reachable copy is never destroyed.
+
+        Convergence under CONCURRENT maintenance (production timer
+        loops, not the tests' sequential cycles): within a duplicate
+        group, only the LOWEST MISMATCHED position rewrites this cycle
+        — a deterministic leader computed from the same census — so two
+        holders of one index can't lockstep-rewrite onto the same
+        missing index forever. A per-key memo (successor-id tuple ->
+        verified distinct) skips the (n-1)-RPC census in the permanent
+        shifted-but-distinct steady state; churn changes the successor
+        list and invalidates it."""
         self.log("Running local maintenance")
         if self.db.size == 0:
             return
@@ -241,13 +272,71 @@ class DHashPeer(AbstractChordPeer):
                     self.synchronize(succ, (self.min_key, Key(self.id)))
                 except RuntimeError:
                     continue
+        for key_int, frag in list(self.db.get_entries()):
+            try:
+                succs = self.get_n_successors(Key(key_int), self.n)
+                pos = next((j for j, s in enumerate(succs)
+                            if s.id == self.id), None)
+                if pos is None or frag.index == pos + 1:
+                    continue  # absent or already canonical: no census
+                succ_ids = tuple(int(s.id) for s in succs)
+                if self._reindex_ok.get(key_int) == succ_ids:
+                    continue  # memo: verified distinct on this topology
+                by_pos = {pos: frag.index}
+                for j, s in enumerate(succs):
+                    if s.id == self.id:
+                        continue
+                    try:
+                        by_pos[j] = self.read_key(Key(key_int), s).index
+                    except RuntimeError:
+                        pass
+                held = list(by_pos.values())
+                missing = [i for i in range(1, len(succs) + 1)
+                           if i not in held]
+                if held.count(frag.index) < 2 or not missing:
+                    if held.count(frag.index) < 2:
+                        self._reindex_ok[key_int] = succ_ids
+                    continue
+                # Leader election within the duplicate group: only the
+                # lowest MISMATCHED position rewrites this cycle.
+                group = [j for j, ix in by_pos.items()
+                         if ix == frag.index and ix != j + 1]
+                if not group or pos != min(group):
+                    continue
+                target = pos + 1 if (pos + 1) in missing else missing[0]
+                block = self.read_block(Key(key_int))
+                if target - 1 < len(block.fragments):
+                    self.db.update(key_int, block.fragments[target - 1])
+            except RuntimeError:
+                continue  # unreadable/mid-churn: keep the old fragment
         self.log("Local maintenance over")
 
     def retrieve_missing(self, key: Key) -> None:
-        """Read the whole block, store ONE RANDOM fragment — the
-        reference's exact (quirky) behavior (dhash_peer.cpp:367-379)."""
+        """Read the whole block, regenerate all n fragments, store the
+        one whose 1-based index matches this peer's POSITION in the
+        key's successor list — the placement invariant Create itself
+        establishes (fragment i on the i-th successor,
+        dhash_peer.cpp:106-123).
+
+        DOCUMENTED DEVIATION (round 5): the reference stores one RANDOM
+        fragment here (dhash_peer.cpp:367-379). Random picks collide,
+        and a successor set whose regenerated fragments share an index
+        serves fewer than m DISTINCT fragments — reads then fail
+        PERMANENTLY even though distinct fragments survive elsewhere in
+        the ring (reproduced by the mixed-impl churn soak: the key's
+        three successors all held idx1 while idx2/idx3 sat stranded on
+        misplaced old holders global maintenance skips by key). Falls
+        back to the reference's random pick only when this peer cannot
+        locate itself in the key's successor list (mid-churn
+        transient)."""
         block = self.read_block(key)
-        frag = random.choice(block.fragments)
+        succs = self.get_n_successors(key, self.n)
+        pos = next((i for i, s in enumerate(succs) if s.id == self.id),
+                   None)
+        if pos is not None and pos < len(block.fragments):
+            frag = block.fragments[pos]  # fragments[i] bears index i+1
+        else:
+            frag = random.choice(block.fragments)
         self.db.insert(int(key), frag)
 
     # -- Merkle sync protocol (dhash_peer.cpp:381-481) -----------------------

@@ -54,6 +54,7 @@ def rng():
 import json as _json
 import signal as _signal
 import subprocess as _subprocess
+import threading as _threading
 import time as _time
 
 _SOAK_SESSION_T0 = _time.time()
@@ -78,6 +79,16 @@ def _repo_commit() -> str:
         return "unknown"
 
 
+class SoakBudgetExceeded(BaseException):
+    """Raised by the soak wall-clock alarm.
+
+    Derives from BaseException so protocol-layer ``except Exception`` /
+    ``except OSError`` blocks cannot swallow it — the builtin
+    TimeoutError IS an OSError, and the RPC layers legitimately catch
+    OSError for dead peers, which is exactly how the first version of
+    this budget was silently eaten mid-soak."""
+
+
 @pytest.fixture(autouse=True)
 def _soak_budget(request):
     """Per-test and per-session wall-clock budgets for soak-marked tests.
@@ -85,9 +96,22 @@ def _soak_budget(request):
     SOAK_TEST_BUDGET_S (default 600) bounds one soak; SOAK_SESSION_BUDGET_S
     (default 3600) bounds the whole `-m soak` run — once exhausted, the
     remaining soaks SKIP (a recorded, clean exit) instead of running
-    unbounded. SIGALRM-based: fires at the next Python bytecode after the
-    budget, so a single long XLA compile can overshoot; the budget is a
-    hygiene bound, not a precise timer.
+    unbounded.
+
+    Two layers, because signals alone demonstrably fail here:
+      1. A SINGLE-SHOT SIGALRM raising SoakBudgetExceeded at the next
+         main-thread bytecode (single-shot on purpose: this autouse
+         fixture tears down AFTER the test's own fixtures, so a
+         repeating alarm would keep firing through e.g. the ring
+         fixture's peer-kill teardown and orphan the very processes the
+         budget exists to prevent).
+      2. A daemon WATCHDOG THREAD that records a hard-overrun line to
+         SOAK_RESULTS.jsonl and os._exit(70)s at budget + 300 s — the
+         backstop both for a swallowed raise and for the case where the
+         main thread is blocked inside native code (observed:
+         interpret-mode Pallas execution blocks the main thread in a
+         futex for HOURS; pending signals never deliver, which is how
+         round 4's `pytest -m soak` became a 6-hour orphan).
     """
     if request.node.get_closest_marker("soak") is None:
         yield
@@ -96,9 +120,32 @@ def _soak_budget(request):
     if _time.time() - _SOAK_SESSION_T0 > session_budget:
         pytest.skip(f"session soak budget ({session_budget:.0f}s) exhausted")
     budget = float(os.environ.get("SOAK_TEST_BUDGET_S", "600"))
+    done = _threading.Event()
+    nodeid = request.node.nodeid
+
+    def _watchdog():
+        if done.wait(budget + 300.0):
+            return
+        try:
+            with open(_SOAK_RESULTS, "a") as f:
+                f.write(_json.dumps({
+                    "test": nodeid,
+                    "outcome": "hard-timeout",
+                    "duration_s": round(budget + 300.0, 1),
+                    "utc": _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          _time.gmtime()),
+                    "commit": _repo_commit(),
+                    "note": "watchdog os._exit: main thread stuck in "
+                            "native code past the hard deadline",
+                }) + "\n")
+        finally:
+            os._exit(70)
+
+    wd = _threading.Thread(target=_watchdog, daemon=True)
+    wd.start()
 
     def _on_alarm(signum, frame):
-        raise TimeoutError(
+        raise SoakBudgetExceeded(
             f"soak exceeded its {budget:.0f}s wall-clock budget")
 
     old = _signal.signal(_signal.SIGALRM, _on_alarm)
@@ -108,6 +155,7 @@ def _soak_budget(request):
     finally:
         _signal.setitimer(_signal.ITIMER_REAL, 0)
         _signal.signal(_signal.SIGALRM, old)
+        done.set()
 
 
 @pytest.hookimpl(hookwrapper=True)

@@ -113,3 +113,43 @@ def test_resolver_index_matches_closed_form_everywhere():
         dist = (k - 98765) % KEYS_IN_RING
         want = dist.bit_length() - 1 if dist else -1
         assert r.lookup_index(k) == want
+
+
+def test_lookup_degrades_to_host_closed_form_when_device_fails():
+    """A backend="jax" peer must keep serving when the device path dies
+    (dead TPU tunnel raises RuntimeError at backend init): lookup falls
+    back to the host closed form, which is semantics-identical."""
+
+    class _Exploding:
+        def lookup_index(self, key_int):
+            raise RuntimeError("backend unavailable (simulated tunnel)")
+
+    start = 1357
+    ft = _full_table(start, "jax")
+    ft._resolver = _Exploding()
+    want = _full_table(start, "python")
+    for k in (start + 1, start + (1 << 64), start - 1):
+        assert ft.lookup(Key(k)).port == want.lookup(Key(k)).port
+
+
+def test_resolver_chunks_oversize_batches(monkeypatch):
+    """A batch larger than MAX_BATCH serves in chunks; every caller
+    still gets the right index."""
+    r = DeviceFingerResolver(0, window_s=0.3)
+    monkeypatch.setattr(DeviceFingerResolver, "MAX_BATCH", 4)
+    keys = list(range(1, 11))
+    got = {}
+    lock = threading.Lock()
+
+    def worker(k):
+        idx = r.lookup_index(k)
+        with lock:
+            got[k] = idx
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in keys]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert got == {k: int(k).bit_length() - 1 for k in keys}
+    assert all(s <= 4 for s in r.batch_sizes)

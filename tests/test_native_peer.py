@@ -348,9 +348,46 @@ def test_mixed_impl_churn_soak(dhash_ring):
             next_port += 1
         _run_full_maintenance(live)
         # Every stored key readable from a random live peer each round.
+        # Consistency is EVENTUAL (the reference's maintenance loop runs
+        # every 5 s forever; after a join, two cycles are sometimes not
+        # enough for notify/fingers/Merkle-sync to all propagate — this
+        # assertion flaked ~1-in-3 at a fixed 2 cycles, failing on keys
+        # that extra cycles heal). Retry maintenance a bounded number of
+        # times; PERMANENT loss still fails the final assert.
         misses = [k for k, v in stored.items()
                   if _try_read(rng.choice(live), k) != v]
-        assert not misses, f"round {rnd}: unreadable keys {misses[:4]}"
+        for _retry in range(3):
+            if not misses:
+                break
+            _run_full_maintenance(live)
+            misses = [k for k in misses
+                      if _try_read(rng.choice(live), k) != stored[k]]
+        assert not misses, (
+            f"round {rnd}: unreadable keys {misses[:4]}; "
+            f"placement: { {k: _frag_census(live, k) for k in misses[:4]} }")
+
+
+def _frag_census(live, plain_key):
+    """Forensics for the eventual-consistency assertion: which live peer
+    holds which fragment index of `plain_key` (READ_RANGE over the
+    key's singleton range against every peer — implementation-neutral,
+    the same wire call local maintenance uses)."""
+    from p2p_dhts_tpu.keyspace import sha1_id
+    from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
+    kid = Key(sha1_id(plain_key))
+    asker = next(p for p in live if isinstance(p, DHashPeer))
+    census = {}
+    for p in live:
+        target = RemotePeer(p.id, p.min_key, p.ip_addr, p.port)
+        try:
+            got = asker.read_range_rpc(target, (kid, kid))
+        except Exception as exc:  # noqa: BLE001 — diagnostics only
+            census[p.port] = f"err:{type(exc).__name__}"
+            continue
+        frag = got.get(int(kid))
+        if frag is not None:
+            census[p.port] = f"idx{frag.index}"
+    return census
 
 
 def _try_read(peer, key):
