@@ -1363,11 +1363,16 @@ class DHashPeerN : public AbstractPeerN {
           continue;  // verified distinct on this topology
         std::map<int, int> by_pos;  // position -> fragment index
         by_pos[pos] = kv.second.index;
+        bool census_complete = true;
         for (size_t j = 0; j < succs.size(); j++) {
           if (succs[j].id == self().id) continue;
           try {
             by_pos[int(j)] = read_fragment(kv.first, succs[j]).index;
           } catch (const std::exception&) {
+            // No memo from a partial view: an unreachable duplicate
+            // holder would otherwise wedge the heal permanently (the
+            // leader defers to us, we memo-skip).
+            census_complete = false;
           }
         }
         int dup = 0;
@@ -1381,7 +1386,7 @@ class DHashPeerN : public AbstractPeerN {
           if (std::find(held.begin(), held.end(), i2) == held.end())
             missing.push_back(i2);
         if (dup < 2 || missing.empty()) {
-          if (dup < 2) reindex_ok_[kv.first] = succ_ids;
+          if (dup < 2 && census_complete) reindex_ok_[kv.first] = succ_ids;
           continue;
         }
         int leader = INT_MAX;
@@ -1401,6 +1406,14 @@ class DHashPeerN : public AbstractPeerN {
       } catch (const std::exception&) {
         continue;  // unreadable/mid-churn: keep the old fragment
       }
+    }
+    // Prune memo entries for keys no longer held so the memo stays
+    // bounded by db size and a re-acquired key re-censuses.
+    for (auto it = reindex_ok_.begin(); it != reindex_ok_.end();) {
+      if (!db_.contains(it->first))
+        it = reindex_ok_.erase(it);
+      else
+        ++it;
     }
   }
 

@@ -283,18 +283,22 @@ class DHashPeer(AbstractChordPeer):
                 if self._reindex_ok.get(key_int) == succ_ids:
                     continue  # memo: verified distinct on this topology
                 by_pos = {pos: frag.index}
+                census_complete = True
                 for j, s in enumerate(succs):
                     if s.id == self.id:
                         continue
                     try:
                         by_pos[j] = self.read_key(Key(key_int), s).index
                     except RuntimeError:
-                        pass
+                        census_complete = False  # no memo from a
+                        # partial view: an unreachable duplicate holder
+                        # would otherwise wedge the heal permanently
+                        # (the leader defers to us, we memo-skip).
                 held = list(by_pos.values())
                 missing = [i for i in range(1, len(succs) + 1)
                            if i not in held]
                 if held.count(frag.index) < 2 or not missing:
-                    if held.count(frag.index) < 2:
+                    if held.count(frag.index) < 2 and census_complete:
                         self._reindex_ok[key_int] = succ_ids
                     continue
                 # Leader election within the duplicate group: only the
@@ -309,6 +313,11 @@ class DHashPeer(AbstractChordPeer):
                     self.db.update(key_int, block.fragments[target - 1])
             except RuntimeError:
                 continue  # unreadable/mid-churn: keep the old fragment
+        # Prune memo entries for keys no longer held (global maintenance
+        # pushes-and-deletes) so the memo stays bounded by db size and a
+        # re-acquired key re-censuses.
+        self._reindex_ok = {k: v for k, v in self._reindex_ok.items()
+                            if self.db.contains(k)}
         self.log("Local maintenance over")
 
     def retrieve_missing(self, key: Key) -> None:

@@ -509,3 +509,48 @@ def test_host_device_placement_parity(dhash_ring):
             assert holder_id == want, (
                 f"{tk} fragment {idx}: host holder {holder_id:#x} != "
                 f"device placement {want:#x}")
+
+
+def test_local_maintenance_heals_duplicate_fragment_indices(dhash_ring):
+    """Regression for the round-5 data-loss fix (deterministic twin of
+    the probabilistic mixed-impl churn soak): when a key's successor set
+    holds DUPLICATE fragment indices (the state the reference's
+    random-index retrieve_missing accumulates under joins), the
+    duplicate-only re-index pass in run_local_maintenance must restore
+    a fully distinct set while the key is still readable — preventing
+    the observed terminal state where all successors converge on one
+    index and reads fail permanently."""
+    from p2p_dhts_tpu.ida import DataBlock
+
+    peers = dhash_ring(5)
+    key_plain, value = "heal-me", "heal-value"
+    peers[0].create(key_plain, value)
+    key = Key.from_plaintext(key_plain)
+
+    # Identify the key's successor peers (n=3) and force a duplicate:
+    # overwrite one non-position-0 holder's fragment with index 1.
+    by_id = {int(p.id): p for p in peers}
+    succs = peers[0].get_n_successors(key, 3)
+    holders = [by_id[int(s.id)] for s in succs]
+    block = DataBlock(value, 3, 2, 257)
+    victim = next(h for h in holders[1:] if h.db.contains(int(key)))
+    victim.db.update(int(key), block.fragments[0])       # force idx 1
+    indices = sorted(h.db.lookup(int(key)).index
+                     for h in holders if h.db.contains(int(key)))
+    assert len(indices) != len(set(indices)), "setup created no duplicate"
+    assert peers[0].read(key_plain) == value  # still >= m distinct
+
+    for _ in range(3):
+        for p in peers:
+            try:
+                p.stabilize()
+                p.run_global_maintenance()
+                p.run_local_maintenance()
+            except RuntimeError:
+                pass
+
+    indices = sorted(h.db.lookup(int(key)).index
+                     for h in holders if h.db.contains(int(key)))
+    assert len(indices) == len(set(indices)), \
+        f"duplicate indices survived maintenance: {indices}"
+    assert peers[0].read(key_plain) == value
