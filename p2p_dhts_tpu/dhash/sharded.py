@@ -83,6 +83,7 @@ from p2p_dhts_tpu.dhash.store import (
     _last_writer_lanes,
     _purge_keys,
     _sort_store,
+    adaptive_decode_default,
     empty_store,
     holder_alive_mask,
 )
@@ -343,17 +344,22 @@ def create_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
     return sstore, ok & guard
 
 
-@functools.partial(jax.jit, static_argnames=("n", "m", "p", "mesh", "axis"))
+@functools.partial(jax.jit, static_argnames=("n", "m", "p", "mesh", "axis",
+                                             "adaptive_decode"))
 def read_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
                        keys: jax.Array, n: int = 14, m: int = 10,
-                       p: int = 257, mesh: Mesh = None, axis: str = "peer"
+                       p: int = 257, mesh: Mesh = None, axis: str = "peer",
+                       adaptive_decode: Optional[bool] = None
                        ) -> Tuple[jax.Array, jax.Array]:
     """Batched DHash Read over the sharded store: one [B, n, S+1] psum
     assembles presence + fragment values from every shard (each live
     (key, idx) row exists on exactly one — module invariant), then the
     first m present distinct indices decode replicated. Same semantics
     as `store.read_batch` (alive holders only; < m reachable fragments
-    fails the lane with zeros)."""
+    fails the lane with zeros), including the platform-split
+    adaptive_decode default (store.adaptive_decode_default; the
+    explicit flag exists mainly so the CPU suite can pin the uniform
+    branch)."""
     b = keys.shape[0]
     smax = sstore.max_segments
     alive = ring.alive
@@ -387,14 +393,20 @@ def read_batch_sharded(ring: RingState, sstore: ShardedFragmentStore,
     rows = jnp.take_along_axis(values, order[:, :, None], axis=1)  # [B, m, S]
     idx = jnp.where(ok[:, None], order + 1,
                     jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
-    # Healthy-store fast path (mirrors read_batch's adaptive default):
-    # when every lane decodes from indices 1..m, one inverse + a
-    # broadcast-LHS MXU matmul replaces the per-block VPU decode.
-    uni_idx = jnp.arange(1, m + 1, dtype=jnp.int32)
-    segments = jax.lax.cond(
-        jnp.all(idx == uni_idx[None, :]),
-        lambda: decode_kernel_uniform(rows, uni_idx, p),
-        lambda: decode_kernel(rows, idx, p))                      # [B, S, m]
+    # Healthy-store fast path: when every lane decodes from indices
+    # 1..m, one inverse + a broadcast-LHS MXU matmul replaces the
+    # per-block decode. Platform-split default — see
+    # store.adaptive_decode_default.
+    if adaptive_decode is None:
+        adaptive_decode = adaptive_decode_default()
+    if adaptive_decode:
+        uni_idx = jnp.arange(1, m + 1, dtype=jnp.int32)
+        segments = jax.lax.cond(
+            jnp.all(idx == uni_idx[None, :]),
+            lambda: decode_kernel_uniform(rows, uni_idx, p),
+            lambda: decode_kernel(rows, idx, p))                  # [B, S, m]
+    else:
+        segments = decode_kernel(rows, idx, p)
     return jnp.where(ok[:, None, None], segments, 0), ok
 
 

@@ -86,6 +86,17 @@ def empty_store(capacity: int, max_segments: int) -> FragmentStore:
     )
 
 
+def adaptive_decode_default() -> bool:
+    """THE single copy of the platform-split read-decode policy (round
+    5, measured): adaptive uniform-index decode on TPU-class backends
+    (dodges the per-block MXU-padding cliff), plain per-block decode on
+    CPU (both branches lower to the same dot there, so the uniformity
+    check + cond is ~10% pure overhead). Shared by read_batch,
+    read_batch_sharded, and bench.py's non-default-variant measurement
+    so the default and its opposite can never drift apart."""
+    return jax.default_backend() != "cpu"
+
+
 def _sort_store(store: FragmentStore) -> FragmentStore:
     """Compacting sort: used rows first, ordered by (key lexicographic,
     frag_idx); unused/purged rows to the tail. Recomputes n_used, so
@@ -295,7 +306,7 @@ def create_batch(ring: RingState, store: FragmentStore,
                    static_argnames=("n", "m", "p", "adaptive_decode"))
 def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
                n: int = 14, m: int = 10, p: int = 257,
-               adaptive_decode: bool = True
+               adaptive_decode: Optional[bool] = None
                ) -> Tuple[jax.Array, jax.Array]:
     """Batched DHash Read (ref dhash_peer.cpp:156-197).
 
@@ -305,14 +316,19 @@ def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
     DISTINCT indices (the reference's distinct-fragment check,
     dhash_peer.cpp:180-186), decode.
 
-    adaptive_decode (DEFAULT, flipped round 5) checks at runtime whether
-    the whole batch decodes from the SAME index set (true whenever no
-    holder has failed: create assigns fragment i+1 to holder i, so
-    healthy reads always collect indices 1..m) and routes it through the
-    one-inverse broadcast-matmul decode (ida.decode_kernel_uniform's
-    MXU-dense shape); mixed index sets take the per-block VPU decode.
-    adaptive_decode=False always takes the per-block path — the
-    pre-flip behavior, kept measurable (bench gets_plain_s).
+    adaptive_decode checks at runtime whether the whole batch decodes
+    from the SAME index set (true whenever no holder has failed: create
+    assigns fragment i+1 to holder i, so healthy reads always collect
+    indices 1..m) and routes it through the one-inverse
+    broadcast-matmul decode (ida.decode_kernel_uniform's MXU-dense
+    shape); mixed index sets take the per-block decode. The DEFAULT
+    (None) is PLATFORM-SPLIT at trace time, like ida.decode_kernel's
+    (round 5, measured): on TPU the uniform path dodges the per-block
+    MXU-padding cliff, so adaptive is on; on CPU both branches lower to
+    the same fast dot and the uniformity check + cond is pure overhead
+    (measured ~10%: 149.5K plain vs 132.8K adaptive gets/s), so it is
+    off. Both explicit settings remain measurable (bench emits the
+    non-default as gets_adaptive_s / gets_plain_s).
 
     Returns (segments [B, S, m] i32, ok [B] bool). Failed lanes (fewer
     than m reachable distinct fragments — the reference throws) give
@@ -332,6 +348,8 @@ def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
     idx = jnp.where(ok[:, None], store.frag_idx[sel],
                     jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
 
+    if adaptive_decode is None:
+        adaptive_decode = adaptive_decode_default()
     if adaptive_decode:
         uni_idx = jnp.arange(1, m + 1, dtype=jnp.int32)
         segments = jax.lax.cond(

@@ -125,6 +125,29 @@ def test_read_parity(rng):
     assert bool(jnp.all(ok_sh))
 
 
+def test_read_adaptive_uniform_branch_parity(rng):
+    """Pin the TPU-default uniform-decode branch ON the CPU suite (the
+    platform-split default would otherwise leave it untested here):
+    adaptive_decode=True must match the plain read bit-for-bit on a
+    healthy store (uniform cond taken) AND after a holder failure
+    (mixed-index cond branch taken)."""
+    from p2p_dhts_tpu.core import churn
+
+    mesh, ring, store, keys, starts, segs, lengths = _setup(rng)
+    ref, _ = create_batch(ring, store, keys, segs, lengths, starts,
+                          N_IDA, M_IDA, P_IDA)
+    sstore = shard_store(ref, mesh, N_PEERS)
+    for r in (ring, churn.fail(ring, jnp.asarray([0], jnp.int32))):
+        got_p, ok_p = read_batch_sharded(r, sstore, keys, N_IDA, M_IDA,
+                                         P_IDA, mesh=mesh,
+                                         adaptive_decode=False)
+        got_a, ok_a = read_batch_sharded(r, sstore, keys, N_IDA, M_IDA,
+                                         P_IDA, mesh=mesh,
+                                         adaptive_decode=True)
+        np.testing.assert_array_equal(np.asarray(ok_p), np.asarray(ok_a))
+        np.testing.assert_array_equal(np.asarray(got_p), np.asarray(got_a))
+
+
 def test_read_with_failed_holders_parity(rng):
     """Fail n-m holders of one block: still readable; one more: lane
     fails — matching the single-device alive-mask semantics."""
