@@ -650,10 +650,12 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
         sorted_ids, key_ints, [sorted_ids[s] for s in starts_np], hops_np)
     assert parity != "FAIL", "hop parity violation"
 
-    # Gathered-pred serve fallback (the pre-round-5 default, with the
-    # per-hop preds gather): measured for the comparison the default
-    # flip is based on; firewalled + parity-asserted when it runs.
-    gathered_t = None
+    # Serve variants, firewalled + parity-asserted when they run:
+    # gathered-pred (the pre-round-5 default, with the per-hop preds
+    # gather — the comparison the flip is based on) and unroll2 (two
+    # budget-guarded hops per loop iteration — the candidate for when
+    # per-iteration overhead dominates; see the hopscan).
+    gathered_t = unroll2_t = None
     if compile_service_ok():
         try:
             from p2p_dhts_tpu.core.ring import find_successor_gathered_pred
@@ -668,6 +670,18 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
         except Exception as exc:
             print(f"# gathered-pred serve unavailable: {exc}",
                   file=sys.stderr)
+        try:
+            from p2p_dhts_tpu.core.ring import find_successor_unroll2
+            o3, h3 = find_successor_unroll2(state, keys, starts)
+            _sync(o3, h3)
+            assert bool(jnp.all(o3 == owner)) and \
+                bool(jnp.all(h3 == hops)), "unroll2 serve diverges"
+            unroll2_t = _time(
+                lambda: find_successor_unroll2(state, keys, starts))
+        except AssertionError:
+            raise
+        except Exception as exc:
+            print(f"# unroll2 serve unavailable: {exc}", file=sys.stderr)
 
     lps = n_keys / best
     return _emit({
@@ -680,6 +694,8 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
         "wall_ms": round(best * 1e3, 2),
         "gathered_pred_lookups_s":
             round(n_keys / gathered_t, 1) if gathered_t else None,
+        "unroll2_lookups_s":
+            round(n_keys / unroll2_t, 1) if unroll2_t else None,
         "mean_hops": round(float(hops_np.mean()), 3),
         "hop_parity": parity,
         "device": str(jax.devices()[0]),

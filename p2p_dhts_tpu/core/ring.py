@@ -521,7 +521,8 @@ def _converged_all_alive(state: RingState) -> jax.Array:
 
 
 def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
-                       cur0: jax.Array, max_hops: int
+                       cur0: jax.Array, max_hops: int,
+                       unroll: int = 1
                        ) -> Tuple[jax.Array, jax.Array]:
     """Straggler-compacted lockstep hop driver, shared by `_fast_lookup`
     and the shard_map kernel (core/sharded.py — all its lane state is
@@ -537,16 +538,33 @@ def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
     they are failed lookups anyway (max_hops == routing loop), so losing
     them past the prefix is safe: phase 2 runs zero trips and the final
     cur != owner0 test marks them failed. Returns (cur, hops).
+
+    unroll > 1 chains that many guarded hop steps per while_loop
+    iteration: identical routes and hop counts (every sub-step is
+    per-lane done- AND budget-guarded — bodies must gate advancement on
+    ``it < max_hops``, as _fast_lookup's does), but the loop condition,
+    straggler count, and loop bookkeeping amortize over `unroll` hops.
+    A measured serve variant (bench lookup_1m unroll2 field); default 1.
     """
     b = keys.shape[0]
     p = max(b // 8, 1)
+
+    def chain(body):
+        if unroll == 1:
+            return body
+
+        def chained(carry):
+            for _ in range(unroll):
+                carry = body(carry)
+            return carry
+        return chained
 
     def cond1(carry):
         cur, _, it = carry
         return (jnp.sum(cur != owner0) > p) & (it < max_hops)
 
     cur, hops, it = jax.lax.while_loop(
-        cond1, body_for(keys, owner0),
+        cond1, chain(body_for(keys, owner0)),
         (cur0, jnp.zeros(b, jnp.int32), jnp.int32(0)))
 
     not_done = cur != owner0
@@ -563,7 +581,7 @@ def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
         return (~jnp.all(cur_p == owner0_c[:p])) & (it < max_hops)
 
     cur_p, hops_p, _ = jax.lax.while_loop(
-        cond2, body_for(keys_c[:p], owner0_c[:p]),
+        cond2, chain(body_for(keys_c[:p], owner0_c[:p])),
         (cur_c[:p], hops_c[:p], it))
 
     cur = jnp.concatenate([cur_p, cur_c[p:]])[pos]
@@ -573,7 +591,8 @@ def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
 
 def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
                  max_hops: int,
-                 structured_pred: bool = False) -> Tuple[jax.Array, jax.Array]:
+                 structured_pred: bool = False,
+                 unroll: int = 1) -> Tuple[jax.Array, jax.Array]:
     """Lean hop loop for converged all-alive rings — identical route and
     hop counts to the general loop (the parity obligation), minus
     everything that can't trigger there: per-hop min_key gathers (16 B),
@@ -631,13 +650,18 @@ def _fast_lookup(state: RingState, keys: jax.Array, start: jax.Array,
             else:
                 pred_cur = preds[cur]
             nxt = jnp.where(nxt == cur, pred_cur, nxt)
-            cur = jnp.where(done, cur, nxt)
-            hops = jnp.where(done, hops, hops + 1)
+            # Budget-guarded per sub-step so two_phase_hop_loop's unroll
+            # preserves exact hop semantics (the loop cond alone checks
+            # the budget only every `unroll` hops).
+            live = (~done) & (it < max_hops)
+            cur = jnp.where(live, nxt, cur)
+            hops = jnp.where(live, hops + 1, hops)
             return cur, hops, it + 1
         return body
 
     cur0 = jnp.asarray(start, dtype=jnp.int32)
-    cur, hops = two_phase_hop_loop(body_for, keys, owner0, cur0, max_hops)
+    cur, hops = two_phase_hop_loop(body_for, keys, owner0, cur0, max_hops,
+                                   unroll=unroll)
 
     failed = cur != owner0  # hop budget exhausted == routing loop
     owner = jnp.where(failed, -1, cur)
@@ -806,6 +830,25 @@ def find_successor_gathered_pred(state: RingState, keys: jax.Array,
     if max_hops is None:
         max_hops = state.max_hops
     return _fast_lookup(state, keys, start, max_hops, structured_pred=False)
+
+
+@functools.partial(jax.jit, static_argnames=("max_hops",))
+def find_successor_unroll2(state: RingState, keys: jax.Array,
+                           start: jax.Array,
+                           max_hops: Optional[int] = None
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """The all-alive fast serve loop with TWO budget-guarded hops per
+    while_loop iteration (two_phase_hop_loop unroll=2): identical routes
+    and hop counts to find_successor on converged all-alive rings, but
+    the loop condition, straggler count, and loop bookkeeping amortize
+    over two hops — a measured candidate for when per-iteration overhead
+    (not gather bandwidth) dominates the serve (bench lookup_1m emits it
+    as unroll2_lookups_s; flips into the default only on chip
+    evidence). Callers must guarantee a converged all-alive ring."""
+    if max_hops is None:
+        max_hops = state.max_hops
+    return _fast_lookup(state, keys, start, max_hops,
+                        structured_pred=True, unroll=2)
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -427,6 +427,7 @@ def test_gathered_pred_serve_matches_default():
     from p2p_dhts_tpu.config import RingConfig
     from p2p_dhts_tpu.core.ring import (build_ring_random, find_successor,
                                         find_successor_gathered_pred,
+                                        find_successor_unroll2,
                                         keys_from_ints,
                                         materialize_converged_fingers)
 
@@ -443,3 +444,13 @@ def test_gathered_pred_serve_matches_default():
         o2, h2 = find_successor_gathered_pred(state, keys, starts)
         assert bool(jnp.all(o1 == o2)) and bool(jnp.all(h1 == h2)), \
             f"divergence at n={n} cap={cap}"
+        o3, h3 = find_successor_unroll2(state, keys, starts)
+        assert bool(jnp.all(o1 == o3)) and bool(jnp.all(h1 == h3)), \
+            f"unroll2 divergence at n={n} cap={cap}"
+        # Exact-parity edge for the unroll: an ODD hop budget whose cond
+        # check lands mid-pair — budget-guarded sub-steps must cap hops
+        # identically to the single-step loop.
+        o4, h4 = find_successor(state, keys, starts, max_hops=3)
+        o5, h5 = find_successor_unroll2(state, keys, starts, max_hops=3)
+        assert bool(jnp.all(o4 == o5)) and bool(jnp.all(h4 == h5)), \
+            f"unroll2 budget-edge divergence at n={n} cap={cap}"
