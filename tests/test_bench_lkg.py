@@ -76,3 +76,34 @@ def test_git_commit_marks_dirty_tree():
     sha = stamp.removesuffix("-dirty")
     assert 6 <= len(sha) <= 16 and all(
         c in "0123456789abcdef" for c in sha)
+
+
+def test_dead_compile_service_skip_path(tmp_path, monkeypatch, capsys):
+    """The driver-facing path for 'chip executes but the remote compile
+    service is dead': bench must skip every selected config in seconds,
+    emit one record per config carrying the stale last-known-good
+    on-chip data, emit the final summary line, and exit 1. (LKG comes
+    from a fixture file — production runs legitimately rewrite the
+    live artifact, so its values must not be pinned here.)"""
+    import sys as _sys
+    import pytest as _pytest
+    _seed(tmp_path, monkeypatch, {
+        "chord16": {"config": "chord16", "value": 123.4, "unit": "x/s",
+                    "commit": "abc1234", "utc": "2026-07-31T03:45:00Z",
+                    "device": "TPU v5 lite0"},
+    })
+    monkeypatch.setattr(bench, "compile_service_ok", lambda: False)
+    monkeypatch.setattr(bench.jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(_sys, "argv", ["bench.py", "--config", "chord16"])
+    with _pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 1
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2  # one config record + the summary
+    rec, summary = lines
+    assert rec["config"] == "chord16" and rec["value"] is None
+    assert rec["last_known_good"]["stale"] is True
+    assert rec["last_known_good"]["value"] == 123.4
+    assert summary["failed_configs"] == ["chord16"]
+    assert summary["configs"][0]["config"] == "chord16"
