@@ -8,6 +8,9 @@ record and per-config failure records.
 
 import json
 import os
+import sys
+
+import pytest
 
 import bench
 
@@ -85,8 +88,6 @@ def test_dead_compile_service_skip_path(tmp_path, monkeypatch, capsys):
     on-chip data, emit the final summary line, and exit 1. (LKG comes
     from a fixture file — production runs legitimately rewrite the
     live artifact, so its values must not be pinned here.)"""
-    import sys as _sys
-    import pytest as _pytest
     _seed(tmp_path, monkeypatch, {
         "chord16": {"config": "chord16", "value": 123.4, "unit": "x/s",
                     "commit": "abc1234", "utc": "2026-07-31T03:45:00Z",
@@ -94,8 +95,8 @@ def test_dead_compile_service_skip_path(tmp_path, monkeypatch, capsys):
     })
     monkeypatch.setattr(bench, "compile_service_ok", lambda: False)
     monkeypatch.setattr(bench.jax, "default_backend", lambda: "axon")
-    monkeypatch.setattr(_sys, "argv", ["bench.py", "--config", "chord16"])
-    with _pytest.raises(SystemExit) as exc:
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--config", "chord16"])
+    with pytest.raises(SystemExit) as exc:
         bench.main()
     assert exc.value.code == 1
     lines = [json.loads(ln) for ln in
