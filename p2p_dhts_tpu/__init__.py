@@ -53,6 +53,8 @@ _LAZY = {
     "save_checkpoint": ("p2p_dhts_tpu.checkpoint", "save_checkpoint"),
     "load_checkpoint": ("p2p_dhts_tpu.checkpoint", "load_checkpoint"),
     "DeviceDHT": ("p2p_dhts_tpu.simulator", "DeviceDHT"),
+    "ServeEngine": ("p2p_dhts_tpu.serve", "ServeEngine"),
+    "EngineFingerResolver": ("p2p_dhts_tpu.serve", "EngineFingerResolver"),
 }
 
 

@@ -1,0 +1,34 @@
+"""Version compatibility shims for the jax API surface.
+
+The sharded kernels target the modern `jax.shard_map` entry point
+(top-level since jax 0.6, `check_vma=` replication-checking kwarg).
+Older runtimes — including the 0.4.x line this container bakes in —
+ship the same machinery as `jax.experimental.shard_map.shard_map` with
+the kwarg spelled `check_rep=`. One import point here keeps every call
+site written against the modern spelling while degrading transparently:
+without this gate, merely importing `p2p_dhts_tpu.dhash` (whose
+__init__ re-exports the sharded layer) died with ImportError on 0.4.x,
+taking bench.py and seven test modules down with it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: the public, stable entry point
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x/0.5.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f=None, /, **kwargs):
+        """Modern-signature adapter over the experimental shard_map:
+        accepts (and translates) `check_vma=` and supports the
+        functools.partial(shard_map, ...) decorator idiom the kernels
+        use."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return functools.partial(shard_map, **kwargs)
+        return _shard_map_legacy(f, **kwargs)
+
+__all__ = ["shard_map"]

@@ -408,7 +408,11 @@ def next_alive_map(state: RingState) -> jax.Array:
     pos = jnp.where(live, rows, _BIG)
     suffix_min = jnp.flip(jax.lax.cummin(jnp.flip(pos)))
     first = suffix_min[0]  # global min (or _BIG if none alive)
-    ext = jnp.concatenate([suffix_min, jnp.full((1,), _BIG, jnp.int32)])
+    # [N+1] extension via update-slice, NOT concatenate([arr, 1-elem]):
+    # XLA's SPMD partitioner (jax 0.4.x) miscompiles a concat involving
+    # slices/pieces of a sharded operand under GSPMD auto-sharding (see
+    # two_phase_hop_loop's merge note); update-slice partitions right.
+    ext = jnp.full((n + 1,), _BIG, jnp.int32).at[:n].set(suffix_min)
     wrapped = jnp.where(ext == _BIG, first, ext)
     return jnp.where(wrapped == _BIG, -1, wrapped)
 
@@ -435,7 +439,16 @@ def placement_converged(state: RingState) -> jax.Array:
     `_converged_all_alive` (dead rows allowed), strong enough that the
     i-th successor of any key is simply the i-th next-alive row after its
     owner — which licenses the O(n)-gather placement fast path in
-    dhash.store (vs n sequential full lookup sweeps)."""
+    dhash.store (vs n sequential full lookup sweeps).
+
+    Known GSPMD residual (jax 0.4.x): under auto-sharding of the peer
+    axis the associative_scan below miscomputes (observed returning
+    False on a converged ring — the SAFE direction: the lax.cond guard
+    then takes the exact walk, costing speed, not correctness). The
+    explicit shard_map path computes this per-shard and is unaffected.
+    Untouched here because its HLO is in the warm on-chip compile cache
+    and a gather-based rewrite is the 10M-shape compile-cliff op class
+    (see churn.leave)."""
     live = live_mask(state)
     n = state.ids.shape[0]
     rows = jnp.arange(n, dtype=jnp.int32)
@@ -584,8 +597,15 @@ def two_phase_hop_loop(body_for, keys: jax.Array, owner0: jax.Array,
         cond2, chain(body_for(keys_c[:p], owner0_c[:p])),
         (cur_c[:p], hops_c[:p], it))
 
-    cur = jnp.concatenate([cur_p, cur_c[p:]])[pos]
-    hops = jnp.concatenate([hops_p, hops_c[p:]])[pos]
+    # Merge via dynamic-update-slice, NOT concatenate([head, tail[p:]]):
+    # identical result, but XLA's SPMD partitioner (jax 0.4.x) miscompiles
+    # a concat of two slices of a lane-sharded array under GSPMD
+    # auto-sharding (outputs get summed across an unrelated mesh axis —
+    # caught by the 8-device dryrun, __graft_entry__._dryrun_impl).
+    # Update-slice partitions correctly on every path, including the
+    # explicit shard_map kernel where lanes are shard-local anyway.
+    cur = cur_c.at[:p].set(cur_p)[pos]
+    hops = hops_c.at[:p].set(hops_p)[pos]
     return cur, hops
 
 

@@ -5,12 +5,22 @@ stdout lines (abstract_chord_peer.cpp:714-718) — plus the Server's
 optional 32-entry request ring buffer (server.h:364-378, mirrored in
 net/rpc.py RequestLog). This module adds what the reference never had:
 
-  * `Metrics` — a process-wide, thread-safe registry of counters and
-    latency timers. The RPC server counts every dispatched command and
-    error; clients time requests; overlay maintenance ops count rounds.
-    `snapshot()` returns a plain dict for tests/bench JSON.
+  * `Metrics` — a process-wide, thread-safe registry of counters,
+    latency timers, gauges, and bounded-reservoir histograms. The RPC
+    server counts every dispatched command and error; clients time
+    requests; overlay maintenance ops count rounds; the serve engine
+    records queue depth / window size gauges and per-request latency
+    histograms. `snapshot()` returns a plain dict for tests/bench JSON
+    (the `gauges`/`hists` sections appear only when non-empty, so
+    pre-gauge consumers see the exact historical shape).
   * `timed(name)` — context manager / decorator recording wall-clock
     latency (count / total / max) under `timers`.
+  * `gauge(name, value)` — last-write-wins instantaneous value (queue
+    depth, adaptive window size, batch fill ratio).
+  * `observe_hist(name, value)` — append to a bounded reservoir (newest
+    `HIST_CAP` samples) from which `quantiles()`/`snapshot()` derive
+    p50/p99 — the per-request latency percentiles the serve bench
+    reports.
   * `device_trace(path)` — context manager around `jax.profiler` for
     TPU timeline capture of the device kernels (no-op if the profiler
     is unavailable on the platform, e.g. the CPU test mesh).
@@ -21,19 +31,38 @@ dict update under one lock — cheap enough for the RPC dispatch path.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+
+def nearest_rank(sorted_samples: Sequence[float],
+                 q: float) -> Optional[float]:
+    """Nearest-rank quantile over an ASCENDING-sorted sample list (None
+    when empty) — THE percentile rule for every latency summary in this
+    package (Metrics, ServeEngine, bench); keep one copy so reported
+    percentiles can never diverge between reporters."""
+    n = len(sorted_samples)
+    if not n:
+        return None
+    return sorted_samples[min(int(q * n), n - 1)]
 
 
 class Metrics:
-    """Thread-safe counters + timers registry."""
+    """Thread-safe counters + timers + gauges + histograms registry."""
+
+    #: Reservoir bound per histogram: newest samples win. Bounded so the
+    #: registry can sit on the per-request serve hot path forever.
+    HIST_CAP = 4096
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._timers: Dict[str, Dict[str, float]] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, collections.deque] = {}
 
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
@@ -47,6 +76,41 @@ class Metrics:
             t["total_s"] += seconds
             t["max_s"] = max(t["max_s"], seconds)
 
+    def gauge(self, name: str, value: float) -> None:
+        """Set an instantaneous value (last write wins)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe_hist(self, name: str, value: float) -> None:
+        """Append one sample to a bounded reservoir histogram."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = collections.deque(
+                    maxlen=self.HIST_CAP)
+            h.append(float(value))
+
+    def observe_hist_many(self, name: str, values: Sequence[float]) -> None:
+        """Append a batch of samples under ONE lock acquisition — the
+        serve engine's fan-out path records a whole batch's latencies
+        at once instead of contending per request."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = collections.deque(
+                    maxlen=self.HIST_CAP)
+            h.extend(float(v) for v in values)
+
+    def quantiles(self, name: str,
+                  qs: Sequence[float] = (0.5, 0.99)
+                  ) -> Tuple[Optional[float], ...]:
+        """Quantiles over the current reservoir (None if no samples).
+        Nearest-rank on the retained window — an operational latency
+        summary, not an exact full-history percentile."""
+        with self._lock:
+            samples = sorted(self._hists.get(name, ()))
+        return tuple(nearest_rank(samples, q) for q in qs)
+
     @contextlib.contextmanager
     def timed(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
@@ -57,15 +121,33 @@ class Metrics:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "counters": dict(self._counters),
                 "timers": {k: dict(v) for k, v in self._timers.items()},
             }
+            # Conditional sections: absent when empty so the historical
+            # two-section shape (and its consumers) is undisturbed.
+            if self._gauges:
+                out["gauges"] = dict(self._gauges)
+            if self._hists:
+                hists = {}
+                for k, dq in self._hists.items():
+                    samples = sorted(dq)
+                    hists[k] = {
+                        "count": len(samples),
+                        "p50": nearest_rank(samples, 0.5),
+                        "p99": nearest_rank(samples, 0.99),
+                        "max": samples[-1] if samples else None,
+                    }
+                out["hists"] = hists
+            return out
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._gauges.clear()
+            self._hists.clear()
 
 
 #: Process-wide default registry (the RPC layer and overlay peers record

@@ -27,7 +27,87 @@
 #include <utility>
 #include <vector>
 
+#if defined(_WIN32)
+#include <locale.h>  // _create_locale / _snprintf_l / _strtod_l
+#else
+#include <locale.h>  // newlocale / uselocale (POSIX.1-2008)
+#if defined(__APPLE__) || defined(__FreeBSD__)
+#include <xlocale.h>  // Darwin/BSD declare newlocale/uselocale here
+#endif
+#endif
+
 namespace ns {
+
+// --------------------------------------------------------------------------
+// C-locale-pinned double <-> text (ADVICE r5 #4): snprintf("%.*e") and
+// strtod honor LC_NUMERIC, so a host process running under e.g. de_DE
+// (',' decimal separator) would emit invalid JSON bytes and mis-parse
+// valid ones — silently forking wire parity with the Python server.
+// Every double conversion below goes through these helpers, which pin
+// the numeric locale to "C" per call (uselocale on POSIX, _l-suffixed
+// CRT calls on Windows). If the one-time "C" locale allocation fails,
+// the helpers degrade to the plain calls — the pre-fix behavior.
+// --------------------------------------------------------------------------
+
+namespace detail {
+
+#if defined(_WIN32)
+
+inline _locale_t c_numeric_locale() {
+  static _locale_t loc = _create_locale(LC_NUMERIC, "C");
+  return loc;
+}
+
+inline int snprintf_double_c(char* buf, size_t n, int precision, double d) {
+  _locale_t loc = c_numeric_locale();
+  if (loc) return _snprintf_l(buf, n, "%.*e", loc, precision, d);
+  return std::snprintf(buf, n, "%.*e", precision, d);
+}
+
+inline double strtod_c(const char* s, char** end) {
+  _locale_t loc = c_numeric_locale();
+  if (loc) return _strtod_l(s, end, loc);
+  return std::strtod(s, end);
+}
+
+#else  // POSIX
+
+inline locale_t c_numeric_locale() {
+  static locale_t loc = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
+  return loc;
+}
+
+// RAII numeric-locale pin for the calling thread (uselocale is
+// per-thread, so concurrent server workers never race on it).
+class ScopedCNumeric {
+ public:
+  ScopedCNumeric()
+      : active_(c_numeric_locale() != (locale_t)0),
+        old_(active_ ? uselocale(c_numeric_locale()) : (locale_t)0) {}
+  ~ScopedCNumeric() {
+    if (active_) uselocale(old_);
+  }
+  ScopedCNumeric(const ScopedCNumeric&) = delete;
+  ScopedCNumeric& operator=(const ScopedCNumeric&) = delete;
+
+ private:
+  bool active_;
+  locale_t old_;
+};
+
+inline int snprintf_double_c(char* buf, size_t n, int precision, double d) {
+  ScopedCNumeric pin;
+  return std::snprintf(buf, n, "%.*e", precision, d);
+}
+
+inline double strtod_c(const char* s, char** end) {
+  ScopedCNumeric pin;
+  return std::strtod(s, end);
+}
+
+#endif
+
+}  // namespace detail
 
 struct Jv {
   enum class T { Null, Bool, Int, Dbl, Str, Arr, Obj };
@@ -152,8 +232,8 @@ inline void dump(const Jv& v, std::string& out) {
       // would fork the wire bytes (ADVICE-r4-adjacent parity test).
       char buf[40];
       for (int p2 = 1; p2 <= 17; p2++) {
-        std::snprintf(buf, sizeof buf, "%.*e", p2 - 1, d);
-        if (std::strtod(buf, nullptr) == d) break;
+        detail::snprintf_double_c(buf, sizeof buf, p2 - 1, d);
+        if (detail::strtod_c(buf, nullptr) == d) break;
       }
       std::string digits;
       bool neg = false;
@@ -354,7 +434,7 @@ class Parser {
       // they are hex strings on the wire).
     }
     out.t = Jv::T::Dbl;
-    out.d = std::strtod(tok.c_str(), nullptr);
+    out.d = detail::strtod_c(tok.c_str(), nullptr);
     return true;
   }
 

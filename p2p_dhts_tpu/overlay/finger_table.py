@@ -17,11 +17,15 @@ RPC exactly like the reference.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import List, Tuple
 
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING, Key
 from p2p_dhts_tpu.overlay.remote_peer import RemotePeer
+
+logger = logging.getLogger(__name__)
 
 
 class Finger:
@@ -41,6 +45,12 @@ class FingerTable:
 
     NUM_ENTRIES = 128  # binary key length (finger_table.h:44, key.h:152-155)
 
+    #: After a device-resolve failure the table serves the host closed
+    #: form for this long, then RETRIES the device path — a recovered
+    #: TPU tunnel puts the device back in service without a restart
+    #: (round-5 advisor #3: the old bare except degraded forever).
+    DEGRADED_RETRY_S = 30.0
+
     def __init__(self, starting_key: Key, backend: str = "python"):
         if backend not in ("python", "jax"):
             raise ValueError(f"unknown backend {backend!r}")
@@ -48,16 +58,84 @@ class FingerTable:
         self.backend = backend
         self._table: List[Finger] = []
         self._lock = threading.RLock()
-        self._resolver = None  # DeviceFingerResolver, built on first use
+        self._resolver = None  # engine-backed resolver, built on first use
+        #: Visible degradation state: True while device resolves are
+        #: failing and lookups fall back to the host closed form.
+        self.degraded = False
+        self._degraded_logged = False
+        self._retry_at = 0.0
+        # Dedicated lock for the degradation state: lookup() runs the
+        # device resolve with the TABLE lock released (so worker
+        # threads can share batches), so these transitions need their
+        # own serialization — it is never held across the device call.
+        self._degrade_lock = threading.Lock()
+        self._probe_inflight = False
 
     def _device_resolver(self):
-        """Lazy per-table batching bridge (overlay.jax_bridge)."""
+        """Lazy batching bridge: the shared ServeEngine (serve.py —
+        adaptive window, cross-table batching); falls back to the
+        legacy per-table DeviceFingerResolver if the engine layer
+        itself cannot be built."""
         with self._lock:
             if self._resolver is None:
-                from p2p_dhts_tpu.overlay.jax_bridge import (
-                    DeviceFingerResolver)
-                self._resolver = DeviceFingerResolver(int(self.starting_key))
+                try:
+                    from p2p_dhts_tpu.serve import EngineFingerResolver
+                    self._resolver = EngineFingerResolver(
+                        int(self.starting_key))
+                except Exception:
+                    from p2p_dhts_tpu.overlay.jax_bridge import (
+                        DeviceFingerResolver)
+                    self._resolver = DeviceFingerResolver(
+                        int(self.starting_key))
             return self._resolver
+
+    def _device_lookup_index(self, key: Key) -> int:
+        """Device-path entry resolve with visible, recoverable
+        degradation: a failure logs ONCE (with traceback), flips
+        `degraded`, and starts serving the semantics-identical host
+        closed form; the device path is retried every
+        DEGRADED_RETRY_S by ONE prober at a time (concurrent workers
+        keep serving host-side — no exception storm against a dead
+        backend), and a successful retry clears the flag."""
+        probing = False
+        with self._degrade_lock:
+            if self.degraded:
+                if (time.monotonic() < self._retry_at
+                        or self._probe_inflight):
+                    return self._host_closed_form_index(key)
+                self._probe_inflight = True
+                probing = True
+        try:
+            idx = self._device_resolver().lookup_index(int(key))
+        except Exception:
+            # jax missing OR its backend unusable (dead TPU tunnel
+            # raises RuntimeError at init — a state this host regularly
+            # sees): the wire path must keep serving.
+            with self._degrade_lock:
+                if probing:
+                    self._probe_inflight = False
+                self._retry_at = time.monotonic() + self.DEGRADED_RETRY_S
+                if not self._degraded_logged:
+                    logger.warning(
+                        "device finger resolve failed; serving host "
+                        "closed form (retry in %.0fs)",
+                        self.DEGRADED_RETRY_S, exc_info=True)
+                    self._degraded_logged = True
+                self.degraded = True
+            return self._host_closed_form_index(key)
+        with self._degrade_lock:
+            if probing:
+                self._probe_inflight = False
+            if self.degraded:
+                logger.warning("device finger resolve recovered; leaving "
+                               "degraded mode")
+                self.degraded = False
+                self._degraded_logged = False
+        return idx
+
+    def _host_closed_form_index(self, key: Key) -> int:
+        dist = (int(key) - int(self.starting_key)) % KEYS_IN_RING
+        return dist.bit_length() - 1 if dist else -1
 
     # -- structure ---------------------------------------------------------
     def add_finger(self, finger: Finger) -> None:
@@ -114,23 +192,15 @@ class FingerTable:
         (entry index = bit_length((key - start) mod 2^128) - 1, the
         closed form of the scan). The device resolve runs with the
         table lock RELEASED so the server's worker threads can share a
-        batch; the entry read re-takes it. Falls back to the host
-        closed form only if jax itself is unavailable.
+        batch; the entry read re-takes it. A failing device path
+        degrades VISIBLY (logged once, `degraded` flag, periodic
+        retry) to the semantics-identical host closed form.
         """
         if self.backend == "jax":
             with self._lock:
                 full = len(self._table) == self.NUM_ENTRIES
             if full:
-                try:
-                    idx = self._device_resolver().lookup_index(int(key))
-                except Exception:
-                    # jax missing OR its backend unusable (dead TPU
-                    # tunnel raises RuntimeError at init — a state this
-                    # host regularly sees): the wire path must keep
-                    # serving, so degrade to the host closed form, which
-                    # is semantics-identical to the device kernel.
-                    dist = (int(key) - int(self.starting_key)) % KEYS_IN_RING
-                    idx = dist.bit_length() - 1 if dist else -1
+                idx = self._device_lookup_index(key)
                 if idx < 0:
                     raise LookupError("ChordKey not found")
                 with self._lock:

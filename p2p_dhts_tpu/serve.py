@@ -1,0 +1,968 @@
+"""Batched request-serving engine: host traffic -> device kernels at rate.
+
+The device kernels already serve millions of lookups per dispatch
+(core.ring.find_successor, dhash.store create/read); what was missing is
+the bridge from *request traffic* — one key per caller, arriving on
+arbitrary host threads — to those kernels at throughput. The legacy
+bridge (overlay.jax_bridge.DeviceFingerResolver) charges every
+uncontended lookup a fixed coalescing sleep and serves one op from one
+table; this engine is the generalization: a pipelined dispatch loop in
+the spirit of continuous-batching inference serving (Yu et al., Orca,
+OSDI 2022), carrying Chord/DHash semantics instead of transformer steps.
+
+Mechanisms, and the reference-behavior obligation each must preserve
+(Stoica et al., Chord, SIGCOMM 2001; the C++ reference pins the exact
+semantics — hop parity is non-negotiable):
+
+  * ADAPTIVE COALESCING — the dispatch window starts at zero and only
+    grows while batches actually coalesce (>1 request) or the queue
+    stays non-empty; it decays back toward zero the moment traffic is
+    solo. Obligation: batching is a *scheduling* choice — a request's
+    result must be byte-identical whether it was served alone or inside
+    a batch of 8192 (find_successor routes and hop counts match the
+    reference's recursive per-RPC resolution exactly; the parity tests
+    drive both paths over the same ring).
+  * SHAPE BUCKETING — batches pad to power-of-two buckets
+    (bucket_min..bucket_max) so every dispatch hits the jit cache;
+    `warmup()` pre-traces every (kind, bucket) program and a per-kind
+    trace counter proves zero steady-state retraces. Obligation: pad
+    lanes replicate the batch's first request, so padding can never
+    introduce new protocol actions — a padded dhash put is the first
+    put applied twice (the reference's sequential last-writer-wins,
+    create_batch's duplicate-lane rule), a padded lookup is a repeated
+    lookup.
+  * DOUBLE-BUFFERED DISPATCH — the dispatcher thread builds and
+    launches batch k+1 while the completion thread blocks on batch k's
+    device->host sync (a bounded in-flight queue, depth 2); key/start
+    buffers are donated to XLA per bucket on TPU backends. Obligation:
+    completion is FIFO, and dhash put batches chain device-side through
+    the store value, so cross-batch store state is exactly the
+    sequential reference's.
+  * BOUNDED ADMISSION + BACKPRESSURE — `submit` blocks (never drops)
+    when max_queue requests are pending; `close(drain=True)` serves
+    every in-flight request before the threads exit, and any error that
+    could not be delivered to a waiting caller is re-raised from
+    `close()` instead of vanishing in a worker thread. Obligation: the
+    reference's RPC server never sheds load silently — a caller either
+    gets its answer or sees the failure.
+
+Request kinds:
+
+  * "find_successor" — payload (key_int|lanes, start_row) -> (owner
+    row, hop count) through core.ring.find_successor on the engine's
+    RingState.
+  * "dhash_get" / "dhash_put" — payloads (key) / (key, segments,
+    length, start_row) through dhash.store read_batch / create_batch;
+    puts mutate the engine's FragmentStore in submission order.
+  * "finger_index" — payload (key, table_start): the overlay bridge op
+    (bit_length((key - start) mod 2^128) - 1, the closed form of
+    FingerTable::Lookup's 128-entry scan, finger_table.h:115-130).
+    Stateless w.r.t. the ring, so a process-global engine
+    (`global_finger_engine`) batches lookups ACROSS finger tables —
+    every backend="jax" peer in the process shares one dispatch loop.
+
+Per-stage metrics (queue depth, batch fill, window size, request
+latency) record into `p2p_dhts_tpu.metrics` gauges/histograms under
+``serve.*``; `stats()` returns the engine-local view including p50/p99
+request latency per kind.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+KINDS = ("find_successor", "dhash_get", "dhash_put", "finger_index")
+
+_SENTINEL = object()
+
+
+class EngineClosedError(RuntimeError):
+    """Raised to submitters/waiters when the engine shut down without
+    (or before) serving their request."""
+
+
+class _Slot:
+    """One pending request: the caller blocks on `wait()`, the
+    completion thread delivers `result` or `error`."""
+
+    __slots__ = ("kind", "payload", "t_submit", "result", "error", "ev")
+
+    def __init__(self, kind: str, payload: tuple):
+        self.kind = kind
+        self.payload = payload
+        self.t_submit = time.perf_counter()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.ev = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self.ev.wait(timeout):
+            raise TimeoutError(
+                f"serve request ({self.kind}) not served in {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def _buckets_between(lo: int, hi: int) -> List[int]:
+    if lo <= 0 or (lo & (lo - 1)) or hi <= 0 or (hi & (hi - 1)):
+        raise ValueError(f"bucket bounds must be powers of two, got "
+                         f"[{lo}, {hi}]")
+    if lo > hi:
+        raise ValueError(f"bucket_min {lo} > bucket_max {hi}")
+    out, b = [], lo
+    while b <= hi:
+        out.append(b)
+        b *= 2
+    return out
+
+
+class ServeEngine:
+    """Concurrent host requests -> bucketed device batches, pipelined.
+
+    Construct with a RingState (for find_successor) and optionally a
+    FragmentStore + IDA params (for dhash get/put); a state-less engine
+    still serves "finger_index". Threads start lazily on first submit
+    (or explicitly via `start()`); `close()` (or the context manager)
+    drains and joins them and re-raises any late error.
+
+    Thread-safety: `submit`/`find_successor`/`dhash_*` are safe from any
+    thread; callers MUST NOT hold locks the completion of *other*
+    requests needs (the finger-table rule, jax_bridge docstring).
+    """
+
+    # Adaptive-window dynamics: grow x2 under coalescing load up to
+    # window_cap_s, decay x4 when solo, snap to exactly 0 below the
+    # floor so the uncontended path never sleeps at all.
+    _WINDOW_GROW_FLOOR_S = 128e-6
+    _WINDOW_ZERO_BELOW_S = 20e-6
+    # Collection sleep granularity: a full bucket dispatches at most
+    # this late, and early-arriving full batches don't wait the window.
+    _POLL_S = 200e-6
+
+    def __init__(self, state=None, store=None, *,
+                 n: int = 14, m: int = 10, p: int = 257,
+                 window_cap_s: float = 0.002,
+                 bucket_min: int = 64, bucket_max: int = 8192,
+                 max_queue: int = 65536,
+                 metrics: Optional[Metrics] = None,
+                 name: str = "serve"):
+        self._state = state
+        self._store = store
+        self._ida = (int(n), int(m), int(p))
+        self._window_cap_s = float(window_cap_s)
+        self._buckets = _buckets_between(int(bucket_min), int(bucket_max))
+        self._bucket_max = self._buckets[-1]
+        self._max_queue = int(max_queue)
+        self._metrics = metrics if metrics is not None else METRICS
+        self._name = name
+
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._pending: collections.deque = collections.deque()
+        self._closing = False
+        self._drain_on_close = True
+        self._started = False
+        self._closed = False
+
+        # window_s is written only by the dispatcher; read anywhere.
+        self._window_s = 0.0
+        self._window_hwm_s = 0.0
+
+        self._dispatcher: Optional[threading.Thread] = None
+        self._completer: Optional[threading.Thread] = None
+        # Depth-2 in-flight queue: batch k syncs on the completion
+        # thread while the dispatcher builds + launches batch k+1.
+        import queue as _queue
+        self._inflight: "_queue.Queue" = _queue.Queue(maxsize=2)
+        # Batches handed to (and not yet finished by) the completion
+        # thread; when 0 with an empty queue the dispatcher completes
+        # inline — the idle path pays no pipeline handoff.
+        self._inflight_n = 0
+        # True while a submitter is serving its own request on the
+        # caller-inline fast path (idle engine, single request).
+        self._fast_busy = False
+        # Store-rollback bookkeeping: puts chain device-side, so a put
+        # batch that fails at sync must restore the last GOOD store or
+        # every later dhash op would consume the poisoned arrays
+        # forever. _store_epoch bumps on every rollback; a put launch
+        # records the epoch it chained under. On failure, a launch from
+        # the CURRENT epoch chained on a good store (restore it, bump
+        # epoch); a stale-epoch launch chained on a store a later
+        # rollback already discarded (skip — completions are FIFO, so
+        # the chain's first failure did the restore).
+        self._store_epoch = 0
+        # True while the dispatcher is between popping a batch and
+        # finishing its launch (for puts: the store swap). The
+        # caller-inline fast path must not run then — a fast-path get
+        # could read the pre-put store and break submit-order
+        # read-your-writes.
+        self._dispatching = False
+        # Kernel construction (jax import + jit wrappers, seconds on a
+        # cold process) must not stall submitters on the main lock.
+        self._kernel_lock = threading.Lock()
+
+        # Telemetry (engine-local; lock-protected by _lock).
+        self.batch_log: collections.deque = collections.deque(maxlen=1024)
+        self.batches_served = 0
+        self.requests_served = 0
+        self._fill_sum = 0.0
+        self._lat: Dict[str, collections.deque] = {
+            k: collections.deque(maxlen=8192) for k in KINDS}
+
+        # jit plumbing, built lazily (importing this module must not
+        # touch jax — overlay etiquette, jax_bridge docstring).
+        self._kernels: Dict[str, Any] = {}
+        self._trace_counts: Dict[str, int] = {k: 0 for k in KINDS}
+        self._warmup_trace_counts: Optional[Dict[str, int]] = None
+        self._late_errors: List[BaseException] = []
+
+        # Test hook: while set, the dispatcher parks before collecting a
+        # batch (deterministic backpressure / bucketing tests).
+        self._test_hold = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError(f"engine {self._name!r} is closed")
+            if self._started:
+                return self
+            self._started = True
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name=f"{self._name}-dispatch",
+            daemon=True)
+        self._completer = threading.Thread(
+            target=self._complete_loop, name=f"{self._name}-complete",
+            daemon=True)
+        self._dispatcher.start()
+        self._completer.start()
+        return self
+
+    def __enter__(self) -> "ServeEngine":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # Suppress nothing; on an exceptional exit still drain cleanly.
+        self.close(drain=exc_type is None)
+
+    def close(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the engine. drain=True serves every pending request
+        first; drain=False fails unserved requests with
+        EngineClosedError. Errors that never reached a caller (late
+        errors) re-raise here instead of dying in a worker thread."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closing = True
+            self._drain_on_close = drain
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            started = self._started
+        if started:
+            assert self._dispatcher is not None
+            self._dispatcher.join(timeout)
+            if self._dispatcher.is_alive():
+                raise TimeoutError("serve dispatcher did not stop "
+                                   f"within {timeout}s")
+            assert self._completer is not None
+            self._completer.join(timeout)
+            if self._completer.is_alive():
+                raise TimeoutError("serve completion thread did not stop "
+                                   f"within {timeout}s")
+        with self._lock:
+            self._closed = True
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for slot in leftovers:  # drain=False, or never started
+            slot.error = EngineClosedError("engine closed before serving")
+            slot.ev.set()
+        if self._late_errors:
+            raise self._late_errors[0]
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, kind: str, payload: tuple) -> _Slot:
+        """Enqueue one request; returns the slot to `wait()` on. Blocks
+        (backpressure, never drops) while max_queue requests pend."""
+        return self.submit_many(kind, [payload])[0]
+
+    def submit_many(self, kind: str, payloads: Sequence[tuple]
+                    ) -> List[_Slot]:
+        """Enqueue a list of same-kind requests contiguously (they share
+        batches up to bucket_max). Blocks for queue space as needed."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown request kind {kind!r}")
+        if kind == "find_successor" and self._state is None:
+            raise ValueError("engine has no RingState; find_successor "
+                             "requests need one")
+        if kind in ("dhash_get", "dhash_put") and (
+                self._state is None or self._store is None):
+            raise ValueError(f"engine has no RingState+FragmentStore; "
+                             f"{kind} requests need both")
+        if kind == "dhash_put":
+            # Validate AND normalize on the SUBMITTING thread: a
+            # malformed request failing at batch-build time would fail
+            # every innocent request coalesced into the same batch, so
+            # the converted int32 array (not the raw payload, which
+            # could be a nested list) is what rides to _launch.
+            import numpy as np
+            smax = int(self._store.max_segments)
+            m = self._ida[1]
+            normalized = []
+            for payload in payloads:
+                seg = np.asarray(payload[1], dtype=np.int32)
+                if seg.ndim != 2 or seg.shape[1] != m or seg.shape[0] > smax:
+                    raise ValueError(
+                        f"dhash_put segments must be [S<={smax}, {m}], "
+                        f"got {seg.shape}")
+                normalized.append((payload[0], seg) + tuple(payload[2:]))
+            payloads = normalized
+        if not self._started:
+            self.start()
+        slots = [_Slot(kind, p) for p in payloads]
+        # Caller-inline fast path: a single request hitting a fully
+        # idle engine (nothing pending or in flight, window at zero) is
+        # dispatched and completed on the SUBMITTING thread — the
+        # legacy bridge's leader model without the sleep, and without
+        # the two pipeline handoffs. dhash_put stays on the dispatcher:
+        # its read-modify-write of the store must never race a
+        # concurrently-dispatched put batch.
+        if len(slots) == 1 and kind != "dhash_put":
+            with self._lock:
+                fast = (not self._pending and self._inflight_n == 0
+                        and not self._dispatching
+                        and self._window_s == 0.0 and not self._fast_busy
+                        and not self._closing
+                        and not self._test_hold.is_set())
+                if fast:
+                    self._fast_busy = True
+            if fast:
+                try:
+                    handle = self._launch(slots)
+                    self._complete_one(slots, handle)
+                except BaseException as exc:  # noqa: BLE001 — fanned out
+                    self._deliver_error(slots, exc)
+                finally:
+                    self._fast_busy = False
+                return slots
+        i = 0
+        with self._lock:
+            while i < len(slots):
+                if self._closing or self._closed:
+                    if i == 0:
+                        raise EngineClosedError(
+                            f"engine {self._name!r} is shutting down")
+                    # A prefix is already enqueued (and will be drained
+                    # and APPLIED — puts mutate the store): the caller
+                    # must keep those handles, so fail only the
+                    # never-enqueued remainder and return the slots
+                    # instead of raising away the whole call.
+                    for slot in slots[i:]:
+                        slot.error = EngineClosedError(
+                            "engine closed before this request was "
+                            "admitted")
+                        slot.ev.set()
+                    break
+                space = self._max_queue - len(self._pending)
+                if space <= 0:
+                    self._not_full.wait(0.1)
+                    continue
+                take = slots[i:i + space]
+                self._pending.extend(take)
+                i += len(take)
+                self._not_empty.notify()
+        return slots
+
+    # -- blocking conveniences ---------------------------------------------
+
+    def find_successor(self, key: int, start_row: int,
+                       timeout: Optional[float] = None
+                       ) -> Tuple[int, int]:
+        """Resolve one key from one starting row; returns (owner_row,
+        hops) — byte-identical to a direct core.ring.find_successor lane
+        (owner -1 / hops -1 for a failed lookup, as the reference throws
+        'Lookup failed')."""
+        slot = self.submit(
+            "find_successor", (int(key) % KEYS_IN_RING, int(start_row)))
+        return slot.wait(timeout)
+
+    def finger_index(self, key: int, table_start: int,
+                     timeout: Optional[float] = None) -> int:
+        """Finger-table entry index for key on a table starting at
+        table_start (-1 for the zero-distance LookupError case)."""
+        slot = self.submit(
+            "finger_index",
+            (int(key) % KEYS_IN_RING, int(table_start) % KEYS_IN_RING))
+        return slot.wait(timeout)
+
+    def dhash_get(self, key: int, timeout: Optional[float] = None):
+        """Read one block: returns (segments [S, m] np.int32, ok)."""
+        slot = self.submit("dhash_get", (int(key) % KEYS_IN_RING,))
+        return slot.wait(timeout)
+
+    def dhash_put(self, key: int, segments, length: int, start_row: int,
+                  timeout: Optional[float] = None) -> bool:
+        """Store one block ([S<=max_segments, m] mod-p rows); returns
+        ok (>= m fragments placed, dhash_peer.cpp:126-128)."""
+        import numpy as np
+        seg = np.asarray(segments, dtype=np.int32)
+        slot = self.submit(
+            "dhash_put",
+            (int(key) % KEYS_IN_RING, seg, int(length), int(start_row)))
+        return slot.wait(timeout)
+
+    # -- warmup / recompile accounting -------------------------------------
+
+    def warmup(self, kinds: Optional[Sequence[str]] = None) -> Dict[str, int]:
+        """Pre-trace every (kind, bucket) program so the steady-state
+        serve loop never compiles. dhash_put warms against a THROWAWAY
+        empty store of identical shape (same compiled program, zero
+        store mutation). Returns traces per kind; after this,
+        `steady_state_retraces` must stay 0 — `assert_no_retraces()`
+        enforces it."""
+        import numpy as np
+
+        if kinds is None:
+            kinds = [k for k in KINDS if self._kind_available(k)]
+        for kind in kinds:
+            if not self._kind_available(kind):
+                raise ValueError(f"cannot warm {kind!r}: engine lacks "
+                                 "the state/store it needs")
+        for kind in kinds:
+            for b in self._buckets:
+                self._warm_one(kind, b, np)
+        with self._lock:
+            self._warmup_trace_counts = dict(self._trace_counts)
+        return dict(self._trace_counts)
+
+    def _kind_available(self, kind: str) -> bool:
+        if kind == "finger_index":
+            return True
+        if kind == "find_successor":
+            return self._state is not None
+        return self._state is not None and self._store is not None
+
+    def _warm_one(self, kind: str, b: int, np) -> None:
+        kern = self._get_kernels()
+        keys = np.zeros((b, 4), np.uint32)
+        if kind == "finger_index":
+            out = kern["finger_index"](kern["jnp"].asarray(keys),
+                                       kern["jnp"].asarray(keys))
+            np.asarray(out)
+        elif kind == "find_successor":
+            starts = np.zeros((b,), np.int32)
+            o, h = kern["find_successor"](
+                self._state, kern["jnp"].asarray(keys),
+                kern["jnp"].asarray(starts))
+            np.asarray(o), np.asarray(h)
+        elif kind == "dhash_get":
+            segs, ok = kern["dhash_get"](
+                self._state, self._store, kern["jnp"].asarray(keys))
+            np.asarray(ok)
+        elif kind == "dhash_put":
+            from p2p_dhts_tpu.dhash.store import empty_store
+            smax = int(self._store.max_segments)
+            shadow = empty_store(int(self._store.capacity), smax)
+            segments = np.zeros((b, smax, self._ida[1]), np.int32)
+            lengths = np.zeros((b,), np.int32)
+            starts = np.zeros((b,), np.int32)
+            _, ok = kern["dhash_put"](
+                self._state, shadow, kern["jnp"].asarray(keys),
+                kern["jnp"].asarray(segments), kern["jnp"].asarray(lengths),
+                kern["jnp"].asarray(starts))
+            np.asarray(ok)
+
+    @property
+    def trace_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._trace_counts)
+
+    @property
+    def steady_state_retraces(self) -> int:
+        """Traces since warmup() — 0 in a correctly-bucketed steady
+        state. -1 if warmup never ran (nothing to measure against)."""
+        with self._lock:
+            if self._warmup_trace_counts is None:
+                return -1
+            return sum(self._trace_counts.values()) - \
+                sum(self._warmup_trace_counts.values())
+
+    def assert_no_retraces(self) -> None:
+        n = self.steady_state_retraces
+        if n != 0:
+            raise AssertionError(
+                f"serve loop retraced {n} time(s) after warmup — a "
+                f"dispatch missed the pre-traced buckets")
+
+    # -- stats --------------------------------------------------------------
+
+    @property
+    def window_s(self) -> float:
+        return self._window_s
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def recent_latencies(self, kind: str, n: Optional[int] = None
+                         ) -> List[float]:
+        """Newest <= n request latencies (seconds, submit -> fan-out)
+        for one kind — the public window the bench's per-phase
+        percentiles are computed from (the engine also records every
+        sample into the metrics registry's serve.latency_ms.* hists)."""
+        with self._lock:
+            samples = list(self._lat[kind])
+        return samples if n is None else samples[-n:]
+
+    def _percentiles(self, samples, qs=(0.5, 0.99)):
+        from p2p_dhts_tpu.metrics import nearest_rank
+        s = sorted(samples)
+        return {q: nearest_rank(s, q) for q in qs}
+
+    def stats(self) -> dict:
+        with self._lock:
+            lat = {k: list(v) for k, v in self._lat.items()}
+            out = {
+                "queue_depth": len(self._pending),
+                "window_us": round(self._window_s * 1e6, 1),
+                "window_hwm_us": round(self._window_hwm_s * 1e6, 1),
+                "batches_served": self.batches_served,
+                "requests_served": self.requests_served,
+                "batch_fill_ratio": round(
+                    self._fill_sum / self.batches_served, 4)
+                if self.batches_served else None,
+                "trace_counts": dict(self._trace_counts),
+                "steady_state_retraces":
+                    sum(self._trace_counts.values()) -
+                    sum(self._warmup_trace_counts.values())
+                    if self._warmup_trace_counts is not None else -1,
+            }
+        for kind, samples in lat.items():
+            if not samples:
+                continue
+            ps = self._percentiles(samples)
+            out[f"latency_{kind}_p50_ms"] = round(ps[0.5] * 1e3, 3)
+            out[f"latency_{kind}_p99_ms"] = round(ps[0.99] * 1e3, 3)
+        return out
+
+    # -- kernels ------------------------------------------------------------
+
+    def _get_kernels(self) -> Dict[str, Any]:
+        if self._kernels:
+            return self._kernels
+        with self._kernel_lock:
+            if self._kernels:
+                return self._kernels
+            import numpy as np  # noqa: F401 — proves host deps resolve
+
+            import jax
+            import jax.numpy as jnp
+
+            from p2p_dhts_tpu.ops import u128
+
+            # Buffer donation frees the per-bucket key/start inputs for
+            # XLA reuse; CPU ignores donation with a warning per
+            # program, so only donate on real-device backends.
+            donate = jax.default_backend() in ("tpu", "axon")
+
+            def count(kind):
+                # Runs at TRACE time only: python side effects inside a
+                # jitted fn execute once per compilation, which is
+                # exactly the recompile counter the zero-retrace
+                # contract needs.
+                self._trace_counts[kind] += 1
+
+            def finger_index(keys, starts):
+                count("finger_index")
+                dist = u128.sub(keys, starts)
+                return u128.bit_length(dist) - 1
+
+            from p2p_dhts_tpu.core import ring as ring_mod
+
+            def find_succ(state, keys, starts):
+                count("find_successor")
+                return ring_mod.find_successor(state, keys, starts)
+
+            n, m, p = self._ida
+            from p2p_dhts_tpu.dhash import store as store_mod
+
+            def dhash_get(state, store, keys):
+                count("dhash_get")
+                return store_mod.read_batch(state, store, keys, n, m, p)
+
+            def dhash_put(state, store, keys, segments, lengths, starts):
+                count("dhash_put")
+                return store_mod.create_batch(
+                    state, store, keys, segments, lengths, starts, n, m, p)
+
+            self._kernels = {
+                "jnp": jnp,
+                "np": np,
+                "finger_index": jax.jit(
+                    finger_index,
+                    donate_argnums=(0, 1) if donate else ()),
+                "find_successor": jax.jit(
+                    find_succ,
+                    donate_argnums=(1, 2) if donate else ()),
+                "dhash_get": jax.jit(dhash_get),
+                # The store is NOT donated: puts chain device-side and a
+                # failed dispatch must leave the previous store intact.
+                "dhash_put": jax.jit(
+                    dhash_put, donate_argnums=(2, 3, 4, 5) if donate
+                    else ()),
+            }
+        return self._kernels
+
+    # -- dispatch loop ------------------------------------------------------
+
+    def _bucket_for(self, size: int) -> int:
+        for b in self._buckets:
+            if b >= size:
+                return b
+        return self._bucket_max
+
+    def _dispatch_loop(self) -> None:
+        batch: List[_Slot] = []
+        try:
+            while True:
+                with self._lock:
+                    while not self._pending and not self._closing:
+                        self._not_empty.wait()
+                    if self._closing and (
+                            not self._pending or not self._drain_on_close):
+                        break
+                while self._test_hold.is_set() and not self._closing:
+                    time.sleep(0.001)
+                self._collect_window()
+                batch = self._pop_batch()
+                if not batch:
+                    continue
+                try:
+                    self._adapt_window(batch)
+                    try:
+                        handle = self._launch(batch)
+                    except BaseException as exc:  # noqa: BLE001 — fanned
+                        self._deliver_error(batch, exc)
+                        batch = []
+                        continue
+                finally:
+                    # Launch done (for puts: store swapped): the
+                    # caller-inline fast path may run again.
+                    with self._lock:
+                        self._dispatching = False
+                with self._lock:
+                    idle = self._inflight_n == 0 and not self._pending
+                    if not idle:
+                        self._inflight_n += 1
+                if idle:
+                    # Nothing in flight and nothing queued: sync + fan
+                    # out right here instead of paying a thread handoff
+                    # (the uncontended-latency path). Under load the
+                    # handoff buys pipelining, so it stays.
+                    self._complete_one(batch, handle)
+                else:
+                    self._inflight.put((batch, handle))
+                batch = []  # handed off; not ours to fail anymore
+        except BaseException as exc:  # noqa: BLE001 — engine is wedged
+            self._late_errors.append(exc)
+        finally:
+            self._inflight.put(_SENTINEL)
+            # A dead dispatcher must not keep accepting work: flip
+            # closing so submits raise instead of enqueueing requests
+            # no thread will ever serve (a crash here otherwise hangs
+            # timeout-less callers like the finger-table wire path).
+            with self._lock:
+                self._closing = True
+                leftovers = batch + list(self._pending)
+                self._pending.clear()
+                self._not_full.notify_all()
+            for slot in leftovers:
+                # Guard: a popped-but-served batch slot must not be
+                # overwritten (leftovers from _pending are never set).
+                if not slot.ev.is_set():
+                    slot.error = EngineClosedError(
+                        "engine stopped before serving this request")
+                    slot.ev.set()
+
+    def _collect_window(self) -> None:
+        """Coalescing wait: sleep the adaptive window in fine slices,
+        bailing as soon as a full bucket is pending (or shutdown)."""
+        window = self._window_s
+        if window <= 0:
+            return
+        deadline = time.perf_counter() + window
+        while True:
+            with self._lock:
+                if len(self._pending) >= self._bucket_max or self._closing:
+                    return
+            rem = deadline - time.perf_counter()
+            if rem <= 0:
+                return
+            time.sleep(min(rem, self._POLL_S))
+
+    def _pop_batch(self) -> List[_Slot]:
+        """Head run of same-kind requests, up to bucket_max — FIFO
+        across kinds, so a get submitted after a put completes against
+        the post-put store."""
+        with self._lock:
+            if not self._pending:
+                return []
+            kind = self._pending[0].kind
+            batch = []
+            while (self._pending and len(batch) < self._bucket_max
+                   and self._pending[0].kind == kind):
+                batch.append(self._pending.popleft())
+            # Popping may leave the queue empty while the batch is not
+            # yet launched; block the fast path until the launch (and
+            # for puts, the store swap) is done. No call that can raise
+            # may follow the pop in here — a popped batch must already
+            # be owned by the dispatcher's local so the crash path can
+            # fail its slots (metrics gauges happen in _adapt_window).
+            self._dispatching = True
+            self._not_full.notify_all()
+        return batch
+
+    def _adapt_window(self, batch: List[_Slot]) -> None:
+        with self._lock:
+            backlog = len(self._pending)
+        if len(batch) > 1 or backlog > 0:
+            self._window_s = min(
+                self._window_cap_s,
+                max(self._window_s * 2.0, self._WINDOW_GROW_FLOOR_S))
+        else:
+            w = self._window_s * 0.25
+            self._window_s = 0.0 if w < self._WINDOW_ZERO_BELOW_S else w
+        self._window_hwm_s = max(self._window_hwm_s, self._window_s)
+        self._metrics.gauge("serve.window_us", self._window_s * 1e6)
+        self._metrics.gauge("serve.queue_depth", backlog)
+
+    def _launch(self, batch: List[_Slot]):
+        """Build padded device inputs and launch the kernel (async).
+        Returns an opaque handle the completion thread syncs + fans
+        out. Pad lanes replicate the first request — semantically a
+        repeat, never a new action (module docstring)."""
+        from p2p_dhts_tpu import keyspace
+        kern = self._get_kernels()
+        jnp, np = kern["jnp"], kern["np"]
+        kind = batch[0].kind
+        size = len(batch)
+        bucket = self._bucket_for(size)
+        pad = bucket - size
+
+        with self._lock:
+            self.batch_log.append((kind, size, bucket))
+            self.batches_served += 1
+            self.requests_served += size
+            self._fill_sum += size / bucket
+        self._metrics.inc(f"serve.requests.{kind}", size)
+        self._metrics.inc("serve.batches")
+        self._metrics.gauge("serve.batch_fill", size / bucket)
+
+        if kind == "finger_index":
+            key_ints = [s.payload[0] for s in batch]
+            start_ints = [s.payload[1] for s in batch]
+            key_ints += [key_ints[0]] * pad
+            start_ints += [start_ints[0]] * pad
+            keys = jnp.asarray(keyspace.ints_to_lanes(key_ints))
+            starts = jnp.asarray(keyspace.ints_to_lanes(start_ints))
+            return ("finger_index", kern["finger_index"](keys, starts))
+
+        if kind == "find_successor":
+            key_ints = [s.payload[0] for s in batch]
+            rows = [s.payload[1] for s in batch]
+            key_ints += [key_ints[0]] * pad
+            rows += [rows[0]] * pad
+            keys = jnp.asarray(keyspace.ints_to_lanes(key_ints))
+            starts = jnp.asarray(np.asarray(rows, np.int32))
+            owner, hops = kern["find_successor"](self._state, keys, starts)
+            return ("find_successor", owner, hops)
+
+        if kind == "dhash_get":
+            key_ints = [s.payload[0] for s in batch]
+            key_ints += [key_ints[0]] * pad
+            keys = jnp.asarray(keyspace.ints_to_lanes(key_ints))
+            segs, ok = kern["dhash_get"](self._state, self._store, keys)
+            return ("dhash_get", segs, ok)
+
+        # dhash_put: payload (key, segments [S, m] i32, length, start).
+        with self._lock:
+            prev_store = self._store
+            epoch = self._store_epoch
+        smax = int(prev_store.max_segments)
+        m = self._ida[1]
+        key_ints = [s.payload[0] for s in batch]
+        key_ints += [key_ints[0]] * pad
+        seg_stack = np.zeros((bucket, smax, m), np.int32)
+        for j, slot in enumerate(batch):
+            # Shape/dtype were validated + normalized on the SUBMITTING
+            # thread (submit_many) so a malformed request can never
+            # reach a batch and fail innocent coalesced requests.
+            seg = slot.payload[1]
+            seg_stack[j, :seg.shape[0], :] = seg
+        lengths = [s.payload[2] for s in batch]
+        rows = [s.payload[3] for s in batch]
+        if pad:
+            seg_stack[size:] = seg_stack[0]
+            lengths += [lengths[0]] * pad
+            rows += [rows[0]] * pad
+        keys = jnp.asarray(keyspace.ints_to_lanes(key_ints))
+        new_store, ok = kern["dhash_put"](
+            self._state, prev_store, keys, jnp.asarray(seg_stack),
+            jnp.asarray(np.asarray(lengths, np.int32)),
+            jnp.asarray(np.asarray(rows, np.int32)))
+        # Chain the store for the NEXT dispatch device-side (async
+        # value: XLA sequences the data dependency, no host sync). The
+        # handle keeps prev_store + epoch so a failure at sync can roll
+        # back instead of leaving the poisoned arrays in place. Install
+        # only if no rollback happened since the capture above — a
+        # concurrent completion failure may have just restored the last
+        # good store, and this batch (chained on the discarded store)
+        # must not clobber the restore; it will fail at its own sync.
+        with self._lock:
+            if epoch == self._store_epoch:
+                self._store = new_store
+        return ("dhash_put", ok, prev_store, epoch)
+
+    # -- completion loop ----------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is _SENTINEL:
+                return
+            batch, handle = item
+            try:
+                self._complete_one(batch, handle)
+            finally:
+                with self._lock:
+                    self._inflight_n -= 1
+
+    def _complete_one(self, batch: List[_Slot], handle) -> None:
+        """Device->host sync + fan-out for one launched batch (runs on
+        the completion thread, or inline on the dispatcher when the
+        engine is idle)."""
+        import numpy as np
+        try:
+            kind = handle[0]
+            if kind == "finger_index":
+                idx = np.asarray(handle[1])
+                for j, slot in enumerate(batch):
+                    slot.result = int(idx[j])
+            elif kind == "find_successor":
+                owner = np.asarray(handle[1])
+                hops = np.asarray(handle[2])
+                for j, slot in enumerate(batch):
+                    slot.result = (int(owner[j]), int(hops[j]))
+            elif kind == "dhash_get":
+                segs = np.asarray(handle[1])
+                ok = np.asarray(handle[2])
+                for j, slot in enumerate(batch):
+                    slot.result = (segs[j], bool(ok[j]))
+            else:  # dhash_put
+                ok = np.asarray(handle[1])
+                for j, slot in enumerate(batch):
+                    slot.result = bool(ok[j])
+        except BaseException as exc:  # noqa: BLE001 — fanned out
+            if handle[0] == "dhash_put":
+                # The device computation failed AFTER self._store was
+                # swapped to its (poisoned) output; restore the last
+                # good store. A launch from the CURRENT epoch chained
+                # on a good store -> restore it and bump the epoch; a
+                # stale-epoch launch chained on a store some earlier
+                # rollback already discarded (completions are FIFO, so
+                # that chain's first failure did the restore) -> skip.
+                # Known residual (double-fault only): if a failure does
+                # NOT poison its output buffers (e.g. a transient
+                # host-transfer error on the ok array alone), a LATER
+                # pipelined put chained on them can still succeed after
+                # the rollback discarded its install — its acknowledged
+                # writes are then absent from the served store. Exact
+                # recovery under arbitrary partial device failures
+                # needs a redo log; callers needing that serialize
+                # puts (wait for each ok) or rebuild the store.
+                _, _, prev_store, epoch = handle
+                with self._lock:
+                    if epoch == self._store_epoch:
+                        self._store = prev_store
+                        self._store_epoch += 1
+            self._deliver_error(batch, exc)
+            return
+        now = time.perf_counter()
+        kind = batch[0].kind
+        lats = [now - slot.t_submit for slot in batch]
+        with self._lock:
+            self._lat[kind].extend(lats)
+        self._metrics.observe_hist_many(
+            f"serve.latency_ms.{kind}", [v * 1e3 for v in lats])
+        for slot in batch:
+            slot.ev.set()
+
+    def _deliver_error(self, batch: List[_Slot], exc: BaseException) -> None:
+        """Fan an error out to every waiting caller in the batch; if
+        NOBODY was left to receive it, keep it as a late error so
+        close() re-raises instead of dropping (the jax_bridge _serve
+        fix, generalized)."""
+        delivered = 0
+        for slot in batch:
+            if not slot.ev.is_set():
+                slot.error = exc
+                slot.ev.set()
+                delivered += 1
+        self._metrics.inc("serve.errors")
+        if delivered == 0:
+            self._late_errors.append(exc)
+
+
+# ---------------------------------------------------------------------------
+# process-global finger engine (the overlay bridge's backend)
+# ---------------------------------------------------------------------------
+
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_FINGER_ENGINE: Optional[ServeEngine] = None
+
+
+def global_finger_engine() -> ServeEngine:
+    """The shared per-process engine serving "finger_index" for every
+    backend="jax" FingerTable: lookups batch ACROSS tables (the legacy
+    DeviceFingerResolver coalesced per table only) and solo lookups pay
+    ~zero window instead of the fixed 1 ms sleep."""
+    global _GLOBAL_FINGER_ENGINE
+    with _GLOBAL_LOCK:
+        if _GLOBAL_FINGER_ENGINE is None:
+            _GLOBAL_FINGER_ENGINE = ServeEngine(
+                bucket_min=64, bucket_max=1024, window_cap_s=0.001,
+                name="finger-serve")
+        return _GLOBAL_FINGER_ENGINE
+
+
+class EngineFingerResolver:
+    """Drop-in for jax_bridge.DeviceFingerResolver with the same
+    `lookup_index` contract, routed through a ServeEngine. Telemetry
+    attrs (`keys_served`) are per-resolver; batch-level telemetry lives
+    on the shared engine (requests from many tables share batches)."""
+
+    def __init__(self, starting_key: int,
+                 engine: Optional[ServeEngine] = None):
+        self._start_int = int(starting_key) % KEYS_IN_RING
+        self._engine = engine if engine is not None \
+            else global_finger_engine()
+        self.keys_served = 0
+
+    @property
+    def engine(self) -> ServeEngine:
+        return self._engine
+
+    def lookup_index(self, key_int: int) -> int:
+        idx = self._engine.finger_index(key_int, self._start_int)
+        self.keys_served += 1
+        return idx

@@ -10,6 +10,7 @@ scan exactly.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -130,6 +131,126 @@ def test_lookup_degrades_to_host_closed_form_when_device_fails():
     want = _full_table(start, "python")
     for k in (start + 1, start + (1 << 64), start - 1):
         assert ft.lookup(Key(k)).port == want.lookup(Key(k)).port
+
+
+def test_solo_leader_skips_coalescing_window():
+    """ADVICE r5 #1: an uncontended lookup must not pay the full fixed
+    window. With a 200 ms window, a solo lookup returning in well under
+    half the window proves the sleep was skipped."""
+    r = DeviceFingerResolver(0, window_s=0.2)
+    r.lookup_index(1)  # warm the kernel outside the timed window
+    t0 = time.perf_counter()
+    assert r.lookup_index(2) == 1
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.1, (
+        f"solo lookup took {elapsed * 1e3:.1f} ms — the 200 ms "
+        f"coalescing window was not skipped")
+
+
+def test_concurrent_leaders_still_coalesce_after_solo_skip():
+    """The solo-skip must not break coalescing: the leader re-checks
+    after the grace period and still sleeps the window when others are
+    pending (covered end-to-end by
+    test_concurrent_lookups_coalesce_into_one_device_batch; this pins
+    the re-check path directly)."""
+    r = DeviceFingerResolver(0, window_s=0.15)
+    r.lookup_index(1)  # warm
+    results = {}
+    lock = threading.Lock()
+
+    def worker(k):
+        idx = r.lookup_index(k)
+        with lock:
+            results[k] = idx
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(1, 9)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {k: int(k).bit_length() - 1 for k in range(1, 9)}
+    assert max(r.batch_sizes) > 1, "no coalescing happened at all"
+
+
+def test_serve_reraises_error_after_all_slots_served(monkeypatch):
+    """ADVICE r5 #2 regression: an exception raised after every slot
+    was served (nobody left to deliver it to) must re-raise, not
+    vanish. Fault injection: a batch whose single slot is already
+    served, plus a kernel loader that raises."""
+    from p2p_dhts_tpu.overlay import jax_bridge
+
+    def exploding_loader():
+        raise RuntimeError("injected post-serve failure")
+
+    monkeypatch.setattr(jax_bridge, "_load_kernel", exploding_loader)
+    r = DeviceFingerResolver(0, window_s=0.0)
+    served = {"ev": threading.Event(), "index": 3}
+    served["ev"].set()
+    with pytest.raises(RuntimeError, match="injected post-serve"):
+        r._serve([(1, served)])
+    # Delivered errors still fan out (and do NOT re-raise) when a slot
+    # is waiting.
+    waiting = {"ev": threading.Event()}
+    r._serve([(1, waiting)])
+    assert isinstance(waiting["error"], RuntimeError)
+    assert waiting["ev"].is_set()
+
+
+# ---------------------------------------------------------------------------
+# finger-table degradation visibility (ADVICE r5 #3)
+# ---------------------------------------------------------------------------
+
+class _ExplodingResolver:
+    def __init__(self):
+        self.calls = 0
+
+    def lookup_index(self, key_int):
+        self.calls += 1
+        raise RuntimeError("backend unavailable (simulated tunnel)")
+
+
+class _ClosedFormResolver:
+    def __init__(self, start):
+        self._start = start
+
+    def lookup_index(self, key_int):
+        dist = (key_int - self._start) % KEYS_IN_RING
+        return dist.bit_length() - 1 if dist else -1
+
+
+def test_degraded_flag_set_and_lookups_keep_serving():
+    start = 1357
+    ft = _full_table(start, "jax")
+    ft._resolver = _ExplodingResolver()
+    want = _full_table(start, "python")
+    assert ft.degraded is False
+    for k in (start + 1, start + (1 << 64), start - 1):
+        assert ft.lookup(Key(k)).port == want.lookup(Key(k)).port
+    assert ft.degraded is True
+    # Within the retry interval the failing device path is NOT
+    # re-probed on every lookup (the fallback is a fast path, not a
+    # per-request exception storm).
+    assert ft._resolver.calls == 1
+
+
+def test_degraded_recovers_on_periodic_retry():
+    start = 2468
+    ft = _full_table(start, "jax")
+    ft._resolver = _ExplodingResolver()
+    ft.lookup(Key(start + 5))
+    assert ft.degraded is True
+    # Retry window still open: device path stays benched.
+    ft.lookup(Key(start + 6))
+    assert ft.degraded is True and ft._resolver.calls == 1
+    # Force the retry due, hand back a working resolver: the next
+    # lookup re-probes the device path and clears the flag.
+    ft._resolver = _ClosedFormResolver(start)
+    ft._retry_at = 0.0
+    want = _full_table(start, "python")
+    assert ft.lookup(Key(start + 7)).port == want.lookup(
+        Key(start + 7)).port
+    assert ft.degraded is False
 
 
 def test_resolver_chunks_oversize_batches(monkeypatch):

@@ -41,6 +41,37 @@ def test_thread_safety():
     assert snap["timers"]["t"]["count"] == 8000
 
 
+def test_gauges_and_histograms():
+    m = Metrics()
+    # Back-compat: without gauges/hists the snapshot keeps the exact
+    # historical two-section shape.
+    assert m.snapshot() == {"counters": {}, "timers": {}}
+    m.gauge("serve.queue_depth", 3)
+    m.gauge("serve.queue_depth", 7)  # last write wins
+    for v in [1.0, 2.0, 3.0, 4.0, 100.0]:
+        m.observe_hist("lat", v)
+    snap = m.snapshot()
+    assert snap["gauges"]["serve.queue_depth"] == 7
+    h = snap["hists"]["lat"]
+    assert h["count"] == 5 and h["max"] == 100.0
+    assert h["p50"] == 3.0
+    p50, p99 = m.quantiles("lat")
+    assert p50 == 3.0 and p99 == 100.0
+    assert m.quantiles("nope") == (None, None)
+    m.reset()
+    assert m.snapshot() == {"counters": {}, "timers": {}}
+
+
+def test_hist_reservoir_is_bounded():
+    m = Metrics()
+    for v in range(Metrics.HIST_CAP + 500):
+        m.observe_hist("x", float(v))
+    snap = m.snapshot()
+    assert snap["hists"]["x"]["count"] == Metrics.HIST_CAP
+    # Newest samples win: the minimum retained value is 500.
+    assert m.quantiles("x", (0.0,))[0] == 500.0
+
+
 def test_rpc_layer_records_metrics():
     """The server counts dispatched commands + errors; the client times
     requests — the instrumentation the reference's request log lacks."""

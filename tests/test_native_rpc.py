@@ -465,3 +465,45 @@ int main() {
          str(src), "-o", str(exe)],
         check=True, capture_output=True, text=True)
     subprocess.run([str(exe)], check=True)
+
+
+def test_json_doubles_are_locale_independent(tmp_path):
+    """ADVICE r5 #4: double emission/parsing must be pinned to the C
+    numeric locale — under a ','-decimal LC_NUMERIC (de_DE/fr_FR) an
+    unpinned snprintf/strtod would emit invalid JSON bytes and mis-parse
+    valid ones. Driven at the C++ level under a forced comma locale;
+    SKIPs (exit 77) when no such locale is installed on the host."""
+    import subprocess
+    from p2p_dhts_tpu.net import native_rpc
+
+    src = tmp_path / "locale_check.cc"
+    src.write_text(r'''
+#include <cassert>
+#include <clocale>
+#include <string>
+#include "json.h"
+int main() {
+  const char* cands[] = {"de_DE.UTF-8", "de_DE.utf8", "de_DE",
+                         "fr_FR.UTF-8", "fr_FR.utf8", "fr_FR"};
+  const char* got = nullptr;
+  for (const char* c : cands)
+    if ((got = std::setlocale(LC_NUMERIC, c))) break;
+  if (!got) return 77;  // no comma-decimal locale installed: skip
+  assert(ns::dumps(ns::Jv::of(1.5)) == "1.5");
+  ns::Jv parsed; std::string err;
+  assert(ns::parse_all("[2.75,1e-7]", parsed, &err));
+  assert(parsed.arr[0].d == 2.75);
+  assert(parsed.arr[1].d == 1e-7);
+  assert(ns::dumps(parsed) == "[2.75,1e-07]");
+  return 0;
+}
+''')
+    exe = tmp_path / "locale_check"
+    subprocess.run(
+        ["g++", "-std=c++17", "-I", native_rpc._NATIVE_DIR,
+         str(src), "-o", str(exe)],
+        check=True, capture_output=True, text=True)
+    rc = subprocess.run([str(exe)]).returncode
+    if rc == 77:
+        pytest.skip("no comma-decimal locale installed on this host")
+    assert rc == 0

@@ -1,0 +1,490 @@
+"""ServeEngine: the batched request-serving engine (ISSUE 2 tentpole).
+
+Pins the four mechanisms against their reference-behavior obligations
+(serve.py module docstring): adaptive coalescing (solo window converges
+to zero, concurrent load grows it), shape bucketing (every dispatch
+pads to a pre-traced power-of-two bucket; zero steady-state retraces),
+pipelined dispatch with bounded admission (backpressure blocks, never
+drops), and the drain/shutdown path (in-flight requests served, late
+errors re-raised). Route/hop parity of engine-served lookups against
+direct find_successor is the non-negotiable: batching is scheduling,
+never semantics.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring, find_successor, keys_from_ints
+from p2p_dhts_tpu.dhash.store import empty_store, read_batch
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.serve import (
+    EngineClosedError,
+    EngineFingerResolver,
+    ServeEngine,
+)
+
+N_PEERS = 64
+IDA_N, IDA_M, IDA_P = 14, 10, 257
+SMAX = 4
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def ring_state():
+    rng = np.random.RandomState(20260729)
+    return build_ring(_rand_ids(rng, N_PEERS),
+                      RingConfig(finger_mode="materialized"))
+
+
+@pytest.fixture(scope="module")
+def engine(ring_state):
+    """One warmed engine shared by the read-only tests (warmup compiles
+    every (kind, bucket) program once for the whole module)."""
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=4096, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P,
+                      window_cap_s=0.001, bucket_min=4, bucket_max=16,
+                      max_queue=4096)
+    eng.start()
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# smoke (tier-1's fast canary: no module-fixture warmup cost, < 5 s)
+# ---------------------------------------------------------------------------
+
+def test_engine_smoke_fast():
+    """Self-contained serve-path canary: one tiny single-bucket engine,
+    stateless finger_index op (cheapest compile), submit -> batch ->
+    dispatch -> fan-out -> clean close."""
+    with ServeEngine(bucket_min=8, bucket_max=8, name="smoke") as eng:
+        keys = [7, 1 << 64, (1 << 128) - 1]
+        slots = eng.submit_many("finger_index", [(k, 0) for k in keys])
+        got = [s.wait(30) for s in slots]
+        assert got == [int(k).bit_length() - 1 for k in keys]
+        assert eng.batches_served >= 1
+        assert eng.queue_depth == 0
+
+
+# ---------------------------------------------------------------------------
+# parity (the non-negotiable)
+# ---------------------------------------------------------------------------
+
+def test_parity_engine_vs_direct_1000_keys(engine, ring_state):
+    """Engine-served lookups return byte-identical owners and hop
+    counts to direct find_successor over >= 1000 keys (mixed batch
+    sizes: 1000 requests split across the 16- and 8-buckets)."""
+    rng = np.random.RandomState(7)
+    key_ints = _rand_ids(rng, 1000)
+    starts_np = rng.randint(0, N_PEERS, size=1000).astype(np.int32)
+
+    slots = engine.submit_many(
+        "find_successor",
+        [(k, int(s)) for k, s in zip(key_ints, starts_np)])
+    got = [s.wait(120) for s in slots]
+
+    owner, hops = find_successor(ring_state, keys_from_ints(key_ints),
+                                 jnp.asarray(starts_np))
+    owner, hops = np.asarray(owner), np.asarray(hops)
+    for j, (o, h) in enumerate(got):
+        assert o == int(owner[j]), f"owner diverges at lane {j}"
+        assert h == int(hops[j]), f"hops diverge at lane {j}"
+    # The whole mixed-size workload hit pre-traced buckets.
+    engine.assert_no_retraces()
+
+
+def test_solo_and_batched_results_identical(engine):
+    """A request's answer must not depend on its batch: serve the same
+    key solo and inside a coalesced batch."""
+    key = 0xDEADBEEF << 64
+    solo = engine.find_successor(key, 3, timeout=60)
+    slots = engine.submit_many("find_successor",
+                               [(key + j, 3) for j in range(11)]
+                               + [(key, 3)])
+    batched = slots[-1].wait(60)
+    assert solo == batched
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing
+# ---------------------------------------------------------------------------
+
+def test_bucket_boundary_single_request(engine):
+    engine.find_successor(123456789, 0, timeout=60)
+    kind, size, bucket = engine.batch_log[-1]
+    assert (kind, size, bucket) == ("find_successor", 1, 4)
+
+
+def test_bucket_boundary_exact_max(engine):
+    """b == bucket_max fills one batch exactly (hold the dispatcher so
+    all requests are pending before collection)."""
+    engine._test_hold.set()
+    try:
+        slots = engine.submit_many("find_successor",
+                                   [(j, 0) for j in range(1, 17)])
+    finally:
+        engine._test_hold.clear()
+    for s in slots:
+        s.wait(60)
+    assert ("find_successor", 16, 16) in list(engine.batch_log)[-2:]
+
+
+def test_bucket_overflow_splits(engine):
+    """b > bucket_max splits: 17 pending requests dispatch as a full
+    16-batch plus a 1-batch in the smallest bucket."""
+    engine._test_hold.set()
+    try:
+        slots = engine.submit_many("find_successor",
+                                   [(j, 0) for j in range(1, 18)])
+    finally:
+        engine._test_hold.clear()
+    for s in slots:
+        s.wait(60)
+    tail = list(engine.batch_log)[-2:]
+    assert tail == [("find_successor", 16, 16), ("find_successor", 1, 4)]
+    engine.assert_no_retraces()
+
+
+# ---------------------------------------------------------------------------
+# adaptive coalescing window
+# ---------------------------------------------------------------------------
+
+def test_window_converges_to_zero_when_solo(engine):
+    for j in range(8):
+        engine.find_successor(j + 1, 0, timeout=60)
+    assert engine.window_s == 0.0
+
+
+def test_window_grows_under_concurrent_load(engine):
+    stop = threading.Event()
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            engine.find_successor(
+                int.from_bytes(rng.bytes(16), "little"), 0, timeout=60)
+
+    threads = [threading.Thread(target=worker, args=(j,)) for j in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while (engine._window_hwm_s < engine._WINDOW_GROW_FLOOR_S
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert engine._window_hwm_s >= engine._WINDOW_GROW_FLOOR_S, \
+        "adaptive window never grew under 6 concurrent callers"
+    engine.assert_no_retraces()
+
+
+# ---------------------------------------------------------------------------
+# dhash through the engine
+# ---------------------------------------------------------------------------
+
+def test_dhash_put_get_roundtrip(engine, ring_state):
+    rng = np.random.RandomState(11)
+    keys = _rand_ids(rng, 12)
+    blocks = {}
+    put_slots = []
+    for k in keys:
+        seg = rng.randint(0, 256, size=(SMAX, IDA_M)).astype(np.int32)
+        blocks[k] = seg
+        put_slots.append(engine.submit("dhash_put", (k, seg, SMAX, 0)))
+    assert all(s.wait(120) for s in put_slots), "puts failed"
+    for k in keys:
+        out, ok = engine.dhash_get(k, timeout=120)
+        assert ok and (out == blocks[k]).all()
+    # Cross-check one key against the direct device read path.
+    out_direct, ok_direct = read_batch(
+        ring_state, engine._store, keys_from_ints([keys[0]]),
+        IDA_N, IDA_M, IDA_P)
+    assert bool(np.asarray(ok_direct)[0])
+    assert (np.asarray(out_direct)[0] == blocks[keys[0]]).all()
+
+
+def test_dhash_put_bad_shape_rejected_at_submit(engine):
+    """Malformed puts fail on the SUBMITTING thread — they must never
+    reach a batch where they would fail innocent coalesced requests."""
+    with pytest.raises(ValueError, match="segments must be"):
+        engine.submit("dhash_put",
+                      (1, np.zeros((SMAX, IDA_M + 1), np.int32), SMAX, 0))
+    with pytest.raises(ValueError, match="segments must be"):
+        engine.dhash_put(2, np.zeros((SMAX + 1, IDA_M), np.int32), SMAX, 0)
+
+
+def test_put_failure_rolls_back_store(ring_state):
+    """A put batch that fails at device sync must NOT leave its
+    poisoned output as the engine store: the pre-batch store is
+    restored, earlier data stays readable, later puts land."""
+    eng = ServeEngine(ring_state, empty_store(capacity=1024,
+                                              max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P,
+                      bucket_min=4, bucket_max=4, name="rollback")
+    eng.start()
+    eng.warmup(["dhash_put", "dhash_get"])
+    rng = np.random.RandomState(21)
+    k1, k2, k3 = _rand_ids(rng, 3)
+    seg1 = rng.randint(0, 256, size=(SMAX, IDA_M)).astype(np.int32)
+    seg3 = rng.randint(0, 256, size=(SMAX, IDA_M)).astype(np.int32)
+    try:
+        assert eng.dhash_put(k1, seg1, SMAX, 0, timeout=120)
+
+        class _BoomArray:
+            def __array__(self, dtype=None):
+                raise RuntimeError("injected device failure at sync")
+
+        real_kernel = eng._kernels["dhash_put"]
+        eng._kernels["dhash_put"] = \
+            lambda *a, **kw: ("poisoned-store", _BoomArray())
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            eng.dhash_put(k2, seg1, SMAX, 0, timeout=120)
+        # A SECOND failing put launched after the rollback must roll
+        # back too (it chained on the restored store, a fresh epoch —
+        # not a member of the first failure's chain).
+        with pytest.raises(RuntimeError, match="injected device failure"):
+            eng.dhash_put(k2, seg1, SMAX, 0, timeout=120)
+        eng._kernels["dhash_put"] = real_kernel
+
+        out, ok = eng.dhash_get(k1, timeout=120)
+        assert ok and (out == seg1).all(), "rollback lost earlier data"
+        assert eng.dhash_put(k3, seg3, SMAX, 0, timeout=120)
+        out, ok = eng.dhash_get(k3, timeout=120)
+        assert ok and (out == seg3).all()
+    finally:
+        eng.close()
+
+
+def test_dhash_get_missing_key_reports_not_ok(engine):
+    _, ok = engine.dhash_get(0x5EED, timeout=120)
+    assert ok is False
+
+
+def test_dhash_fifo_read_your_writes(engine):
+    """A get submitted after a put of the same key (same queue, held so
+    they land in consecutive batches) sees the put's data — FIFO
+    head-run dispatch keeps cross-kind submission order."""
+    rng = np.random.RandomState(13)
+    k = int.from_bytes(rng.bytes(16), "little")
+    seg = rng.randint(0, 256, size=(SMAX, IDA_M)).astype(np.int32)
+    engine._test_hold.set()
+    try:
+        pslot = engine.submit("dhash_put", (k, seg, SMAX, 0))
+        gslot = engine.submit("dhash_get", (k,))
+    finally:
+        engine._test_hold.clear()
+    assert pslot.wait(120) is True
+    out, ok = gslot.wait(120)
+    assert ok and (out == seg).all()
+
+
+# ---------------------------------------------------------------------------
+# admission control / shutdown
+# ---------------------------------------------------------------------------
+
+def test_backpressure_blocks_not_drops():
+    eng = ServeEngine(bucket_min=4, bucket_max=4, max_queue=4,
+                      name="bp").start()
+    try:
+        eng._test_hold.set()
+        eng.submit_many("finger_index", [(j + 1, 0) for j in range(4)])
+        done = threading.Event()
+        extra = {}
+
+        def submit_fifth():
+            extra["slot"] = eng.submit("finger_index", (99, 0))
+            done.set()
+
+        t = threading.Thread(target=submit_fifth)
+        t.start()
+        assert not done.wait(0.3), \
+            "submit into a full queue returned instead of blocking"
+        eng._test_hold.clear()
+        assert done.wait(30), "blocked submit never unblocked"
+        assert extra["slot"].wait(30) == int(99).bit_length() - 1
+        t.join()
+    finally:
+        eng._test_hold.clear()
+        eng.close()
+
+
+def test_clean_shutdown_drains_inflight_requests():
+    eng = ServeEngine(bucket_min=4, bucket_max=4, name="drain").start()
+    eng._test_hold.set()
+    slots = eng.submit_many("finger_index", [(j + 1, 0) for j in range(10)])
+    # close(drain=True) releases the hold via _closing and must serve
+    # every pending request before the threads exit.
+    eng.close(drain=True)
+    assert [s.wait(0) for s in slots] == \
+        [int(j + 1).bit_length() - 1 for j in range(10)]
+    with pytest.raises(EngineClosedError):
+        eng.submit("finger_index", (1, 0))
+
+
+def test_close_without_drain_fails_pending():
+    eng = ServeEngine(bucket_min=4, bucket_max=4, name="nodrain").start()
+    eng._test_hold.set()
+    slots = eng.submit_many("finger_index", [(j + 1, 0) for j in range(6)])
+    eng.close(drain=False)
+    for s in slots:
+        with pytest.raises(EngineClosedError):
+            s.wait(0)
+
+
+def test_late_error_reraises_on_close():
+    """An error nobody was left to receive (every slot already served)
+    must surface from close(), not die in a worker thread."""
+    eng = ServeEngine(bucket_min=4, bucket_max=4, name="late").start()
+    slot = eng.submit("finger_index", (5, 0))
+    assert slot.wait(30) == 2
+    boom = RuntimeError("late failure after fan-out")
+    eng._deliver_error([slot], boom)  # delivered to nobody: slot is set
+    with pytest.raises(RuntimeError, match="late failure"):
+        eng.close()
+
+
+def test_dispatcher_crash_fails_requests_and_closes_engine():
+    """A dispatcher-thread crash (here: a metrics backend raising on
+    the dispatch path) must fail the in-flight batch, flip the engine
+    closed so new submits raise instead of enqueueing forever-unserved
+    work, and surface the crash from close()."""
+    from p2p_dhts_tpu.metrics import Metrics
+
+    class _BadMetrics(Metrics):
+        def gauge(self, name, value):
+            raise RuntimeError("metrics backend down")
+
+    eng = ServeEngine(bucket_min=4, bucket_max=4, metrics=_BadMetrics(),
+                      name="crash").start()
+    eng._test_hold.set()  # force the dispatcher path (no inline fast path)
+    slot = eng.submit("finger_index", (5, 0))
+    eng._test_hold.clear()
+    with pytest.raises(EngineClosedError):
+        slot.wait(30)
+    with pytest.raises(EngineClosedError):
+        eng.submit("finger_index", (6, 0))
+    with pytest.raises(RuntimeError, match="metrics backend down"):
+        eng.close()
+
+
+def test_submit_validates_kind_and_state(ring_state):
+    eng = ServeEngine(bucket_min=4, bucket_max=4, name="val")
+    try:
+        with pytest.raises(ValueError, match="unknown request kind"):
+            eng.submit("frobnicate", (1,))
+        with pytest.raises(ValueError, match="no RingState"):
+            eng.submit("find_successor", (1, 0))
+        with pytest.raises(ValueError, match="FragmentStore"):
+            eng.submit("dhash_get", (1,))
+    finally:
+        eng.close()
+    with pytest.raises(ValueError):
+        ServeEngine(bucket_min=3, bucket_max=8)  # not a power of two
+    with pytest.raises(ValueError):
+        ServeEngine(bucket_min=16, bucket_max=8)
+
+
+# ---------------------------------------------------------------------------
+# the overlay bridge op
+# ---------------------------------------------------------------------------
+
+def test_engine_finger_resolver_matches_closed_form(engine):
+    start = 98765
+    r = EngineFingerResolver(start, engine=engine)
+    rng = np.random.RandomState(11)
+    for k in _rand_ids(rng, 32) + [start]:
+        dist = (k - start) % KEYS_IN_RING
+        want = dist.bit_length() - 1 if dist else -1
+        assert r.lookup_index(k) == want
+    assert r.keys_served == 33
+
+
+def test_finger_resolvers_share_engine_batches(engine):
+    """Resolvers for DIFFERENT tables coalesce into shared engine
+    batches — the cross-table batching the legacy per-table bridge
+    could not do."""
+    resolvers = [EngineFingerResolver(s, engine=engine)
+                 for s in (1, 2, 3, 4, 5, 6)]
+    engine._test_hold.set()
+    try:
+        slots = [engine.submit("finger_index",
+                               (100 + j, r._start_int))
+                 for j, r in enumerate(resolvers)]
+    finally:
+        engine._test_hold.clear()
+    for j, s in enumerate(slots):
+        want = (100 + j - (j + 1)) % KEYS_IN_RING
+        assert s.wait(60) == want.bit_length() - 1
+    kind, size, _ = engine.batch_log[-1]
+    assert kind == "finger_index" and size == 6
+
+
+# ---------------------------------------------------------------------------
+# soak (excluded from tier-1 and the default run; minutes-scale evidence
+# that the steady state holds: zero retraces, no stuck slots, no errors)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_engine_soak_mixed_sustained_load(ring_state):
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=65536, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P,
+                      window_cap_s=0.002, bucket_min=4, bucket_max=32,
+                      name="soak")
+    eng.start()
+    eng.warmup()
+    stop = threading.Event()
+    errors = []
+
+    def lookup_worker(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            while not stop.is_set():
+                eng.find_successor(
+                    int.from_bytes(rng.bytes(16), "little"),
+                    int(rng.randint(N_PEERS)), timeout=120)
+        except BaseException as exc:  # noqa: BLE001 — recorded
+            errors.append(exc)
+
+    def dhash_worker(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            while not stop.is_set():
+                k = int.from_bytes(rng.bytes(16), "little")
+                seg = rng.randint(0, 256,
+                                  size=(SMAX, IDA_M)).astype(np.int32)
+                assert eng.dhash_put(k, seg, SMAX, 0, timeout=120)
+                out, ok = eng.dhash_get(k, timeout=120)
+                assert ok and (out == seg).all()
+        except BaseException as exc:  # noqa: BLE001 — recorded
+            errors.append(exc)
+
+    threads = [threading.Thread(target=lookup_worker, args=(j,))
+               for j in range(6)]
+    threads += [threading.Thread(target=dhash_worker, args=(100 + j,))
+                for j in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(20.0)
+    stop.set()
+    for t in threads:
+        t.join(120)
+    assert not errors, f"soak workers failed: {errors[:3]}"
+    assert eng.requests_served > 1000
+    eng.assert_no_retraces()
+    eng.close()
