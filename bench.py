@@ -80,6 +80,7 @@ def _deadline_call(fn, timeout_s: float):
     def _run():
         try:
             out["result"] = fn()
+        # chordax-lint: disable=bare-except -- deadline-call worker: every failure is reported to the caller as a string
         except Exception as exc:  # noqa: BLE001 — reported to caller
             out["error"] = f"{type(exc).__name__}: {exc}"
 
@@ -114,7 +115,7 @@ def _git_commit() -> str:
         # Evidence must point at the code that RAN: a dirty tree means
         # HEAD is not that code.
         return sha + "-dirty" if dirty.stdout.strip() else sha
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         return "unknown"
 
 
@@ -124,6 +125,7 @@ def _load_lkg() -> dict:
             return json.load(f)
     except FileNotFoundError:
         return {}
+    # chordax-lint: disable=bare-except -- corrupt LKG store: park the bytes aside, never crash the bench
     except Exception as exc:  # corrupt store: preserve, don't clobber
         # Returning {} and later rewriting would erase every OTHER
         # config's hardware evidence — the exact loss this store
@@ -165,6 +167,7 @@ def _record_lkg(rec: dict) -> None:
             json.dump(lkg, f, indent=1, sort_keys=True)
             f.write("\n")
         os.replace(tmp, _LKG_PATH)
+    # chordax-lint: disable=bare-except -- LKG recording is best-effort evidence; a bench must never die writing it
     except Exception as exc:  # noqa: BLE001 — evidence is best-effort
         print(f"# lkg record failed: {exc}", file=sys.stderr)
 
@@ -267,6 +270,7 @@ def compile_service_ok(timeout_s: float = 120.0) -> bool:
         # probe straight out of the cache.
         n = 4099 + (int(time.time() * 1000) % 997)
         x = jnp.arange(n)
+        # chordax-lint: disable=scalar-closure -- the probe WANTS a fresh jit program: it tests the remote compile service
         _sync(jax.jit(lambda v: (v * 3 + 1).cumsum())(x))
         return True
 
@@ -411,6 +415,7 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
         try:
             got = fn(v_rows, v_idx, p)
             _sync(got)  # compile/lowering errors surface at the sync
+        # chordax-lint: disable=bare-except -- optional decode variant: unavailability is reported, not fatal
         except Exception as exc:
             print(f"# {label} decode unavailable: {exc}", file=sys.stderr)
             return None
@@ -429,6 +434,7 @@ def bench_ida(blocks: int = 8192, segs: int = 128) -> dict:
         try:
             from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
             pal_t = _try_variant(decode_kernel_pallas, "pallas")
+        # chordax-lint: disable=bare-except -- pallas decode is optional; import/lowering failure downgrades the variant
         except Exception as exc:
             print(f"# pallas decode unavailable: {exc}", file=sys.stderr)
 
@@ -505,6 +511,7 @@ def bench_dhash(n_peers: int = 1024, n_keys: int = 16384) -> dict:
                 repeats=2)
         except AssertionError:
             raise
+        # chordax-lint: disable=bare-except -- alt-decode variant is optional; AssertionError re-raised above
         except Exception as exc:
             print(f"# alt-decode read unavailable: {exc}", file=sys.stderr)
 
@@ -672,6 +679,7 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
                 lambda: find_successor_gathered_pred(state, keys, starts))
         except AssertionError:
             raise
+        # chordax-lint: disable=bare-except -- optional serve variant; parity AssertionError re-raised above
         except Exception as exc:
             print(f"# gathered-pred serve unavailable: {exc}",
                   file=sys.stderr)
@@ -685,6 +693,7 @@ def bench_lookup_1m(n_peers: int = 1_000_000, n_keys: int = 1_000_000,
                 lambda: find_successor_unroll2(state, keys, starts))
         except AssertionError:
             raise
+        # chordax-lint: disable=bare-except -- optional serve variant; parity AssertionError re-raised above
         except Exception as exc:
             print(f"# unroll2 serve unavailable: {exc}", file=sys.stderr)
 
@@ -1158,6 +1167,7 @@ def main() -> None:
                 results.append(fn())
             if not args.smoke:
                 _record_lkg(results[-1])
+        # chordax-lint: disable=bare-except -- per-config firewall: one failed config records FAILED and the rest still run
         except Exception as exc:  # noqa: BLE001 — deliberate firewall
             import traceback
             traceback.print_exc()

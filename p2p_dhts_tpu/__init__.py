@@ -24,8 +24,21 @@ Layer map (mirrors SURVEY.md §1):
 
 __version__ = "0.1.0"
 
+import os as _os
+
 from p2p_dhts_tpu.config import RingConfig, IdaParams  # noqa: F401
 from p2p_dhts_tpu.keyspace import Key  # noqa: F401
+
+if _os.environ.get("CHORDAX_LOCK_CHECK") == "1":
+    # Opt-in runtime lock-order watchdog (chordax-lint Pass 3's dynamic
+    # twin): every threading.Lock/RLock created after this import is
+    # wrapped with acquisition-order bookkeeping, and inverted orders
+    # accumulate in analysis.lockcheck.WATCHDOG.violations (the serve
+    # soak asserts they stay empty). Installed at import so the env var
+    # alone instruments a whole run; lockcheck never imports jax, so
+    # the package's zero-backend-init rule holds.
+    from p2p_dhts_tpu.analysis.lockcheck import WATCHDOG as _WATCHDOG
+    _WATCHDOG.install()
 
 # Everything that would pull in jax (or socket machinery) resolves
 # lazily (PEP 562): `from p2p_dhts_tpu import build_ring` still works,

@@ -1,0 +1,89 @@
+"""chordax-lint: three-pass static analysis for the repo's hard-bug
+classes, with a CLI (`python -m p2p_dhts_tpu.analysis`) and CI gates.
+
+  Pass 1  trace-safety     AST: jit-boundary hazards (Python control
+                           flow over traced values, host syncs,
+                           per-call jit wrappers, shard_map imports
+                           bypassing compat.py, bare excepts).
+  Pass 2  gspmd            jaxpr: the known jax-0.4.x GSPMD miscompile
+                           patterns (concat-of-slices on sharded axes,
+                           associative_scan under auto-sharding,
+                           dynamic_slice with traced starts), traced
+                           over the public kernels on a simulated
+                           8-device mesh.
+  Pass 3  lock-discipline  static lock-order graph + blocking-call
+                           audit over the threaded serving layer; an
+                           opt-in runtime watchdog (CHORDAX_LOCK_CHECK=1)
+                           verifies the order during soaks.
+
+Inline suppressions: `# chordax-lint: disable=<rule> -- <reason>`
+(reason mandatory; see analysis.common). `run_all` is the library
+entry the pytest session gate and the dryrun scan stage call.
+
+This package imports jax only inside Pass 2 — Pass 1/3 (and the
+runtime watchdog) stay importable in processes whose accelerator
+runtime is unusable, the same hygiene rule as `__graft_entry__`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.analysis.common import (  # noqa: F401
+    Finding,
+    SuppressionIndex,
+    apply_suppressions,
+    json_report,
+    package_files,
+    render_report,
+)
+
+ALL_PASSES = ("trace", "gspmd", "locks")
+
+
+def default_root() -> str:
+    """The repo checkout this package is installed in."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_all(root: Optional[str] = None,
+            passes: Sequence[str] = ALL_PASSES,
+            files: Optional[Sequence[str]] = None,
+            ) -> Tuple[List[Finding], int]:
+    """Run the selected passes over the shipped tree; returns
+    (unsuppressed findings incl. suppression-hygiene problems,
+    n_suppressed).
+
+    `files` restricts the scan set and is only meaningful for the
+    AST-driven trace pass; the locks pass scans its fixed serving-layer
+    module list and the gspmd pass traces the IMPORTED package's
+    kernels regardless, so combining `files` with those passes would
+    silently analyze files the caller never named."""
+    if files is not None and set(passes) - {"trace"}:
+        raise ValueError(
+            "run_all(files=...) only supports passes=('trace',); the "
+            "locks/gspmd passes scan fixed module sets")
+    root = root if root is not None else default_root()
+    scan_files = list(files) if files is not None else package_files(root)
+    raw: List[Finding] = []
+    if "trace" in passes:
+        from p2p_dhts_tpu.analysis import trace_safety
+        raw.extend(trace_safety.run(scan_files, root))
+    if "locks" in passes:
+        from p2p_dhts_tpu.analysis import lockcheck
+        raw.extend(lockcheck.run_default(root))
+    if "gspmd" in passes:
+        from p2p_dhts_tpu.analysis import gspmd
+        raw.extend(gspmd.run_default(root))
+    # Index EVERY scanned file up front, not just files with findings:
+    # a reasonless or unknown-rule suppression in an otherwise-clean
+    # file must still surface as a lint-suppression finding, or stale
+    # opt-outs rot silently (the documented contract).
+    from p2p_dhts_tpu.analysis.common import SuppressionIndex, repo_rel
+    index = SuppressionIndex()
+    for path in scan_files:
+        index.add_file(path, repo_rel(path, root))
+    findings, n_sup, _ = apply_suppressions(raw, root, index)
+    return findings, n_sup

@@ -1,0 +1,81 @@
+"""CLI: `python -m p2p_dhts_tpu.analysis [--strict] [--json PATH]
+[--passes trace,gspmd,locks] [--root DIR]`.
+
+--strict is the CI-gate mode: exit 1 on any unsuppressed finding
+(exit 2 on an internal analyzer error). Without it the run is
+informational and always exits 0 unless the analyzer itself breaks.
+
+The gspmd pass needs a backend to trace against; a fresh CLI process
+self-provisions the unit suite's virtual 8-device CPU mesh (env set
+BEFORE jax imports, plus the config-level pin the axon sitecustomize
+makes necessary — see tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _provision_cpu_mesh() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m p2p_dhts_tpu.analysis",
+        description="chordax-lint: trace-safety, GSPMD-miscompile and "
+                    "lock-discipline analyzer")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit nonzero on any unsuppressed finding "
+                             "(the CI gate)")
+    parser.add_argument("--json", metavar="PATH", default=None,
+                        help="write the machine-readable report here "
+                             "('-' for stdout)")
+    parser.add_argument("--passes", default="trace,gspmd,locks",
+                        help="comma list from {trace,gspmd,locks}")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: the checkout this "
+                             "package lives in)")
+    args = parser.parse_args(argv)
+
+    from p2p_dhts_tpu import analysis
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    unknown = set(passes) - set(analysis.ALL_PASSES)
+    if unknown:
+        parser.error(f"unknown pass(es): {sorted(unknown)}")
+
+    if "gspmd" in passes and "jax" not in sys.modules:
+        _provision_cpu_mesh()
+
+    try:
+        findings, n_sup = analysis.run_all(root=args.root, passes=passes)
+    # chordax-lint: disable=bare-except -- CLI boundary: an analyzer crash must become exit 2, not a traceback
+    except Exception as exc:
+        print(f"chordax-lint: internal analyzer error: {exc!r}",
+              file=sys.stderr)
+        return 2
+
+    if args.json:
+        report = analysis.json_report(findings, n_sup, passes)
+        if args.json == "-":
+            print(report)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(report + "\n")
+    print(analysis.render_report(findings, n_sup, passes))
+    if findings and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""Shared findings model + inline-suppression machinery for chordax-lint.
+
+Every analyzer pass (trace_safety, gspmd, lockcheck) reports `Finding`
+rows; the CLI (and the pytest/dryrun gates) render them and exit
+nonzero when any UNSUPPRESSED finding remains — the CI-gate contract.
+
+Suppression syntax (mandatory reason, enforced):
+
+    x = thing()  # chordax-lint: disable=bare-except -- why it is safe
+
+A standalone comment line suppresses the next non-comment source line
+(so multi-line statements can carry the annotation above themselves):
+
+    # chordax-lint: disable=gspmd-associative-scan -- per-shard only
+    carried = jax.lax.associative_scan(...)
+
+A suppression without a `-- reason` tail does not suppress anything and
+is itself reported as a `lint-suppression` finding: silent opt-outs are
+exactly the rot this gate exists to stop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Rules a suppression comment may name. Passes register theirs at
+#: import; `lint-suppression` itself is never suppressible.
+KNOWN_RULES = {
+    # pass 1 — trace safety
+    "trace-branch", "host-sync", "scalar-closure", "shardmap-import",
+    "module-jnp-constant", "bare-except",
+    # pass 2 — GSPMD miscompile patterns
+    "gspmd-concat-of-slices", "gspmd-associative-scan",
+    "gspmd-dynamic-slice-traced-start",
+    # pass 3 — lock discipline
+    "lock-order-cycle", "lock-held-across-blocking", "lock-reacquire",
+    # meta
+    "lint-suppression",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*chordax-lint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(.+?))?\s*$")
+
+
+def dotted_name(node) -> Optional[str]:
+    """'jax.experimental.shard_map' for a nested Attribute/Name AST
+    node, else None — the one shared resolver for every AST pass."""
+    import ast
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One analyzer hit, anchored to source. `path` is repo-relative."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    pass_name: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message} "
+                f"({self.pass_name})")
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SuppressionIndex:
+    """Per-file map line -> set of suppressed rules, built from the
+    inline comments; malformed suppressions surface as findings."""
+
+    def __init__(self) -> None:
+        self._by_file: Dict[str, Dict[int, set]] = {}
+        self.problems: List[Finding] = []
+
+    def add_file(self, path: str, rel: str,
+                 text: Optional[str] = None) -> None:
+        if rel in self._by_file:
+            return
+        if text is None:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                self._by_file[rel] = {}
+                return
+        self._by_file[rel] = self._parse(rel, text)
+
+    def _parse(self, rel: str, text: str) -> Dict[int, set]:
+        lines = text.splitlines()
+        out: Dict[int, set] = {}
+        for i, raw in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.problems.append(Finding(
+                    rel, i, "lint-suppression",
+                    "suppression without a `-- reason` tail suppresses "
+                    "nothing; state why the finding is safe", "meta"))
+                continue
+            unknown = rules - KNOWN_RULES
+            if unknown or "lint-suppression" in rules:
+                bad = sorted(unknown | (rules & {"lint-suppression"}))
+                self.problems.append(Finding(
+                    rel, i, "lint-suppression",
+                    f"suppression names unknown/unsuppressible rule(s) "
+                    f"{bad}", "meta"))
+                rules -= set(bad)
+            if not rules:
+                continue
+            target = i
+            if raw.lstrip().startswith("#"):
+                # Standalone comment: covers the next non-comment line.
+                j = i + 1
+                while j <= len(lines) and (
+                        not lines[j - 1].strip()
+                        or lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                target = j
+            out.setdefault(target, set()).update(rules)
+        return out
+
+    def suppressed(self, finding: Finding) -> bool:
+        return finding.rule in self._by_file.get(
+            finding.path, {}).get(finding.line, set())
+
+
+def repo_rel(path: str, root: str) -> str:
+    try:
+        return os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    except ValueError:  # different drive (windows); keep absolute
+        return path
+
+
+def package_files(root: str,
+                  subdirs: Sequence[str] = ("p2p_dhts_tpu",),
+                  extra: Sequence[str] = ("__graft_entry__.py", "bench.py"),
+                  ) -> List[str]:
+    """The shipped-tree scan set: the package + top-level entry points.
+    tests/ and fixture corpora are deliberately excluded — they hold
+    seeded violations."""
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    for name in extra:
+        p = os.path.join(root, name)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def apply_suppressions(findings: Iterable[Finding], root: str,
+                       index: Optional[SuppressionIndex] = None
+                       ) -> Tuple[List[Finding], int, SuppressionIndex]:
+    """Split raw findings into (unsuppressed + suppression-problems,
+    n_suppressed, index). Files referenced by findings are lazily added
+    to the index so Pass-2/3 findings (attributed by file:line, not by
+    an AST walk) honor the same inline syntax."""
+    index = index if index is not None else SuppressionIndex()
+    kept: List[Finding] = []
+    n_sup = 0
+    for f in sorted(set(findings)):
+        index.add_file(os.path.join(root, f.path), f.path)
+        if index.suppressed(f):
+            n_sup += 1
+        else:
+            kept.append(f)
+    kept.extend(index.problems)
+    return sorted(set(kept)), n_sup, index
+
+
+def render_report(findings: Sequence[Finding], n_suppressed: int,
+                  passes: Sequence[str]) -> str:
+    lines = [f.render() for f in findings]
+    lines.append(f"chordax-lint: {len(findings)} finding(s), "
+                 f"{n_suppressed} suppressed "
+                 f"(passes: {', '.join(passes)})")
+    return "\n".join(lines)
+
+
+def json_report(findings: Sequence[Finding], n_suppressed: int,
+                passes: Sequence[str]) -> str:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "passes": list(passes),
+        "suppressed": n_suppressed,
+        "counts": counts,
+        "findings": [f.as_dict() for f in findings],
+    }, indent=2, sort_keys=True)

@@ -1,0 +1,300 @@
+"""Pass 2 — GSPMD miscompile detector (jaxpr pattern scan).
+
+jax 0.4.x's SPMD partitioner miscompiles a small, known set of HLO
+patterns on sharded operands under GSPMD *auto-sharding* — the bug
+class this repo has been bitten by twice (PR 2's two_phase_hop_loop
+merge and next_alive_map extension; the placement_converged
+associative_scan residual). This pass traces the public device kernels
+to jaxprs under a simulated 8-device mesh (the dryrun's layout:
+ring-state rows sharded over "peer", key batches over "data") and
+scans every equation — recursing through pjit/while/cond/scan — for
+those patterns:
+
+  gspmd-concat-of-slices        `concatenate` where at least one input
+                                is a slice of a sharded-axis operand
+                                and the inputs do NOT all slice the
+                                same source array (a same-source
+                                concat-of-slices is the jnp.roll
+                                rotation idiom, which partitions
+                                correctly — the dryrun is the
+                                evidence). The partitioner can sum the
+                                merged output across an unrelated mesh
+                                axis; rewrite as dynamic-update-slice.
+  gspmd-associative-scan        `lax.associative_scan` over sharded
+                                data: its lowering IS an interleave of
+                                concat-of-slices, and auto-sharding
+                                miscomputes it (placement_converged,
+                                pre-fix). Rewrite as a roll-and-select
+                                doubling reduction or an explicit
+                                shard_map scan.
+  gspmd-dynamic-slice-traced-start
+                                `dynamic_slice` whose start indices
+                                derive from batch/table (sharded) data
+                                rather than replicated scalars — the
+                                partitioner cannot prove the slice
+                                stays shard-local.
+
+"Sharded" is tracked as a conservative taint: every array argument
+with a shardable axis (ndim >= 1) seeds taint — exactly the set
+auto-sharding is free to partition — and taint propagates through
+every equation. Replicated scalars (n_valid and friends) stay clean,
+so e.g. ring_genesis-style `dynamic_slice(ids, (n_valid - 1, 0), ...)`
+does not fire. Explicit `shard_map` bodies are SKIPPED: they are
+manually partitioned and the GSPMD partitioner never sees them (the
+repo's production sharded path is unaffected by this bug class by
+construction).
+
+Findings carry the file:line of the offending primitive's *user* source
+(jax-internal frames are filtered), so a hit inside a library helper
+points at the helper's line, and inline suppressions at that line work
+exactly like the AST pass's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.analysis.common import Finding, repo_rel
+
+PASS = "gspmd"
+
+#: Primitives whose output is (a view of) a slice of their first input —
+#: provenance carriers for the concat-of-slices rule.
+_SLICE_PRIMS = {"slice", "dynamic_slice"}
+_VIEW_PRIMS = {"squeeze", "reshape", "convert_element_type",
+               "broadcast_in_dim", "rev"}
+
+
+@dataclasses.dataclass
+class KernelSpec:
+    """One public kernel to trace: `fn(*args)` must be traceable by
+    jax.make_jaxpr. Array args with ndim >= 1 seed the sharded taint."""
+
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+
+
+class _SourceLines:
+    """Cached source-line reads for rule classification."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[str, List[str]] = {}
+
+    def line(self, path: str, lineno: int) -> str:
+        if path not in self._cache:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    self._cache[path] = fh.read().splitlines()
+            except OSError:
+                self._cache[path] = []
+        lines = self._cache[path]
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1]
+        return ""
+
+
+def _user_frame(eqn) -> Optional[Tuple[str, int]]:
+    try:
+        from jax._src import source_info_util
+        fr = source_info_util.user_frame(eqn.source_info)
+    # chordax-lint: disable=bare-except -- source-info layout differs across jax versions; a missing frame just drops attribution
+    except Exception:
+        return None
+    if fr is None:
+        return None
+    return fr.file_name, fr.start_line
+
+
+class _JaxprScanner:
+    def __init__(self, root: str, kernel: str):
+        self.root = root
+        self.kernel = kernel
+        self.findings: set = set()
+        self.src = _SourceLines()
+
+    # -- finding emission ----------------------------------------------------
+    def _emit(self, eqn, rule: str, msg: str) -> None:
+        loc = _user_frame(eqn)
+        if loc is None:
+            return  # jax-internal only: nothing actionable to point at
+        path, line = loc
+        self.findings.add(Finding(
+            repo_rel(path, self.root), line, rule,
+            f"{msg} [kernel {self.kernel}]", PASS))
+
+    def _classify_concat(self, eqn) -> Tuple[str, str]:
+        loc = _user_frame(eqn)
+        text = self.src.line(*loc) if loc else ""
+        if "associative_scan" in text:
+            return ("gspmd-associative-scan",
+                    "associative_scan over sharded data lowers to "
+                    "concat-of-slices, which jax 0.4.x GSPMD "
+                    "auto-sharding miscompiles; rewrite as a "
+                    "roll+select doubling reduction or an explicit "
+                    "shard_map scan")
+        return ("gspmd-concat-of-slices",
+                "concatenate of slice(s) on a sharded operand — jax "
+                "0.4.x's SPMD partitioner can sum the output across an "
+                "unrelated mesh axis under auto-sharding; use "
+                "dynamic-update-slice (see two_phase_hop_loop's merge)")
+
+    # -- core walk -----------------------------------------------------------
+    def scan(self, closed_jaxpr, taint_in: Sequence[bool]) -> List[bool]:
+        return self._scan_jaxpr(closed_jaxpr.jaxpr, list(taint_in))
+
+    def _scan_jaxpr(self, jaxpr, taint_in: List[bool]) -> List[bool]:
+        from jax.core import Literal
+
+        taint: Dict[Any, bool] = {}
+        prov: Dict[Any, Any] = {}  # var -> source var it is a slice of
+
+        for var in jaxpr.constvars:
+            taint[var] = False
+        for var, t in zip(jaxpr.invars, taint_in):
+            taint[var] = bool(t)
+
+        def t_of(v) -> bool:
+            if isinstance(v, Literal):
+                return False
+            return taint.get(v, False)
+
+        def p_of(v):
+            if isinstance(v, Literal):
+                return None
+            return prov.get(v)
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_taint = [t_of(v) for v in eqn.invars]
+            any_taint = any(in_taint)
+
+            if name == "shard_map":
+                # Manually partitioned: GSPMD never touches the body.
+                for v in eqn.outvars:
+                    taint[v] = any_taint
+                continue
+
+            sub = self._sub_jaxprs(eqn, in_taint)
+            if sub is not None:
+                out_taint = sub
+                for v, t in zip(eqn.outvars, out_taint):
+                    taint[v] = t or any_taint
+                continue
+
+            # -- pattern rules --------------------------------------------
+            if name == "concatenate" and any_taint and len(eqn.invars) > 1:
+                provs = [p_of(v) for v in eqn.invars]
+                has_slice = any(p is not None for p in provs)
+                same_source = (has_slice
+                               and all(p is not None for p in provs)
+                               and len({id(p) for p in provs}) == 1)
+                if has_slice and not same_source:
+                    rule, msg = self._classify_concat(eqn)
+                    self._emit(eqn, rule, msg)
+            elif name == "dynamic_slice":
+                starts = eqn.invars[1:]
+                if any(t_of(v) for v in starts):
+                    self._emit(
+                        eqn, "gspmd-dynamic-slice-traced-start",
+                        "dynamic_slice start index derives from "
+                        "sharded (batch/table) data — non-replicated "
+                        "starts miscompile under GSPMD auto-sharding; "
+                        "gather by index instead")
+
+            # -- provenance + taint propagation ---------------------------
+            if name in _SLICE_PRIMS and eqn.invars:
+                src_v = eqn.invars[0]
+                base = p_of(src_v)
+                prov[eqn.outvars[0]] = base if base is not None else src_v
+            elif name in _VIEW_PRIMS and eqn.invars:
+                base = p_of(eqn.invars[0])
+                if base is not None:
+                    prov[eqn.outvars[0]] = base
+            for v in eqn.outvars:
+                taint[v] = any_taint
+
+        return [t_of(v) for v in jaxpr.outvars]
+
+    def _sub_jaxprs(self, eqn, in_taint: List[bool]
+                    ) -> Optional[List[bool]]:
+        """Descend into call-like primitives; returns outvar taint, or
+        None when the primitive has no sub-jaxpr to walk."""
+        name = eqn.primitive.name
+        p = eqn.params
+        if name == "pjit" and "jaxpr" in p:
+            return self._scan_closed(p["jaxpr"], in_taint)
+        if name == "while":
+            cn, bn = p["cond_nconsts"], p["body_nconsts"]
+            carry = in_taint[cn + bn:]
+            body_consts = in_taint[cn:cn + bn]
+            # Fixpoint over the carry: taint injected by the body flows
+            # back around the loop. Monotone boolean taint over k carry
+            # slots converges in at most k rounds (each round taints at
+            # least one more slot or is stable).
+            for _ in range(len(carry) + 1):
+                out = self._scan_closed(p["body_jaxpr"],
+                                        body_consts + carry)
+                new = [a or b for a, b in zip(carry, out)]
+                if new == carry:
+                    break
+                carry = new
+            self._scan_closed(p["cond_jaxpr"], in_taint[:cn] + carry)
+            return carry
+        if name == "scan":
+            nc, ncar = p["num_consts"], p["num_carry"]
+            consts = in_taint[:nc]
+            carry = in_taint[nc:nc + ncar]
+            xs = in_taint[nc + ncar:]
+            out: List[bool] = []
+            for _ in range(len(carry) + 1):  # monotone: <= k rounds
+                out = self._scan_closed(p["jaxpr"], consts + carry + xs)
+                new = [a or b for a, b in zip(carry, out[:ncar])]
+                if new == carry:
+                    break
+                carry = new
+            return carry + out[ncar:]
+        if name == "cond":
+            ops = in_taint[1:]
+            outs = None
+            for br in p["branches"]:
+                o = self._scan_closed(br, ops)
+                outs = o if outs is None else [a or b
+                                               for a, b in zip(outs, o)]
+            return outs
+        for key in ("call_jaxpr", "fun_jaxpr"):
+            if key in p:
+                return self._scan_closed(p[key], in_taint)
+        return None
+
+    def _scan_closed(self, closed, in_taint: List[bool]) -> List[bool]:
+        inner = getattr(closed, "jaxpr", closed)
+        n = len(inner.invars)
+        padded = (list(in_taint) + [False] * n)[:n]
+        return self._scan_jaxpr(inner, padded)
+
+
+def analyze_kernel(spec: KernelSpec, root: str) -> List[Finding]:
+    """Trace one kernel and scan its jaxpr for the known-bad patterns."""
+    import jax
+
+    closed = jax.make_jaxpr(spec.fn)(*spec.args)
+    leaves = jax.tree_util.tree_leaves(spec.args)
+    taint = [getattr(leaf, "ndim", 0) >= 1 for leaf in leaves]
+    scanner = _JaxprScanner(root, spec.name)
+    scanner.scan(closed, taint)
+    return sorted(scanner.findings)
+
+
+def run(specs: Sequence[KernelSpec], root: str) -> List[Finding]:
+    findings: set = set()
+    for spec in specs:
+        findings.update(analyze_kernel(spec, root))
+    return sorted(findings)
+
+
+def run_default(root: str) -> List[Finding]:
+    from p2p_dhts_tpu.analysis.registry import default_kernels
+    return run(default_kernels(), root)

@@ -1,0 +1,653 @@
+"""Pass 3 — lock discipline: static order graph + runtime watchdog.
+
+The threaded serving layer (ServeEngine's dispatcher/completer pair,
+the RPC server's worker pool, the finger table's degrade state) has
+exactly two documented failure classes: acquiring locks in inconsistent
+order across threads (deadlock), and holding a lock across a blocking
+call (convoy / stall — the "callers MUST NOT hold locks the completion
+of other requests needs" rule in serve.py's docstring).
+
+Static half (pure AST, no imports of the analyzed code):
+
+  * discovers lock objects — `self._x = threading.Lock()/RLock()`,
+    module-level locks, `threading.Condition(lock)` associations, plus
+    `queue.Queue` / `threading.Thread` / `threading.Event` attributes
+    (their .get/.put/.join/.wait are blocking);
+  * walks each function with the syntactic `with <lock>:` nesting as
+    the held-set, recording acquisition-order edges, and follows
+    same-module calls ONE level deep through per-function summaries
+    (locks a callee acquires, whether it blocks). Cross-module calls
+    are out of scope — the runtime watchdog covers those;
+  * reports: `lock-order-cycle` (every acquisition edge on a cycle,
+    anchored at its `with` line), `lock-held-across-blocking` (sleep,
+    socket I/O, queue get/put, thread join, Condition/Event wait,
+    device sync via np.asarray/device_get/block_until_ready — waiting
+    on a Condition is exempt when the ONLY held lock is the
+    condition's own, which wait() releases), and `lock-reacquire`
+    (nested `with` on a non-reentrant Lock).
+
+Runtime half (opt-in, `CHORDAX_LOCK_CHECK=1` at import of
+`p2p_dhts_tpu`, or `WATCHDOG.install()` from a test): patches
+`threading.Lock`/`threading.RLock` so every lock created AFTER install
+is wrapped with creation-site bookkeeping. Each thread keeps its held
+stack; acquiring B while holding A records the site-level edge A->B,
+and an edge whose reverse was ever observed is a violation — the
+dynamic twin of the static order graph, catching the cross-module and
+data-dependent orders the AST cannot see. `WATCHDOG.assert_clean()` is
+the soak-test hook. This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from p2p_dhts_tpu.analysis.common import (Finding, dotted_name as _dotted,
+                                          repo_rel)
+
+PASS = "lock-discipline"
+
+#: The threaded serving layer — the default static-analysis surface.
+DEFAULT_LOCK_MODULES = (
+    os.path.join("p2p_dhts_tpu", "serve.py"),
+    os.path.join("p2p_dhts_tpu", "net", "rpc.py"),
+    os.path.join("p2p_dhts_tpu", "overlay", "finger_table.py"),
+    os.path.join("p2p_dhts_tpu", "overlay", "jax_bridge.py"),
+)
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",
+               "Queue": "queue", "Thread": "thread", "Event": "event",
+               "Semaphore": "lock", "BoundedSemaphore": "lock"}
+
+#: Dotted call names that block the calling thread outright.
+_BLOCKING_CALLS = {"time.sleep", "sleep", "socket.create_connection",
+                   "subprocess.run", "subprocess.check_call",
+                   "subprocess.check_output", "jax.device_get",
+                   "np.asarray", "numpy.asarray",
+                   "jax.block_until_ready"}
+
+#: Method names that block regardless of receiver (socket I/O, device
+#: sync). `.wait`/`.get`/`.put`/`.join` are resolved against the
+#: discovered attribute kinds instead — `.get` on a dict or `.join` on
+#: a str must not fire.
+_BLOCKING_METHODS = {"accept", "recv", "recv_into", "sendall", "connect",
+                     "block_until_ready"}
+
+
+def _ctor_kind(call: ast.AST) -> Optional[str]:
+    if not isinstance(call, ast.Call):
+        return None
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    base = d.rsplit(".", 1)[-1]
+    return _LOCK_CTORS.get(base)
+
+
+class _ModuleModel:
+    """Discovered lock/queue/thread attributes + function summaries."""
+
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.base = os.path.splitext(os.path.basename(rel))[0]
+        # key -> kind ("lock"/"rlock"/"cond"/"queue"/"thread"/"event")
+        self.kinds: Dict[str, str] = {}
+        # condition key -> its underlying lock key (None = private)
+        self.cond_lock: Dict[str, Optional[str]] = {}
+        self.functions: Dict[str, ast.AST] = {}
+        self._discover(tree)
+
+    # keys: "<base>:<Class>.<attr>" or "<base>:<global>"
+    def attr_key(self, cls: Optional[str], attr: str) -> str:
+        return f"{self.base}:{cls}.{attr}" if cls else f"{self.base}:{attr}"
+
+    def _discover(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._note_assign(stmt, None)
+            elif isinstance(stmt, ast.FunctionDef):
+                self.functions[stmt.name] = stmt
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        self.functions[f"{stmt.name}.{sub.name}"] = sub
+                        for node in ast.walk(sub):
+                            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                                self._note_assign(node, stmt.name)
+
+    def _note_assign(self, stmt, cls: Optional[str]) -> None:
+        value = stmt.value
+        kind = _ctor_kind(value)
+        if kind is None:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for tgt in targets:
+            attr = None
+            if isinstance(tgt, ast.Name) and cls is None:
+                attr = tgt.id
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self" and cls is not None:
+                attr = tgt.attr
+            if attr is None:
+                continue
+            key = self.attr_key(cls, attr)
+            self.kinds[key] = kind
+            if kind == "cond":
+                lock_key = None
+                if value.args:
+                    lk = self._lock_expr_key(value.args[0], cls)
+                    lock_key = lk
+                self.cond_lock[key] = lock_key
+
+    def _lock_expr_key(self, expr: ast.AST, cls: Optional[str]
+                       ) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return self.attr_key(cls, expr.attr)
+        if isinstance(expr, ast.Name):
+            key = self.attr_key(None, expr.id)
+            return key if key in self.kinds else None
+        return None
+
+
+class _FnSummary:
+    __slots__ = ("acquires", "blocking")
+
+    def __init__(self) -> None:
+        self.acquires: Set[str] = set()
+        self.blocking: Optional[str] = None  # description of first block
+
+
+class _LockWalker:
+    """Per-function walk with the syntactic held-set."""
+
+    def __init__(self, model: _ModuleModel, cls: Optional[str],
+                 summaries: Dict[str, _FnSummary],
+                 edges: Dict[Tuple[str, str], List[Tuple[str, int]]],
+                 findings: List[Finding]):
+        self.model = model
+        self.cls = cls
+        self.summaries = summaries
+        self.edges = edges
+        self.findings = findings
+
+    def _flag(self, line: int, rule: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.model.rel, line, rule, msg, PASS))
+
+    def _resolve(self, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        key = self.model._lock_expr_key(expr, self.cls)
+        if key is None:
+            return None
+        kind = self.model.kinds.get(key)
+        if kind in ("lock", "rlock"):
+            return key, kind
+        return None
+
+    def walk_function(self, fn: ast.AST) -> None:
+        self._walk(fn.body, [])
+
+    # -- statement recursion -------------------------------------------------
+    def _walk(self, stmts: Sequence[ast.stmt],
+              held: List[Tuple[str, str]]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in stmt.items:
+                    res = self._resolve(item.context_expr)
+                    if res is None:
+                        self._scan_calls(item.context_expr, held)
+                        continue
+                    key, kind = res
+                    if kind == "lock" and any(h == key for h, _ in held):
+                        self._flag(stmt.lineno, "lock-reacquire",
+                                   f"nested `with` on non-reentrant "
+                                   f"lock {key} (already held) "
+                                   f"deadlocks")
+                    for h, _ in held:
+                        if h != key:
+                            self.edges.setdefault((h, key), []).append(
+                                (self.model.rel, stmt.lineno))
+                    held.append((key, kind))
+                    pushed += 1
+                self._walk(stmt.body, held)
+                for _ in range(pushed):
+                    held.pop()
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(stmt.body, [])  # runs later, on its own stack
+            elif isinstance(stmt, ast.If):
+                self._scan_calls(stmt.test, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_calls(stmt.iter, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.While):
+                self._scan_calls(stmt.test, held)
+                self._walk(stmt.body, held)
+                self._walk(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, held)
+                for h in stmt.handlers:
+                    self._walk(h.body, held)
+                self._walk(stmt.orelse, held)
+                self._walk(stmt.finalbody, held)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            else:
+                self._scan_calls(stmt, held)
+
+    # -- call classification --------------------------------------------------
+    def _scan_calls(self, node: ast.AST,
+                    held: List[Tuple[str, str]]) -> None:
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call):
+                self._on_call(call, held)
+
+    def _attr_kind_of_receiver(self, func: ast.Attribute
+                               ) -> Optional[Tuple[str, str]]:
+        key = self.model._lock_expr_key(func.value, self.cls)
+        if key is None:
+            return None
+        kind = self.model.kinds.get(key)
+        return (key, kind) if kind else None
+
+    def _blocking_desc(self, call: ast.Call,
+                       held: List[Tuple[str, str]]
+                       ) -> Optional[Tuple[str, bool]]:
+        """(description, is_exempt_condition_wait) or None."""
+        d = _dotted(call.func)
+        if d in _BLOCKING_CALLS:
+            return d, False
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            if meth in _BLOCKING_METHODS:
+                return f".{meth}()", False
+            rk = self._attr_kind_of_receiver(call.func)
+            if rk is not None:
+                key, kind = rk
+                if kind == "cond" and meth in ("wait", "wait_for"):
+                    assoc = self.model.cond_lock.get(key)
+                    others = [h for h, _ in held if h != assoc]
+                    if not others:
+                        return None  # wait() releases the only held lock
+                    return (f"{key}.wait() (releases only {assoc}; "
+                            f"still holding {others})", False)
+                if kind == "queue" and meth in ("get", "put", "join"):
+                    return f"{key}.{meth}()", False
+                if kind == "thread" and meth == "join":
+                    return f"{key}.join()", False
+                if kind == "event" and meth == "wait":
+                    return f"{key}.wait()", False
+        return None
+
+    def _on_call(self, call: ast.Call,
+                 held: List[Tuple[str, str]]) -> None:
+        if not held:
+            return
+        desc = self._blocking_desc(call, held)
+        if desc is not None:
+            self._flag(call.lineno, "lock-held-across-blocking",
+                       f"blocking call {desc[0]} while holding "
+                       f"{[h for h, _ in held]}")
+            return
+        # One-level closure through same-module calls.
+        summary = self._callee_summary(call)
+        if summary is None:
+            return
+        for key in summary.acquires:
+            if key not in {h for h, _ in held}:
+                for h, _ in held:
+                    if h != key:
+                        self.edges.setdefault((h, key), []).append(
+                            (self.model.rel, call.lineno))
+        if summary.blocking is not None:
+            self._flag(call.lineno, "lock-held-across-blocking",
+                       f"call blocks ({summary.blocking}) while holding "
+                       f"{[h for h, _ in held]}")
+
+    def _callee_summary(self, call: ast.Call) -> Optional[_FnSummary]:
+        func = call.func
+        name = None
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == "self" \
+                and self.cls is not None:
+            name = f"{self.cls}.{func.attr}"
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name is None:
+            return None
+        return self.summaries.get(name)
+
+
+def _summarize(model: _ModuleModel) -> Dict[str, _FnSummary]:
+    out: Dict[str, _FnSummary] = {}
+    for qual, fn in model.functions.items():
+        cls = qual.split(".")[0] if "." in qual else None
+        s = _FnSummary()
+        sink: List[Finding] = []
+        walker = _LockWalker(model, cls, {}, {}, sink)
+
+        def collect(stmts):
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            res = walker._resolve(item.context_expr)
+                            if res is not None:
+                                s.acquires.add(res[0])
+                    elif isinstance(node, ast.Call) and s.blocking is None:
+                        d = walker._blocking_desc(node, [("?", "lock")])
+                        if d is not None:
+                            s.blocking = d[0]
+
+        collect(fn.body)
+        out[qual] = s
+        if "." in qual:
+            out.setdefault(qual.split(".", 1)[1], s)
+    return out
+
+
+def _edges_on_cycles(edges: Dict[Tuple[str, str], List[Tuple[str, int]]]
+                     ) -> List[Tuple[Tuple[str, str], Tuple[str, int]]]:
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reachable(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    out = []
+    for (a, b), sites in edges.items():
+        if reachable(b, a):
+            for site in sites:
+                out.append(((a, b), site))
+    return out
+
+
+def run(paths: Sequence[str], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for path in paths:
+        rel = repo_rel(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError) as exc:
+            findings.append(Finding(rel, 1, "lint-suppression",
+                                    f"unparseable file: {exc}", PASS))
+            continue
+        model = _ModuleModel(rel, tree)
+        summaries = _summarize(model)
+        for qual, fn in model.functions.items():
+            cls = qual.split(".")[0] if "." in qual else None
+            _LockWalker(model, cls, summaries, edges,
+                        findings).walk_function(fn)
+    for (a, b), (rel, line) in _edges_on_cycles(edges):
+        findings.append(Finding(
+            rel, line, "lock-order-cycle",
+            f"acquiring {b} while holding {a} lies on a lock-order "
+            f"cycle — another path acquires these in the reverse "
+            f"order; pick one global order", PASS))
+    return findings
+
+
+def run_default(root: str) -> List[Finding]:
+    paths = [os.path.join(root, p) for p in DEFAULT_LOCK_MODULES]
+    return run([p for p in paths if os.path.exists(p)], root)
+
+
+# ---------------------------------------------------------------------------
+# runtime watchdog (CHORDAX_LOCK_CHECK=1)
+# ---------------------------------------------------------------------------
+
+_THIS_FILE = os.path.abspath(__file__)
+
+
+def _creation_site() -> str:
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.abspath(fn) != _THIS_FILE and \
+                not fn.replace("\\", "/").endswith("/threading.py"):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class _WatchedLockBase:
+    _reentrant = False
+
+    def __init__(self, inner, site: str, dog: "LockOrderWatchdog"):
+        self._inner = inner
+        self._site = site
+        self._dog = dog
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._dog._note_acquire(self)
+        return got
+
+    def release(self):
+        self._dog._note_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<watched {type(self._inner).__name__} @ {self._site}>"
+
+
+class _WatchedLock(_WatchedLockBase):
+    pass
+
+
+class _WatchedRLock(_WatchedLockBase):
+    _reentrant = True
+
+    # Condition() wires these through when present; delegating keeps a
+    # watched RLock usable as a Condition's lock with exact semantics
+    # (full release on wait), while the bookkeeping tracks the handoff.
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        n = self._dog._drop_all(self)
+        return self._inner._release_save(), n
+
+    def _acquire_restore(self, state):
+        inner_state, n = state
+        self._inner._acquire_restore(inner_state)
+        self._dog._note_acquire(self, count=n)
+
+
+class LockOrderWatchdog:
+    """Site-level lock-order verifier. Install wraps every lock created
+    afterwards; violations accumulate in `.violations` (never raised in
+    line — a watchdog must not alter the code under test mid-flight)."""
+
+    def __init__(self) -> None:
+        self._orig: Optional[tuple] = None
+        self._tls = threading.local()
+        self._reg_lock: Optional[threading.Lock] = None
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._reported: Set[frozenset] = set()
+        # Every thread's held-stack, keyed by thread id: the release
+        # path needs to reach the ACQUIRER's stack when a plain Lock is
+        # legally handed off and released by a different thread.
+        self._stacks: Dict[int, List[_WatchedLockBase]] = {}
+        self.violations: List[dict] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def installed(self) -> bool:
+        return self._orig is not None
+
+    def install(self) -> "LockOrderWatchdog":
+        if self._orig is not None:
+            return self
+        owner = getattr(threading.Lock, "_chordax_watchdog", None)
+        if owner is not None:
+            # Refusing loudly beats double-wrapping: snapshotting an
+            # already-patched factory as "orig" would make THIS dog's
+            # registry lock itself watched and every lock double
+            # wrapped — which detonates as unbounded re-entrancy
+            # during thread bootstrap. Reuse the installed singleton
+            # (the CHORDAX_LOCK_CHECK=1 path) instead.
+            raise RuntimeError(
+                "a LockOrderWatchdog is already installed; reuse it "
+                "(p2p_dhts_tpu.analysis.lockcheck.WATCHDOG) instead "
+                "of installing a second one")
+        self._orig = (threading.Lock, threading.RLock)
+        self._reg_lock = self._orig[0]()  # a REAL, unwatched lock
+        dog = self
+        orig_lock, orig_rlock = self._orig
+
+        def lock_factory():
+            return _WatchedLock(orig_lock(), _creation_site(), dog)
+
+        def rlock_factory():
+            return _WatchedRLock(orig_rlock(), _creation_site(), dog)
+
+        lock_factory._chordax_watchdog = dog
+        rlock_factory._chordax_watchdog = dog
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig is None:
+            return
+        threading.Lock, threading.RLock = self._orig
+        self._orig = None
+
+    def reset(self) -> None:
+        with self._reg():
+            self._edges.clear()
+            self._reported.clear()
+            self.violations.clear()
+
+    def _reg(self):
+        # Late-bound so reset() before install() still works.
+        if self._reg_lock is None:
+            self._reg_lock = threading.Lock() if self._orig is None \
+                else self._orig[0]()
+        return self._reg_lock
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _stack(self) -> List[_WatchedLockBase]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+            with self._reg():
+                self._stacks[threading.get_ident()] = st
+        return st
+
+    def _note_acquire(self, lock: _WatchedLockBase, count: int = 1) -> None:
+        # Re-entrancy guard: the bookkeeping itself may touch locks
+        # (e.g. interpreter internals during thread bootstrap acquire
+        # watched Event locks before the thread is registered);
+        # recursing back in here would be unbounded. Inner acquisitions
+        # skip bookkeeping — strictly lossy, never wrong.
+        if getattr(self._tls, "busy", False):
+            return
+        self._tls.busy = True
+        try:
+            self._note_acquire_inner(lock, count)
+        finally:
+            self._tls.busy = False
+
+    def _note_acquire_inner(self, lock: _WatchedLockBase,
+                            count: int) -> None:
+        stack = self._stack()
+        held_sites = {id(h): h._site for h in stack if h is not lock}
+        new_edges = []
+        for site in set(held_sites.values()):
+            if site != lock._site:
+                new_edges.append((site, lock._site))
+        stack.extend([lock] * count)
+        if not new_edges:
+            return
+        # get_ident(), NOT current_thread(): the latter constructs a
+        # _DummyThread for unregistered threads, whose Event.set()
+        # acquires another watched lock mid-bookkeeping.
+        thread = f"tid:{threading.get_ident()}"
+        with self._reg():
+            for edge in new_edges:
+                rev = (edge[1], edge[0])
+                pair = frozenset(edge)
+                if rev in self._edges and pair not in self._reported:
+                    self._reported.add(pair)
+                    self.violations.append({
+                        "edge": edge,
+                        "reverse_first_seen_in": self._edges[rev],
+                        "thread": thread,
+                    })
+                self._edges.setdefault(edge, thread)
+
+    def _note_release(self, lock: _WatchedLockBase) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+        # Not held by THIS thread: a plain Lock may legally be acquired
+        # in one thread and released in another (handoff). Purge the
+        # stale entry from the acquirer's stack, or every later
+        # acquisition there records phantom order edges (and possibly
+        # false violations). GIL-atomic list del; a concurrently-read
+        # snapshot in _note_acquire can at worst miss one bookkeeping
+        # edge, never corrupt.
+        with self._reg():
+            stacks = list(self._stacks.values())
+        for st in stacks:
+            for i in range(len(st) - 1, -1, -1):
+                if st[i] is lock:
+                    del st[i]
+                    return
+
+    def _drop_all(self, lock: _WatchedLockBase) -> int:
+        stack = self._stack()
+        n = sum(1 for h in stack if h is lock)
+        stack[:] = [h for h in stack if h is not lock]
+        return n
+
+    # -- assertions ----------------------------------------------------------
+    def assert_clean(self) -> None:
+        if self.violations:
+            lines = [
+                f"  {v['edge'][0]} -> {v['edge'][1]} (thread "
+                f"{v['thread']}; reverse order first seen in thread "
+                f"{v['reverse_first_seen_in']})"
+                for v in self.violations]
+            raise AssertionError(
+                "lock-order violations observed at runtime:\n"
+                + "\n".join(lines))
+
+
+#: Process singleton the CHORDAX_LOCK_CHECK=1 hook installs.
+WATCHDOG = LockOrderWatchdog()

@@ -1,0 +1,113 @@
+"""Default Pass-2 kernel registry: the public device kernels, traced at
+tiny shapes under the dryrun's simulated 8-device mesh layout.
+
+Shapes are deliberately minimal (16-peer ring, batch 8) — jaxpr pattern
+scanning is shape-independent, so small traces keep the gate cheap
+(~2 s total, no XLA compiles). When >= 8 devices are available (the
+unit suite's virtual CPU mesh, or the CLI's self-provisioned one) the
+ring state is placed row-sharded over "peer" and the key batch over
+"data", mirroring `__graft_entry__._dryrun_impl`; with fewer devices
+the same kernels trace unsharded — the taint seeding (any array with a
+shardable axis) is identical either way.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from p2p_dhts_tpu.analysis.gspmd import KernelSpec
+
+
+def default_kernels() -> List[KernelSpec]:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from p2p_dhts_tpu.config import RingConfig
+    from p2p_dhts_tpu.core import churn, ring
+    from p2p_dhts_tpu.dhash import store as dstore
+    from p2p_dhts_tpu.ops import u128
+
+    rng = np.random.RandomState(7)
+
+    def rand_ids(n):
+        return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+    n_peers, batch = 16, 8
+    state_m = ring.build_ring(rand_ids(n_peers),
+                              RingConfig(finger_mode="materialized"))
+    state_c = ring.build_ring(rand_ids(n_peers),
+                              RingConfig(finger_mode="computed"))
+    keys = ring.keys_from_ints(rand_ids(batch))
+    starts = jnp.zeros(batch, jnp.int32)
+
+    mesh = None
+    devs = jax.devices()
+    if len(devs) >= 8 and devs[0].platform == "cpu":
+        mesh = Mesh(np.asarray(devs[:8]).reshape(2, 4), ("data", "peer"))
+        from p2p_dhts_tpu.core.sharded import shard_ring
+        state_m = shard_ring(state_m, mesh, axis="peer")
+        state_c = shard_ring(state_c, mesh, axis="peer")
+        keys = jax.device_put(keys, NamedSharding(mesh, P("data", None)))
+        starts = jax.device_put(starts, NamedSharding(mesh, P("data")))
+
+    store = dstore.empty_store(capacity=16 * batch, max_segments=4)
+    segments = jnp.zeros((batch, 4, 10), jnp.int32)
+    lengths = jnp.full((batch,), 4, jnp.int32)
+    churn_rows = jnp.asarray([1, 3], jnp.int32)
+    join_ids = jnp.asarray(
+        np.frombuffer(rng.bytes(16 * 2), dtype="<u4").reshape(-1, 4).copy())
+
+    specs = [
+        KernelSpec("core.ring.find_successor[materialized]",
+                   ring.find_successor, (state_m, keys, starts)),
+        KernelSpec("core.ring.find_successor[computed]",
+                   ring.find_successor, (state_c, keys, starts)),
+        KernelSpec("core.ring.find_successor_gathered_pred",
+                   ring.find_successor_gathered_pred,
+                   (state_m, keys, starts)),
+        KernelSpec("core.ring.find_successor_unroll2",
+                   ring.find_successor_unroll2, (state_m, keys, starts)),
+        KernelSpec("core.ring.get_n_successors",
+                   lambda s, k, st: ring.get_n_successors(s, k, st, 3),
+                   (state_m, keys, starts)),
+        KernelSpec("core.ring.owner_of", ring.owner_of, (state_m, keys)),
+        KernelSpec("core.ring.placement_converged",
+                   ring.placement_converged, (state_m,)),
+        KernelSpec("core.ring.next_alive_map",
+                   ring.next_alive_map, (state_m,)),
+        KernelSpec("core.ring.materialize_converged_fingers",
+                   lambda s: ring.materialize_converged_fingers(s, 16),
+                   (state_c,)),
+        KernelSpec("core.churn.fail", churn.fail, (state_m, churn_rows)),
+        KernelSpec("core.churn.leave", churn.leave, (state_m, churn_rows)),
+        KernelSpec("core.churn.join", churn.join, (state_m, join_ids)),
+        KernelSpec("core.churn.stabilize_sweep",
+                   churn.stabilize_sweep, (state_m,)),
+        KernelSpec("dhash.store.create_batch",
+                   lambda *a: dstore.create_batch(*a),
+                   (state_m, store, keys, segments, lengths, starts)),
+        KernelSpec("dhash.store.read_batch",
+                   lambda *a: dstore.read_batch(*a),
+                   (state_m, store, keys)),
+        KernelSpec("dhash.store.placement_owners",
+                   lambda s, k, st: dstore.placement_owners(s, k, st, 3),
+                   (state_m, keys, starts)),
+        KernelSpec("ops.u128.ring_successor",
+                   u128.ring_successor,
+                   (state_m.ids, keys, state_m.n_valid)),
+        KernelSpec("ops.u128.searchsorted",
+                   u128.searchsorted,
+                   (state_m.ids, keys, state_m.n_valid)),
+    ]
+
+    if mesh is not None:
+        from p2p_dhts_tpu.core import sharded as csh
+        specs.append(KernelSpec(
+            "core.sharded.find_successor_sharded",
+            lambda s, k, st: csh.find_successor_sharded(s, k, st, mesh),
+            (state_m, keys, starts)))
+
+    return specs

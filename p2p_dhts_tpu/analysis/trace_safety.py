@@ -1,0 +1,347 @@
+"""Pass 1 — trace-safety lint (pure AST, no jax import).
+
+Walks the package for jit-boundary hazards. Scope is deliberately
+syntactic: functions *decorated* with `jax.jit` / `shard_map` (directly
+or through `functools.partial`) are "jit contexts"; everything lexically
+inside one — including nested `def`s, whose parameters are loop-body
+carries and therefore traced — is checked. Helpers that are only
+*called* from jit code are out of scope (the jaxpr pass covers what
+actually traces); the decorated surface is where the repo's contracts
+live and where a Python-level hazard is unambiguous.
+
+Rules:
+
+  trace-branch          Python `if`/`while`/`for` over a traced value
+                        inside a jit body — trace-time concretization
+                        (ConcretizationTypeError at best, silent
+                        shape-specialized retrace at worst). Access to
+                        static attributes (.shape/.ndim/.dtype/.size)
+                        is exempt.
+  host-sync             `.item()`, `jax.device_get`, `np.asarray` /
+                        `np.array`, or `float()`/`int()`/`bool()` over a
+                        traced value inside a jit body: a device->host
+                        sync (or trace-time failure) on the hot path.
+  scalar-closure        `jax.jit(f)(...)` immediately invoked, or a
+                        `jax.jit(...)` wrapper constructed inside a
+                        `for`/`while` body: a FRESH jit wrapper per
+                        call/iteration defeats the trace cache — the
+                        shape/dtype-driven steady-state retrace class
+                        the ServeEngine's trace counter guards at
+                        runtime; this catches it at review time.
+  shardmap-import       importing `jax.experimental.shard_map` (or
+                        `jax.shard_map`) anywhere but compat.py —
+                        bypasses the check_vma<->check_rep version gate
+                        that un-broke seven modules on jax 0.4.x.
+  module-jnp-constant   module-scope `jnp.*(...)` constant: initializes
+                        the default backend at import time — fatal in
+                        driver processes whose TPU runtime is unusable
+                        (the core/ring.py `_BIG` rule, mechanized).
+  bare-except           `except Exception:` / bare `except:` — replace
+                        with typed handling or suppress with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence, Set
+
+from p2p_dhts_tpu.analysis.common import (Finding, dotted_name as _dotted,
+                                          repo_rel)
+
+PASS = "trace-safety"
+
+#: Attribute reads on a traced value that are static at trace time.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type"}
+
+#: numpy module aliases (host-sync rule).
+_NP_NAMES = {"np", "numpy"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _is_shard_map_ref(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and (d == "shard_map"
+                              or d.endswith(".shard_map"))
+
+
+def _const_str_seq(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _jit_decoration(fn: ast.AST) -> Optional[Set[str]]:
+    """If `fn` is decorated as a jit/shard_map body, return the set of
+    STATIC argument names (empty set for shard_map); else None."""
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jit_ref(dec) or _is_shard_map_ref(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            callee = dec.func
+            if _is_jit_ref(callee) or _is_shard_map_ref(callee):
+                return _static_names(fn, dec)
+            if _dotted(callee) in ("functools.partial", "partial"):
+                if dec.args and (_is_jit_ref(dec.args[0])
+                                 or _is_shard_map_ref(dec.args[0])):
+                    return _static_names(fn, dec)
+    return None
+
+
+def _static_names(fn: ast.AST, call: ast.Call) -> Set[str]:
+    static: Set[str] = set()
+    argnames = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            static.update(_const_str_seq(kw.value))
+        elif kw.arg == "static_argnums":
+            nums = []
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)]
+            for i in nums:
+                if 0 <= i < len(argnames):
+                    static.add(argnames[i])
+    return static
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _uses_traced(expr: ast.AST, traced: Set[str]) -> bool:
+    """Does `expr` read a traced name outside a static-attribute access?"""
+    if isinstance(expr, ast.Attribute) and expr.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in traced
+    if isinstance(expr, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+        # `x is (not) None` is an identity check on pytree STRUCTURE —
+        # tracers never intercept `is`; the jax idiom for optional
+        # fields (state.fingers is None) and defaulted args.
+        return False
+    if isinstance(expr, ast.Call):
+        d = _dotted(expr.func)
+        if d == "len":
+            # len() resolves through __len__ -> shape[0]: static.
+            return False
+        if d in ("range", "enumerate", "isinstance", "type"):
+            return any(_uses_traced(a, traced) for a in expr.args)
+    return any(_uses_traced(child, traced)
+               for child in ast.iter_child_nodes(expr))
+
+
+class _JitBodyChecker(ast.NodeVisitor):
+    """Checks one jit-context function body (nested defs included)."""
+
+    def __init__(self, rel: str, traced: Set[str],
+                 findings: List[Finding]):
+        self.rel = rel
+        self.traced = set(traced)
+        self.findings = findings
+
+    def _flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.rel, node.lineno, rule, msg, PASS))
+
+    # nested defs: parameters are traced loop-body carries
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        inner = _JitBodyChecker(self.rel, self.traced | set(
+            _param_names(node)), self.findings)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        inner = _JitBodyChecker(self.rel, self.traced | set(
+            _param_names(node)), self.findings)
+        inner.visit(node.body)
+
+    def visit_If(self, node: ast.If) -> None:
+        if _uses_traced(node.test, self.traced):
+            self._flag(node, "trace-branch",
+                       "Python `if` over a traced value inside a jit "
+                       "body; use jnp.where / lax.cond")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if _uses_traced(node.test, self.traced):
+            self._flag(node, "trace-branch",
+                       "Python `while` over a traced value inside a jit "
+                       "body; use lax.while_loop")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if _uses_traced(node.iter, self.traced):
+            self._flag(node, "trace-branch",
+                       "Python `for` over a traced value inside a jit "
+                       "body; use lax.scan / lax.fori_loop")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == \
+                "item" and not node.args:
+            self._flag(node, "host-sync",
+                       ".item() inside a jit body forces a device->host "
+                       "sync / trace-time concretization")
+        elif d in ("jax.device_get", "device_get"):
+            self._flag(node, "host-sync",
+                       "jax.device_get inside a jit body is a host sync")
+        elif d is not None and any(
+                d == f"{m}.{fn}" for m in _NP_NAMES
+                for fn in ("asarray", "array")):
+            self._flag(node, "host-sync",
+                       f"{d} inside a jit body pulls the value to host "
+                       "(or fails at trace time); use jnp")
+        elif d in ("float", "int", "bool") and any(
+                _uses_traced(a, self.traced) for a in node.args):
+            self._flag(node, "host-sync",
+                       f"{d}() over a traced value inside a jit body is "
+                       "a trace-time concretization")
+        self.generic_visit(node)
+
+
+class _ModuleChecker(ast.NodeVisitor):
+    def __init__(self, rel: str, findings: List[Finding]):
+        self.rel = rel
+        self.findings = findings
+        self._loop_depth = 0
+        self._is_compat = os.path.basename(rel) == "compat.py"
+
+    def _flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.rel, node.lineno, rule, msg, PASS))
+
+    # -- imports -----------------------------------------------------------
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if self._is_compat:
+            return
+        mod = node.module or ""
+        names = {a.name for a in node.names}
+        if mod == "jax.experimental.shard_map" or (
+                mod in ("jax", "jax.experimental")
+                and "shard_map" in names):
+            self._flag(node, "shardmap-import",
+                       "import shard_map via p2p_dhts_tpu.compat (the "
+                       "check_vma<->check_rep version gate), not "
+                       f"directly from {mod!r}")
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if self._is_compat:
+            return
+        for a in node.names:
+            if a.name.startswith("jax.experimental.shard_map"):
+                self._flag(node, "shardmap-import",
+                           "import shard_map via p2p_dhts_tpu.compat, "
+                           "not jax.experimental.shard_map")
+
+    # -- except handlers ----------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        t = node.type
+        if t is None or (isinstance(t, ast.Name)
+                         and t.id == "Exception"):
+            what = "bare `except:`" if t is None else "`except Exception:`"
+            self._flag(node, "bare-except",
+                       f"{what} swallows unrelated failures; type the "
+                       "exception or suppress with a reason")
+        self.generic_visit(node)
+
+    # -- loops (for the jit-in-loop half of scalar-closure) ------------------
+    def visit_For(self, node: ast.For) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    # -- calls ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Call) and _is_jit_ref(node.func.func):
+            self._flag(node, "scalar-closure",
+                       "jax.jit(...)(...) builds a FRESH jit wrapper per "
+                       "call — every invocation retraces; hoist the "
+                       "jitted callable")
+        elif _is_jit_ref(node.func) and self._loop_depth > 0:
+            self._flag(node, "scalar-closure",
+                       "jax.jit(...) constructed inside a loop body — a "
+                       "new wrapper (and trace cache) per iteration; "
+                       "hoist it out of the loop")
+        self.generic_visit(node)
+
+    # -- function defs: dispatch jit-context bodies --------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        static = _jit_decoration(node)
+        if static is not None:
+            traced = set(_param_names(node)) - static
+            checker = _JitBodyChecker(self.rel, traced, self.findings)
+            for stmt in node.body:
+                checker.visit(stmt)
+            # scalar-closure / import checks still apply inside.
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _check_module_constants(tree: ast.Module, rel: str,
+                            findings: List[Finding]) -> None:
+    for stmt in tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and (d.startswith("jnp.")
+                          or d.startswith("jax.numpy.")):
+                    findings.append(Finding(
+                        rel, node.lineno, "module-jnp-constant",
+                        f"module-scope {d}(...) creates a concrete "
+                        "device array at import time — initializes the "
+                        "default backend (see core/ring.py:_BIG)", PASS))
+
+
+def run(paths: Iterable[str], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        rel = repo_rel(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError) as exc:
+            findings.append(Finding(rel, 1, "lint-suppression",
+                                    f"unparseable file: {exc}", PASS))
+            continue
+        _ModuleChecker(rel, findings).visit(tree)
+        _check_module_constants(tree, rel, findings)
+    return findings
+
+
+def run_default(root: str,
+                files: Optional[Sequence[str]] = None) -> List[Finding]:
+    from p2p_dhts_tpu.analysis.common import package_files
+    return run(files if files is not None else package_files(root), root)

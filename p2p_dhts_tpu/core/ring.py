@@ -441,40 +441,42 @@ def placement_converged(state: RingState) -> jax.Array:
     owner — which licenses the O(n)-gather placement fast path in
     dhash.store (vs n sequential full lookup sweeps).
 
-    Known GSPMD residual (jax 0.4.x): under auto-sharding of the peer
-    axis the associative_scan below miscomputes (observed returning
-    False on a converged ring — the SAFE direction: the lax.cond guard
-    then takes the exact walk, costing speed, not correctness). The
-    explicit shard_map path computes this per-shard and is unaffected.
-    Untouched here because its HLO is in the warm on-chip compile cache
-    and a gather-based rewrite is the 10M-shape compile-cliff op class
-    (see churn.leave)."""
+    pred_ids (the id of the nearest live row strictly before each
+    position, ring-wrapped) is computed by a log-depth roll+select
+    doubling reduction — the shard_map-safe spelling of the
+    "carry the last live id" prefix pass. It used to be a
+    `lax.associative_scan`, whose lowering is an interleave of
+    concat-of-slices that jax 0.4.x's SPMD partitioner miscompiles
+    under GSPMD auto-sharding (observed returning False on a converged
+    ring — the safe direction, but it silently routed dhash placement
+    to the slow exact walk on every sharded call). Rolls partition
+    correctly on every path (the two_phase_hop_loop merge rule; the
+    8-device dryrun asserts the post-sweep True), no [N]-index gather
+    is introduced (the TPU compile-cliff op class, see churn.leave),
+    and the ring wrap falls out of the rotation for free."""
     live = live_mask(state)
     n = state.ids.shape[0]
-    rows = jnp.arange(n, dtype=jnp.int32)
     pa = prev_alive_map(state)
     # pa[rows - 1] with ring wrap at row 0 is a pure shift of pa.
     want_pred = jnp.roll(pa[:n], 1)
     preds_ok = ~jnp.any(live & (state.preds != want_pred))
-    # ids[want_pred] WITHOUT the [N]-index gather (the XLA TPU
-    # shape-sensitive compile-cliff op class, see churn.leave): carry
-    # "last live id so far" with a log-depth associative scan, shift by
-    # one, and wrap row positions before the first live row to the
-    # globally-last live id (one scalar-row gather).
-    carried = jax.lax.associative_scan(
-        lambda a, b: (a[0] | b[0],
-                      jnp.where(b[0][:, None], b[1], a[1])),
-        (live, state.ids))[1]
-    last_live_id = state.ids[jnp.maximum(pa[n - 1], 0)]  # scalar-row gather
-    # Strictly-before shift; rows at or before the first live row wrap
-    # to the globally-last live id. "A live row exists before i" is
-    # already encoded in want_pred: with one, pa[i-1] <= i-1 < i; with
-    # none, pa wraps to a live row >= i (the all-dead -1 case is masked
-    # by `live &` below either way).
-    has_prev = (want_pred < rows) & (rows > 0)
-    pred_ids = jnp.where(has_prev[:, None],
-                         jnp.roll(carried, 1, axis=0),
-                         last_live_id[None, :])
+    # carried[i] = id of the nearest LIVE row at-or-before i, wrapping
+    # past row 0 (Hillis-Steele doubling over the ring; log2(N) steps,
+    # each one roll + select — shape-insensitive, GSPMD-safe).
+    carried = jnp.where(live[:, None], state.ids,
+                        jnp.zeros((1, LANES), jnp.uint32))
+    have = live
+    shift = 1
+    while shift < n:
+        carried = jnp.where(have[:, None], carried,
+                            jnp.roll(carried, shift, axis=0))
+        have = have | jnp.roll(have, shift)
+        shift *= 2
+    # Strictly-before = shift the at-or-before result by one row; the
+    # wrap row 0 <- row n-1 is exactly the ring wrap (rows past the
+    # last live row already carry the globally-last live id). All-dead
+    # rings are vacuously converged via the `live &` masks.
+    pred_ids = jnp.roll(carried, 1, axis=0)
     want_min = u128.add_scalar(pred_ids, 1)
     mk_ok = ~jnp.any(live & ~u128.eq(state.min_key, want_min))
     return preds_ok & mk_ok

@@ -257,6 +257,7 @@ class NativeServer:
                 resp = handler(req) or {}
             body = json.dumps(resp, separators=(",", ":")).encode()
             self._lib.ns_respond(slot, body)
+        # chordax-lint: disable=bare-except -- reference envelope parity: handler errors become SUCCESS:false
         except Exception as exc:  # -> SUCCESS:false envelope, like rpc.py
             METRICS.inc("rpc.server.handler_error")
             self._lib.ns_respond_error(slot, str(exc).encode())
@@ -291,6 +292,7 @@ class NativeServer:
     def __del__(self):  # best-effort; tests call close() explicitly
         try:
             self.close()
+        # chordax-lint: disable=bare-except -- best-effort finalizer; close() is the real teardown path
         except Exception:
             pass
 

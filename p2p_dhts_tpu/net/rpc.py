@@ -355,6 +355,7 @@ class Server:
                 resp = handler(req) or {}
             resp["SUCCESS"] = True
             return resp
+        # chordax-lint: disable=bare-except -- reference envelope parity: handler errors become SUCCESS:false (server.h:151-165)
         except Exception as exc:  # handler errors -> SUCCESS false
             METRICS.inc("rpc.server.handler_error")
             return {"SUCCESS": False, "ERRORS": str(exc)}

@@ -158,9 +158,11 @@ def vandermonde_inverse(basis: jax.Array, p: int) -> jax.Array:
     # Multiply (poly) by (x - b_t) iteratively; static m, unrolled.
     for t in range(m):
         b_t = basis[..., t : t + 1]
-        shifted = jnp.concatenate(
-            [jnp.zeros(batch + (1,), jnp.int32), coeffs[..., :-1]], axis=-1
-        )
+        # Shift-by-one via update-slice, NOT concatenate([zeros, slice]):
+        # jax 0.4.x's SPMD partitioner miscompiles concat-of-slices on
+        # sharded operands under GSPMD auto-sharding (the
+        # two_phase_hop_loop merge rule; chordax-lint gspmd pass).
+        shifted = jnp.zeros_like(coeffs).at[..., 1:].set(coeffs[..., :-1])
         coeffs = (shifted - b_t * coeffs) % p
     # coeffs[k] = coeff of x^k (ascending); coeffs[m] = 1 is the leading term.
 

@@ -480,6 +480,7 @@ class AbstractChordPeer:
                     continue
                 try:
                     body()
+                # chordax-lint: disable=bare-except -- reference catch-and-continue parity (StabilizeLoop, chord_peer.cpp:225-238)
                 except Exception as exc:  # catch-and-continue
                     self.log(f"CAUGHT {exc} - CONTINUING")
                 last = time.monotonic()

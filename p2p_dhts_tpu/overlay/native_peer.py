@@ -211,6 +211,7 @@ class NativeChordPeer:
     def __del__(self):
         try:
             self.close()
+        # chordax-lint: disable=bare-except -- best-effort finalizer; close() is the real teardown path
         except Exception:
             pass
 

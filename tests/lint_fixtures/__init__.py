@@ -1,0 +1,10 @@
+"""Seeded-violation corpus for the chordax-lint analyzer tests.
+
+Every file here deliberately contains the hazards the analyzer must
+catch; each offending line carries a `# LINT-EXPECT: <rule>` marker and
+the tests assert the analyzer reports exactly the marked (rule, line)
+pairs — file:line-exact attribution is part of the acceptance contract.
+These files live under tests/ precisely so the shipped-tree scan
+(which covers p2p_dhts_tpu/ + the top-level entry points) never sees
+them.
+"""

@@ -1,0 +1,38 @@
+"""Pass-2 (GSPMD) seeded violations: the exact pre-fix kernel forms
+this repo shipped and was bitten by, reproduced as traceable fixtures.
+
+`two_phase_merge_pre_pr2` is the pre-PR-2 merge of
+core.ring.two_phase_hop_loop (concatenate of the finished straggler
+prefix with a slice of the compacted tail — XLA's SPMD partitioner
+summed the output across an unrelated mesh axis on lane-sharded
+arrays; fixed in PR 2 with dynamic-update-slice).
+`placement_scan_pre_fix` is the pre-fix placement_converged carried-id
+reduction (the associative_scan residual fixed in this PR with a
+roll+select doubling). `dynamic_window_traced_start` is the
+non-replicated-start dynamic_slice class.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def two_phase_merge_pre_pr2(cur_c, cur_p, pos):
+    p = cur_p.shape[0]
+    cur = jnp.concatenate([cur_p, cur_c[p:]])  # LINT-EXPECT: gspmd-concat-of-slices
+    return cur[pos]
+
+
+def placement_scan_pre_fix(live, ids):
+    carried = jax.lax.associative_scan(lambda a, b: (a[0] | b[0], jnp.where(b[0][:, None], b[1], a[1])), (live, ids))[1]  # noqa: E501  # LINT-EXPECT: gspmd-associative-scan
+    return jnp.roll(carried, 1, axis=0)
+
+
+def dynamic_window_traced_start(table, starts):
+    i = starts.sum()
+    return jax.lax.dynamic_slice(table, (i, 0), (2, 4))  # LINT-EXPECT: gspmd-dynamic-slice-traced-start
+
+
+def roll_idiom_is_clean(x):
+    """Same-source concat-of-slices (jnp.roll): partitions correctly —
+    must NOT be flagged (the dryrun's rolls are the evidence)."""
+    return jnp.roll(x, 1, axis=0) + jnp.roll(x, -1, axis=0)

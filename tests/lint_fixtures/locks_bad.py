@@ -1,0 +1,44 @@
+"""Pass-3 (lock-discipline) seeded violations. Parsed, never run."""
+
+import threading
+import time
+
+
+class Tangle:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.guard = threading.Lock()
+        self.cv = threading.Condition(self.a)
+
+    def forward(self):
+        with self.a:
+            with self.b:  # LINT-EXPECT: lock-order-cycle
+                return 1
+
+    def backward(self):
+        with self.b:
+            with self.a:  # LINT-EXPECT: lock-order-cycle
+                return 2
+
+    def sleepy(self):
+        with self.guard:
+            time.sleep(0.5)  # LINT-EXPECT: lock-held-across-blocking
+
+    def chatty(self, sock):
+        with self.guard:
+            sock.sendall(b"x")  # LINT-EXPECT: lock-held-across-blocking
+
+    def doubled(self):
+        with self.guard:
+            with self.guard:  # LINT-EXPECT: lock-reacquire
+                return 3
+
+    def waits_holding_foreign_lock(self):
+        with self.guard:
+            with self.a:
+                self.cv.wait(1.0)  # LINT-EXPECT: lock-held-across-blocking
+
+    def waits_correctly(self):
+        with self.a:
+            self.cv.wait(1.0)  # wait() releases self.a: NOT a violation

@@ -1,0 +1,43 @@
+"""Pass-1 (trace-safety) seeded violations. NEVER imported — the AST
+pass parses it; importing would touch jax.experimental directly and
+build a device constant at import time (which is the point)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map  # noqa: F401  # LINT-EXPECT: shardmap-import
+
+_BAD_CONST = jnp.int32(7)  # LINT-EXPECT: module-jnp-constant
+
+
+@jax.jit
+def branchy(x):
+    if x > 0:  # LINT-EXPECT: trace-branch
+        return x
+    while x.sum() > 0:  # LINT-EXPECT: trace-branch
+        x = x - 1
+    return -x
+
+
+@functools.partial(jax.jit, static_argnames=("flip",))
+def syncy(x, flip=False):
+    if flip:  # static argname: NOT a violation
+        x = -x
+    y = float(x)  # LINT-EXPECT: host-sync
+    total = x.sum().item()  # LINT-EXPECT: host-sync
+    return y + total
+
+
+def retracer(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v + 1)(x))  # LINT-EXPECT: scalar-closure
+    return out
+
+
+def swallower(fn):
+    try:
+        return fn()
+    except Exception:  # LINT-EXPECT: bare-except
+        return None

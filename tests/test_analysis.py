@@ -1,0 +1,254 @@
+"""chordax-lint analyzer: fixture-corpus detection (file:line-exact),
+suppression machinery, the shipped-tree strict gate, and the
+placement_converged GSPMD-rewrite regression."""
+
+import os
+import re
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu import analysis
+from p2p_dhts_tpu.analysis import gspmd, lockcheck, trace_safety
+from p2p_dhts_tpu.analysis.common import apply_suppressions
+from p2p_dhts_tpu.analysis.gspmd import KernelSpec
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "lint_fixtures")
+
+pytestmark = pytest.mark.lint
+
+
+def expected_markers(path):
+    """{(rule, line)} pairs from the fixture's LINT-EXPECT comments."""
+    out = set()
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = re.search(r"#\s*LINT-EXPECT:\s*([a-z0-9\-, ]+)", line)
+            if m:
+                for rule in m.group(1).split(","):
+                    out.add((rule.strip(), i))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 1 — trace safety
+# ---------------------------------------------------------------------------
+
+def test_trace_safety_detects_fixture_corpus_exactly():
+    path = os.path.join(FIXDIR, "trace_bad.py")
+    got = {(f.rule, f.line) for f in trace_safety.run([path], ROOT)}
+    want = expected_markers(path)
+    assert want, "fixture lost its LINT-EXPECT markers"
+    assert got == want, (f"missing: {sorted(want - got)}; "
+                         f"spurious: {sorted(got - want)}")
+
+
+def test_trace_safety_clean_on_idiomatic_jit(tmp_path):
+    # The repo's own idioms must not fire: static argnames branches,
+    # `is None` structure checks, len()/shape reads, range loops.
+    src = textwrap.dedent("""\
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def fine(x, mode="a", extra=None):
+            if mode == "a":
+                x = x + 1
+            if extra is not None:
+                x = x + extra
+            if x.shape[0] > 4:
+                x = x[:4]
+            for i in range(len(x.shape)):
+                x = x + i
+            return jnp.where(x > 0, x, -x)
+        """)
+    p = tmp_path / "fine.py"
+    p.write_text(src)
+    assert trace_safety.run([str(p)], str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2 — GSPMD patterns
+# ---------------------------------------------------------------------------
+
+def _fixture_specs():
+    from lint_fixtures import gspmd_bad
+    cur_c = jnp.arange(8, dtype=jnp.int32)
+    cur_p = jnp.arange(2, dtype=jnp.int32)
+    pos = jnp.zeros(8, jnp.int32)
+    live = jnp.ones(8, bool)
+    ids = jnp.ones((8, 4), jnp.uint32)
+    table = jnp.zeros((8, 4), jnp.int32)
+    starts = jnp.zeros(4, jnp.int32)
+    return gspmd_bad, [
+        KernelSpec("fixture.two_phase_merge_pre_pr2",
+                   gspmd_bad.two_phase_merge_pre_pr2,
+                   (cur_c, cur_p, pos)),
+        KernelSpec("fixture.placement_scan_pre_fix",
+                   gspmd_bad.placement_scan_pre_fix, (live, ids)),
+        KernelSpec("fixture.dynamic_window_traced_start",
+                   gspmd_bad.dynamic_window_traced_start,
+                   (table, starts)),
+        KernelSpec("fixture.roll_idiom_is_clean",
+                   gspmd_bad.roll_idiom_is_clean, (table,)),
+    ]
+
+
+def test_gspmd_detects_pre_fix_kernel_forms_exactly():
+    """The acceptance pair: the pre-PR-2 two_phase_hop_loop merge and
+    the pre-fix placement_converged scan are both flagged, at the
+    offending lines, and the jnp.roll idiom is NOT."""
+    gspmd_bad, specs = _fixture_specs()
+    path = gspmd_bad.__file__
+    got = {(f.rule, f.line) for f in gspmd.run(specs, ROOT)
+           if f.path.endswith("gspmd_bad.py")}
+    want = expected_markers(path)
+    assert want, "fixture lost its LINT-EXPECT markers"
+    assert got == want, (f"missing: {sorted(want - got)}; "
+                         f"spurious: {sorted(got - want)}")
+
+
+def test_gspmd_shipped_kernels_clean():
+    """The fixed tree (dynamic-update-slice merges, roll+select
+    placement scan) has zero GSPMD findings — the regression the
+    analyzer scan stage in the dryrun now enforces every round."""
+    assert gspmd.run_default(ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3 — lock discipline (static)
+# ---------------------------------------------------------------------------
+
+def test_lockcheck_detects_fixture_corpus_exactly():
+    path = os.path.join(FIXDIR, "locks_bad.py")
+    got = {(f.rule, f.line) for f in lockcheck.run([path], ROOT)}
+    want = expected_markers(path)
+    assert want, "fixture lost its LINT-EXPECT markers"
+    assert got == want, (f"missing: {sorted(want - got)}; "
+                         f"spurious: {sorted(got - want)}")
+
+
+def test_lockcheck_shipped_serving_layer_clean():
+    assert lockcheck.run_default(ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESSIBLE = textwrap.dedent("""\
+    def f(fn):
+        try:
+            return fn()
+        except Exception:{comment}
+            return None
+    """)
+
+
+def test_suppression_with_reason_suppresses(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(_SUPPRESSIBLE.format(
+        comment="  # chordax-lint: disable=bare-except -- fallback"))
+    raw = trace_safety.run([str(p)], str(tmp_path))
+    findings, n_sup, _ = apply_suppressions(raw, str(tmp_path))
+    assert findings == [] and n_sup == 1
+
+
+def test_suppression_without_reason_is_its_own_finding(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(_SUPPRESSIBLE.format(
+        comment="  # chordax-lint: disable=bare-except"))
+    raw = trace_safety.run([str(p)], str(tmp_path))
+    findings, n_sup, _ = apply_suppressions(raw, str(tmp_path))
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["bare-except", "lint-suppression"] and n_sup == 0
+
+
+def test_suppression_on_standalone_line_covers_next_statement(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        def f(fn):
+            try:
+                return fn()
+            # chordax-lint: disable=bare-except -- boundary
+            except Exception:
+                return None
+        """))
+    raw = trace_safety.run([str(p)], str(tmp_path))
+    findings, n_sup, _ = apply_suppressions(raw, str(tmp_path))
+    assert findings == [] and n_sup == 1
+
+
+def test_reasonless_suppression_in_otherwise_clean_file(tmp_path):
+    # The hygiene check must not depend on the file having some OTHER
+    # finding: a reasonless opt-out in a clean file still surfaces.
+    p = tmp_path / "clean.py"
+    p.write_text("def f():\n"
+                 "    # chordax-lint: disable=bare-except\n"
+                 "    return 1\n")
+    findings, n_sup = analysis.run_all(root=str(tmp_path),
+                                       passes=("trace",),
+                                       files=[str(p)])
+    assert [f.rule for f in findings] == ["lint-suppression"]
+    assert n_sup == 0
+
+
+def test_unknown_rule_suppression_flagged(tmp_path):
+    from p2p_dhts_tpu.analysis.common import SuppressionIndex
+    p = tmp_path / "mod.py"
+    p.write_text("x = 1  # chordax-lint: disable=no-such-rule -- why\n")
+    idx = SuppressionIndex()
+    idx.add_file(str(p), "mod.py")
+    assert [f.rule for f in idx.problems] == ["lint-suppression"]
+
+
+# ---------------------------------------------------------------------------
+# the CI gate: shipped tree is strict-clean
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_strict_clean():
+    """`python -m p2p_dhts_tpu.analysis --strict` exits 0 on this tree:
+    zero unsuppressed findings across all three passes, and the
+    suppression machinery is genuinely exercised (every suppression in
+    the tree carries a reason)."""
+    findings, n_sup = analysis.run_all(root=ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert n_sup > 0  # the reasoned bare-except sweep rides this gate
+
+
+# ---------------------------------------------------------------------------
+# placement_converged rewrite regression (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_placement_converged_roll_reduction_semantics(rng):
+    from p2p_dhts_tpu.config import RingConfig
+    from p2p_dhts_tpu.core import churn
+    from p2p_dhts_tpu.core.ring import build_ring, placement_converged
+
+    ids = [int.from_bytes(rng.bytes(16), "little") for _ in range(24)]
+    state = build_ring(ids, RingConfig(finger_mode="computed"))
+    assert bool(placement_converged(state))
+
+    # Dead rows, un-swept: preds/min_key stale -> not converged.
+    failed = churn.fail(state, jnp.asarray([2, 3, 11], jnp.int32))
+    assert not bool(placement_converged(failed))
+
+    # Post-sweep: custody boundaries re-tile the surviving ring.
+    swept = churn.stabilize_sweep(failed)
+    assert bool(placement_converged(swept))
+
+    # A single corrupted live min_key flips it back off (the scan must
+    # see through dead gaps to the true previous LIVE id).
+    alive = np.asarray(swept.alive)
+    live_rows = np.nonzero(alive[: int(swept.n_valid)])[0]
+    victim = int(live_rows[len(live_rows) // 2])
+    bad = swept._replace(
+        min_key=swept.min_key.at[victim].set(
+            jnp.asarray([1, 2, 3, 4], jnp.uint32)))
+    assert not bool(placement_converged(bad))

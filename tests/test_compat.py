@@ -1,0 +1,101 @@
+"""compat.shard_map shim: the check_vma -> check_rep mapping on jax
+0.4.x/0.5.x must hold exactly, and a future jax bump must fail HERE
+(loudly, in one test) instead of re-breaking the seven sharded modules
+that import through the shim."""
+
+import functools
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu import compat
+
+_LEGACY = not hasattr(jax, "shard_map")
+
+
+def test_shim_selects_the_right_entry_point():
+    if _LEGACY:
+        # 0.4.x/0.5.x: the adapter wraps jax.experimental.shard_map.
+        assert compat.shard_map is not getattr(jax, "shard_map", None)
+        assert hasattr(compat, "_shard_map_legacy")
+    else:
+        # Modern jax: the shim must be the public entry point itself —
+        # and that entry point must accept check_vma, or the adapter
+        # below has to come back. This is the loud bump-time failure.
+        assert compat.shard_map is jax.shard_map
+        import inspect
+        assert "check_vma" in inspect.signature(jax.shard_map).parameters
+
+
+@pytest.mark.skipif(not _LEGACY, reason="adapter only exists on jax<0.6")
+def test_check_vma_translates_to_check_rep(monkeypatch):
+    captured = {}
+
+    def fake_legacy(f, **kwargs):
+        captured.update(kwargs)
+        return f
+
+    monkeypatch.setattr(compat, "_shard_map_legacy", fake_legacy)
+    out = compat.shard_map(lambda x: x, mesh="m", in_specs="i",
+                           out_specs="o", check_vma=False)
+    assert callable(out)
+    assert captured["check_rep"] is False
+    assert "check_vma" not in captured
+    assert captured["mesh"] == "m"
+    assert captured["in_specs"] == "i" and captured["out_specs"] == "o"
+
+
+@pytest.mark.skipif(not _LEGACY, reason="adapter only exists on jax<0.6")
+def test_partial_decorator_idiom(monkeypatch):
+    # functools.partial(shard_map, ...) — the kernels' decorator form —
+    # must defer and still translate the kwarg on the final call.
+    captured = {}
+
+    def fake_legacy(f, **kwargs):
+        captured.update(kwargs)
+        return f
+
+    monkeypatch.setattr(compat, "_shard_map_legacy", fake_legacy)
+    deco = functools.partial(compat.shard_map, mesh="m", in_specs="i",
+                             out_specs="o", check_vma=True)
+
+    def fn(x):
+        return x
+
+    assert deco(fn) is fn
+    assert captured["check_rep"] is True and "check_vma" not in captured
+
+    # The shim's own keyword-only partial application too:
+    captured.clear()
+    deco2 = compat.shard_map(mesh="m", in_specs="i", out_specs="o",
+                             check_vma=False)
+    assert deco2(fn) is fn
+    assert captured["check_rep"] is False
+
+
+def test_shim_executes_end_to_end_on_the_mesh():
+    """Functional proof on the suite's 8-device CPU mesh: a shard_map
+    written in the MODERN spelling (check_vma=) runs through the shim
+    on whatever jax this container bakes in."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs), ("peer",))
+
+    @functools.partial(compat.shard_map, mesh=mesh,
+                       in_specs=P("peer"), out_specs=P(),
+                       check_vma=False)
+    def total(x):
+        return jax.lax.psum(jnp.sum(x), "peer")
+
+    x = jnp.arange(16, dtype=jnp.int32)
+    assert int(total(x)) == 120
+
+
+def test_importing_compat_reexports_only_shard_map():
+    mod = importlib.import_module("p2p_dhts_tpu.compat")
+    assert mod.__all__ == ["shard_map"]

@@ -1,0 +1,188 @@
+"""Runtime lock-order watchdog (chordax-lint Pass 3's dynamic half):
+deliberate-inversion detection, Condition compatibility, a fast
+engine burst under instrumentation, and the slow satellite — the
+existing serve soak re-run in a subprocess under CHORDAX_LOCK_CHECK=1
+with zero order violations asserted at session end."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.analysis.lockcheck import LockOrderWatchdog
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def dog():
+    from p2p_dhts_tpu.analysis.lockcheck import WATCHDOG
+    if WATCHDOG.installed:
+        # CHORDAX_LOCK_CHECK=1 run: the env singleton already owns the
+        # threading patch — installing a second watchdog double-wraps
+        # every lock (install() refuses). Reuse it, and reset after
+        # each test so the DELIBERATE inversions below don't fail the
+        # whole session through conftest's sessionfinish verdict.
+        WATCHDOG.reset()
+        try:
+            yield WATCHDOG
+        finally:
+            WATCHDOG.reset()
+        return
+    d = LockOrderWatchdog().install()
+    try:
+        yield d
+    finally:
+        d.uninstall()
+
+
+def test_watchdog_catches_deliberate_inversion(dog):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    forward()
+    t = threading.Thread(target=backward)
+    t.start()
+    t.join()
+    assert len(dog.violations) == 1
+    edge = dog.violations[0]["edge"]
+    assert {s.split(":")[0] for s in edge} == {__file__}
+    with pytest.raises(AssertionError, match="lock-order violations"):
+        dog.assert_clean()
+
+
+def test_watchdog_consistent_order_is_clean(dog):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    dog.assert_clean()
+
+
+def test_watchdog_condition_wait_releases_lock(dog):
+    # Condition wraps a watched lock; wait() must hand the lock off
+    # cleanly through the wrapper (bookkeeping included) and notify
+    # must wake the waiter — the exact mechanism the ServeEngine's
+    # _not_empty/_not_full conditions rely on.
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    box = []
+
+    def waiter():
+        with cond:
+            while not box:
+                cond.wait(5.0)
+            box.append("seen")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        box.append("item")
+        cond.notify()
+    t.join(10.0)
+    assert not t.is_alive() and box == ["item", "seen"]
+    dog.assert_clean()
+
+
+def test_watchdog_cross_thread_release_leaves_no_stale_hold(dog):
+    # A plain Lock may legally be acquired in one thread and released
+    # in another (handoff). The stale held-entry must be purged from
+    # the ACQUIRER's stack, or later acquisitions there fabricate
+    # phantom edges — and eventually a false violation.
+    gate = threading.Lock()
+    other = threading.Lock()
+    gate.acquire()
+    t = threading.Thread(target=gate.release)
+    t.start()
+    t.join()
+    with other:  # pre-fix: recorded a phantom gate->other edge here
+        pass
+    with other:
+        with gate:  # other->gate; with the phantom edge this was a
+            pass    # false inversion
+    dog.assert_clean()
+
+
+def test_watchdog_rlock_reentrancy_tracked(dog):
+    r = threading.RLock()
+    inner = threading.Lock()
+    with r:
+        with r:
+            with inner:
+                pass
+    # Reentrant holds must not self-report; the r->inner edge records.
+    dog.assert_clean()
+
+
+def test_engine_burst_under_watchdog_clean(dog):
+    """A concurrent find_successor burst through a fresh ServeEngine
+    with every lock instrumented: the tier-1-speed version of the soak
+    satellite (the full soak runs below, slow-marked)."""
+    from p2p_dhts_tpu.config import RingConfig
+    from p2p_dhts_tpu.core.ring import build_ring
+    from p2p_dhts_tpu.serve import ServeEngine
+
+    rng = np.random.RandomState(11)
+    ids = [int.from_bytes(rng.bytes(16), "little") for _ in range(32)]
+    state = build_ring(ids, RingConfig(finger_mode="materialized"))
+    eng = ServeEngine(state, bucket_min=4, bucket_max=16,
+                      name="lockwatch-burst")
+    errors = []
+
+    def worker(seed):
+        r = np.random.RandomState(seed)
+        try:
+            for _ in range(20):
+                eng.find_successor(
+                    int.from_bytes(r.bytes(16), "little"),
+                    int(r.randint(32)), timeout=120)
+        except BaseException as exc:  # noqa: BLE001 — recorded
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    eng.close()
+    assert not errors
+    dog.assert_clean()
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_serve_soak_under_lock_check_env():
+    """Satellite: the EXISTING tests/test_serve.py soak, run under
+    CHORDAX_LOCK_CHECK=1 in a subprocess (the env hook installs the
+    watchdog before any engine lock exists; the conftest sessionfinish
+    hook fails the run on any recorded order violation)."""
+    env = dict(os.environ)
+    env["CHORDAX_LOCK_CHECK"] = "1"
+    env["CHORDAX_LINT_GATE"] = "0"  # the gate already ran out here
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_serve.py::test_engine_soak_mixed_sustained_load",
+         "-q", "-m", "soak", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=3000)
+    assert proc.returncode == 0, (
+        f"soak under CHORDAX_LOCK_CHECK=1 failed:\n{proc.stdout[-4000:]}"
+        f"\n{proc.stderr[-4000:]}")
+    assert "lock-order violations" not in proc.stdout

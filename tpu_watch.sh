@@ -43,6 +43,17 @@ for i in $(seq 1 80); do
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
+  # chordax-lint gate (ISSUE 3): a finding means this tree is not the
+  # code we want hardware evidence for — fail the cycle before any
+  # bench touches the chip. CPU-pinned so the gate never claims the
+  # TPU (same etiquette as the dryrun respawn).
+  if ! JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m p2p_dhts_tpu.analysis --strict >> tpu_watch.log 2>&1; then
+    log "chordax-lint gate FAILED - fix findings before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
