@@ -23,6 +23,12 @@ Configs (BASELINE.json.configs):
                   sustained req/s + p50/p99 latency under closed-loop
                   and open-loop host traffic, batch fill ratio,
                   zero-retrace and sub-legacy-window latency invariants.
+  7. gateway    — the multi-ring RPC front door (gateway.Gateway): TCP
+                  FIND_SUCCESSOR vectors -> router -> per-ring engines;
+                  keys/s + latency vs the direct-engine path, 1000-key
+                  parity, zero retraces through the RPC path, and
+                  slow-ring isolation (held ring degrades visibly while
+                  the healthy ring keeps engine-serving).
 
 vs_baseline everywhere is measured against the north-star derivative
 1.25M lookups/sec/chip (1M concurrent lookups < 100 ms on a v5e-8 = 8
@@ -42,7 +48,7 @@ Usage:
     python bench.py --smoke         # scaled-down quick pass
     python bench.py --config NAME   # one config (chord16|ida|dhash|
                                     #   dhash_sharded|lookup_1m|sweep_10m|
-                                    #   serve)
+                                    #   serve|gateway)
 """
 
 from __future__ import annotations
@@ -1072,13 +1078,231 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
 
 
 # ---------------------------------------------------------------------------
+# config 7: gateway — RPC -> gateway -> engine front door (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+def bench_gateway(n_peers_a: int = 65536, n_peers_b: int = 16384,
+                  rpc_workers: int = 8, rpc_reqs_each: int = 50,
+                  vector_keys: int = 16, parity_keys: int = 1000,
+                  bucket_min: int = 16, bucket_max: int = 256) -> dict:
+    """End-to-end RPC -> gateway -> ServeEngine serving: two rings
+    routed by key-range ownership behind one net/rpc.py server, closed-
+    loop TCP FIND_SUCCESSOR traffic (each request a vector of keys),
+    measured against the direct-engine path from --config serve. Hard
+    assertions: engine-vs-gateway parity over >= 1000 keys, ZERO
+    steady-state retraces through the RPC path, and a held (slow) ring
+    demonstrably not blocking requests routed to the healthy ring —
+    the slow ring degrades VISIBLY onto the fallback path while the
+    healthy ring keeps serving engine-batched answers."""
+    import threading
+
+    from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+    from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+    from p2p_dhts_tpu.metrics import nearest_rank
+    from p2p_dhts_tpu.net.rpc import Client, Server
+
+    rng = np.random.RandomState(0xCAFE)
+    half = 1 << 127
+    state_a = build_ring(_rand_lanes(rng, n_peers_a),
+                         RingConfig(finger_mode="materialized"))
+    state_b = build_ring(_rand_lanes(rng, n_peers_b),
+                         RingConfig(finger_mode="materialized"))
+    gw = Gateway()
+    gw.add_ring("a", state_a, key_range=(0, half - 1), default=True,
+                bucket_min=bucket_min, bucket_max=bucket_max,
+                reprobe_s=300.0, warmup=["find_successor"])
+    gw.add_ring("b", state_b, key_range=(half, KEYS_IN_RING - 1),
+                bucket_min=bucket_min, bucket_max=bucket_max,
+                reprobe_s=300.0, warmup=["find_successor"])
+    eng_a = gw.router.get("a").engine
+    eng_b = gw.router.get("b").engine
+
+    # -- parity gate: gateway answers == direct kernel, >= 1000 keys ---
+    pkeys = _rand_ids(rng, parity_keys)
+    res = gw.find_successor_many([(k, 0) for k in pkeys], timeout=600)
+    for state, rid in ((state_a, "a"), (state_b, "b")):
+        lanes = [(k, r) for k, r in zip(pkeys, res) if r[2] == rid]
+        ints = [k for k, _ in lanes]
+        o, h = find_successor(state, keys_from_ints(ints),
+                              jnp.zeros(len(ints), jnp.int32))
+        o, h = np.asarray(o), np.asarray(h)
+        assert all(r[0] == int(o[j]) and r[1] == int(h[j])
+                   for j, (_, r) in enumerate(lanes)), \
+            f"gateway/direct parity FAIL on ring {rid}"
+
+    # -- the RPC front door --------------------------------------------
+    # Everything after run_in_background tears down in the finally: a
+    # failed assertion must surface as the assertion, not as leaked
+    # server threads, a permanently held dispatcher, or undrained
+    # engines confusing the tpu_watch gate.
+    srv = Server(0, {}, num_threads=max(rpc_workers, 3))
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        stats = _bench_gateway_phases(
+            gw, srv, eng_a, eng_b, rng, pkeys, half, rpc_workers,
+            rpc_reqs_each, vector_keys)
+    finally:
+        eng_b._test_hold.clear()
+        srv.kill()
+        gw.close()
+
+    return _emit({
+        "config": "gateway",
+        "metric": f"RPC->gateway->engine find_successor keys/sec "
+                  f"(2 rings {n_peers_a}+{n_peers_b} peers, "
+                  f"{rpc_workers} TCP workers x {vector_keys}-key "
+                  f"vectors)",
+        "value": round(stats["rpc_keys_s"], 1),
+        "unit": "keys/sec",
+        "vs_baseline": None,
+        "rpc_req_s": round(stats["rpc_req_s"], 1),
+        "rpc_p50_ms": round(stats["rpc_p50"] * 1e3, 3),
+        "rpc_p99_ms": round(stats["rpc_p99"] * 1e3, 3),
+        "direct_engine_keys_s": round(stats["direct_keys_s"], 1),
+        "gateway_overhead_x": round(
+            stats["direct_keys_s"] / stats["rpc_keys_s"], 2)
+        if stats["rpc_keys_s"] else None,
+        "steady_state_retraces": 0,
+        "slow_ring_isolation": {
+            "b_state_under_hold": stats["b_state"],
+            "b_outcomes": stats["b_outcomes"],
+            "a_p99_ms_under_b_hold": round(stats["a_p99"] * 1e3, 3),
+        },
+        "ring_stats": {r: stats["gw_stats"]["rings"][r]
+                       for r in ("a", "b")},
+        "single_flight_hits": stats["gw_stats"]["single_flight_hits"],
+        "parity": f"ok (exact, {len(pkeys)} keys gateway vs direct)",
+        "buckets": f"{bucket_min}..{bucket_max}",
+        "device": str(jax.devices()[0]),
+    })
+
+
+def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
+                          rpc_workers, rpc_reqs_each, vector_keys) -> dict:
+    """The measured phases of bench_gateway (closed-loop RPC, direct
+    comparison, retrace check, slow-ring isolation); split out so the
+    caller's try/finally owns ALL teardown."""
+    import threading
+
+    from p2p_dhts_tpu.net.rpc import Client
+    from p2p_dhts_tpu.metrics import nearest_rank
+
+    def _p50_p99(samples):
+        s = sorted(samples)
+        return nearest_rank(s, 0.5), nearest_rank(s, 0.99)
+
+    # Closed loop over TCP: each request carries a vector of keys.
+    lats: list = []
+    lat_lock = threading.Lock()
+    errors: list = []
+
+    def worker(seed):
+        wrng = np.random.RandomState(seed)
+        mine = []
+        for _ in range(rpc_reqs_each):
+            keys = [format(int.from_bytes(wrng.bytes(16), "little"), "x")
+                    for _ in range(vector_keys)]
+            t0 = time.perf_counter()
+            resp = Client.make_request(
+                "127.0.0.1", srv.port,
+                {"COMMAND": "FIND_SUCCESSOR", "KEYS": keys,
+                 "DEADLINE_MS": 60000.0}, timeout=120.0)
+            mine.append(time.perf_counter() - t0)
+            if not resp.get("SUCCESS") or -1 in resp["OWNERS"]:
+                errors.append(resp)
+        with lat_lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(j,))
+               for j in range(rpc_workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rpc_wall = time.perf_counter() - t0
+    assert not errors, f"RPC-path failures: {errors[:3]}"
+    total_keys = rpc_workers * rpc_reqs_each * vector_keys
+    rpc_keys_s = total_keys / rpc_wall
+    rpc_req_s = rpc_workers * rpc_reqs_each / rpc_wall
+    rpc_p50, rpc_p99 = _p50_p99(lats)
+
+    # Direct-engine comparison (the --config serve path, same keys/s
+    # basis): submit the identical vectors straight into ring a's
+    # engine — the gateway/RPC overhead is the difference.
+    dkeys = _rand_ids(rng, total_keys)
+    t0 = time.perf_counter()
+    slots = eng_a.submit_many("find_successor", [(k, 0) for k in dkeys])
+    for s in slots:
+        s.wait(600)
+    direct_keys_s = total_keys / (time.perf_counter() - t0)
+
+    # -- zero steady-state retraces through the RPC path ---------------
+    eng_a.assert_no_retraces()
+    eng_b.assert_no_retraces()
+
+    # -- slow-ring isolation -------------------------------------------
+    # Hold ring b's dispatcher (the deterministic slow-ring hook) and
+    # drive it with NO caller deadline against a tightened gateway
+    # wait bound: an engine that cannot answer the gateway's OWN wait
+    # is health evidence (a caller's short deadline deliberately is
+    # not, post-review), so ring b must degrade VISIBLY onto the
+    # fallback path WITHOUT dragging ring a's engine-served requests
+    # along.
+    eng_b._test_hold.set()
+    gw.DEFAULT_WAIT_S = 1.0  # instance override; restored in finally
+    b_outcomes = {"fallback_ok": 0, "shed": 0}
+    half_key = half  # first key of ring b's range
+    try:
+        for j in range(4):
+            try:
+                owner, hops = gw.find_successor(half_key + j * 12345, 0)
+                # Served despite the held engine: the fallback path.
+                b_outcomes["fallback_ok"] += 1
+            except RuntimeError:  # Timeout/DeadlineExpired/RingBusy
+                b_outcomes["shed"] += 1
+    finally:
+        del gw.DEFAULT_WAIT_S  # back to the class default
+    a_lats = []
+    a_batches_before = eng_a.batches_served
+    for j in range(40):
+        t0 = time.perf_counter()
+        gw.find_successor(int(pkeys[j]) % half, 0, timeout=30.0)
+        a_lats.append(time.perf_counter() - t0)
+    eng_b._test_hold.clear()
+    a_p99 = _p50_p99(a_lats)[1]
+    b_state = gw.router.get("b").state
+    assert b_outcomes["fallback_ok"] + b_outcomes["shed"] == 4, b_outcomes
+    assert b_state in ("degraded", "ejected"), (
+        f"held ring b should be visibly degraded, is {b_state}")
+    assert eng_a.batches_served > a_batches_before, \
+        "ring a stopped serving through its engine during the b stall"
+    assert a_p99 < 10.0, (
+        f"healthy-ring p99 {a_p99:.3f}s while ring b was held — the "
+        f"slow ring is convoying the healthy one")
+    return {
+        "rpc_keys_s": rpc_keys_s,
+        "rpc_req_s": rpc_req_s,
+        "rpc_p50": rpc_p50,
+        "rpc_p99": rpc_p99,
+        "direct_keys_s": direct_keys_s,
+        "b_state": b_state,
+        "b_outcomes": b_outcomes,
+        "a_p99": a_p99,
+        "gw_stats": gw.stats(),
+    }
+
+
+# ---------------------------------------------------------------------------
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--config", default=None,
                     choices=["chord16", "ida", "dhash", "dhash_sharded",
-                             "lookup_1m", "sweep_10m", "serve"])
+                             "lookup_1m", "sweep_10m", "serve",
+                             "gateway"])
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace per config "
                          "into DIR/<config> (VERDICT r3 #4: evidence-based "
@@ -1104,6 +1328,10 @@ def main() -> None:
                 n_peers=1024, closed_workers=8, closed_reqs_each=150,
                 open_rate=1500.0, open_reqs=1500, solo_reqs=200,
                 bucket_min=8, bucket_max=64),
+            "gateway": lambda: bench_gateway(
+                n_peers_a=2048, n_peers_b=1024, rpc_workers=4,
+                rpc_reqs_each=25, vector_keys=8, parity_keys=1000,
+                bucket_min=8, bucket_max=64),
         }
     else:
         runs = {
@@ -1114,6 +1342,7 @@ def main() -> None:
             "lookup_1m": bench_lookup_1m,
             "sweep_10m": lambda: bench_sweep_10m(hopscan=args.hopscan),
             "serve": bench_serve,
+            "gateway": bench_gateway,
         }
     if args.config:
         runs = {args.config: runs[args.config]}

@@ -51,11 +51,18 @@ from p2p_dhts_tpu.analysis.common import (Finding, dotted_name as _dotted,
 PASS = "lock-discipline"
 
 #: The threaded serving layer — the default static-analysis surface.
+#: The gateway front door (ISSUE 4) is part of it: its documented lock
+#: order (router/backend/admission locks are LEAVES, never held across
+#: engine calls — gateway/router.py docstring) is audited here.
 DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "serve.py"),
     os.path.join("p2p_dhts_tpu", "net", "rpc.py"),
     os.path.join("p2p_dhts_tpu", "overlay", "finger_table.py"),
     os.path.join("p2p_dhts_tpu", "overlay", "jax_bridge.py"),
+    os.path.join("p2p_dhts_tpu", "gateway", "router.py"),
+    os.path.join("p2p_dhts_tpu", "gateway", "admission.py"),
+    os.path.join("p2p_dhts_tpu", "gateway", "frontend.py"),
+    os.path.join("p2p_dhts_tpu", "gateway", "metrics_ext.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",
@@ -450,6 +457,19 @@ class _WatchedLockBase:
 
     def locked(self):
         return self._inner.locked()
+
+    def __getattr__(self, name):
+        # Delegate everything else to the real lock: stdlib modules
+        # poke CPython-specific surface at IMPORT time (e.g.
+        # concurrent.futures.thread registers
+        # _global_shutdown_lock._at_fork_reinit with os.register_at_fork)
+        # and a wrapper that hides it breaks those imports under
+        # CHORDAX_LOCK_CHECK=1. Guarded through __dict__ so a
+        # half-constructed wrapper can't recurse.
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
 
     def __enter__(self):
         return self.acquire()

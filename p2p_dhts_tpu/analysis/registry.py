@@ -101,6 +101,12 @@ def default_kernels() -> List[KernelSpec]:
         KernelSpec("ops.u128.searchsorted",
                    u128.searchsorted,
                    (state_m.ids, keys, state_m.n_valid)),
+        # The serve/gateway finger kernel (serve.ServeEngine's
+        # "finger_index" kind — the RPC FINGER_INDEX command's device
+        # path): entry index = bit_length((key - start) mod 2^128) - 1.
+        KernelSpec("serve.finger_index",
+                   lambda k, s: u128.bit_length(u128.sub(k, s)) - 1,
+                   (keys, keys)),
     ]
 
     if mesh is not None:

@@ -1,0 +1,45 @@
+"""chordax-gateway: the multi-ring serving front door (ISSUE 4).
+
+Fronts N named rings/stores with one router, coalesces all inbound RPC
+traffic into each ring's ServeEngine, and degrades gracefully per ring:
+
+  net/rpc.py Server ──> Gateway (route/health/admission) ──> ServeEngine
+                                                             ──> device
+
+Modules:
+  router       ring registry, key-range routing, health state machine
+               (healthy -> degraded -> ejected, periodic re-probe)
+  admission    per-ring bounded admission, deadline propagation,
+               single-flight duplicate suppression
+  frontend     the Gateway itself + the FIND_SUCCESSOR / GET / PUT /
+               FINGER_INDEX RPC handlers + the process-global instance
+  metrics_ext  per-ring/per-op counters, gauges, p50/p99 histograms
+
+Importing this package never initializes a jax backend (overlay
+etiquette); device work happens only once requests flow.
+"""
+
+from p2p_dhts_tpu.gateway.admission import (  # noqa: F401
+    Deadline,
+    NO_DEADLINE,
+    RingAdmission,
+    RingBusyError,
+    SingleFlight,
+)
+from p2p_dhts_tpu.gateway.frontend import (  # noqa: F401
+    FINGER_RING_ID,
+    GATEWAY_COMMANDS,
+    Gateway,
+    global_gateway,
+    install_gateway_handlers,
+)
+from p2p_dhts_tpu.gateway.metrics_ext import GatewayMetrics  # noqa: F401
+from p2p_dhts_tpu.gateway.router import (  # noqa: F401
+    DEGRADED,
+    EJECTED,
+    HEALTHY,
+    RingBackend,
+    RingRouter,
+    RingUnavailableError,
+    UnknownRingError,
+)

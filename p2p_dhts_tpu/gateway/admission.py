@@ -1,0 +1,234 @@
+"""Front-door admission policy: per-ring bounds, deadlines, single-flight.
+
+Three mechanisms, each answering one overload question:
+
+  * `RingAdmission` — a bounded per-ring in-flight counter, DISTINCT
+    from the engine's global queue: every ring gets its own admission
+    budget, so a slow or held ring fills ITS budget and starts
+    rejecting (RingBusyError) while the other rings' requests never
+    queue behind it. Waiting for a slot is bounded by `max_wait_s` AND
+    by the request's deadline, whichever is tighter — admission can
+    delay a request, never wedge it.
+  * `Deadline` — one absolute time.perf_counter() instant threaded
+    end-to-end: client timeout -> gateway budget -> engine slot
+    (serve.ServeEngine drops expired slots pre-dispatch). `None` means
+    no deadline (the reference's 5 s client timeout still bounds the
+    TCP wait).
+  * `SingleFlight` — duplicate suppression for idempotent lookups: a
+    FIND_SUCCESSOR storm on one hot key collapses to ONE engine
+    submission whose answer fans out to every concurrent duplicate.
+    Entries live only while the leader is in flight (no staleness — a
+    completed answer is never re-served), and a full table degrades to
+    pass-through, never to blocking.
+
+LOCK ORDER: `RingAdmission` waits only on its own condition (which
+releases its own lock — the lockcheck-exempt pattern) and `SingleFlight`
+holds its lock only for dict bookkeeping; the leader's engine call and
+the followers' event wait both run lock-free. Neither lock ever nests
+with the router's or a backend's. This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from p2p_dhts_tpu.serve import DeadlineExpiredError
+
+
+class RingBusyError(RuntimeError):
+    """The ring's admission budget stayed full past the caller's wait
+    bound — per-ring backpressure, surfaced instead of queued."""
+
+
+class Deadline:
+    """An absolute time.perf_counter() instant (or None = unbounded)."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: Optional[float]):
+        self.at = at
+
+    @classmethod
+    def from_timeout(cls, timeout_s: Optional[float]) -> "Deadline":
+        if timeout_s is None:
+            return cls(None)
+        return cls(time.perf_counter() + float(timeout_s))
+
+    @classmethod
+    def from_budget_ms(cls, budget_ms) -> "Deadline":
+        """Wire-form budget (the RPC request's DEADLINE_MS field)."""
+        if budget_ms is None:
+            return cls(None)
+        return cls.from_timeout(float(budget_ms) / 1e3)
+
+    def remaining(self) -> Optional[float]:
+        if self.at is None:
+            return None
+        return self.at - time.perf_counter()
+
+    def expired(self) -> bool:
+        return self.at is not None and time.perf_counter() >= self.at
+
+    def clamp(self, timeout_s: Optional[float]) -> Optional[float]:
+        """timeout_s bounded by the remaining budget (None = neither)."""
+        rem = self.remaining()
+        if rem is None:
+            return timeout_s
+        if timeout_s is None:
+            return max(rem, 0.0)
+        return max(min(timeout_s, rem), 0.0)
+
+
+#: The no-deadline singleton callers may share.
+NO_DEADLINE = Deadline(None)
+
+
+class RingAdmission:
+    """Bounded in-flight budget for one ring's front door."""
+
+    #: Default bound on the wait for an admission slot; the deadline
+    #: tightens it, never widens it.
+    MAX_WAIT_S = 0.25
+
+    def __init__(self, ring_id: str, max_inflight: int = 4096,
+                 max_wait_s: Optional[float] = None):
+        if max_inflight <= 0:
+            raise ValueError(f"max_inflight must be positive, got "
+                             f"{max_inflight}")
+        self.ring_id = str(ring_id)
+        self.max_inflight = int(max_inflight)
+        self.max_wait_s = float(max_wait_s if max_wait_s is not None
+                                else self.MAX_WAIT_S)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight = 0
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def acquire(self, n: int = 1,
+                deadline: Deadline = NO_DEADLINE) -> None:
+        """Take n admission slots; raises RingBusyError when the budget
+        stays full past min(max_wait_s, deadline), DeadlineExpiredError
+        when the deadline lapses first. A request larger than the whole
+        budget is rejected outright (it could never be admitted)."""
+        if n > self.max_inflight:
+            raise RingBusyError(
+                f"ring {self.ring_id!r}: batch of {n} exceeds the "
+                f"admission budget ({self.max_inflight})")
+        wait_until = time.perf_counter() + self.max_wait_s
+        with self._cond:
+            while self._inflight + n > self.max_inflight:
+                if deadline.expired():
+                    raise DeadlineExpiredError(
+                        f"ring {self.ring_id!r}: deadline passed while "
+                        f"waiting for admission")
+                now = time.perf_counter()
+                if now >= wait_until:
+                    raise RingBusyError(
+                        f"ring {self.ring_id!r}: admission budget "
+                        f"({self.max_inflight}) full for "
+                        f"{self.max_wait_s:.3f}s")
+                slice_s = wait_until - now
+                rem = deadline.remaining()
+                if rem is not None:
+                    slice_s = min(slice_s, rem)
+                self._cond.wait(max(slice_s, 0.0))
+            self._inflight += n
+
+    def release(self, n: int = 1) -> None:
+        with self._cond:
+            self._inflight -= n
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def admit(self, n: int = 1,
+              deadline: Deadline = NO_DEADLINE) -> Iterator[None]:
+        self.acquire(n, deadline)
+        try:
+            yield
+        finally:
+            self.release(n)
+
+
+class _SFEntry:
+    __slots__ = ("ev", "result", "error")
+
+    def __init__(self) -> None:
+        self.ev = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class SingleFlight:
+    """Collapse concurrent identical idempotent requests to one flight.
+
+    `run(key, fn, deadline)`: the first caller for a key becomes the
+    leader and executes fn(); concurrent callers with the same key wait
+    on the leader's outcome (result OR exception — a failed flight
+    fails every duplicate, exactly as if each had flown). The entry is
+    removed the moment the flight completes, so answers are never
+    served stale. A table at capacity passes through (duplicate work
+    over blocked work).
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._inflight: Dict[Any, _SFEntry] = {}
+        self._hits = 0
+
+    @property
+    def hits(self) -> int:
+        with self._lock:
+            return self._hits
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def run(self, key: Any, fn: Callable[[], Any],
+            deadline: Deadline = NO_DEADLINE,
+            on_hit: Optional[Callable[[], None]] = None) -> Any:
+        """`on_hit` fires exactly once per FOLLOWER (a caller whose
+        request collapsed onto an existing flight) — the accurate
+        dedup metric; callers must not diff the shared `hits` counter
+        themselves (concurrent deltas over-count)."""
+        with self._lock:
+            entry = self._inflight.get(key)
+            if entry is None:
+                if len(self._inflight) >= self.capacity:
+                    entry = None  # full: pass through below
+                else:
+                    entry = self._inflight[key] = _SFEntry()
+                    lead = True
+            else:
+                lead = False
+                self._hits += 1
+        if entry is None:
+            return fn()
+        if not lead and on_hit is not None:
+            on_hit()
+        if lead:
+            try:
+                entry.result = fn()
+            except BaseException as exc:  # noqa: BLE001 — fanned out
+                entry.error = exc
+                raise
+            finally:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                entry.ev.set()
+            return entry.result
+        if not entry.ev.wait(deadline.clamp(None)):
+            raise DeadlineExpiredError(
+                "single-flight wait outlived the request deadline")
+        if entry.error is not None:
+            raise entry.error
+        return entry.result

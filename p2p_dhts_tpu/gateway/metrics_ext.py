@@ -1,0 +1,112 @@
+"""Gateway observability: per-ring / per-op counters, gauges, histograms.
+
+Thin, bounded naming layer over the package metrics registry
+(p2p_dhts_tpu.metrics.Metrics — the reservoir/quantile machinery lives
+there; this module only owns the KEY SCHEMA and the per-ring summary
+view). Ring ids and op names are operator-chosen and finite, so every
+key family below is bounded:
+
+  counters   gateway.requests.<op>.<ring>          admitted requests
+             gateway.errors.<op>.<ring>            device-path failures
+             gateway.fallback.<op>.<ring>          served via fallback
+             gateway.deadline_dropped.<ring>       shed before dispatch
+             gateway.rejected.<ring>               RingBusy admissions
+             gateway.ejected_fastfail.<ring>       refused while ejected
+             gateway.single_flight_hits            duplicate collapses
+  gauges     gateway.health.<ring>                 0 healthy / 1 degraded
+                                                   / 2 ejected
+             gateway.inflight.<ring>               admission occupancy
+  histograms gateway.latency_ms.<op>.<ring>        request latency
+                                                   (admission -> answer)
+
+`ring_stats(ring)` folds these into one plain dict (counts + p50/p99)
+— what `bench.py --config gateway`, the dryrun's gateway stage, and
+the tests assert against.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+#: The gateway op vocabulary (the engine's kinds, served over the wire).
+OPS = ("find_successor", "dhash_get", "dhash_put", "finger_index")
+
+
+class GatewayMetrics:
+    """Namespaced recording + per-ring summary over a Metrics registry."""
+
+    def __init__(self, base: Optional[Metrics] = None):
+        self.base = base if base is not None else METRICS
+
+    # -- recording -----------------------------------------------------------
+    def count_requests(self, op: str, ring_id: str, n: int = 1) -> None:
+        self.base.inc(f"gateway.requests.{op}.{ring_id}", n)
+
+    def count_errors(self, op: str, ring_id: str, n: int = 1) -> None:
+        self.base.inc(f"gateway.errors.{op}.{ring_id}", n)
+
+    def count_fallback(self, op: str, ring_id: str, n: int = 1) -> None:
+        self.base.inc(f"gateway.fallback.{op}.{ring_id}", n)
+
+    def count_deadline_dropped(self, ring_id: str, n: int = 1) -> None:
+        self.base.inc(f"gateway.deadline_dropped.{ring_id}", n)
+
+    def count_rejected(self, ring_id: str, n: int = 1) -> None:
+        self.base.inc(f"gateway.rejected.{ring_id}", n)
+
+    def count_ejected_fastfail(self, ring_id: str, n: int = 1) -> None:
+        self.base.inc(f"gateway.ejected_fastfail.{ring_id}", n)
+
+    def count_single_flight_hit(self, n: int = 1) -> None:
+        self.base.inc("gateway.single_flight_hits", n)
+
+    def gauge_health(self, ring_id: str, state: str) -> None:
+        from p2p_dhts_tpu.gateway.router import HEALTH_CODE
+        self.base.gauge(f"gateway.health.{ring_id}",
+                        HEALTH_CODE.get(state, -1))
+
+    def gauge_inflight(self, ring_id: str, n: int) -> None:
+        self.base.gauge(f"gateway.inflight.{ring_id}", n)
+
+    def observe_latency(self, op: str, ring_id: str,
+                        latencies_s: Iterable[float]) -> None:
+        self.base.observe_hist_many(
+            f"gateway.latency_ms.{op}.{ring_id}",
+            [v * 1e3 for v in latencies_s])
+
+    # -- summary views -------------------------------------------------------
+    def ring_stats(self, ring_id: str) -> Dict[str, object]:
+        """One ring's gateway-level view: per-op request/error/fallback
+        counts and latency percentiles, plus the ring-wide shed/reject
+        counters. One prefix scan of the registry instead of a lock
+        acquisition per key."""
+        c = self.base.counters_with_prefix("gateway.")
+        out: Dict[str, object] = {"ring": ring_id}
+        for op in OPS:
+            reqs = c.get(f"gateway.requests.{op}.{ring_id}", 0)
+            if not reqs:
+                continue
+            p50, p99 = self.base.quantiles(
+                f"gateway.latency_ms.{op}.{ring_id}")
+            out[op] = {
+                "requests": reqs,
+                "errors": c.get(f"gateway.errors.{op}.{ring_id}", 0),
+                "fallback": c.get(f"gateway.fallback.{op}.{ring_id}", 0),
+                "p50_ms": round(p50, 3) if p50 is not None else None,
+                "p99_ms": round(p99, 3) if p99 is not None else None,
+            }
+        out["deadline_dropped"] = c.get(
+            f"gateway.deadline_dropped.{ring_id}", 0)
+        out["rejected"] = c.get(f"gateway.rejected.{ring_id}", 0)
+        out["ejected_fastfail"] = c.get(
+            f"gateway.ejected_fastfail.{ring_id}", 0)
+        return out
+
+    def snapshot(self, ring_ids: Iterable[str]) -> Dict[str, object]:
+        return {
+            "rings": {r: self.ring_stats(r) for r in ring_ids},
+            "single_flight_hits": self.base.counter(
+                "gateway.single_flight_hits"),
+        }

@@ -1,0 +1,302 @@
+"""Multi-ring routing + per-ring health for the chordax gateway.
+
+A *ring* is one named serving backend: a device ring (RingState +
+optionally a FragmentStore) fronted by its own ServeEngine. The router
+holds the registry and answers "which backend serves this request" by
+explicit ring_id, by key-range ownership on the 2^128 identifier
+circle, or by the default ring — the router-in-front-of-batched-
+backends shape of every continuous-batching serving stack, carrying
+Chord/DHash semantics (Stoica et al. 2001; Cates 2003) instead of
+transformer steps.
+
+Each backend carries a three-state health machine —
+
+    healthy --failure--> degraded --EJECT_AFTER consecutive--> ejected
+       ^                    |  ^                                  |
+       +----probe success---+  +------- probe failure -----------+
+       +--------------------- probe success ----------------------+
+
+— mirroring the VISIBLE-degradation pattern overlay/finger_table.py
+established: a failure is logged once (with traceback), flips the
+state, and the device path is re-probed every `reprobe_s` by ONE
+prober at a time so a dead backend never eats an exception storm.
+DEGRADED rings keep serving through the gateway's fallback path
+(frontend._fallback_serve — the legacy-bridge analog); EJECTED rings
+fail fast so their traffic cannot convoy the healthy rings' worker
+threads.
+
+LOCK ORDER (audited by chordax-lint pass 3 and the runtime watchdog;
+extend this note if the order ever grows):
+
+  * `RingRouter._lock` and `RingBackend._health_lock` are both LEAVES:
+    neither is ever held across an engine call, a device dispatch, any
+    blocking wait, or the other lock. `route()` copies the backend
+    reference out and releases before the caller touches it; health
+    transitions collect their state-change callback and fire it AFTER
+    release.
+  * Hot add/remove: `add_ring`/`remove_ring` touch only `_lock`;
+    `remove_ring` returns the backend so the caller drains/closes its
+    engine OUTSIDE the lock (a draining engine blocks for seconds).
+
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+
+logger = logging.getLogger(__name__)
+
+#: Health states, in degradation order.
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+EJECTED = "ejected"
+
+#: Numeric codes for the `gateway.health.<ring>` gauge.
+HEALTH_CODE = {HEALTHY: 0, DEGRADED: 1, EJECTED: 2}
+
+
+class UnknownRingError(RuntimeError):
+    """No registered ring matches the request's ring_id / key."""
+
+
+class RingUnavailableError(RuntimeError):
+    """The routed ring is ejected (or has no usable serving path)."""
+
+
+def key_in_range(key_int: int, lo: int, hi: int) -> bool:
+    """Clockwise-inclusive [lo, hi] membership on the 2^128 circle
+    (the overlay's Key.in_between rule, key.h:103-131, for plain
+    ints). lo == hi matches exactly that one key."""
+    key_int %= KEYS_IN_RING
+    lo %= KEYS_IN_RING
+    hi %= KEYS_IN_RING
+    if lo <= hi:
+        return lo <= key_int <= hi
+    return key_int >= lo or key_int <= hi
+
+
+class RingBackend:
+    """One named serving backend: engine + key range + health machine.
+
+    `engine` is a started ServeEngine (any object with the engine's
+    submit/submit_many contract works — tests inject stubs). The
+    backend itself never calls the engine: the frontend asks
+    `admit_device_path()` for a verdict, runs the request, and reports
+    back via `record_success`/`record_failure` — so no backend lock is
+    ever held across device work.
+    """
+
+    #: Consecutive device-path failures before degraded becomes ejected.
+    EJECT_AFTER = 5
+    #: Seconds between device-path re-probes while degraded/ejected.
+    REPROBE_S = 30.0
+
+    def __init__(self, ring_id: str, engine,
+                 key_range: Optional[Tuple[int, int]] = None,
+                 reprobe_s: Optional[float] = None,
+                 on_state_change: Optional[
+                     Callable[[str, str], None]] = None,
+                 state=None):
+        self.ring_id = str(ring_id)
+        self.engine = engine
+        #: The ring's device RingState (None for stateless backends,
+        #: e.g. the finger front). The frontend's DEGRADED fallback
+        #: dispatches find_successor directly against it, bypassing the
+        #: engine — the per-table-bridge shape, kept as the fallback.
+        #: (`state` the property is HEALTH state; hence the prefix.)
+        self.ring_state = state
+        self.key_range = (
+            (int(key_range[0]) % KEYS_IN_RING,
+             int(key_range[1]) % KEYS_IN_RING)
+            if key_range is not None else None)
+        self.reprobe_s = float(reprobe_s if reprobe_s is not None
+                               else self.REPROBE_S)
+        self._on_state_change = on_state_change
+        self._health_lock = threading.Lock()
+        self._state = HEALTHY
+        self._consecutive_failures = 0
+        self._retry_at = 0.0
+        self._probe_inflight = False
+        self._degraded_logged = False
+
+    # -- routing -------------------------------------------------------------
+    def owns_key(self, key_int: int) -> bool:
+        if self.key_range is None:
+            return False
+        return key_in_range(key_int, *self.key_range)
+
+    # -- health machine ------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._health_lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._health_lock:
+            return self._consecutive_failures
+
+    def admit_device_path(self) -> str:
+        """Verdict for one request: "engine" (healthy), "probe" (this
+        caller is THE one re-prober of a degraded/ejected backend —
+        it MUST report back via record_success/record_failure or
+        probe_release), "fallback" (degraded, serve the fallback path),
+        or "ejected" (fail fast)."""
+        with self._health_lock:
+            if self._state == HEALTHY:
+                return "engine"
+            if (time.monotonic() >= self._retry_at
+                    and not self._probe_inflight):
+                self._probe_inflight = True
+                return "probe"
+            return "ejected" if self._state == EJECTED else "fallback"
+
+    def record_success(self, probing: bool = False) -> None:
+        fire = None
+        with self._health_lock:
+            if probing:
+                self._probe_inflight = False
+            if self._state != HEALTHY:
+                logger.warning("gateway ring %r device path recovered "
+                               "(was %s)", self.ring_id, self._state)
+                self._state = HEALTHY
+                self._degraded_logged = False
+                fire = HEALTHY
+            self._consecutive_failures = 0
+        if fire is not None and self._on_state_change is not None:
+            self._on_state_change(self.ring_id, fire)
+
+    def record_failure(self, exc: Optional[BaseException] = None,
+                       probing: bool = False) -> str:
+        """Count one device-path failure; returns the resulting state.
+        Logged ONCE per degradation episode, with traceback — the
+        visible-degradation contract."""
+        fire = None
+        with self._health_lock:
+            if probing:
+                self._probe_inflight = False
+            self._consecutive_failures += 1
+            self._retry_at = time.monotonic() + self.reprobe_s
+            new_state = (EJECTED
+                         if self._consecutive_failures >= self.EJECT_AFTER
+                         else DEGRADED)
+            if not self._degraded_logged:
+                logger.warning(
+                    "gateway ring %r device path failed (%s); state -> "
+                    "%s, re-probe in %.1fs", self.ring_id,
+                    type(exc).__name__ if exc is not None else "failure",
+                    new_state, self.reprobe_s,
+                    exc_info=exc if exc is not None else None)
+                self._degraded_logged = True
+            if new_state != self._state:
+                self._state = new_state
+                fire = new_state
+            state = self._state
+        if fire is not None and self._on_state_change is not None:
+            self._on_state_change(self.ring_id, fire)
+        return state
+
+    def probe_release(self) -> None:
+        """Release the probe slot WITHOUT a health verdict (e.g. the
+        probe's deadline expired before the engine answered — neither
+        evidence of recovery nor of failure)."""
+        with self._health_lock:
+            self._probe_inflight = False
+
+
+class RingRouter:
+    """Registry of named RingBackends with hot add/remove."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rings: Dict[str, RingBackend] = {}
+        self._default: Optional[str] = None
+
+    # -- registry ------------------------------------------------------------
+    def add_ring(self, backend: RingBackend, default: bool = False) -> None:
+        with self._lock:
+            if backend.ring_id in self._rings:
+                raise ValueError(
+                    f"ring {backend.ring_id!r} is already registered")
+            self._rings[backend.ring_id] = backend
+            if default or self._default is None:
+                self._default = backend.ring_id
+
+    def remove_ring(self, ring_id: str) -> RingBackend:
+        """Unregister and RETURN the backend; the caller closes its
+        engine outside this router's lock (draining blocks)."""
+        with self._lock:
+            backend = self._rings.pop(ring_id, None)
+            if backend is None:
+                raise UnknownRingError(f"no ring {ring_id!r}")
+            if self._default == ring_id:
+                self._default = next(iter(self._rings), None)
+        return backend
+
+    def get(self, ring_id: str) -> RingBackend:
+        with self._lock:
+            backend = self._rings.get(ring_id)
+        if backend is None:
+            raise UnknownRingError(f"no ring {ring_id!r}")
+        return backend
+
+    def route(self, key_int: Optional[int] = None,
+              ring_id: Optional[str] = None) -> RingBackend:
+        """Resolve one request to a backend: explicit ring_id wins;
+        else the first registered ring whose key_range owns the key;
+        else the default ring."""
+        with self._lock:
+            if ring_id is not None:
+                backend = self._rings.get(ring_id)
+                if backend is None:
+                    raise UnknownRingError(f"no ring {ring_id!r}")
+                return backend
+            if key_int is not None:
+                for backend in self._rings.values():
+                    if backend.owns_key(int(key_int)):
+                        return backend
+            if self._default is not None:
+                return self._rings[self._default]
+        raise UnknownRingError("no ring routes this request (empty "
+                               "router, or no key-range owner and no "
+                               "default ring)")
+
+    def snapshot(self) -> Tuple[List[RingBackend],
+                                Optional[RingBackend]]:
+        """(registered backends in insertion order, default backend) in
+        ONE lock acquisition — the batch-routing prologue classifies a
+        whole key vector against this instead of taking the router lock
+        once per key."""
+        with self._lock:
+            backends = list(self._rings.values())
+            default = (self._rings.get(self._default)
+                       if self._default is not None else None)
+        return backends, default
+
+    # -- introspection -------------------------------------------------------
+    def ring_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._rings)
+
+    @property
+    def default_ring_id(self) -> Optional[str]:
+        with self._lock:
+            return self._default
+
+    def health_snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            backends = list(self._rings.values())
+        return {
+            b.ring_id: {
+                "state": b.state,
+                "consecutive_failures": b.consecutive_failures,
+                "key_range": b.key_range,
+            }
+            for b in backends
+        }

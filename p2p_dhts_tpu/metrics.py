@@ -81,6 +81,20 @@ class Metrics:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def counter(self, name: str) -> int:
+        """Read one counter (0 if never incremented) — the accessor the
+        gateway's per-ring stat views and tests use instead of reaching
+        into snapshot()'s whole dict."""
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counters_with_prefix(self, prefix: str) -> Dict[str, int]:
+        """All counters under a dotted prefix (e.g. "gateway.") — the
+        bounded per-subsystem view snapshot() is too coarse for."""
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
     def observe_hist(self, name: str, value: float) -> None:
         """Append one sample to a bounded reservoir histogram."""
         with self._lock:

@@ -24,6 +24,7 @@ parsed connections, with one acceptor thread.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import threading
 import time
@@ -98,9 +99,30 @@ class RequestLog:
 class Client:
     """One-shot request client (ref class Client, client.h:24-46)."""
 
+    #: Retry backoff base. The k-th retry sleeps a JITTERED slice of
+    #: base * 2^k: N clients that all saw the same failure at the same
+    #: instant must not come back in lockstep (a retry storm re-wedges
+    #: the 3-worker server pool that caused the failure), so the sleep
+    #: is uniform in [base*2^k / 4, base*2^k] rather than fixed.
+    RETRY_BACKOFF_S = 0.05
+
     @staticmethod
     def make_request(ip_addr: str, port: int, request: JsonObj,
-                     timeout: Optional[float] = None) -> JsonObj:
+                     timeout: Optional[float] = None, *,
+                     retries: int = 0,
+                     deadline: Optional[float] = None) -> JsonObj:
+        """One-shot request, optionally retried.
+
+        `retries=0` (the default) is the reference behavior: one
+        attempt, transport failure raises RpcError. With retries > 0,
+        transport-level RpcErrors are retried up to that many times
+        with jittered exponential backoff (never fixed sleeps — see
+        RETRY_BACKOFF_S). `deadline` is an absolute time.perf_counter()
+        instant honored END-TO-END: each attempt's socket timeout is
+        clamped to the remaining budget, backoff sleeps never overrun
+        it, and an expired deadline raises RpcError immediately — this
+        is the client half of the gateway's deadline propagation
+        (client timeout -> gateway budget -> engine slot)."""
         # Default resolved at CALL time so a harness can lower
         # rpc.DEFAULT_TIMEOUT_S process-wide: deep recursive handler
         # chains right after mass churn can exhaust the 3-per-server
@@ -109,16 +131,49 @@ class Client:
         # tests wait out the same stalls with sleep(20)/sleep(40).
         if timeout is None:
             timeout = DEFAULT_TIMEOUT_S
-        METRICS.inc("rpc.client.requests")
-        t0 = time.perf_counter()
-        try:
-            return Client._make_request_inner(ip_addr, port, request,
-                                              timeout)
-        except RpcError:
-            METRICS.inc("rpc.client.errors")
-            raise
-        finally:
-            METRICS.observe("rpc.client.request", time.perf_counter() - t0)
+        attempt = 0
+        while True:
+            if deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    METRICS.inc("rpc.client.deadline_expired")
+                    raise RpcError("RPC deadline expired")
+                eff_timeout = min(timeout, remaining)
+            else:
+                eff_timeout = timeout
+            METRICS.inc("rpc.client.requests")
+            t0 = time.perf_counter()
+            try:
+                resp = Client._make_request_inner(ip_addr, port, request,
+                                                  eff_timeout)
+            except RpcError:
+                # Observe the ATTEMPT's latency before any backoff
+                # sleep — the histogram measures requests, not the
+                # retry policy's deliberate waiting.
+                METRICS.observe("rpc.client.request",
+                                time.perf_counter() - t0)
+                METRICS.inc("rpc.client.errors")
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                METRICS.inc("rpc.client.retries")
+                base = Client.RETRY_BACKOFF_S * (2 ** (attempt - 1))
+                delay = random.uniform(base * 0.25, base)
+                if deadline is not None:
+                    # Never sleep more than HALF the remaining budget:
+                    # sleeping it all would guarantee the deadline miss
+                    # the retry exists to beat — the re-attempt must
+                    # still fit. An exhausted budget skips the sleep
+                    # and lets the loop's next pass raise.
+                    delay = min(delay,
+                                max(deadline - time.perf_counter(), 0.0)
+                                * 0.5)
+                if delay > 0:
+                    time.sleep(delay)
+            else:
+                METRICS.observe("rpc.client.request",
+                                time.perf_counter() - t0)
+                return resp
 
     @staticmethod
     def _make_request_inner(ip_addr: str, port: int, request: JsonObj,
@@ -168,7 +223,14 @@ class Server:
                  num_threads: int = 3, logging_enabled: bool = False,
                  host: str = "127.0.0.1"):
         self.port = port
-        self.handlers = dict(handlers)
+        # Handler map is COPY-ON-WRITE: `_handlers` is only ever
+        # REPLACED (never mutated in place) under `_handlers_lock`, so
+        # worker threads read one immutable snapshot per request and a
+        # hot handler install (the gateway's update_handlers while
+        # traffic is in flight) can never expose a half-updated map or
+        # let the membership check and the dispatch read disagree.
+        self._handlers: Dict[str, Handler] = dict(handlers)
+        self._handlers_lock = threading.Lock()
         self.logging_enabled = logging_enabled
         self.request_log = RequestLog()
         self._pool = ThreadPoolExecutor(max_workers=num_threads)
@@ -274,10 +336,26 @@ class Server:
     def is_alive(self) -> bool:
         return self._alive
 
+    @property
+    def handlers(self) -> Dict[str, Handler]:
+        """The CURRENT handler-map snapshot. Read-only by contract:
+        mutate via update_handlers (which swaps the reference whole) —
+        in-place writes here would reintroduce the torn-read race the
+        copy-on-write design removes."""
+        return self._handlers
+
     def update_handlers(self, handlers: Dict[str, Handler]) -> None:
         """Register additional command handlers (peers construct the server
-        first — the bound port feeds their id — then attach handlers)."""
-        self.handlers.update(handlers)
+        first — the bound port feeds their id — then attach handlers).
+        Safe while the server is LIVE: builds a merged copy and swaps
+        the reference atomically, so concurrent _process dispatches see
+        either the old complete map or the new complete map, never a
+        mid-update hybrid (the gateway installs its handlers through
+        here on servers already carrying traffic)."""
+        with self._handlers_lock:
+            merged = dict(self._handlers)
+            merged.update(handlers)
+            self._handlers = merged
 
     def get_log(self) -> List[JsonObj]:
         """ref Server::GetLog (server.h:399-402)."""
@@ -342,14 +420,19 @@ class Server:
         exception-to-envelope path. Counter keys are bounded to KNOWN
         commands (peer-supplied garbage would otherwise grow the metrics
         dict without limit); unknown ones share one counter."""
+        # ONE snapshot per request: the membership check (metrics key
+        # bounding) and the dispatch must read the SAME map, or a
+        # concurrent update_handlers swap between them miscounts — or
+        # dispatches a handler the counter called invalid.
+        handlers = self._handlers
         try:
             command = req.get("COMMAND", "")
-            if command in self.handlers:
+            if command in handlers:
                 METRICS.inc(f"rpc.server.command.{command}")
             else:
                 METRICS.inc("rpc.server.invalid_command")
             with METRICS.timed("rpc.server.dispatch"):
-                handler = self.handlers.get(command)
+                handler = handlers.get(command)
                 if handler is None:
                     raise RuntimeError("Invalid command.")
                 resp = handler(req) or {}

@@ -78,6 +78,20 @@ class AbstractChordPeer:
             raise ValueError(f"unknown server_backend {server_backend!r}")
         self.port = self.server.port
         self.server.update_handlers(self.handlers())
+        # Gateway front door (ISSUE 4): every peer's server also speaks
+        # the device-serving commands (FIND_SUCCESSOR / GET / PUT /
+        # FINGER_INDEX), routed through the process-global gateway into
+        # the batched ServeEngine path — concurrent wire lookups from
+        # ANY peer's port coalesce into shared device batches. Install
+        # is a handler-map swap (no jax, no backend init); a gateway
+        # build failure must not take the reference protocol down.
+        try:
+            from p2p_dhts_tpu.gateway import install_gateway_handlers
+            install_gateway_handlers(self.server)
+        # chordax-lint: disable=bare-except -- the gateway surface is additive; the 8 reference commands must come up regardless
+        except Exception:
+            logger.warning("gateway handlers unavailable on peer %s:%s",
+                           ip_addr, self.port, exc_info=True)
 
         # id = SHA1("ip:port") (abstract_chord_peer.cpp:13-28)
         self.id = Key.from_plaintext(f"{self.ip_addr}:{self.port}")

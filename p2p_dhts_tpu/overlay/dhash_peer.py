@@ -63,6 +63,16 @@ class DHashPeer(AbstractChordPeer):
         super().__init__(ip_addr, port, num_replicas, backend,
                          maintenance_interval, num_server_threads,
                          server_backend)
+        # Gateway wiring: device rings registered in this process after
+        # a DHash peer exists default to ITS replication params, so
+        # gateway PUT/GET validation (segments [S, m]) matches the
+        # overlay's erasure-coding config instead of a hardcoded one.
+        try:
+            from p2p_dhts_tpu.gateway import global_gateway
+            global_gateway().set_default_ida(self.n, self.m, self.p)
+        # chordax-lint: disable=bare-except -- gateway layer is additive; DHash protocol comes up regardless
+        except Exception:
+            pass
 
     def handlers(self):
         return {

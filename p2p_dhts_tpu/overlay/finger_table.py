@@ -72,22 +72,30 @@ class FingerTable:
         self._probe_inflight = False
 
     def _device_resolver(self):
-        """Lazy batching bridge: the shared ServeEngine (serve.py —
-        adaptive window, cross-table batching); falls back to the
-        legacy per-table DeviceFingerResolver if the engine layer
-        itself cannot be built."""
+        """Lazy batching bridge, built through the gateway so the
+        overlay's lookups and the RPC front door share ONE finger
+        engine (cross-table AND cross-path batching); falls back to a
+        bare EngineFingerResolver if the gateway layer cannot be
+        built, then to the legacy per-table DeviceFingerResolver if
+        the engine layer itself cannot be."""
         with self._lock:
             if self._resolver is None:
                 try:
-                    from p2p_dhts_tpu.serve import EngineFingerResolver
-                    self._resolver = EngineFingerResolver(
+                    from p2p_dhts_tpu.gateway import global_gateway
+                    self._resolver = global_gateway().finger_resolver(
                         int(self.starting_key))
-                # chordax-lint: disable=bare-except -- any engine-layer construction failure must fall back to the legacy bridge
+                # chordax-lint: disable=bare-except -- any gateway/engine construction failure must fall back down the chain
                 except Exception:
-                    from p2p_dhts_tpu.overlay.jax_bridge import (
-                        DeviceFingerResolver)
-                    self._resolver = DeviceFingerResolver(
-                        int(self.starting_key))
+                    try:
+                        from p2p_dhts_tpu.serve import EngineFingerResolver
+                        self._resolver = EngineFingerResolver(
+                            int(self.starting_key))
+                    # chordax-lint: disable=bare-except -- any engine-layer construction failure must fall back to the legacy bridge
+                    except Exception:
+                        from p2p_dhts_tpu.overlay.jax_bridge import (
+                            DeviceFingerResolver)
+                        self._resolver = DeviceFingerResolver(
+                            int(self.starting_key))
             return self._resolver
 
     def _device_lookup_index(self, key: Key) -> int:

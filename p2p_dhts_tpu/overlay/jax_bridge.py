@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 
@@ -106,7 +106,16 @@ class DeviceFingerResolver:
         self.keys_served = 0
 
     # -- public ------------------------------------------------------------
-    def lookup_index(self, key_int: int) -> int:
+    def lookup_index(self, key_int: int,
+                     timeout: Optional[float] = None) -> int:
+        """Resolve one key's finger-table entry index. `timeout` bounds
+        the wait for the containing batch (None = wait forever, the
+        historical behavior) — the same bounded-wait contract the
+        engine path's slot.wait offers, so a caller propagating a
+        deadline can hold it on whichever resolver layer it lands on.
+        A timed-out follower leaves its slot in place — the leader
+        still serves it (results nobody reads are dropped), so timing
+        out never corrupts a batch."""
         slot: dict = {"ev": threading.Event()}
         with self._lock:
             self._pending.append((int(key_int) % KEYS_IN_RING, slot))
@@ -135,7 +144,9 @@ class DeviceFingerResolver:
                         s["error"] = exc
                         s["ev"].set()
                 raise
-        slot["ev"].wait()
+        if not slot["ev"].wait(timeout):
+            raise TimeoutError(
+                f"legacy bridge lookup not served within {timeout}s")
         if "error" in slot:
             raise slot["error"]
         return slot["index"]

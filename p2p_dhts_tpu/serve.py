@@ -87,19 +87,32 @@ class EngineClosedError(RuntimeError):
     (or before) serving their request."""
 
 
+class DeadlineExpiredError(RuntimeError):
+    """The request's deadline passed before it reached the device; the
+    work was dropped pre-dispatch (gateway deadline propagation: client
+    timeout -> gateway budget -> engine slot). The caller had already
+    stopped waiting, so no answer was lost — only wasted device work."""
+
+
 class _Slot:
     """One pending request: the caller blocks on `wait()`, the
-    completion thread delivers `result` or `error`."""
+    completion thread delivers `result` or `error`. `deadline` is an
+    absolute time.perf_counter() instant (None = no deadline); an
+    expired slot is failed with DeadlineExpiredError BEFORE device
+    dispatch instead of burning a batch lane on an abandoned answer."""
 
-    __slots__ = ("kind", "payload", "t_submit", "result", "error", "ev")
+    __slots__ = ("kind", "payload", "t_submit", "result", "error", "ev",
+                 "deadline")
 
-    def __init__(self, kind: str, payload: tuple):
+    def __init__(self, kind: str, payload: tuple,
+                 deadline: Optional[float] = None):
         self.kind = kind
         self.payload = payload
         self.t_submit = time.perf_counter()
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.ev = threading.Event()
+        self.deadline = deadline
 
     def wait(self, timeout: Optional[float] = None):
         if not self.ev.wait(timeout):
@@ -290,15 +303,23 @@ class ServeEngine:
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, kind: str, payload: tuple) -> _Slot:
+    def submit(self, kind: str, payload: tuple,
+               deadline: Optional[float] = None) -> _Slot:
         """Enqueue one request; returns the slot to `wait()` on. Blocks
         (backpressure, never drops) while max_queue requests pend."""
-        return self.submit_many(kind, [payload])[0]
+        return self.submit_many(kind, [payload], deadline=deadline)[0]
 
-    def submit_many(self, kind: str, payloads: Sequence[tuple]
-                    ) -> List[_Slot]:
+    def submit_many(self, kind: str, payloads: Sequence[tuple],
+                    deadline: Optional[float] = None) -> List[_Slot]:
         """Enqueue a list of same-kind requests contiguously (they share
-        batches up to bucket_max). Blocks for queue space as needed."""
+        batches up to bucket_max). Blocks for queue space as needed.
+
+        `deadline` (absolute time.perf_counter() instant) applies to
+        every slot in the call: a slot whose deadline has passed when
+        the dispatcher picks it up is failed with DeadlineExpiredError
+        instead of being dispatched — expired work never reaches the
+        device (the gateway front door relies on this to shed abandoned
+        requests under overload)."""
         if kind not in KINDS:
             raise ValueError(f"unknown request kind {kind!r}")
         if kind == "find_successor" and self._state is None:
@@ -328,7 +349,13 @@ class ServeEngine:
             payloads = normalized
         if not self._started:
             self.start()
-        slots = [_Slot(kind, p) for p in payloads]
+        slots = [_Slot(kind, p, deadline) for p in payloads]
+        if deadline is not None and time.perf_counter() >= deadline:
+            # Already expired at submission: fail out without touching
+            # the queue (the cheapest possible drop, and it keeps the
+            # fast path below from dispatching dead work).
+            self._drop_expired(slots)
+            return slots
         # Caller-inline fast path: a single request hitting a fully
         # idle engine (nothing pending or in flight, window at zero) is
         # dispatched and completed on the SUBMITTING thread — the
@@ -647,6 +674,25 @@ class ServeEngine:
                 batch = self._pop_batch()
                 if not batch:
                     continue
+                # Deadline shedding BEFORE device dispatch: an expired
+                # slot's caller already gave up, so burning a batch lane
+                # on it only delays live requests. The popped batch is
+                # dispatcher-owned, so failing slots here is safe.
+                now = time.perf_counter()
+                live: List[_Slot] = []
+                expired: List[_Slot] = []
+                for slot in batch:
+                    if slot.deadline is not None and slot.deadline <= now:
+                        expired.append(slot)
+                    else:
+                        live.append(slot)
+                if expired:
+                    self._drop_expired(expired)
+                batch = live
+                if not batch:
+                    with self._lock:
+                        self._dispatching = False
+                    continue
                 try:
                     self._adapt_window(batch)
                     try:
@@ -907,6 +953,21 @@ class ServeEngine:
         for slot in batch:
             slot.ev.set()
 
+    def _drop_expired(self, slots: List[_Slot]) -> None:
+        """Fail slots whose deadline passed before dispatch. Distinct
+        from _deliver_error: an expired drop is ACCOUNTED (the gateway's
+        per-ring drop counters build on this) and never becomes a late
+        error — the deadline's owner was, by definition, done waiting."""
+        dropped = 0
+        for slot in slots:
+            if not slot.ev.is_set():
+                slot.error = DeadlineExpiredError(
+                    f"deadline passed before dispatch ({slot.kind})")
+                slot.ev.set()
+                dropped += 1
+        if dropped:
+            self._metrics.inc("serve.deadline_dropped", dropped)
+
     def _deliver_error(self, batch: List[_Slot], exc: BaseException) -> None:
         """Fan an error out to every waiting caller in the batch; if
         NOBODY was left to receive it, keep it as a late error so
@@ -962,7 +1023,13 @@ class EngineFingerResolver:
     def engine(self) -> ServeEngine:
         return self._engine
 
-    def lookup_index(self, key_int: int) -> int:
-        idx = self._engine.finger_index(key_int, self._start_int)
+    def lookup_index(self, key_int: int,
+                     timeout: Optional[float] = None) -> int:
+        """Same bounded-wait contract as the legacy bridge's
+        lookup_index: `timeout` caps the wait for the containing batch
+        (None = wait forever), so deadline propagation holds on
+        whichever resolver layer a caller lands on."""
+        idx = self._engine.finger_index(key_int, self._start_int,
+                                        timeout=timeout)
         self.keys_served += 1
         return idx

@@ -274,3 +274,20 @@ def test_resolver_chunks_oversize_batches(monkeypatch):
         t.join()
     assert got == {k: int(k).bit_length() - 1 for k in keys}
     assert all(s <= 4 for s in r.batch_sizes)
+
+
+def test_lookup_index_timeout_bounds_follower_wait():
+    """A non-leader whose wait outlives `timeout` gets TimeoutError
+    instead of blocking forever behind a stuck leader; the slot stays
+    pending so the real leader can still serve it harmlessly."""
+    r = DeviceFingerResolver(42, window_s=0.0)
+    r._leader_active = True  # simulate a leader wedged mid-serve
+    t0 = time.time()
+    with pytest.raises(TimeoutError):
+        r.lookup_index(7, timeout=0.05)
+    assert time.time() - t0 < 2.0
+    # Hand leadership back: the next lookup serves the stale slot's
+    # batch plus its own without error.
+    r._leader_active = False
+    want = ((7 - 42) % KEYS_IN_RING).bit_length() - 1
+    assert r.lookup_index(7) == want

@@ -54,6 +54,16 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Gateway smoke (ISSUE 4): the RPC->gateway->engine front door must
+  # pass its CPU smoke (1000-key parity, zero retraces, slow-ring
+  # isolation) before any bench touches the chip — same etiquette as
+  # the lint gate above (CPU-pinned, never claims the TPU).
+  if ! JAX_PLATFORMS=cpu python bench.py --config gateway --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "gateway smoke FAILED - fix the front door before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
