@@ -1294,6 +1294,169 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
     }
 
 
+def bench_repair(n_peers: int = 4096, stranded: int = 256,
+                 corrupt: int = 32, parity_keys: int = 128,
+                 smax: int = 4,
+                 bucket_min: int = 16, bucket_max: int = 256,
+                 max_keys_round: int = 512, max_rounds: int = 16) -> dict:
+    """chordax-repair end to end (ISSUE 6): quorum PUT parity, then a
+    churned 2-ring divergence (stranded keys on one ring + duplicate-
+    index corruption, the r05 fragment-stranding shape) healed by the
+    scheduler's device-batched anti-entropy. Hard assertions: every
+    replicated PUT byte-matches a direct n-ring write on every ring;
+    the diverged pair converges to 100%% readable keys on BOTH rings
+    within `max_rounds` scheduler rounds; ZERO steady-state retraces
+    through the repair path (engine kinds after warmup AND the repair
+    kernels after their first round)."""
+    from p2p_dhts_tpu.dhash.store import _sort_store, empty_store
+    from p2p_dhts_tpu.gateway import Gateway
+    from p2p_dhts_tpu.metrics import Metrics
+    from p2p_dhts_tpu.ops import u128
+    from p2p_dhts_tpu.repair import (RepairScheduler, ReplicationPolicy,
+                                     kernels as rkern)
+
+    rng = np.random.RandomState(0xD15C)
+    ida_n = 14
+    capacity = (stranded + parity_keys * 2 + 64) * ida_n
+    mets = Metrics()
+    gw = Gateway(metrics=mets, name="bench-repair")
+    warm = ["dhash_get", "dhash_put", "sync_digest", "repair_reindex"]
+    for rid, default in (("ra", True), ("rb", False)):
+        gw.add_ring(rid, build_ring(_rand_lanes(rng, n_peers),
+                                    RingConfig(finger_mode="materialized")),
+                    empty_store(capacity, smax), default=default,
+                    bucket_min=bucket_min, bucket_max=bucket_max,
+                    max_queue=65536, warmup=warm)
+    gw.set_replication(ReplicationPolicy(n_replicas=2, w=2))
+    try:
+        return _bench_repair_phases(
+            gw, mets, rng, rkern, u128, _sort_store, stranded,
+            corrupt, parity_keys, smax, max_keys_round, max_rounds)
+    finally:
+        gw.close()
+
+
+def _bench_repair_phases(gw, mets, rng, rkern, u128, _sort_store,
+                         stranded, corrupt, parity_keys, smax,
+                         max_keys_round, max_rounds) -> dict:
+    from p2p_dhts_tpu.repair import RepairScheduler
+
+    def _seg(r):
+        return r.randint(0, 200, size=(smax, 10)).astype(np.int32)
+
+    def _key(r):
+        return int.from_bytes(r.bytes(16), "little")
+
+    # -- phase 1: quorum PUT parity vs a direct n-ring write -----------
+    repl_keys = [_key(rng) for _ in range(parity_keys)]
+    repl_segs = [_seg(rng) for _ in range(parity_keys)]
+    t0 = time.perf_counter()
+    for k, s in zip(repl_keys, repl_segs):
+        assert gw.dhash_put(k, s, smax, 0), "replicated PUT failed"
+    repl_wall = time.perf_counter() - t0
+    direct_keys = [_key(rng) for _ in range(parity_keys)]
+    for k, s in zip(direct_keys, repl_segs):
+        for rid in ("ra", "rb"):
+            assert gw.dhash_put(k, s, smax, 0, ring_id=rid,
+                                replicate=False)
+    for rid in ("ra", "rb"):
+        for keys_set in (repl_keys, direct_keys):
+            got = gw.dhash_get_many(keys_set, ring_id=rid)
+            for j, (seg, ok) in enumerate(got):
+                assert bool(ok), f"{rid}: parity key unreadable"
+                assert np.array_equal(np.asarray(seg), repl_segs[j]), \
+                    f"{rid}: quorum PUT diverges from direct write"
+    q50, q99 = mets.quantiles("repair.replication.quorum_ms")
+
+    # -- phase 2: churn the pair into the r05 divergence shape ---------
+    # Stranded keys exist on ring a ONLY (the gateway-level analog of
+    # fragments stranded on misplaced holders)...
+    stranded_keys = [_key(rng) for _ in range(stranded)]
+    stranded_segs = [_seg(rng) for _ in range(stranded)]
+    for k, s in zip(stranded_keys, stranded_segs):
+        assert gw.dhash_put(k, s, smax, 0, ring_id="ra",
+                            replicate=False)
+    # ...and `corrupt` replicated keys on ring b get their index-11..14
+    # rows rewritten into DUPLICATES of index 1 (distinct count 10 = m:
+    # still readable, one holder loss from stranding — the exact defect
+    # BENCH_NOTES_r05 documented). Induced store surgery, swapped in
+    # through the engine's own chain point while idle.
+    import jax.numpy as jnp
+    from p2p_dhts_tpu.core.ring import keys_from_ints as kfi
+    eng_b = gw.router.get("rb").engine
+    corrupt_lanes = kfi(repl_keys[:corrupt])
+    store_b = eng_b.store_snapshot()
+    for lane in corrupt_lanes:
+        hit = u128.eq(store_b.keys, lane[None, :]) & \
+            (store_b.frag_idx >= 11) & store_b.used
+        row1 = u128.eq(store_b.keys, lane[None, :]) & \
+            (store_b.frag_idx == 1)
+        v1 = store_b.values[jnp.argmax(row1)]
+        store_b = store_b._replace(
+            frag_idx=jnp.where(hit, 1, store_b.frag_idx),
+            values=jnp.where(hit[:, None], v1[None, :], store_b.values))
+    store_b = _sort_store(store_b)
+    with eng_b._lock:
+        eng_b._store = store_b
+
+    # -- phase 3: scheduler rounds until convergence -------------------
+    sched = RepairScheduler(gw, [("ra", "rb")], rate_keys_s=1e6,
+                            burst_keys=1e6, max_keys_round=max_keys_round,
+                            round_timeout_s=600.0, metrics=mets)
+    loop = sched.loops[0]
+    t0 = time.perf_counter()
+    first = loop.run_once()  # warm round: repair kernels trace here
+    ksnap = rkern.trace_snapshot()
+    rounds = 1
+    while not loop.converged and rounds < max_rounds:
+        loop.run_once()
+        rounds += 1
+    heal_wall = time.perf_counter() - t0
+    assert loop.converged, \
+        f"repair did not converge in {max_rounds} rounds"
+    assert rkern.retraces_since(ksnap) == 0, \
+        "repair kernels retraced after the warm round"
+    for rid in ("ra", "rb"):
+        gw.router.get(rid).engine.assert_no_retraces()
+    # 100% readable: every key written anywhere reads on BOTH rings.
+    all_keys = repl_keys + direct_keys + stranded_keys
+    for rid in ("ra", "rb"):
+        got = gw.dhash_get_many(all_keys, ring_id=rid)
+        n_ok = sum(1 for _, ok in got if bool(ok))
+        assert n_ok == len(all_keys), \
+            f"{rid}: {len(all_keys) - n_ok} keys unreadable post-repair"
+    healed = mets.counter("repair.keys_healed.ra") + \
+        mets.counter("repair.keys_healed.rb")
+    reindexed = mets.counter("repair.reindexed.rb")
+    assert reindexed >= corrupt * 4, \
+        f"re-pair pass rewrote {reindexed} rows, wanted >= {corrupt * 4}"
+
+    return _emit({
+        "config": "repair",
+        "metric": f"anti-entropy healing throughput (2 rings, "
+                  f"{stranded} stranded keys + {corrupt} dup-corrupted, "
+                  f"max {max_keys_round} keys/round)",
+        "value": round(healed / heal_wall, 1),
+        "unit": "keys healed/sec",
+        "vs_baseline": None,
+        "rounds_to_converge": rounds,
+        "keys_healed": healed,
+        "canonicalized": mets.counter("repair.canonicalized"),
+        "reindexed_rows": reindexed,
+        "bytes_moved": mets.counter("repair.bytes_moved"),
+        "first_round_leaf_diffs": first.leaf_diffs,
+        "nodes_exchanged_equiv": first.nodes_exchanged,
+        "replicated_puts_s": round(parity_keys / repl_wall, 1),
+        "quorum_p50_ms": round(q50, 3) if q50 is not None else None,
+        "quorum_p99_ms": round(q99, 3) if q99 is not None else None,
+        "steady_state_retraces": 0,
+        "parity": f"ok (quorum PUT == direct 2-ring write, "
+                  f"{parity_keys} keys x 2 rings; 100% readable "
+                  f"post-churn: {len(all_keys)} keys x 2 rings)",
+        "device": str(jax.devices()[0]),
+    })
+
+
 # ---------------------------------------------------------------------------
 
 def main() -> None:
@@ -1302,7 +1465,7 @@ def main() -> None:
     ap.add_argument("--config", default=None,
                     choices=["chord16", "ida", "dhash", "dhash_sharded",
                              "lookup_1m", "sweep_10m", "serve",
-                             "gateway"])
+                             "gateway", "repair"])
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace per config "
                          "into DIR/<config> (VERDICT r3 #4: evidence-based "
@@ -1332,6 +1495,10 @@ def main() -> None:
                 n_peers_a=2048, n_peers_b=1024, rpc_workers=4,
                 rpc_reqs_each=25, vector_keys=8, parity_keys=1000,
                 bucket_min=8, bucket_max=64),
+            "repair": lambda: bench_repair(
+                n_peers=256, stranded=48, corrupt=8, parity_keys=32,
+                bucket_min=4, bucket_max=64, max_keys_round=128,
+                max_rounds=12),
         }
     else:
         runs = {
@@ -1343,6 +1510,7 @@ def main() -> None:
             "sweep_10m": lambda: bench_sweep_10m(hopscan=args.hopscan),
             "serve": bench_serve,
             "gateway": bench_gateway,
+            "repair": bench_repair,
         }
     if args.config:
         runs = {args.config: runs[args.config]}

@@ -63,6 +63,8 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "gateway", "admission.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "frontend.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "metrics_ext.py"),
+    os.path.join("p2p_dhts_tpu", "repair", "scheduler.py"),
+    os.path.join("p2p_dhts_tpu", "repair", "replication.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

@@ -109,6 +109,27 @@ def default_kernels() -> List[KernelSpec]:
                    (keys, keys)),
     ]
 
+    # The chordax-repair kernels (ISSUE 6): the Merkle-diff comparison
+    # (digest two stores, level-compare, extract the delta key-set) and
+    # the duplicate-index re-pair pass — the anti-entropy device path a
+    # GSPMD miscompile would silently corrupt.
+    from p2p_dhts_tpu.dhash.antientropy import store_index
+    from p2p_dhts_tpu.repair import kernels as rk
+    store_b = dstore.empty_store(capacity=16 * batch, max_segments=4)
+
+    def merkle_delta(sa, sb):
+        ia, ib = store_index(sa), store_index(sb)
+        leaf_diff, nodes = rk.merkle_diff(ia, ib)
+        cand, ok = rk.delta_scan(sa, leaf_diff)
+        return leaf_diff, nodes, cand, ok
+
+    specs += [
+        KernelSpec("repair.merkle_delta", merkle_delta, (store, store_b)),
+        KernelSpec("repair.reindex_duplicates",
+                   lambda s, st: rk.reindex_duplicates(s, st, 3, 2),
+                   (state_m, store)),
+    ]
+
     if mesh is not None:
         from p2p_dhts_tpu.core import sharded as csh
         specs.append(KernelSpec(

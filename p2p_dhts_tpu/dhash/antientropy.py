@@ -19,7 +19,13 @@ Use cases (both stores device-resident):
     overlay's peer-vs-successor sync, `overlay/dhash_peer.py`, is the
     wire-level twin of this op);
   * drift repair — a live store against its checkpoint restore
-    (checkpoint.py), catching rows lost or gained since the snapshot.
+    (checkpoint.py), catching rows lost or gained since the snapshot;
+  * the chordax-repair control plane (ISSUE 6) — `repair/` builds its
+    CROSS-RING anti-entropy on these pieces: `store_index` is the
+    ServeEngine "sync_digest" kind, `_marked_leader_keys` backs
+    repair.kernels.delta_scan, and the row-copy `reconcile` below
+    stays the intra-ring (same ring state) form while the scheduler
+    heals ring PAIRS block-level through gateway GET/PUT batches.
 
 Repair semantics follow CompareNodes/RetrieveMissing
 (dhash_peer.cpp:367-447) in batched form: a (key, frag_idx) row STORED

@@ -22,6 +22,15 @@ Here both are single batched ops over the global fragment table:
     indices from >= m surviving ones (decode + re-encode, the exact
     regeneration path of DataBlock(fragments), data_block.cpp:30-54) and
     append them on their designated holders.
+
+Related (chordax-repair, ISSUE 6): `repair/kernels.reindex_duplicates`
+is the device-store generalization of the host heal's duplicate-only
+re-index (overlay/dhash_peer.py run_local_maintenance) — where
+local_maintenance here regenerates MISSING indices, the re-pair pass
+rewrites DUPLICATED ones onto missing slots under the same
+last-copy-never-destroyed guard, and runs engine-ordered as the
+ServeEngine "repair_reindex" kind. Cross-RING repair (two rings'
+stores) lives in repair/scheduler.py, not here.
 """
 
 from __future__ import annotations

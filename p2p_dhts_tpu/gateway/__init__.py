@@ -12,7 +12,10 @@ Modules:
   admission    per-ring bounded admission, deadline propagation,
                single-flight duplicate suppression
   frontend     the Gateway itself + the FIND_SUCCESSOR / GET / PUT /
-               FINGER_INDEX RPC handlers + the process-global instance
+               FINGER_INDEX / SYNC_RANGE / REPAIR_STATUS RPC handlers
+               + the process-global instance. PUT optionally fans to
+               n rings at quorum w (Gateway.set_replication, backed by
+               p2p_dhts_tpu.repair — the chordax-repair subsystem).
   metrics_ext  per-ring/per-op counters, gauges, p50/p99 histograms
 
 Importing this package never initializes a jax backend (overlay

@@ -73,8 +73,12 @@ _FALLBACK_KINDS = frozenset({"find_successor", "finger_index"})
 #: process-global finger engine, serve.global_finger_engine).
 FINGER_RING_ID = "__finger__"
 
-#: Wire commands install_gateway_handlers registers.
-GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX")
+#: Wire commands install_gateway_handlers registers. SYNC_RANGE and
+#: REPAIR_STATUS are the chordax-repair control verbs (ISSUE 6): one
+#: on-demand anti-entropy round between two named rings, and the
+#: replication/scheduler observability snapshot.
+GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
+                    "SYNC_RANGE", "REPAIR_STATUS")
 
 
 def _key_int(v) -> int:
@@ -105,10 +109,63 @@ class Gateway:
         # sets these so device rings added afterwards match the
         # process's overlay replication config.
         self._default_ida = (14, 10, 257)
+        # chordax-repair wiring (ISSUE 6): PUT fan-out policy/writer and
+        # any attached anti-entropy schedulers (REPAIR_STATUS's view).
+        # All repair imports are lazy — the repair package imports this
+        # module, and a plain gateway must not pay for the subsystem.
+        self._repl_policy = None
+        self._repl_writer = None
+        self._repair_scheds: List[Any] = []
 
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
         self._default_ida = (int(n), int(m), int(p))
+
+    # -- replication policy (chordax-repair) ---------------------------------
+    def set_replication(self, policy) -> None:
+        """Install (or, with None, remove) the PUT replication policy
+        (repair.replication.ReplicationPolicy). While set, a PUT with
+        no explicit ring_id fans to policy.n_replicas rings and returns
+        at quorum w; an explicit ring_id always writes that one ring
+        (the repair scheduler and the reference-shape wire form rely on
+        that bypass)."""
+        from p2p_dhts_tpu.repair.replication import ReplicatedWriter
+        with self._rings_lock:
+            old = self._repl_writer
+            self._repl_policy = policy
+            self._repl_writer = (
+                ReplicatedWriter(self, policy, metrics=self.metrics.base)
+                if policy is not None else None)
+        if old is not None:
+            old.close()
+
+    @property
+    def replication_policy(self):
+        with self._rings_lock:
+            return self._repl_policy
+
+    def _writer(self):
+        with self._rings_lock:
+            return self._repl_writer
+
+    def attach_repair(self, scheduler) -> None:
+        """Register a RepairScheduler for REPAIR_STATUS visibility and
+        for teardown with the gateway (close() closes it first)."""
+        with self._rings_lock:
+            self._repair_scheds.append(scheduler)
+
+    def repair_status(self) -> dict:
+        """The chordax-repair observability snapshot: the replication
+        policy, every attached scheduler's status, and the repair.*
+        counter family."""
+        with self._rings_lock:
+            policy = self._repl_policy
+            scheds = list(self._repair_scheds)
+        return {
+            "replication": policy.as_dict() if policy is not None else None,
+            "schedulers": [s.status() for s in scheds],
+            "counters": self.metrics.base.counters_with_prefix("repair."),
+        }
 
     def add_ring(self, ring_id: str, state=None, store=None, *,
                  key_range: Optional[Tuple[int, int]] = None,
@@ -463,14 +520,79 @@ class Gateway:
     def dhash_put(self, key, segments, length: int, start_row: int = 0, *,
                   ring_id: Optional[str] = None,
                   timeout: Optional[float] = None,
-                  deadline: Optional[Deadline] = None) -> bool:
+                  deadline: Optional[Deadline] = None,
+                  replicate: Optional[bool] = None) -> bool:
+        """Store one block. With a replication policy installed and no
+        explicit ring_id, the PUT fans to n_replicas rings and returns
+        at quorum w (repair.replication); `replicate=False` forces the
+        single-ring path, True demands the policy be set."""
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
         k = _key_int(key)
+        writer = self._writer()
+        if replicate and ring_id is not None:
+            # The documented contract is "an explicit ring_id always
+            # writes that one ring" — honoring replicate=True here
+            # would silently fan a targeted write elsewhere.
+            raise ValueError("replicate=True and an explicit ring_id "
+                             "are contradictory; drop one")
+        use_repl = (replicate if replicate is not None
+                    else (writer is not None and ring_id is None))
+        if use_repl:
+            if writer is None:
+                raise ValueError("replicate=True but no replication "
+                                 "policy is set (Gateway.set_replication)")
+            return writer.put(k, segments, int(length), int(start_row), dl)
         backend = self.router.route(key_int=k, ring_id=ring_id)
         return self._serve_many(
             backend, "dhash_put",
             [(k, segments, int(length), int(start_row))], dl)[0]
+
+    # -- batched store ops on ONE explicit ring (the repair heal path) -------
+    def dhash_get_many(self, keys: Sequence, *, ring_id: str,
+                       timeout: Optional[float] = None,
+                       deadline: Optional[Deadline] = None) -> List[Any]:
+        """[(segments, ok)] for a key list against one named ring, as
+        one engine batch."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        return self._serve_many(
+            backend, "dhash_get", [(_key_int(k),) for k in keys], dl)
+
+    def dhash_put_many(self, entries: Sequence[tuple], *, ring_id: str,
+                       timeout: Optional[float] = None,
+                       deadline: Optional[Deadline] = None) -> List[bool]:
+        """[(key, segments, length, start_row)] -> [ok] against one
+        named ring, as one engine batch (never replicated — the heal
+        path targets a specific under-replicated ring)."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        payloads = [(_key_int(k), seg, int(length), int(start))
+                    for k, seg, length, start in entries]
+        return self._serve_many(backend, "dhash_put", payloads, dl)
+
+    # -- repair control ops (chordax-repair, ISSUE 6) ------------------------
+    def sync_digest(self, ring_id: str, *,
+                    timeout: Optional[float] = None,
+                    deadline: Optional[Deadline] = None):
+        """The named ring's Merkle index (host arrays), engine-ordered
+        after every put submitted before this call."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        return self._serve_many(backend, "sync_digest", [()], dl)[0]
+
+    def repair_reindex(self, ring_id: str, *,
+                       timeout: Optional[float] = None,
+                       deadline: Optional[Deadline] = None) -> int:
+        """Run the duplicate-index re-pair pass on the named ring's
+        store; returns rewritten-row count."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        return self._serve_many(backend, "repair_reindex", [()], dl)[0]
 
     # -- stats ---------------------------------------------------------------
     def stats(self) -> dict:
@@ -574,6 +696,41 @@ class Gateway:
             payloads = [(_key_int(e["KEY"]), e["SEGMENTS"],
                          int(e.get("LENGTH", len(e["SEGMENTS"]))),
                          int(e.get("START", 0))) for e in entries]
+            writer = self._writer()
+            if writer is not None and ring_id is None:
+                # Replicated vector PUT. Entries are grouped by OWNING
+                # ring first (same per-key routing as the non-replicated
+                # path — a key-range owner must stay each entry's
+                # primary replica) and each group fans to its owner +
+                # the next registered rings; per-entry OK is the
+                # w-quorum verdict at return time (stragglers finish
+                # asynchronously).
+                groups, _ = self._group_by_ring(
+                    [p[0] for p in payloads], None)
+                ok_out = [False] * len(payloads)
+                rings_out = [""] * len(payloads)
+                target_union: List[str] = []
+                group_reports = []
+                for rid, idxs in groups.items():
+                    outcome = writer.put_many([payloads[i] for i in idxs],
+                                              dl)
+                    for i, ok in zip(idxs, outcome.per_entry_ok):
+                        ok_out[i] = bool(ok)
+                        rings_out[i] = outcome.targets[0]
+                    for t in outcome.targets:
+                        if t not in target_union:
+                            target_union.append(t)
+                    group_reports.append({
+                        "PRIMARY": outcome.targets[0],
+                        "TARGETS": outcome.targets,
+                        "ACKED": outcome.acked_rings,
+                        "FAILED": outcome.failed_rings,
+                        "ENTRIES": len(idxs)})
+                return {"OK": ok_out, "RINGS": rings_out,
+                        "REPLICATION": {
+                            "TARGETS": target_union,
+                            "GROUPS": group_reports,
+                            "W": writer.policy.w}}
             groups, backends = self._group_by_ring(
                 [p[0] for p in payloads], ring_id)
             ok_out = [False] * len(payloads)
@@ -603,6 +760,32 @@ class Gateway:
                             ring_id=ring_id, deadline=dl)
         return {"OK": bool(ok)}
 
+    def handle_sync_range(self, req: dict) -> dict:
+        """One on-demand anti-entropy round between two named rings —
+        the wire form of the repair scheduler's round (the reference's
+        whole XCHNG_NODE recursion behind a single verb)."""
+        dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
+        from p2p_dhts_tpu.repair.scheduler import run_sync_round
+        res = run_sync_round(
+            self, req["RING_A"], req["RING_B"],
+            max_keys=int(req.get("MAX_KEYS", 256)),
+            reindex=bool(req.get("REINDEX", True)),
+            deadline=dl, metrics=self.metrics.base)
+        return {
+            "CONVERGED": bool(res.converged),
+            "LEAF_DIFFS": int(res.leaf_diffs),
+            "NODES_EXCHANGED": int(res.nodes_exchanged),
+            "CANDIDATES": int(res.candidates),
+            "HEALED": {k: int(v) for k, v in res.healed.items()},
+            "CANONICALIZED": int(res.canonicalized),
+            "REINDEXED": {k: int(v) for k, v in res.reindexed.items()},
+            "UNHEALABLE": int(res.unhealable),
+            "DEFERRED": int(res.deferred),
+        }
+
+    def handle_repair_status(self, req: dict) -> dict:
+        return {"STATUS": self.repair_status()}
+
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         if "KEYS" in req:
@@ -618,12 +801,32 @@ class Gateway:
 
     def close(self, drain: bool = True) -> None:
         """Close every registered ring's engine (the shared finger
-        engine is process-global and stays up)."""
+        engine is process-global and stays up). Attached repair
+        schedulers and the replication writer stop FIRST so no repair
+        round lands on a half-torn-down router."""
+        with self._rings_lock:
+            scheds = list(self._repair_scheds)
+            self._repair_scheds.clear()
+            writer, self._repl_writer = self._repl_writer, None
+            self._repl_policy = None
+        # A wedged scheduler/writer must not abort the rest of the
+        # teardown (leaked engines + pool threads outlive one stuck
+        # pair loop); remember the first error, finish, then re-raise.
+        first_exc: Optional[BaseException] = None
+        for closer in [s.close for s in scheds] + (
+                [writer.close] if writer is not None else []):
+            try:
+                closer()
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                if first_exc is None:
+                    first_exc = exc
         for ring_id in self.router.ring_ids():
             try:
                 self.remove_ring(ring_id, drain=drain)
             except UnknownRingError:
                 pass  # concurrently removed
+        if first_exc is not None:
+            raise first_exc
 
 
 # ---------------------------------------------------------------------------
@@ -656,5 +859,7 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "GET": gw.handle_get,
         "PUT": gw.handle_put,
         "FINGER_INDEX": gw.handle_finger_index,
+        "SYNC_RANGE": gw.handle_sync_range,
+        "REPAIR_STATUS": gw.handle_repair_status,
     })
     return gw

@@ -30,8 +30,10 @@ from typing import Dict, Iterable, Optional
 
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
-#: The gateway op vocabulary (the engine's kinds, served over the wire).
-OPS = ("find_successor", "dhash_get", "dhash_put", "finger_index")
+#: The gateway op vocabulary (the engine's kinds, served over the wire;
+#: sync_digest / repair_reindex are the chordax-repair control ops).
+OPS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
+       "sync_digest", "repair_reindex")
 
 
 class GatewayMetrics:

@@ -54,9 +54,19 @@ class DHashPeer(AbstractChordPeer):
                  backend: str = "python",
                  maintenance_interval: Optional[float] = 5.0,
                  num_server_threads: int = 3,
-                 server_backend: str = "python"):
+                 server_backend: str = "python",
+                 device_store_ring: Optional[str] = None):
         self.db = FragmentDb()
         self.n, self.m, self.p = 14, 10, 257
+        # Host-overlay/device-store hybrid (the ROADMAP's gateway
+        # follow-through): when set, create/read route block STORAGE
+        # through a gateway-registered device ring while the host
+        # overlay keeps doing membership/routing. A ring id names one
+        # explicitly; "auto" uses the default ring if it carries a
+        # store whose IDA m matches this peer's; None (the default)
+        # keeps the pure host path.
+        self.device_store_ring = device_store_ring
+        self._device_ring_warned = False
         # Re-index census memo: key -> successor-id tuple last verified
         # duplicate-free (run_local_maintenance's heal pass).
         self._reindex_ok: Dict[int, tuple] = {}
@@ -95,9 +105,66 @@ class DHashPeer(AbstractChordPeer):
     def set_ida_params(self, n: int, m: int, p: int) -> None:
         self.n, self.m, self.p = n, m, p
 
+    # -- device-store hybrid (chordax-repair satellite) ----------------------
+    def _device_backend(self):
+        """(gateway, ring_id) serving this peer's block storage, or
+        None for the host path. Resolution is per-call so rings
+        registered after the peer came up are picked up, and any
+        gateway-layer surprise degrades to the host path (logged once)
+        — the DHash protocol must come up regardless."""
+        if self.device_store_ring is None:
+            return None
+        try:
+            from p2p_dhts_tpu.gateway import global_gateway
+            gw = global_gateway()
+            if self.device_store_ring != "auto":
+                backend = gw.router.get(self.device_store_ring)
+            else:
+                _, backend = gw.router.snapshot()
+            if backend is None or not getattr(backend.engine,
+                                              "has_store", False):
+                return None
+            # The device ring's erasure coding must match this peer's
+            # (segments are [S, m]); a mismatched ring cannot serve it.
+            if backend.engine.ida_params[1] != self.m:
+                return None
+            return gw, backend.ring_id
+        # chordax-lint: disable=bare-except -- hybrid resolution is additive; any failure routes to the host path
+        except Exception:
+            return None
+
+    def _device_fallback(self, op: str, exc: Exception) -> None:
+        if not self._device_ring_warned:
+            self._device_ring_warned = True
+            self.log(f"device-store {op} failed "
+                     f"({type(exc).__name__}: {exc}); falling back to "
+                     f"the host store path (logged once)")
+
     # -- create (dhash_peer.cpp:89-154) --------------------------------------
     def create(self, key, val: str) -> None:
         key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        hybrid = self._device_backend()
+        if hybrid is not None:
+            gw, ring_id = hybrid
+            from p2p_dhts_tpu.ida import split_to_segments
+            seg = split_to_segments(val.encode(), self.m)
+            try:
+                ok = gw.dhash_put(int(key), seg, seg.shape[0], 0,
+                                  ring_id=ring_id)
+            except (RuntimeError, ValueError) as exc:
+                # Gateway-layer failure (degraded ring, busy, deadline)
+                # OR a value the device store cannot hold (segments
+                # beyond the ring's max_segments raise ValueError at
+                # engine validation): visible fallback, the host path
+                # still serves the write.
+                self._device_fallback("create", exc)
+            else:
+                if not ok:
+                    # The ring answered: placement quorum failed — the
+                    # reference's error, not a fallback case.
+                    raise RuntimeError("Too few succs responded to "
+                                       "requests.")
+                return
         block = DataBlock(val, self.n, self.m, self.p)
         self.create_block(key, block)
 
@@ -138,6 +205,20 @@ class DHashPeer(AbstractChordPeer):
     # -- read (dhash_peer.cpp:156-217) ---------------------------------------
     def read(self, key) -> str:
         key = key if isinstance(key, Key) else Key.from_plaintext(key)
+        hybrid = self._device_backend()
+        if hybrid is not None:
+            gw, ring_id = hybrid
+            try:
+                segments, ok = gw.dhash_get(int(key), ring_id=ring_id)
+            except (RuntimeError, ValueError) as exc:
+                self._device_fallback("read", exc)
+            else:
+                if ok:
+                    from p2p_dhts_tpu.ida import strip_decoded
+                    return strip_decoded(segments).decode()
+                # Device miss (key may predate the device ring, or its
+                # block is device-unreadable): the host overlay is the
+                # durable fallback, exactly like a degraded lookup.
         return self.read_block(key).decode()
 
     def read_block(self, key: Key) -> DataBlock:

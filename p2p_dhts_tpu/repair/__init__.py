@@ -1,0 +1,46 @@
+"""chordax-repair: replicated writes + device-batched anti-entropy
+(ISSUE 6).
+
+The DHash durability promise (Cates 2003) as a first-class subsystem on
+top of the PR-4 gateway, driving all repair compute through the PR-2
+ServeEngine:
+
+  replication   a gateway PUT fans to n registered rings through each
+                ring's own admission, returns at quorum w, stragglers
+                complete asynchronously with per-ring lag recorded
+                (repair/replication.py).
+  anti-entropy  ring pairs reconcile by Merkle digest diff — the
+                engine-ordered "sync_digest" kind, one vectorized
+                equality per level, a bounded delta key-set, batched
+                GET/PUT heals (repair/kernels.py + repair/scheduler.py).
+  re-pair       the r05 fragment-stranding fix generalized: duplicate
+                fragment indices rewrite onto missing ones via the
+                store-chaining "repair_reindex" engine kind; distinct
+                count strictly increases, the last copy is never
+                destroyed.
+  control       SYNC_RANGE / REPAIR_STATUS RPC verbs on every gateway
+                server; repair.* metrics; bench.py --config repair.
+
+Importing this package pulls the gateway/serve stack but never
+initializes a jax backend (overlay etiquette); device work happens only
+once digests/heals flow.
+"""
+
+from p2p_dhts_tpu.repair.replication import (  # noqa: F401
+    PutOutcome,
+    QuorumWriteError,
+    ReplicatedWriter,
+    ReplicationPolicy,
+)
+from p2p_dhts_tpu.repair.scheduler import (  # noqa: F401
+    RepairScheduler,
+    RoundResult,
+    TokenBucket,
+    run_sync_round,
+)
+
+__all__ = [
+    "PutOutcome", "QuorumWriteError", "RepairScheduler",
+    "ReplicatedWriter", "ReplicationPolicy", "RoundResult", "TokenBucket",
+    "run_sync_round",
+]

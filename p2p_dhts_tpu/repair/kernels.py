@@ -1,0 +1,222 @@
+"""chordax-repair device kernels: Merkle delta extraction + the
+duplicate-index re-pair pass, as batched XLA programs.
+
+Two kernels close the gap between "two rings' trees differ" and "the
+stores converge", with work proportional to the DIVERGENCE:
+
+  * `merkle_diff` / `delta_scan` — the comparison half. Two rings'
+    keyspace-partitioned Merkle indices (dhash.merkle level arrays,
+    built through each ring's ServeEngine "sync_digest" kind so the
+    digest is FIFO-ordered with in-flight puts) compare level-by-level
+    in one vectorized equality per level, and the keys living in
+    DIFFERING leaf buckets come back as a bounded candidate set — the
+    whole recursive XCHNG_NODE exchange (dhash_peer.cpp:381-481) as a
+    log-depth device op plus one store scan, no per-key host loops.
+  * `reindex_duplicates` — the repair half of the r05
+    fragment-stranding fix (overlay/dhash_peer.py
+    run_local_maintenance's duplicate-only heal), generalized to the
+    device store: rows whose fragment index DUPLICATES an earlier
+    reachable row of the same key are rewritten to a missing index
+    (decode from >= m distinct survivors, re-encode, in-place row
+    rewrite). Each rewrite strictly INCREASES the block's
+    distinct-fragment count — a duplicate only ever becomes a missing
+    index, never another duplicate — and the guard set mirrors the
+    host heal's: no rewrite unless the block is decodable (>= m
+    distinct reachable fragments, the "successful whole-block read"
+    precondition), and only the dedup LOSERS rewrite (the first row
+    bearing an index is never touched), so the last copy of any
+    fragment is never destroyed.
+
+Trace accounting: every kernel bumps `TRACE_COUNTS` at trace time (the
+serve.py recompile-counter pattern) so the repair path can prove zero
+steady-state retraces — `trace_snapshot()` / `retraces_since()` are the
+scheduler's and the bench's measuring stick.
+
+This module imports jax at module scope (it is pure kernel code, pulled
+in lazily by serve/_get_kernels and the repair scheduler) but never
+initializes a backend at import.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.dhash.antientropy import _marked_leader_keys, store_index
+from p2p_dhts_tpu.dhash.merkle import MerkleIndex, diff_indices
+from p2p_dhts_tpu.dhash.store import (FragmentStore, _sort_store,
+                                      placement_owners)
+from p2p_dhts_tpu.ida import decode_kernel, encode_kernel
+from p2p_dhts_tpu.ops import u128
+
+#: Traces per kernel since process start (bumped at TRACE time — python
+#: side effects inside jit run once per compilation, exactly the
+#: recompile counter the zero-retrace contract needs).
+TRACE_COUNTS: Dict[str, int] = {"merkle_diff": 0, "delta_scan": 0,
+                                "reindex_duplicates": 0}
+
+
+def _count(kernel: str) -> None:
+    TRACE_COUNTS[kernel] += 1
+
+
+def trace_snapshot() -> Dict[str, int]:
+    return dict(TRACE_COUNTS)
+
+
+def retraces_since(snapshot: Dict[str, int]) -> int:
+    return sum(TRACE_COUNTS.values()) - sum(snapshot.values())
+
+
+# ---------------------------------------------------------------------------
+# comparison: digest diff + delta key extraction
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def merkle_diff(ia: MerkleIndex, ib: MerkleIndex
+                ) -> Tuple[jax.Array, jax.Array]:
+    """(leaf_diff [n_leaf] bool, nodes_exchanged i32) for two indices of
+    the same (depth, fanout) — dhash.merkle.diff_indices with the repair
+    path's trace accounting."""
+    _count("merkle_diff")
+    return diff_indices(ia, ib)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("depth", "fanout_bits", "max_keys"))
+def delta_scan(store: FragmentStore, leaf_diff: jax.Array,
+               depth: int = 4, fanout_bits: int = 3,
+               max_keys: int = 256) -> Tuple[jax.Array, jax.Array]:
+    """Up to max_keys distinct keys of live rows hashing into DIFFERING
+    leaf buckets: (keys [max_keys, 4] u32, ok [max_keys] bool). The
+    bounded per-round candidate set a heal batch is built from (call
+    again next round while diffs remain — the reference's recursion
+    also descends incrementally)."""
+    _count("delta_scan")
+    cand = _marked_leader_keys(store, leaf_diff, depth, fanout_bits,
+                               max_keys)
+    sentinel = jnp.full((1, 4), 0xFFFFFFFF, jnp.uint32)
+    ok = ~u128.eq(cand, sentinel)
+    return cand, ok
+
+
+# ---------------------------------------------------------------------------
+# repair: the duplicate-index re-pair pass
+# ---------------------------------------------------------------------------
+
+class ReindexStats(NamedTuple):
+    rewritten: jax.Array        # i32 — rows re-pointed to missing indices
+    duplicate_rows: jax.Array   # i32 — dup rows observed pre-repair
+    blocks_repaired: jax.Array  # i32 — distinct keys that had a rewrite
+
+
+def reindex_duplicates_impl(ring, store: FragmentStore,
+                            n: int = 14, m: int = 10, p: int = 257,
+                            max_hops: Optional[int] = None
+                            ) -> Tuple[FragmentStore, ReindexStats]:
+    """Un-jitted body (serve.py wraps it with its own trace counter;
+    `reindex_duplicates` below is the standalone jitted form)."""
+    c = store.capacity
+    rows = jnp.arange(c, dtype=jnp.int32)
+    live = store.used & (rows < store.n_used)
+    prev_same = jnp.concatenate([
+        jnp.zeros((1,), bool), u128.eq(store.keys[1:], store.keys[:-1])])
+    leaders = live & ~prev_same
+
+    # Window of up to n rows after each leader (the store is sorted by
+    # (key, frag_idx), so a key's rows are contiguous). Unlike
+    # _key_window this keeps RAW validity — the dedup losers are
+    # exactly the rows this pass exists to rewrite.
+    w = jnp.arange(n, dtype=jnp.int32)[None, :]
+    win = rows[:, None] + w
+    win_c = jnp.minimum(win, c - 1)
+    h = store.holder[win_c]
+    valid = (win < store.n_used) \
+        & u128.eq(store.keys[win_c], store.keys[:, None, :]) \
+        & store.used[win_c] \
+        & ring.alive[jnp.maximum(h, 0)] & (h >= 0)
+    fidx = store.frag_idx[win_c]
+
+    # A later reachable row bearing an earlier reachable row's index is
+    # the dedup LOSER — the rewrite candidate. The first bearer stays.
+    dup_pair = (fidx[:, :, None] == fidx[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    earlier = jnp.tril(jnp.ones((n, n), bool), k=-1)[None]
+    is_dup = (dup_pair & earlier).any(axis=2)                   # [C, n]
+    distinct = valid & ~is_dup
+
+    idx_grid = jnp.arange(1, n + 1, dtype=jnp.int32)
+    present = ((fidx[:, :, None] == idx_grid[None, None, :])
+               & distinct[:, :, None]).any(axis=1)              # [C, n]
+    n_distinct = present.sum(axis=1)
+
+    # Designated holders: fragment i belongs on the key's i-th alive
+    # successor — a rewritten row moves to its canonical position.
+    start = jnp.zeros((c,), jnp.int32)
+    owners = placement_owners(ring, store.keys, start, n, max_hops)
+    owner_alive = ring.alive[jnp.maximum(owners, 0)] & (owners >= 0)
+    missing = ~present & owner_alive                            # [C, n]
+
+    # The whole-block-read precondition: decodable (>= m distinct
+    # reachable fragments) or nothing is touched.
+    can = leaders & (n_distinct >= m) & is_dup.any(axis=1) \
+        & missing.any(axis=1)
+
+    # Decode from the first m distinct fragments, re-encode all n.
+    order = jnp.argsort(~distinct, axis=1, stable=True)[:, :m]
+    sel = jnp.take_along_axis(win_c, order, axis=1)
+    rows_v = store.values[sel]                                  # [C, m, S]
+    idx_v = jnp.where(jnp.take_along_axis(distinct, order, axis=1),
+                      store.frag_idx[sel], 0)
+    idx_safe = jnp.where(can[:, None], idx_v,
+                         jnp.arange(1, m + 1, dtype=jnp.int32)[None, :])
+    segments = decode_kernel(rows_v, idx_safe, p)               # [C, S, m]
+    all_frags = encode_kernel(segments, n, m, p)                # [C, n, S]
+
+    # k-th duplicate takes the k-th missing index: every rewrite lands
+    # on a DISTINCT absent index, so the distinct count strictly grows.
+    dup_rank = jnp.cumsum(is_dup.astype(jnp.int32), axis=1) - 1  # [C, n]
+    miss_order = jnp.argsort(~missing, axis=1, stable=True)      # [C, n]
+    miss_count = missing.sum(axis=1)
+    k = jnp.clip(dup_rank, 0, n - 1)
+    tgt_pos = jnp.take_along_axis(miss_order, k, axis=1)         # 0-based
+    assign = can[:, None] & is_dup & (dup_rank < miss_count[:, None])
+
+    smax = store.max_segments
+    flat_rows = jnp.where(assign, win_c, c).reshape(-1)  # OOB -> dropped
+    new_vals = jnp.take_along_axis(
+        all_frags, tgt_pos[:, :, None], axis=1).reshape(-1, smax)
+    new_fidx = (tgt_pos + 1).reshape(-1)
+    new_holder = jnp.take_along_axis(owners, tgt_pos, axis=1).reshape(-1)
+
+    out = store._replace(
+        frag_idx=store.frag_idx.at[flat_rows].set(new_fidx, mode="drop"),
+        values=store.values.at[flat_rows].set(new_vals, mode="drop"),
+        holder=store.holder.at[flat_rows].set(new_holder, mode="drop"))
+    stats = ReindexStats(
+        rewritten=assign.astype(jnp.int32).sum(),
+        duplicate_rows=(is_dup & leaders[:, None]).astype(jnp.int32).sum(),
+        blocks_repaired=assign.any(axis=1).astype(jnp.int32).sum())
+    return _sort_store(out), stats
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m", "p", "max_hops"))
+def reindex_duplicates(ring, store: FragmentStore,
+                       n: int = 14, m: int = 10, p: int = 257,
+                       max_hops: Optional[int] = None
+                       ) -> Tuple[FragmentStore, ReindexStats]:
+    """Jitted standalone form (tests, the GSPMD registry); the serve
+    engine's "repair_reindex" kind wraps the impl with the engine's own
+    per-kind trace counter instead."""
+    _count("reindex_duplicates")
+    return reindex_duplicates_impl(ring, store, n, m, p, max_hops)
+
+
+__all__ = [
+    "MerkleIndex", "ReindexStats", "TRACE_COUNTS", "delta_scan",
+    "merkle_diff", "reindex_duplicates", "reindex_duplicates_impl",
+    "retraces_since", "store_index", "trace_snapshot",
+]

@@ -1,0 +1,294 @@
+"""Replicated gateway writes: one PUT fanned to n rings, quorum return.
+
+The reference gets durability from striping one block's n IDA fragments
+over n PEERS of one ring (DHashPeer::Create, dhash_peer.cpp:89-129);
+the gateway generalizes the same >= quorum-acks contract one level up:
+a PUT fans to `n_replicas` registered RINGS through each ring's own
+bounded admission, the caller returns as soon as `w` rings acked, and
+the remaining replicas complete ASYNCHRONOUSLY on a small fan-out pool
+with their lag recorded per ring (`repair.replication.lag_ms.<ring>`).
+
+Semantics pinned by tests/test_gateway.py's quorum oracle checks:
+
+  * w-of-n success — the caller's PUT succeeds iff >= w target rings
+    ack within its deadline; a slow ring cannot delay a satisfied
+    quorum (it finishes in the background, lag-accounted).
+  * no cross-ring store forks on failure — a replica that fails keeps
+    its engine-applied store EXACTLY as the engine left it: there is
+    no side-path retry, no fallback write (the gateway's store ops
+    never fall back), and no rollback of the rings that DID ack — the
+    under-replicated key is the anti-entropy scheduler's job, which is
+    how the reference treats a Create that reached only m..n-1 peers.
+  * per-replica deadlines — the quorum WAIT honors the caller's
+    deadline; the replica PUTs themselves run under
+    max(caller deadline, now + async_grace_s) so a tight caller budget
+    returns fast without shedding the background replication work.
+
+LOCK ORDER: `_QuorumState` waits only on its own condition (the
+lockcheck-exempt pattern) and the writer's lock guards pool
+construction only; no lock is ever held across a gateway/engine call.
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.serve import DeadlineExpiredError
+
+logger = logging.getLogger(__name__)
+
+
+class QuorumWriteError(RuntimeError):
+    """Fewer than w target rings could ack the PUT."""
+
+
+class ReplicationPolicy:
+    """PUT fan-out policy: n_replicas target rings, quorum w."""
+
+    def __init__(self, n_replicas: int = 2, w: int = 1,
+                 async_grace_s: float = 30.0):
+        n_replicas, w = int(n_replicas), int(w)
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if not 1 <= w <= n_replicas:
+            raise ValueError(f"quorum w must be in [1, n_replicas], got "
+                             f"w={w} n_replicas={n_replicas}")
+        self.n_replicas = n_replicas
+        self.w = w
+        self.async_grace_s = float(async_grace_s)
+
+    def as_dict(self) -> dict:
+        return {"n_replicas": self.n_replicas, "w": self.w,
+                "async_grace_s": self.async_grace_s}
+
+    def __repr__(self) -> str:
+        return (f"ReplicationPolicy(n_replicas={self.n_replicas}, "
+                f"w={self.w})")
+
+
+class PutOutcome:
+    """What a replicated PUT looked like at quorum-return time."""
+
+    __slots__ = ("ok", "per_entry_ok", "targets", "acked_rings",
+                 "failed_rings", "quorum_s")
+
+    def __init__(self, ok: bool, per_entry_ok: List[bool],
+                 targets: List[str], acked_rings: List[str],
+                 failed_rings: List[str], quorum_s: float):
+        self.ok = ok
+        self.per_entry_ok = per_entry_ok
+        self.targets = targets
+        self.acked_rings = acked_rings
+        self.failed_rings = failed_rings
+        self.quorum_s = quorum_s
+
+
+class _QuorumState:
+    """Per-call ack bookkeeping: ring completions arrive on pool
+    threads; the caller waits on the condition until every entry has w
+    acks, a quorum becomes impossible, or its deadline lapses."""
+
+    def __init__(self, n_entries: int, n_targets: int, w: int):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.acks = [0] * n_entries          # rings acking each entry
+        self.rings_done = 0
+        self.rings_failed = 0
+        self.n_targets = n_targets
+        self.w = w
+        self.acked_rings: List[str] = []
+        self.failed_rings: List[str] = []
+        self.t_quorum: Optional[float] = None
+
+    def _quorum_met_locked(self) -> bool:
+        return all(a >= self.w for a in self.acks)
+
+    def _quorum_impossible_locked(self) -> bool:
+        remaining = self.n_targets - self.rings_done
+        return any(a + remaining < self.w for a in self.acks)
+
+    def record(self, ring_id: str, oks: Optional[Sequence[bool]]) -> None:
+        """One ring finished: oks per entry, or None for a ring-level
+        failure. Returns after waking any quorum waiter."""
+        with self.cond:
+            self.rings_done += 1
+            if oks is None:
+                self.rings_failed += 1
+                self.failed_rings.append(ring_id)
+            else:
+                ring_ok = True
+                for i, ok in enumerate(oks):
+                    if ok:
+                        self.acks[i] += 1
+                    else:
+                        ring_ok = False
+                (self.acked_rings if ring_ok
+                 else self.failed_rings).append(ring_id)
+            if self.t_quorum is None and self._quorum_met_locked():
+                self.t_quorum = time.perf_counter()
+            self.cond.notify_all()
+
+    def wait_quorum(self, deadline) -> bool:
+        """True iff the quorum was met; False when it became impossible
+        or the deadline lapsed first (the caller maps each to its
+        error). Never blocks past the deadline."""
+        with self.cond:
+            while True:
+                if self._quorum_met_locked():
+                    return True
+                if self._quorum_impossible_locked() \
+                        or self.rings_done >= self.n_targets:
+                    return False
+                rem = deadline.remaining()
+                if rem is not None and rem <= 0:
+                    return False
+                self.cond.wait(rem if rem is not None else 0.5)
+
+
+class ReplicatedWriter:
+    """The gateway's PUT fan-out engine (one per Gateway, built when a
+    ReplicationPolicy is set)."""
+
+    #: Fan-out pool bound: replicas of concurrent PUTs share it; the
+    #: per-ring admission budgets are the real backpressure.
+    POOL_WORKERS = 8
+
+    def __init__(self, gateway, policy: ReplicationPolicy,
+                 metrics: Optional[Metrics] = None):
+        self.gateway = gateway
+        self.policy = policy
+        self.metrics = metrics if metrics is not None else METRICS
+        self._pool_lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _get_pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.POOL_WORKERS,
+                    thread_name_prefix=f"repl-{self.gateway.name}")
+            return self._pool
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    # -- target selection ----------------------------------------------------
+    def targets_for(self, key_int: Optional[int]) -> List[Any]:
+        """The n_replicas target backends: the routed primary first
+        (key-range owner / default ring), then the other registered
+        rings in registration order. Fewer registered rings than
+        n_replicas is allowed (best effort — the policy's w still
+        gates success); fewer than w raises up front."""
+        ring_list, default = self.gateway.router.snapshot()
+        primary = None
+        if key_int is not None:
+            primary = next(
+                (b for b in ring_list if b.owns_key(int(key_int))), None)
+        if primary is None:
+            primary = default if default is not None else (
+                ring_list[0] if ring_list else None)
+        if primary is None:
+            from p2p_dhts_tpu.gateway.router import UnknownRingError
+            raise UnknownRingError("replicated PUT: no rings registered")
+        targets = [primary] + [b for b in ring_list if b is not primary]
+        targets = targets[: self.policy.n_replicas]
+        if len(targets) < self.policy.w:
+            raise QuorumWriteError(
+                f"quorum w={self.policy.w} impossible: only "
+                f"{len(targets)} ring(s) registered")
+        return targets
+
+    # -- the fan-out ---------------------------------------------------------
+    def put_many(self, payloads: Sequence[tuple], deadline) -> PutOutcome:
+        """Fan the (key_int, segments, length, start_row) payload list
+        to every target ring; return at quorum. `deadline` bounds the
+        QUORUM WAIT; each replica's engine work runs under
+        max(deadline, now + async_grace_s) so post-quorum stragglers
+        finish in the background instead of being shed."""
+        from p2p_dhts_tpu.gateway.admission import Deadline
+        policy = self.policy
+        targets = self.targets_for(payloads[0][0] if payloads else None)
+        state = _QuorumState(len(payloads), len(targets), policy.w)
+        t0 = time.perf_counter()
+        grace_at = t0 + policy.async_grace_s
+        replica_dl = Deadline(
+            max(deadline.at, grace_at) if deadline.at is not None
+            else grace_at)
+        self.metrics.inc("repair.replication.requests")
+        self.metrics.inc("repair.replication.replica_puts", len(targets))
+
+        pool = self._get_pool()
+        for backend in targets:
+            pool.submit(self._replica_put, backend, list(payloads),
+                        replica_dl, state, t0)
+
+        met = state.wait_quorum(deadline)
+        with state.lock:
+            per_entry = [a >= policy.w for a in state.acks]
+            outcome = PutOutcome(
+                ok=met and all(per_entry),
+                per_entry_ok=per_entry,
+                targets=[b.ring_id for b in targets],
+                acked_rings=list(state.acked_rings),
+                failed_rings=list(state.failed_rings),
+                quorum_s=(state.t_quorum - t0) if state.t_quorum else
+                time.perf_counter() - t0)
+        if outcome.ok:
+            self.metrics.inc("repair.replication.quorum_ok")
+            self.metrics.observe_hist("repair.replication.quorum_ms",
+                                      outcome.quorum_s * 1e3)
+        else:
+            self.metrics.inc("repair.replication.quorum_failed")
+            if deadline.expired():
+                raise DeadlineExpiredError(
+                    f"replicated PUT: deadline lapsed with "
+                    f"{min(state.acks) if state.acks else 0}/{policy.w} "
+                    f"acks (replicas continue in the background)")
+        return outcome
+
+    def put(self, key_int: int, segments, length: int, start_row: int,
+            deadline) -> bool:
+        return self.put_many(
+            [(key_int, segments, int(length), int(start_row))],
+            deadline).ok
+
+    def _replica_put(self, backend, payloads, replica_dl, state,
+                     t0: float) -> None:
+        """One ring's replica write, on a pool thread. Routes through
+        the gateway's full admission/health path (RingBusy and
+        fail-fast semantics included) and reports to the quorum state;
+        post-quorum completions record their lag."""
+        rid = backend.ring_id
+        oks: Optional[List[bool]] = None
+        try:
+            oks = [bool(v) for v in self.gateway._serve_many(
+                backend, "dhash_put", payloads, replica_dl)]
+        # chordax-lint: disable=bare-except -- a replica failure is DATA for the quorum state, never a pool-thread crash
+        except Exception as exc:  # noqa: BLE001 — fanned into quorum state
+            self.metrics.inc(f"repair.replication.replica_failed.{rid}")
+            logger.warning("replicated PUT: ring %r replica failed "
+                           "(%s: %s)", rid, type(exc).__name__, exc)
+        else:
+            if all(oks):
+                self.metrics.inc(f"repair.replication.replica_ok.{rid}")
+            else:
+                self.metrics.inc(
+                    f"repair.replication.replica_failed.{rid}")
+        state.record(rid, oks)
+        with state.lock:
+            t_q = state.t_quorum
+        now = time.perf_counter()
+        lag_s = max(now - t_q, 0.0) if t_q is not None else 0.0
+        self.metrics.observe_hist(f"repair.replication.lag_ms.{rid}",
+                                  lag_s * 1e3)
+        if t_q is not None and now > t_q:
+            self.metrics.inc("repair.replication.async_completed")

@@ -1,0 +1,509 @@
+"""RepairScheduler: device-batched anti-entropy rounds between rings.
+
+The reference runs maintenance per peer every 5 s — Merkle-sync with
+each successor, one XCHNG_NODE RPC per differing tree node
+(dhash_peer.cpp:271-296, 381-481). Here one background loop PER RING
+PAIR drives the whole reconciliation as a handful of engine-batched
+device ops per round:
+
+  round =  digest(A) + digest(B)      # ServeEngine "sync_digest" kind:
+                                      # FIFO-ordered with in-flight puts
+        -> merkle_diff                # one vectorized equality/level
+        -> reindex(A) + reindex(B)    # "repair_reindex" kind — the r05
+                                      # duplicate-index re-pair pass
+        -> delta_scan(A) + delta_scan(B)  # keys in differing buckets
+        -> heal batch                 # batched GET on the readable
+                                      # side, batched PUT on the other
+                                      # (both sides re-put when both
+                                      # read, canonicalizing layout)
+
+Every GET/PUT/digest/reindex goes through the gateway's
+route->health->admission->engine path, so repair traffic obeys the same
+per-ring budgets and deadline shedding as client traffic (a repair
+batch whose round deadline lapsed is dropped BEFORE device dispatch,
+the PR-4 rule) and can never starve it.
+
+Pacing: a token bucket bounds healed keys/second per pair (a huge
+divergence heals over many rounds instead of one store-sized burst);
+failed rounds back off exponentially WITH JITTER (the net/rpc.py retry
+rule — N pair loops that saw the same failure must not re-converge in
+lockstep); a converged pair idles at `interval_idle_s`.
+
+Convergence: digests equal => the pair's stored (key, frag_idx)
+multisets are equal (dhash/merkle.py's contract) => every key readable
+on one ring is readable on both. Keys readable on NEITHER ring are
+data loss (the reference's Read throws) — counted `unhealable` and
+excluded from the convergence wait so a lost block cannot wedge the
+loop forever.
+
+Observability (metrics.py, `repair.*`): rounds / deltas_found /
+keys_healed.<ring> / canonicalized / reindexed.<ring> / bytes_moved /
+unhealable / round_failures counters, backlog + converged + tokens
+gauges per pair, round_ms + convergence_ms histograms.
+
+LOCK ORDER: `TokenBucket._lock` and the scheduler's `_lock` are
+LEAVES — neither is ever held across a gateway call, a device op, or a
+sleep; the pair loops sleep on `threading.Event.wait` (interruptible
+close) holding nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+logger = logging.getLogger(__name__)
+
+
+class TokenBucket:
+    """Non-blocking token bucket: `take(n)` grants what is available
+    (never waits — an under-granted heal batch defers the remainder to
+    the next round)."""
+
+    def __init__(self, rate_per_s: float, burst: float):
+        if rate_per_s <= 0 or burst <= 0:
+            raise ValueError("rate_per_s and burst must be positive")
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self._lock = threading.Lock()
+        self._tokens = float(burst)
+        self._t_last = time.monotonic()
+
+    def _refill_locked(self, now: float) -> None:
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t_last) * self.rate)
+        self._t_last = now
+
+    def take(self, n: int) -> int:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            granted = int(min(n, self._tokens))
+            self._tokens -= granted
+            return granted
+
+    def refund(self, n: int) -> None:
+        """Return unused tokens (capped at burst) — a round that took a
+        full grant but found few candidates must not drain the bucket
+        for the round that finally needs the burst."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            return self._tokens
+
+
+class RoundResult(NamedTuple):
+    pair: Tuple[str, str]
+    converged: bool
+    leaf_diffs: int
+    nodes_exchanged: int
+    candidates: int          # delta keys found (pre token limit)
+    examined: int            # delta keys actually healed this round
+    healed: Dict[str, int]   # ring_id -> keys written there
+    canonicalized: int       # both-readable keys re-put on both sides
+    reindexed: Dict[str, int]  # ring_id -> duplicate rows rewritten
+    unhealable: int          # readable on neither side (data loss)
+    deferred: int            # token-shed candidates (next round's work)
+
+
+def _derived_length(segments) -> int:
+    """Real segment count of a decoded block: trailing all-zero rows
+    are padding (ida.strip_decoded's rule). A true data block whose
+    tail rows are all zero shrinks its stored `length` metadata — reads
+    return the full padded [S, m] either way, so readability and
+    payload bytes are unaffected (documented deviation)."""
+    import numpy as np
+    seg = np.asarray(segments)
+    nz = np.nonzero(seg.any(axis=1))[0]
+    return int(nz[-1]) + 1 if nz.size else 1
+
+
+def run_sync_round(gateway, ring_a: str, ring_b: str, *,
+                   max_keys: int = 256,
+                   max_heal: Optional[int] = None,
+                   deadline=None,
+                   reindex: bool = True,
+                   metrics: Optional[Metrics] = None) -> RoundResult:
+    """One anti-entropy round between two registered store rings.
+    Standalone (the SYNC_RANGE RPC verb calls this directly); the
+    scheduler adds pacing/backoff around it."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from p2p_dhts_tpu.dhash.merkle import MerkleIndex
+    from p2p_dhts_tpu.gateway.admission import NO_DEADLINE
+    from p2p_dhts_tpu.keyspace import ints_to_lanes, lanes_to_ints
+    from p2p_dhts_tpu.repair import kernels
+
+    mets = metrics if metrics is not None else METRICS
+    dl = deadline if deadline is not None else NO_DEADLINE
+    pair = (str(ring_a), str(ring_b))
+    backends = {rid: gateway.router.get(rid) for rid in pair}
+    depths = {rid: getattr(b.engine, "merkle_shape", (4, 3))
+              for rid, b in backends.items()}
+    if depths[pair[0]] != depths[pair[1]]:
+        raise ValueError(
+            f"rings {pair} have mismatched merkle shapes {depths} — "
+            f"their digests cannot be compared")
+    depth, fanout_bits = depths[pair[0]]
+
+    # 1. digests, engine-ordered with in-flight puts.
+    dig = {rid: gateway.sync_digest(rid, deadline=dl) for rid in pair}
+    ia = MerkleIndex(
+        levels=tuple(jnp.asarray(l) for l in dig[pair[0]].levels),
+        counts=jnp.asarray(dig[pair[0]].counts))
+    ib = MerkleIndex(
+        levels=tuple(jnp.asarray(l) for l in dig[pair[1]].levels),
+        counts=jnp.asarray(dig[pair[1]].counts))
+    leaf_diff, nodes = kernels.merkle_diff(ia, ib)
+    leaf_diffs = int(jnp.sum(leaf_diff))
+    mets.inc("repair.rounds")
+    if leaf_diffs == 0:
+        return RoundResult(pair, True, 0, int(nodes), 0, 0,
+                           {rid: 0 for rid in pair}, 0,
+                           {rid: 0 for rid in pair}, 0, 0)
+    mets.inc("repair.deltas_found", leaf_diffs)
+
+    # 2. the duplicate-index re-pair pass (engine-ordered store rewrite).
+    rw = {rid: 0 for rid in pair}
+    if reindex:
+        for rid in pair:
+            rw[rid] = int(gateway.repair_reindex(rid, deadline=dl))
+            if rw[rid]:
+                mets.inc(f"repair.reindexed.{rid}", rw[rid])
+
+    # 3. delta key extraction from each ring's store snapshot.
+    cand_ints: List[int] = []
+    seen = set()
+    for rid in pair:
+        snap = backends[rid].engine.store_snapshot()
+        cand, ok = kernels.delta_scan(snap, leaf_diff, depth,
+                                      fanout_bits, max_keys)
+        ok_np = np.asarray(ok)
+        for j, k in enumerate(lanes_to_ints(np.asarray(cand))):
+            if ok_np[j] and k not in seen:
+                seen.add(k)
+                cand_ints.append(k)
+    candidates = len(cand_ints)
+    heal_n = candidates if max_heal is None else min(candidates,
+                                                    int(max_heal))
+    deferred = candidates - heal_n
+    heal_keys = cand_ints[:heal_n]
+    healed = {rid: 0 for rid in pair}
+    canonicalized = 0
+    unhealable = 0
+    if heal_keys:
+        # 4. batched reads from BOTH sides, one engine batch each.
+        reads = {rid: gateway.dhash_get_many(heal_keys, ring_id=rid,
+                                             deadline=dl)
+                 for rid in pair}
+        # Entries are (payload, is_canon): canonicalize re-puts of
+        # already-readable keys are layout repair, NOT heals — keeping
+        # them out of `healed` is what lets the scheduler's stall
+        # detector see a round that changed nothing.
+        puts: Dict[str, List[tuple]] = {rid: [] for rid in pair}
+        bytes_moved = 0
+        for j, k in enumerate(heal_keys):
+            res = {rid: reads[rid][j] for rid in pair}
+            ok_by = {rid: bool(res[rid][1]) for rid in pair}
+            if not any(ok_by.values()):
+                unhealable += 1
+                continue
+            if all(ok_by.values()):
+                # Both readable yet the pair still differs somewhere in
+                # this bucket: re-put each side from ITS OWN read —
+                # canonical (key, 1..n) layout, per-ring values
+                # preserved (value divergence is invisible to a
+                # keys-only tree, exactly as in the reference).
+                canonicalized += 1
+                for rid in pair:
+                    seg = np.asarray(res[rid][0])
+                    puts[rid].append(
+                        ((k, seg, _derived_length(seg), 0), True))
+                continue
+            src = pair[0] if ok_by[pair[0]] else pair[1]
+            dst = pair[1] if src == pair[0] else pair[0]
+            seg = np.asarray(res[src][0])
+            puts[dst].append(((k, seg, _derived_length(seg), 0), False))
+            bytes_moved += int(seg.size) * 4
+        for rid, entries in puts.items():
+            if not entries:
+                continue
+            oks = gateway.dhash_put_many([e for e, _ in entries],
+                                         ring_id=rid, deadline=dl)
+            n_ok = sum(1 for (_, canon), v in zip(entries, oks)
+                       if v and not canon)
+            healed[rid] += n_ok
+            if n_ok:
+                mets.inc(f"repair.keys_healed.{rid}", n_ok)
+        if bytes_moved:
+            mets.inc("repair.bytes_moved", bytes_moved)
+        if canonicalized:
+            mets.inc("repair.canonicalized", canonicalized)
+        if unhealable:
+            mets.inc("repair.unhealable", unhealable)
+    # Converged means NOTHING healable remained this round: no
+    # candidates beyond data loss, nothing deferred, nothing rewritten.
+    converged = (deferred == 0 and canonicalized == 0
+                 and sum(healed.values()) == 0 and sum(rw.values()) == 0
+                 and candidates == unhealable)
+    return RoundResult(pair, converged, leaf_diffs, int(nodes),
+                       candidates, heal_n, healed, canonicalized, rw,
+                       unhealable, deferred)
+
+
+class _PairLoop:
+    """One ring pair's background loop + pacing state."""
+
+    def __init__(self, sched: "RepairScheduler",
+                 pair: Tuple[str, str]) -> None:
+        self.sched = sched
+        self.pair = pair
+        self.bucket = TokenBucket(sched.rate_keys_s, sched.burst_keys)
+        self.rounds = 0
+        self.failures = 0
+        self.backoff_s = 0.0
+        self.converged = False
+        #: True when consecutive rounds make NO progress on a residual
+        #: diff (e.g. one ring structurally cannot hold a key's full
+        #: fragment multiset — fewer than n alive peers): the loop
+        #: drops to the idle interval instead of re-putting the same
+        #: keys at full rate forever. Any progress clears it.
+        self.stalled = False
+        self._stall_rounds = 0
+        self.last: Optional[RoundResult] = None
+        self.last_error: Optional[str] = None
+        self._diverged_at: Optional[float] = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"repair-{pair[0]}-{pair[1]}",
+            daemon=True)
+
+    def _run(self) -> None:
+        sched = self.sched
+        # Jittered start so N pair loops never digest in lockstep.
+        sched._stop.wait(random.uniform(0, sched.interval_s))
+        while not sched._stop.is_set():
+            try:
+                self.run_once()
+                self.failures = 0
+                self.backoff_s = 0.0
+                self.last_error = None
+            # chordax-lint: disable=bare-except -- the pair loop must survive any round failure; it is counted, logged and backed off
+            except Exception as exc:  # noqa: BLE001 — backoff + retry
+                self.failures += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                sched.metrics.inc(
+                    f"repair.round_failures.{self.pair[0]}-{self.pair[1]}")
+                base = min(sched.backoff_base_s * (2 ** (self.failures - 1)),
+                           sched.backoff_cap_s)
+                self.backoff_s = random.uniform(base * 0.5, base)
+                logger.warning("repair pair %s round failed (%s); "
+                               "backing off %.2fs", self.pair,
+                               self.last_error, self.backoff_s,
+                               exc_info=exc)
+            wait = self.backoff_s if self.backoff_s else (
+                sched.interval_idle_s if (self.converged or self.stalled)
+                else sched.interval_s)
+            sched._stop.wait(wait)
+
+    def run_once(self) -> RoundResult:
+        """One paced round (also the deterministic entry tests and the
+        dryrun call directly — no background thread needed)."""
+        sched = self.sched
+        granted = self.bucket.take(sched.max_keys_round)
+        t0 = time.perf_counter()
+        try:
+            res = run_sync_round(
+                sched.gateway, self.pair[0], self.pair[1],
+                max_keys=sched.max_keys_round, max_heal=granted,
+                deadline=sched._round_deadline(), reindex=sched.reindex,
+                metrics=sched.metrics)
+        except BaseException:
+            self.bucket.refund(granted)  # nothing was healed
+            raise
+        self.bucket.refund(granted - res.examined)
+        self.rounds += 1
+        prev = self.last
+        self.last = res
+        # Stall detection: an unconverged round whose only action was
+        # re-putting already-readable keys, with the SAME residual diff
+        # as last round, made no progress — two in a row and the loop
+        # idles (counted) instead of burning its rate on a diff it
+        # cannot close (e.g. a ring below n alive peers).
+        no_progress = (not res.converged and res.deferred == 0
+                       and sum(res.healed.values()) == 0
+                       and sum(res.reindexed.values()) == 0
+                       and prev is not None
+                       and res.leaf_diffs == prev.leaf_diffs)
+        if no_progress:
+            self._stall_rounds += 1
+            sched.metrics.inc(
+                f"repair.stalled_rounds.{self.pair[0]}-{self.pair[1]}")
+        else:
+            self._stall_rounds = 0
+        self.stalled = self._stall_rounds >= 2
+        if res.deferred:
+            sched.metrics.inc("repair.token_deferred", res.deferred)
+        pair_key = f"{self.pair[0]}-{self.pair[1]}"
+        sched.metrics.observe_hist(f"repair.round_ms.{pair_key}",
+                                   (time.perf_counter() - t0) * 1e3)
+        sched.metrics.gauge(f"repair.backlog.{pair_key}", res.deferred)
+        sched.metrics.gauge(f"repair.tokens.{pair_key}",
+                            self.bucket.tokens)
+        now = time.perf_counter()
+        if res.converged:
+            if not self.converged and self._diverged_at is not None:
+                sched.metrics.observe_hist(
+                    "repair.convergence_ms",
+                    (now - self._diverged_at) * 1e3)
+            self._diverged_at = None
+        elif self._diverged_at is None:
+            self._diverged_at = now
+        self.converged = res.converged
+        sched.metrics.gauge(f"repair.converged.{pair_key}",
+                            1.0 if res.converged else 0.0)
+        return res
+
+    def status(self) -> dict:
+        last = self.last
+        return {
+            "pair": list(self.pair),
+            "rounds": self.rounds,
+            "converged": self.converged,
+            "stalled": self.stalled,
+            "failures": self.failures,
+            "backoff_s": round(self.backoff_s, 3),
+            "tokens": round(self.bucket.tokens, 1),
+            "last_error": self.last_error,
+            "last_round": None if last is None else {
+                "leaf_diffs": last.leaf_diffs,
+                "candidates": last.candidates,
+                "healed": dict(last.healed),
+                "canonicalized": last.canonicalized,
+                "reindexed": dict(last.reindexed),
+                "unhealable": last.unhealable,
+                "deferred": last.deferred,
+            },
+        }
+
+
+class RepairScheduler:
+    """Background anti-entropy over a set of ring pairs.
+
+    `start()` spawns one loop per pair; `run_until_converged()` is the
+    deterministic foreground form (tests, the dryrun, bench --config
+    repair). Construct, then `gateway.attach_repair(sched)` so the
+    REPAIR_STATUS verb can see it."""
+
+    def __init__(self, gateway, pairs: Sequence[Tuple[str, str]], *,
+                 interval_s: float = 1.0,
+                 interval_idle_s: float = 10.0,
+                 rate_keys_s: float = 2048.0,
+                 burst_keys: float = 4096.0,
+                 max_keys_round: int = 256,
+                 round_timeout_s: Optional[float] = 30.0,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 30.0,
+                 reindex: bool = True,
+                 metrics: Optional[Metrics] = None):
+        if not pairs:
+            raise ValueError("RepairScheduler needs at least one ring pair")
+        self.gateway = gateway
+        self.interval_s = float(interval_s)
+        self.interval_idle_s = float(interval_idle_s)
+        self.rate_keys_s = float(rate_keys_s)
+        self.burst_keys = float(burst_keys)
+        self.max_keys_round = int(max_keys_round)
+        self.round_timeout_s = round_timeout_s
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.reindex = bool(reindex)
+        self.metrics = metrics if metrics is not None else METRICS
+        self._stop = threading.Event()
+        self._started = False
+        self._lock = threading.Lock()
+        self.loops = [_PairLoop(self, (str(a), str(b))) for a, b in pairs]
+
+    def _round_deadline(self):
+        from p2p_dhts_tpu.gateway.admission import Deadline
+        return Deadline.from_timeout(self.round_timeout_s)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "RepairScheduler":
+        with self._lock:
+            if self._started:
+                return self
+            if self._stop.is_set():
+                raise RuntimeError("RepairScheduler is closed")
+            self._started = True
+        for loop in self.loops:
+            loop.thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        with self._lock:
+            started = self._started
+        if not started:
+            return
+        for loop in self.loops:
+            loop.thread.join(timeout)
+            if loop.thread.is_alive():
+                raise TimeoutError(
+                    f"repair pair loop {loop.pair} did not stop within "
+                    f"{timeout}s")
+
+    def __enter__(self) -> "RepairScheduler":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- deterministic foreground driving ------------------------------------
+    def run_until_converged(self, max_rounds: int = 16
+                            ) -> List[RoundResult]:
+        """Drive every pair's rounds inline until all converge; raises
+        if any pair is still diverged after max_rounds (the bounded-
+        convergence contract the bench smoke asserts)."""
+        out: List[RoundResult] = []
+        for _ in range(int(max_rounds)):
+            all_conv = True
+            for loop in self.loops:
+                res = loop.run_once()
+                out.append(res)
+                all_conv = all_conv and res.converged
+            if all_conv:
+                return out
+            if all(loop.converged or loop.stalled for loop in self.loops):
+                stalled = [loop.pair for loop in self.loops
+                           if loop.stalled]
+                raise RuntimeError(
+                    f"repair STALLED: pairs {stalled} hold a residual "
+                    f"diff no round can close (one ring likely cannot "
+                    f"store the full fragment multiset — check alive "
+                    f"peer counts vs IDA n)")
+        still = [loop.pair for loop in self.loops if not loop.converged]
+        raise RuntimeError(
+            f"repair did not converge within {max_rounds} rounds; "
+            f"diverged pairs: {still}")
+
+    def status(self) -> dict:
+        return {
+            "started": self._started,
+            "closed": self._stop.is_set(),
+            "interval_s": self.interval_s,
+            "rate_keys_s": self.rate_keys_s,
+            "max_keys_round": self.max_keys_round,
+            "pairs": [loop.status() for loop in self.loops],
+        }

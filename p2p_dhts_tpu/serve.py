@@ -60,6 +60,16 @@ Request kinds:
     Stateless w.r.t. the ring, so a process-global engine
     (`global_finger_engine`) batches lookups ACROSS finger tables —
     every backend="jax" peer in the process shares one dispatch loop.
+  * "sync_digest" / "repair_reindex" — the chordax-repair control
+    plane's ops (ISSUE 6). sync_digest (payload ()) returns the
+    store's keyspace-partitioned Merkle index
+    (dhash.antientropy.store_index at this engine's `merkle_shape`) as
+    host arrays; repair_reindex (payload ()) runs the duplicate-index
+    re-pair pass (repair.kernels) and returns the rewritten-row count.
+    Both ride the normal dispatch queue ON PURPOSE: FIFO across kinds
+    means a digest observes every put submitted before it, and the
+    reindex store-swap chains/rolls back exactly like a put batch — a
+    repair op can never race or fork the serving store.
 
 Per-stage metrics (queue depth, batch fill, window size, request
 latency) record into `p2p_dhts_tpu.metrics` gauges/histograms under
@@ -77,7 +87,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
-KINDS = ("find_successor", "dhash_get", "dhash_put", "finger_index")
+KINDS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
+         "sync_digest", "repair_reindex")
 
 _SENTINEL = object()
 
@@ -164,11 +175,13 @@ class ServeEngine:
                  window_cap_s: float = 0.002,
                  bucket_min: int = 64, bucket_max: int = 8192,
                  max_queue: int = 65536,
+                 merkle_depth: int = 4, merkle_fanout_bits: int = 3,
                  metrics: Optional[Metrics] = None,
                  name: str = "serve"):
         self._state = state
         self._store = store
         self._ida = (int(n), int(m), int(p))
+        self._merkle = (int(merkle_depth), int(merkle_fanout_bits))
         self._window_cap_s = float(window_cap_s)
         self._buckets = _buckets_between(int(bucket_min), int(bucket_max))
         self._bucket_max = self._buckets[-1]
@@ -325,10 +338,13 @@ class ServeEngine:
         if kind == "find_successor" and self._state is None:
             raise ValueError("engine has no RingState; find_successor "
                              "requests need one")
-        if kind in ("dhash_get", "dhash_put") and (
+        if kind in ("dhash_get", "dhash_put", "repair_reindex") and (
                 self._state is None or self._store is None):
             raise ValueError(f"engine has no RingState+FragmentStore; "
                              f"{kind} requests need both")
+        if kind == "sync_digest" and self._store is None:
+            raise ValueError("engine has no FragmentStore; sync_digest "
+                             "requests need one")
         if kind == "dhash_put":
             # Validate AND normalize on the SUBMITTING thread: a
             # malformed request failing at batch-build time would fail
@@ -360,10 +376,11 @@ class ServeEngine:
         # idle engine (nothing pending or in flight, window at zero) is
         # dispatched and completed on the SUBMITTING thread — the
         # legacy bridge's leader model without the sleep, and without
-        # the two pipeline handoffs. dhash_put stays on the dispatcher:
-        # its read-modify-write of the store must never race a
+        # the two pipeline handoffs. dhash_put (and repair_reindex, the
+        # other store mutator) stays on the dispatcher: its
+        # read-modify-write of the store must never race a
         # concurrently-dispatched put batch.
-        if len(slots) == 1 and kind != "dhash_put":
+        if len(slots) == 1 and kind not in ("dhash_put", "repair_reindex"):
             with self._lock:
                 fast = (not self._pending and self._inflight_n == 0
                         and not self._dispatching
@@ -447,6 +464,41 @@ class ServeEngine:
             (int(key) % KEYS_IN_RING, seg, int(length), int(start_row)))
         return slot.wait(timeout)
 
+    def sync_digest(self, timeout: Optional[float] = None):
+        """The store's Merkle index (dhash.merkle.MerkleIndex of host
+        numpy arrays) at this engine's merkle_shape — FIFO-ordered
+        after every previously-submitted put."""
+        return self.submit("sync_digest", ()).wait(timeout)
+
+    def repair_reindex(self, timeout: Optional[float] = None) -> int:
+        """Run the duplicate-index re-pair pass on the engine's store;
+        returns the number of rows rewritten to missing indices."""
+        return self.submit("repair_reindex", ()).wait(timeout)
+
+    # -- store introspection (the repair control plane's view) --------------
+
+    @property
+    def has_store(self) -> bool:
+        return self._store is not None
+
+    @property
+    def ida_params(self) -> Tuple[int, int, int]:
+        return self._ida
+
+    @property
+    def merkle_shape(self) -> Tuple[int, int]:
+        """(depth, fanout_bits) of this engine's sync_digest index —
+        two rings must match to be diff-compared."""
+        return self._merkle
+
+    def store_snapshot(self):
+        """The current chained FragmentStore value (a consistent
+        functional snapshot: every launched put batch is sequenced into
+        it device-side; puts submitted later are not). The repair
+        delta scan reads this, never the live attribute."""
+        with self._lock:
+            return self._store
+
     # -- warmup / recompile accounting -------------------------------------
 
     def warmup(self, kinds: Optional[Sequence[str]] = None) -> Dict[str, int]:
@@ -476,6 +528,8 @@ class ServeEngine:
             return True
         if kind == "find_successor":
             return self._state is not None
+        if kind == "sync_digest":
+            return self._store is not None
         return self._state is not None and self._store is not None
 
     def _warm_one(self, kind: str, b: int, np) -> None:
@@ -507,6 +561,19 @@ class ServeEngine:
                 kern["jnp"].asarray(segments), kern["jnp"].asarray(lengths),
                 kern["jnp"].asarray(starts))
             np.asarray(ok)
+        elif kind == "sync_digest":
+            # Read-only: warming against the live store compiles the
+            # same program and mutates nothing. Bucket size is
+            # irrelevant (the kernel has no per-lane input), so every
+            # bucket iteration hits the one cached program.
+            idx = kern["sync_digest"](self._store)
+            np.asarray(idx.counts)
+        elif kind == "repair_reindex":
+            from p2p_dhts_tpu.dhash.store import empty_store
+            shadow = empty_store(int(self._store.capacity),
+                                 int(self._store.max_segments))
+            _, stats = kern["repair_reindex"](self._state, shadow)
+            np.asarray(stats.rewritten)
 
     @property
     def trace_counts(self) -> Dict[str, int]:
@@ -632,6 +699,19 @@ class ServeEngine:
                 return store_mod.create_batch(
                     state, store, keys, segments, lengths, starts, n, m, p)
 
+            from p2p_dhts_tpu.dhash import antientropy as ae_mod
+            from p2p_dhts_tpu.repair import kernels as repair_mod
+            depth, fanout_bits = self._merkle
+
+            def sync_digest(store):
+                count("sync_digest")
+                return ae_mod.store_index(store, depth, fanout_bits)
+
+            def repair_reindex(state, store):
+                count("repair_reindex")
+                return repair_mod.reindex_duplicates_impl(
+                    state, store, n, m, p)
+
             self._kernels = {
                 "jnp": jnp,
                 "np": np,
@@ -647,6 +727,10 @@ class ServeEngine:
                 "dhash_put": jax.jit(
                     dhash_put, donate_argnums=(2, 3, 4, 5) if donate
                     else ()),
+                # Repair kinds: nothing donated either — the digest
+                # reads the live store, the reindex chains it like a put.
+                "sync_digest": jax.jit(sync_digest),
+                "repair_reindex": jax.jit(repair_reindex),
             }
         return self._kernels
 
@@ -840,6 +924,26 @@ class ServeEngine:
             segs, ok = kern["dhash_get"](self._state, self._store, keys)
             return ("dhash_get", segs, ok)
 
+        if kind == "sync_digest":
+            # No per-lane input: one kernel call serves the whole batch
+            # (a padded digest batch costs exactly one digest).
+            with self._lock:
+                cur = self._store
+            return ("sync_digest", kern["sync_digest"](cur))
+
+        if kind == "repair_reindex":
+            # Store-mutating, so it chains + rolls back exactly like a
+            # put batch (same epoch bookkeeping, same handle shape).
+            with self._lock:
+                prev_store = self._store
+                epoch = self._store_epoch
+            new_store, stats = kern["repair_reindex"](self._state,
+                                                      prev_store)
+            with self._lock:
+                if epoch == self._store_epoch:
+                    self._store = new_store
+            return ("repair_reindex", stats, prev_store, epoch)
+
         # dhash_put: payload (key, segments [S, m] i32, length, start).
         with self._lock:
             prev_store = self._store
@@ -914,12 +1018,24 @@ class ServeEngine:
                 ok = np.asarray(handle[2])
                 for j, slot in enumerate(batch):
                     slot.result = (segs[j], bool(ok[j]))
+            elif kind == "sync_digest":
+                from p2p_dhts_tpu.dhash.merkle import MerkleIndex
+                idx = handle[1]
+                host = MerkleIndex(
+                    levels=tuple(np.asarray(l) for l in idx.levels),
+                    counts=np.asarray(idx.counts))
+                for slot in batch:
+                    slot.result = host
+            elif kind == "repair_reindex":
+                rewritten = int(np.asarray(handle[1].rewritten))
+                for slot in batch:
+                    slot.result = rewritten
             else:  # dhash_put
                 ok = np.asarray(handle[1])
                 for j, slot in enumerate(batch):
                     slot.result = bool(ok[j])
         except BaseException as exc:  # noqa: BLE001 — fanned out
-            if handle[0] == "dhash_put":
+            if handle[0] in ("dhash_put", "repair_reindex"):
                 # The device computation failed AFTER self._store was
                 # swapped to its (poisoned) output; restore the last
                 # good store. A launch from the CURRENT epoch chained

@@ -746,3 +746,143 @@ def test_gateway_soak_under_lock_check_env():
         f"gateway soak under CHORDAX_LOCK_CHECK=1 failed:\n"
         f"{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}")
     assert "lock-order violations" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# replicated writes: the quorum oracle checks (chordax-repair, ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def _repl_gateway(rng, w):
+    """Two store rings + an n=2/w replication policy (fresh per test:
+    quorum tests mutate stores and health state)."""
+    from p2p_dhts_tpu.repair import ReplicationPolicy
+    gw = Gateway(metrics=Metrics(), name=f"repl-w{w}")
+    for rid, default in (("pa", True), ("pb", False)):
+        gw.add_ring(rid,
+                    build_ring(_rand_ids(rng, N_LO),
+                               RingConfig(finger_mode="materialized")),
+                    empty_store(capacity=1024, max_segments=SMAX),
+                    default=default, bucket_min=4, bucket_max=16,
+                    max_queue=4096)
+    gw.set_replication(ReplicationPolicy(n_replicas=2, w=w))
+    return gw
+
+
+def _put_seg(rng):
+    return np.asarray(rng.randint(0, 200, size=(2, IDA_M)), np.int32)
+
+
+def test_replicated_put_w_of_n_and_parity():
+    """w=2-of-2 success: one replicated PUT lands the block on BOTH
+    rings with byte parity against a direct per-ring write — the
+    quorum fan-out adds replicas, never changes what a ring stores."""
+    rng = np.random.RandomState(61)
+    gw = _repl_gateway(rng, w=2)
+    try:
+        k = int.from_bytes(rng.bytes(16), "little")
+        seg = _put_seg(rng)
+        assert gw.dhash_put(k, seg, 2, 0) is True
+        # Direct n-ring write of a second key: the parity oracle.
+        k2 = int.from_bytes(rng.bytes(16), "little")
+        for rid in ("pa", "pb"):
+            assert gw.dhash_put(k2, seg, 2, 0, ring_id=rid,
+                                replicate=False)
+        for rid in ("pa", "pb"):
+            for key in (k, k2):
+                got, ok = gw.dhash_get(key, ring_id=rid)
+                assert bool(ok), f"{key:#x} unreadable on {rid}"
+                assert np.array_equal(np.asarray(got)[:2], seg)
+        mets = gw.metrics.base
+        assert mets.counter("repair.replication.quorum_ok") == 1
+        assert mets.counter("repair.replication.replica_ok.pa") == 1
+        assert mets.counter("repair.replication.replica_ok.pb") == 1
+    finally:
+        gw.close()
+
+
+def test_replicated_put_quorum_returns_before_slow_replica():
+    """w=1-of-2 with ring pb's dispatcher HELD: the PUT returns at the
+    fast ring's ack; the held replica completes asynchronously after
+    release, and its post-quorum lag is recorded."""
+    rng = np.random.RandomState(62)
+    gw = _repl_gateway(rng, w=1)
+    eng_b = gw.router.get("pb").engine
+    try:
+        eng_b.start()
+        eng_b._test_hold.set()
+        k = int.from_bytes(rng.bytes(16), "little")
+        seg = _put_seg(rng)
+        t0 = time.perf_counter()
+        assert gw.dhash_put(k, seg, 2, 0, timeout=60.0) is True
+        quorum_wall = time.perf_counter() - t0
+        # pa is readable NOW; pb must not be required for the ack.
+        _, ok_a = gw.dhash_get(k, ring_id="pa")
+        assert bool(ok_a)
+        eng_b._test_hold.clear()
+        deadline = time.time() + 60
+        ok_b = False
+        while time.time() < deadline and not ok_b:
+            _, ok_b = gw.dhash_get(k, ring_id="pb")
+            ok_b = bool(ok_b)
+            if not ok_b:
+                time.sleep(0.05)
+        assert ok_b, "held replica never completed asynchronously"
+        mets = gw.metrics.base
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                mets.counter("repair.replication.async_completed") < 1:
+            time.sleep(0.05)
+        assert mets.counter("repair.replication.async_completed") >= 1
+        p50, _ = mets.quantiles("repair.replication.lag_ms.pb")
+        assert p50 is not None and p50 >= 0.0
+        assert quorum_wall < 30.0
+    finally:
+        eng_b._test_hold.clear()
+        gw.close()
+
+
+def test_replicated_put_failure_no_cross_ring_forks():
+    """A failed replica NEVER forks a store: an ejected ring's store is
+    byte-identical before and after the PUT (store ops have no
+    fallback path), the failure is counted per ring, and the acked
+    ring keeps its write (no rollback — under-replication is the
+    anti-entropy scheduler's job). w beyond the healthy rings fails
+    the quorum visibly."""
+    rng = np.random.RandomState(63)
+    gw = _repl_gateway(rng, w=1)
+    try:
+        backend_b = gw.router.get("pb")
+        for _ in range(RingBackend.EJECT_AFTER):
+            backend_b.record_failure(RuntimeError("induced"))
+        assert backend_b.state == EJECTED
+        store_b_before = backend_b.engine.store_snapshot()
+        k = int.from_bytes(rng.bytes(16), "little")
+        seg = _put_seg(rng)
+        assert gw.dhash_put(k, seg, 2, 0, timeout=60.0) is True  # w=1
+        mets = gw.metrics.base
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                mets.counter("repair.replication.replica_failed.pb") < 1:
+            time.sleep(0.05)
+        assert mets.counter("repair.replication.replica_failed.pb") == 1
+        store_b_after = backend_b.engine.store_snapshot()
+        assert store_b_after is store_b_before, \
+            "ejected ring's store object changed under a failed replica"
+        assert int(store_b_after.n_used) == 0
+        assert mets.counter("gateway.fallback.dhash_put.pb") == 0
+        _, ok_a = gw.dhash_get(k, ring_id="pa")
+        assert bool(ok_a)  # the acked ring keeps its write
+
+        # w=2 with only one healthy ring: quorum fails VISIBLY and the
+        # healthy ring still applied its replica (documented: no
+        # rollback; repair heals the gap once pb recovers).
+        from p2p_dhts_tpu.repair import ReplicationPolicy
+        gw.set_replication(ReplicationPolicy(n_replicas=2, w=2))
+        k2 = int.from_bytes(rng.bytes(16), "little")
+        assert gw.dhash_put(k2, seg, 2, 0, timeout=20.0) is False
+        assert mets.counter("repair.replication.quorum_failed") >= 1
+        _, ok_a2 = gw.dhash_get(k2, ring_id="pa")
+        assert bool(ok_a2)
+        assert int(backend_b.engine.store_snapshot().n_used) == 0
+    finally:
+        gw.close()

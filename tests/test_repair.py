@@ -1,0 +1,485 @@
+"""chordax-repair (ISSUE 6): replicated writes + device-batched
+anti-entropy.
+
+Pins the subsystem's contracts:
+
+  * engine-ordered digests — the "sync_digest" kind equals a direct
+    store_index over the engine's chained store, and equal stores give
+    ZERO leaf diffs (the bandwidth-proportional-to-divergence property
+    the Merkle tree exists for).
+  * the duplicate-index re-pair pass — rewritten rows land on MISSING
+    indices with the exact re-encoded fragment values (distinct count
+    strictly increases), and a block below m distinct fragments is
+    never touched (the last copy is never destroyed) — the r05
+    fragment-stranding fix generalized to the device store.
+  * anti-entropy convergence — a diverged ring pair (missing keys AND
+    duplicate-index corruption) converges to 100%%-readable on both
+    rings within a bounded number of rounds, through the gateway's
+    admission/deadline path, with zero steady-state retraces.
+  * pacing — token bucket grants bound per-round heals (the remainder
+    defers, and converges over later rounds); round failures back off
+    with jitter.
+  * the control verbs — SYNC_RANGE / REPAIR_STATUS over a live
+    net/rpc.py server.
+  * the host-overlay/device-store hybrid — DHashPeer.create/read
+    through a registered device ring, parity against the host path.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring, keys_from_ints
+from p2p_dhts_tpu.dhash.antientropy import store_index
+from p2p_dhts_tpu.dhash.store import (_sort_store, empty_store,
+                                      read_batch)
+from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.net.rpc import Client, Server
+from p2p_dhts_tpu.ops import u128
+from p2p_dhts_tpu.repair import (RepairScheduler, ReplicationPolicy,
+                                 TokenBucket, run_sync_round)
+from p2p_dhts_tpu.repair import kernels as rk
+
+pytestmark = pytest.mark.repair
+
+N_PEERS = 32
+CAPACITY = 512
+SMAX = 4
+IDA_N, IDA_M, IDA_P = 14, 10, 257
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+def _rand_segs(rng, s=3):
+    return np.asarray(rng.randint(0, 200, size=(s, IDA_M)), np.int32)
+
+
+@pytest.fixture()
+def repair_gw():
+    """Two store rings behind one gateway (fresh per test: repair
+    rounds and replicated puts mutate the stores)."""
+    rng = np.random.RandomState(20260804)
+    gw = Gateway(metrics=Metrics(), name="repair-test")
+    for rid, default in (("ra", True), ("rb", False)):
+        gw.add_ring(rid,
+                    build_ring(_rand_ids(rng, N_PEERS),
+                               RingConfig(finger_mode="materialized")),
+                    empty_store(CAPACITY, SMAX), default=default,
+                    bucket_min=4, bucket_max=16, max_queue=4096)
+    yield gw, rng
+    gw.close()
+
+
+# ---------------------------------------------------------------------------
+# digests through the engine
+# ---------------------------------------------------------------------------
+
+def test_sync_digest_matches_direct_index(repair_gw):
+    gw, rng = repair_gw
+    keys = _rand_ids(rng, 12)
+    for k in keys:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    dig = gw.sync_digest("ra")
+    direct = store_index(gw.router.get("ra").engine.store_snapshot())
+    for lvl_e, lvl_d in zip(dig.levels, direct.levels):
+        assert np.array_equal(np.asarray(lvl_e), np.asarray(lvl_d))
+    assert np.array_equal(np.asarray(dig.counts),
+                          np.asarray(direct.counts))
+
+
+def test_equal_stores_zero_diffs_and_converged_round(repair_gw):
+    gw, rng = repair_gw
+    keys = _rand_ids(rng, 8)
+    for k in keys:
+        seg = _rand_segs(rng)
+        assert gw.dhash_put(k, seg, 3, 0, ring_id="ra")
+        assert gw.dhash_put(k, seg, 3, 0, ring_id="rb")
+    res = run_sync_round(gw, "ra", "rb", metrics=gw.metrics.base)
+    assert res.converged and res.leaf_diffs == 0
+    assert res.nodes_exchanged == 1  # the root exchange only
+
+
+def test_sync_digest_orders_after_puts(repair_gw):
+    """A digest submitted after a put observes that put (FIFO across
+    kinds) — the race a snapshot outside the engine could lose."""
+    gw, rng = repair_gw
+    eng = gw.router.get("ra").engine
+    k = _rand_ids(rng, 1)[0]
+    seg = _rand_segs(rng)
+    put_slot = eng.submit("dhash_put", (k, seg, 3, 0))
+    dig_slot = eng.submit("sync_digest", ())
+    assert put_slot.wait(120)
+    dig = dig_slot.wait(120)
+    direct = store_index(eng.store_snapshot())
+    assert np.array_equal(np.asarray(dig.levels[0]),
+                          np.asarray(direct.levels[0]))
+    assert int(np.asarray(dig.counts).sum()) == IDA_N
+
+
+# ---------------------------------------------------------------------------
+# the duplicate-index re-pair pass
+# ---------------------------------------------------------------------------
+
+def _corrupt_duplicates(store, key_lanes, from_idx):
+    """Rewrite a key's rows with frag_idx >= from_idx into duplicates
+    of its idx-1 row (the stranding shape: copies abound, distinct
+    fragments shrink)."""
+    hit = u128.eq(store.keys, key_lanes[None, :]) & \
+        (store.frag_idx >= from_idx) & store.used
+    row1 = u128.eq(store.keys, key_lanes[None, :]) & (store.frag_idx == 1)
+    v1 = store.values[jnp.argmax(row1)]
+    return _sort_store(store._replace(
+        frag_idx=jnp.where(hit, 1, store.frag_idx),
+        values=jnp.where(hit[:, None], v1[None, :], store.values)))
+
+
+def test_reindex_rewrites_duplicates_to_missing(repair_gw):
+    gw, rng = repair_gw
+    backend = gw.router.get("ra")
+    eng = backend.engine
+    keys = _rand_ids(rng, 3)
+    for k in keys:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    state = backend.ring_state
+    store = eng.store_snapshot()
+    pristine = store
+    lanes = keys_from_ints(keys)
+    corrupted = _corrupt_duplicates(store, lanes[0], from_idx=11)
+    fixed, stats = rk.reindex_duplicates(state, corrupted,
+                                         IDA_N, IDA_M, IDA_P)
+    assert int(stats.rewritten) == 4
+    assert int(stats.blocks_repaired) == 1
+    sel = np.asarray(u128.eq(fixed.keys, lanes[0][None, :]) & fixed.used)
+    fidx = sorted(np.asarray(fixed.frag_idx)[sel].tolist())
+    assert fidx == list(range(1, IDA_N + 1)), fidx
+    # Rewritten fragment VALUES are the exact original encode: compare
+    # the repaired store row-for-row against the pristine one.
+    for idx in (11, 12, 13, 14):
+        want_sel = np.asarray(
+            u128.eq(pristine.keys, lanes[0][None, :])
+            & (pristine.frag_idx == idx))
+        got_sel = np.asarray(
+            u128.eq(fixed.keys, lanes[0][None, :])
+            & (fixed.frag_idx == idx))
+        assert np.array_equal(np.asarray(pristine.values)[want_sel],
+                              np.asarray(fixed.values)[got_sel])
+    # Untouched keys' blocks still read back identically.
+    segs_a, ok_a = read_batch(state, fixed, lanes, IDA_N, IDA_M, IDA_P)
+    assert bool(np.asarray(ok_a).all())
+
+
+def test_reindex_never_destroys_last_copy(repair_gw):
+    """Below m distinct fragments the block is undecodable: the pass
+    must not touch it — a rewrite would destroy the last copy of the
+    duplicated index."""
+    gw, rng = repair_gw
+    backend = gw.router.get("ra")
+    state = backend.ring_state
+    k = _rand_ids(rng, 1)[0]
+    assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    store = backend.engine.store_snapshot()
+    lane = keys_from_ints([k])[0]
+    # Drop indices > 5, then duplicate idx 3 onto idx 2's row: 4
+    # distinct < m=10 left.
+    drop = u128.eq(store.keys, lane[None, :]) & (store.frag_idx > 5)
+    store = _sort_store(store._replace(used=store.used & ~drop))
+    dup = u128.eq(store.keys, lane[None, :]) & (store.frag_idx == 3)
+    store = _sort_store(store._replace(
+        frag_idx=jnp.where(dup, 2, store.frag_idx)))
+    before = sorted(np.asarray(store.frag_idx)[
+        np.asarray(u128.eq(store.keys, lane[None, :]) & store.used)
+    ].tolist())
+    fixed, stats = rk.reindex_duplicates(state, store,
+                                         IDA_N, IDA_M, IDA_P)
+    assert int(stats.rewritten) == 0
+    after = sorted(np.asarray(fixed.frag_idx)[
+        np.asarray(u128.eq(fixed.keys, lane[None, :]) & fixed.used)
+    ].tolist())
+    assert after == before  # the duplicate survives; nothing destroyed
+
+
+def test_repair_reindex_kind_chains_store(repair_gw):
+    """The engine's repair_reindex kind rewrites the SERVED store (same
+    chaining as a put): corrupt, swap in via a put-free engine path,
+    reindex through the gateway, read back through the gateway."""
+    gw, rng = repair_gw
+    backend = gw.router.get("ra")
+    eng = backend.engine
+    keys = _rand_ids(rng, 2)
+    for k in keys:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    lanes = keys_from_ints(keys)
+    with eng._lock:
+        eng._store = _corrupt_duplicates(eng._store, lanes[0],
+                                         from_idx=11)
+    rewritten = gw.repair_reindex("ra")
+    assert rewritten == 4
+    segs, ok = gw.dhash_get(keys[0], ring_id="ra")
+    assert bool(ok)
+    st = eng.store_snapshot()
+    sel = np.asarray(u128.eq(st.keys, lanes[0][None, :]) & st.used)
+    assert sorted(np.asarray(st.frag_idx)[sel].tolist()) == \
+        list(range(1, IDA_N + 1))
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy rounds + scheduler
+# ---------------------------------------------------------------------------
+
+def test_round_heals_divergence_both_directions(repair_gw):
+    gw, rng = repair_gw
+    only_a = _rand_ids(rng, 10)
+    only_b = _rand_ids(rng, 7)
+    for k in only_a:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    for k in only_b:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="rb")
+    res = run_sync_round(gw, "ra", "rb", metrics=gw.metrics.base)
+    assert not res.converged
+    assert res.healed["rb"] == 10 and res.healed["ra"] == 7
+    res2 = run_sync_round(gw, "ra", "rb", metrics=gw.metrics.base)
+    assert res2.converged
+    for rid in ("ra", "rb"):
+        got = gw.dhash_get_many(only_a + only_b, ring_id=rid)
+        assert all(bool(ok) for _, ok in got)
+    mets = gw.metrics.base
+    assert mets.counter("repair.keys_healed.rb") == 10
+    assert mets.counter("repair.keys_healed.ra") == 7
+    assert mets.counter("repair.bytes_moved") > 0
+
+
+def test_scheduler_converges_with_corruption_and_tokens(repair_gw):
+    """The full shape the bench smoke asserts: missing keys on B plus
+    duplicate-index corruption on A, healed under a token bucket that
+    forces multi-round pacing, converging with zero steady-state
+    retraces through the engines."""
+    gw, rng = repair_gw
+    keys = _rand_ids(rng, 12)
+    for k in keys:
+        seg = _rand_segs(rng)
+        assert gw.dhash_put(k, seg, 3, 0, ring_id="ra")
+        if keys.index(k) < 4:  # only a prefix reaches rb
+            assert gw.dhash_put(k, seg, 3, 0, ring_id="rb")
+    eng_a = gw.router.get("ra").engine
+    lanes = keys_from_ints(keys)
+    with eng_a._lock:
+        eng_a._store = _corrupt_duplicates(eng_a._store, lanes[0],
+                                           from_idx=11)
+    for rid in ("ra", "rb"):
+        gw.router.get(rid).engine.warmup(
+            ["dhash_get", "dhash_put", "sync_digest", "repair_reindex"])
+    snap = rk.trace_snapshot()
+    sched = RepairScheduler(
+        gw, [("ra", "rb")], rate_keys_s=5000.0, burst_keys=5.0,
+        max_keys_round=64, round_timeout_s=120.0,
+        metrics=gw.metrics.base)
+    results = sched.run_until_converged(max_rounds=12)
+    assert results[-1].converged
+    assert any(r.deferred > 0 for r in results), \
+        "burst=5 over 12+ candidates must defer at least once"
+    assert sum(r.reindexed["ra"] for r in results) == 4
+    for rid in ("ra", "rb"):
+        got = gw.dhash_get_many(keys, ring_id=rid)
+        assert all(bool(ok) for _, ok in got), f"unreadable keys on {rid}"
+        gw.router.get(rid).engine.assert_no_retraces()
+    # After the warm first round the repair kernels never retrace.
+    assert rk.retraces_since(snap) <= 3  # diff + scan + reindex warmup
+    snap2 = rk.trace_snapshot()
+    assert run_sync_round(gw, "ra", "rb",
+                          metrics=gw.metrics.base).converged
+    assert rk.retraces_since(snap2) == 0
+
+
+def test_scheduler_background_loop_and_status(repair_gw):
+    gw, rng = repair_gw
+    keys = _rand_ids(rng, 6)
+    for k in keys:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    sched = RepairScheduler(gw, [("ra", "rb")], interval_s=0.02,
+                            interval_idle_s=0.2, rate_keys_s=10000,
+                            burst_keys=10000, round_timeout_s=120.0,
+                            metrics=gw.metrics.base)
+    gw.attach_repair(sched)
+    sched.start()
+    deadline = time.time() + 90
+    while time.time() < deadline and not sched.loops[0].converged:
+        time.sleep(0.05)
+    assert sched.loops[0].converged, sched.status()
+    got = gw.dhash_get_many(keys, ring_id="rb")
+    assert all(bool(ok) for _, ok in got)
+    status = gw.repair_status()
+    assert status["schedulers"][0]["pairs"][0]["converged"]
+    assert status["counters"].get("repair.keys_healed.rb", 0) == 6
+    # close() via the gateway (attach_repair teardown contract).
+    gw.close()
+    assert sched._stop.is_set()
+
+
+def test_token_bucket_grants_never_block():
+    bucket = TokenBucket(0.001, 5.0)  # rate ~0: no refill mid-test
+    assert bucket.take(3) == 3
+    assert bucket.take(10) == 2  # only the burst remainder grants
+    assert bucket.take(10) == 0  # empty: non-blocking zero grant
+    bucket.refund(3)             # unused grants return...
+    assert bucket.take(5) == 3
+    bucket.refund(100)           # ...capped at burst
+    assert bucket.take(10) == 5
+    with pytest.raises(ValueError):
+        TokenBucket(0.0, 5.0)
+
+
+def test_scheduler_stalls_on_unclosable_residual():
+    """A residual diff no round can close (here: ring rb's store too
+    small to hold any block's fragment rows, so every heal put reports
+    False) must flip the pair to STALLED — counted, visible in
+    status(), surfaced by run_until_converged — instead of re-running
+    full-rate rounds forever."""
+    rng = np.random.RandomState(4242)
+    gw = Gateway(metrics=Metrics(), name="stall-test")
+    gw.add_ring("ra",
+                build_ring(_rand_ids(rng, N_PEERS),
+                           RingConfig(finger_mode="materialized")),
+                empty_store(CAPACITY, SMAX), default=True,
+                bucket_min=4, bucket_max=16)
+    gw.add_ring("rb",
+                build_ring(_rand_ids(rng, N_PEERS),
+                           RingConfig(finger_mode="materialized")),
+                empty_store(8, SMAX),  # < n rows: no block ever fits
+                bucket_min=4, bucket_max=16)
+    try:
+        for k in _rand_ids(rng, 3):
+            assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+        sched = RepairScheduler(gw, [("ra", "rb")], rate_keys_s=1e6,
+                                burst_keys=1e6, round_timeout_s=120.0,
+                                metrics=gw.metrics.base)
+        with pytest.raises(RuntimeError, match="STALLED"):
+            sched.run_until_converged(max_rounds=10)
+        loop = sched.loops[0]
+        assert loop.stalled and not loop.converged
+        assert gw.metrics.base.counter(
+            "repair.stalled_rounds.ra-rb") >= 2
+        assert sched.status()["pairs"][0]["stalled"]
+    finally:
+        gw.close()
+
+
+def test_pair_loop_failure_backs_off_visibly(repair_gw):
+    """A failing round (unknown ring here) is counted, surfaces in
+    status(), and backs off with jitter inside [base/2, cap] instead of
+    hot-looping or killing the loop thread."""
+    gw, rng = repair_gw
+    sched = RepairScheduler(gw, [("ra", "missing-ring")],
+                            interval_s=0.01, backoff_base_s=0.05,
+                            backoff_cap_s=0.2, metrics=gw.metrics.base)
+    loop = sched.loops[0]
+    with pytest.raises(Exception):
+        loop.run_once()  # the foreground form surfaces the error
+    sched.start()
+    deadline = time.time() + 30
+    while time.time() < deadline and loop.failures < 2:
+        time.sleep(0.02)
+    try:
+        assert loop.failures >= 2, sched.status()
+        assert 0 < loop.backoff_s <= 0.2
+        assert "missing-ring" in (loop.last_error or "")
+        assert gw.metrics.base.counter(
+            "repair.round_failures.ra-missing-ring") >= 2
+        assert loop.thread.is_alive()
+    finally:
+        sched.close()
+
+
+def test_sync_range_and_repair_status_rpc(repair_gw):
+    gw, rng = repair_gw
+    keys = _rand_ids(rng, 5)
+    for k in keys:
+        assert gw.dhash_put(k, _rand_segs(rng), 3, 0, ring_id="ra")
+    srv = Server(0, {})
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        resp = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "SYNC_RANGE", "RING_A": "ra", "RING_B": "rb",
+             "DEADLINE_MS": 120000.0}, timeout=120.0)
+        assert resp["SUCCESS"]
+        assert not resp["CONVERGED"]
+        assert resp["HEALED"]["rb"] == 5
+        resp2 = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "SYNC_RANGE", "RING_A": "ra", "RING_B": "rb",
+             "DEADLINE_MS": 120000.0}, timeout=120.0)
+        assert resp2["SUCCESS"] and resp2["CONVERGED"]
+        status = Client.make_request(
+            "127.0.0.1", srv.port, {"COMMAND": "REPAIR_STATUS"},
+            timeout=60.0)
+        assert status["SUCCESS"]
+        assert status["STATUS"]["counters"]["repair.keys_healed.rb"] == 5
+        # Unknown ring surfaces as the reference's error envelope.
+        bad = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "SYNC_RANGE", "RING_A": "ra", "RING_B": "nope"},
+            timeout=60.0)
+        assert not bad["SUCCESS"] and "nope" in bad["ERRORS"]
+    finally:
+        srv.kill()
+
+
+# ---------------------------------------------------------------------------
+# host-overlay/device-store hybrid (DHashPeer satellite)
+# ---------------------------------------------------------------------------
+
+def test_dhash_peer_device_store_hybrid_parity():
+    """DHashPeer.create/read through a registered device ring: blocks
+    land in the device store (host DBs stay empty), read back with
+    byte parity against the pure host path, and a device MISS falls
+    back to the host overlay."""
+    from p2p_dhts_tpu.core.ring import build_ring as _build
+    from p2p_dhts_tpu.gateway import global_gateway
+    from p2p_dhts_tpu.overlay.dhash_peer import DHashPeer
+
+    rng = np.random.RandomState(99)
+    gw = global_gateway()
+    gw.set_default_ida(3, 2, 257)
+    gw.add_ring("dev-hybrid",
+                _build(_rand_ids(rng, N_PEERS),
+                       RingConfig(finger_mode="materialized")),
+                empty_store(256, 16), default=True,
+                bucket_min=4, bucket_max=16)
+    peers = []
+    try:
+        p_host = DHashPeer("127.0.0.1", 18741, 3,
+                           maintenance_interval=None)
+        peers.append(p_host)
+        p_dev = DHashPeer("127.0.0.1", 18742, 3,
+                          maintenance_interval=None,
+                          device_store_ring="dev-hybrid")
+        peers.append(p_dev)
+        for p in peers:
+            p.set_ida_params(3, 2, 257)
+        p_host.start_chord()
+        p_dev.join("127.0.0.1", 18741)
+        for _ in range(2):
+            for p in peers:
+                p.stabilize()
+        val = "hybrid parity value \N{BULLET} bytes"
+        p_dev.create("hyb-key", val)
+        st = gw.router.get("dev-hybrid").engine.store_snapshot()
+        assert int(st.n_used) == 3  # n=3 fragments, device-resident
+        assert p_host.db.size == 0 and p_dev.db.size == 0
+        assert p_dev.read("hyb-key") == val
+        p_host.create("host-key", val)
+        assert p_host.read("host-key") == val  # pure host path parity
+        assert p_dev.read("host-key") == val   # device miss -> host
+    finally:
+        for p in peers:
+            p.fail()
+        gw.remove_ring("dev-hybrid")
+        gw.set_default_ida(14, 10, 257)

@@ -1,23 +1,25 @@
 #!/bin/bash
-# TPU recovery watcher, round 5: the default-flip round changed every
-# config's HLO, so ALL SIX bench configs need fresh on-chip runs. Wait
-# for the chip to be free, probe the remote-compile service (dead since
-# round 4: connection-refused on its port while cached programs kept
-# executing), and when it answers, run the configs without a green
-# record one at a time into BENCH_ATTEMPT_r05.jsonl. Never kills
-# anything mid-TPU-work; every probe and bench attempt runs to
-# completion (a blocked fresh-shape jit takes ~25 min to fail — that is
-# the probe's cost when the service is down, accepted).
+# TPU recovery watcher, round 6: the round-5 six plus the serving-stack
+# configs (serve/gateway, ISSUE 4 follow-through) and the chordax-repair
+# config (ISSUE 6) all want on-chip records. Wait for the chip to be
+# free, probe the remote-compile service (dead since round 4:
+# connection-refused on its port while cached programs kept executing),
+# and when it answers, run the configs without a green record one at a
+# time into BENCH_ATTEMPT_r06.jsonl (bench's _record_lkg promotes each
+# green on-chip record into BENCH_LKG.json). Never kills anything
+# mid-TPU-work; every probe and bench attempt runs to completion (a
+# blocked fresh-shape jit takes ~25 min to fail — that is the probe's
+# cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-5 watcher start (all configs need fresh compiles)"
+log "round-6 watcher start (core + serve/gateway/repair configs)"
 
-needed() {  # configs without a green r05 record yet
+needed() {  # configs without a green r06 record yet
   python - <<'EOF'
 import json
 ok = set()
 try:
-    for line in open("BENCH_ATTEMPT_r05.jsonl"):
+    for line in open("BENCH_ATTEMPT_r06.jsonl"):
         try:
             rec = json.loads(line)
         except ValueError:
@@ -27,7 +29,7 @@ try:
 except FileNotFoundError:
     pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
-        "sweep_10m"]
+        "sweep_10m", "serve", "gateway", "repair"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -64,6 +66,15 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Repair smoke (ISSUE 6): quorum-PUT parity, churned-pair convergence
+  # and zero repair-path retraces must hold on CPU before the repair
+  # config (or anything else) claims the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config repair --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "repair smoke FAILED - fix the control plane before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -76,7 +87,7 @@ EOF
   then
     for c in $CONFIGS; do
       log "running --config $c"
-      python bench.py --config "$c" >> BENCH_ATTEMPT_r05.jsonl 2>> BENCH_ATTEMPT_r05.err
+      python bench.py --config "$c" >> BENCH_ATTEMPT_r06.jsonl 2>> BENCH_ATTEMPT_r06.err
       log "config $c rc=$?"
     done
   else
