@@ -65,6 +65,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "gateway", "metrics_ext.py"),
     os.path.join("p2p_dhts_tpu", "repair", "scheduler.py"),
     os.path.join("p2p_dhts_tpu", "repair", "replication.py"),
+    os.path.join("p2p_dhts_tpu", "membership", "manager.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

@@ -130,6 +130,29 @@ def default_kernels() -> List[KernelSpec]:
                    (state_m, store)),
     ]
 
+    # The chordax-membership kernels (ISSUE 7): the mixed-op churn
+    # batch (join/leave/fail rows over a capacity-padded state) and the
+    # paced stabilize round — the elasticity device path a GSPMD
+    # miscompile would silently corrupt mid-storm.
+    from p2p_dhts_tpu.membership import OP_FAIL, OP_JOIN, OP_LEAVE
+    from p2p_dhts_tpu.membership import kernels as mk
+    state_cap = ring.build_ring(rand_ids(n_peers),
+                                RingConfig(finger_mode="materialized"),
+                                capacity=mk.padded_capacity(n_peers + 4))
+    churn_ops = jnp.asarray(
+        np.asarray([OP_JOIN, OP_JOIN, OP_FAIL, OP_FAIL, OP_LEAVE,
+                    OP_FAIL, OP_JOIN, OP_LEAVE][:batch], np.int32))
+    churn_lanes = jnp.asarray(
+        np.frombuffer(rng.bytes(16 * batch),
+                      dtype="<u4").reshape(-1, 4).copy())
+
+    specs += [
+        KernelSpec("membership.churn_apply", mk.churn_apply,
+                   (state_cap, churn_ops, churn_lanes)),
+        KernelSpec("membership.stabilize_sweep", mk.stabilize_round,
+                   (state_cap,)),
+    ]
+
     if mesh is not None:
         from p2p_dhts_tpu.core import sharded as csh
         specs.append(KernelSpec(

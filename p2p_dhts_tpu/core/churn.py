@@ -39,7 +39,7 @@ Design notes / deliberate deviations (same fixpoint, different cadence):
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -90,8 +90,14 @@ def _succ_chain(na: jax.Array, rows: jax.Array, s: int, n: int) -> jax.Array:
 def fail(state: RingState, rows: jax.Array) -> RingState:
     """Silent failure of a batch of peers (ref Fail(),
     chord_peer.cpp:293-300): only the alive bit changes; every stale
-    reference stays until stabilize_sweep repairs it."""
-    return state._replace(alive=state.alive.at[rows].set(False))
+    reference stays until stabilize_sweep repairs it.
+
+    Rows >= capacity are masked no-op lanes (the membership control
+    plane's churn_apply resolves ids to rows on device and routes
+    not-found / wrong-op lanes to the capacity sentinel); mode="drop"
+    discards them instead of clamping onto a real peer."""
+    return state._replace(
+        alive=state.alive.at[rows].set(False, mode="drop"))
 
 
 @jax.jit
@@ -105,21 +111,32 @@ def leave(state: RingState, rows: jax.Array) -> RingState:
     alive predecessor). Successor-list entries naming leavers are cleared
     (RemotePeerList::Delete). Fingers: untouched (the reference's
     LeaveHandler finger adjustment is a no-op quirk, see module doc).
+
+    Rows >= capacity are masked no-op lanes (see fail()): their alive
+    bit, custody scatter, and notify scatter are all dropped, so the
+    membership churn_apply kernel can pad/route rejected lanes to the
+    capacity sentinel without corrupting a live peer's state.
     """
-    state = state._replace(alive=state.alive.at[rows].set(False))
     n = state.ids.shape[0]
+    lane_ok = rows < n
+    rows_c = jnp.minimum(rows, n - 1)
+    state = state._replace(
+        alive=state.alive.at[rows].set(False, mode="drop"))
     na = next_alive_map(state)
     pa = prev_alive_map(state)
 
     # Successor of each leaver among survivors; its new custody/pred.
-    succ_rows = _alive_succ_of_row(na, rows, n)
-    pred_rows = _alive_pred_of_row(pa, rows, n)
+    # Masked lanes (and an all-dead ring's -1 maps) route to n, which
+    # mode="drop" discards — a negative scatter index would wrap.
+    succ_rows = _alive_succ_of_row(na, rows_c, n)
+    succ_rows = jnp.where(lane_ok & (succ_rows >= 0), succ_rows, n)
+    pred_rows = _alive_pred_of_row(pa, rows_c, n)
     # For leaver chains, several leavers share one alive successor; the
     # correct inherited min_key is (alive pred id + 1), which equals the
     # chain-lowest NEW_MIN. Scatter both (duplicate scatters agree).
-    new_min = u128.add_scalar(state.ids[pred_rows], 1)
-    min_key = state.min_key.at[succ_rows].set(new_min)
-    preds = state.preds.at[succ_rows].set(pred_rows)
+    new_min = u128.add_scalar(state.ids[jnp.maximum(pred_rows, 0)], 1)
+    min_key = state.min_key.at[succ_rows].set(new_min, mode="drop")
+    preds = state.preds.at[succ_rows].set(pred_rows, mode="drop")
 
     # RemotePeerList::Delete of every leaver from every succ list.
     # Membership is resolved by BINARY SEARCH into the sorted [K] leaver
@@ -203,12 +220,22 @@ def stabilize_sweep(state: RingState,
 # ---------------------------------------------------------------------------
 
 @jax.jit
-def join(state: RingState, new_ids: jax.Array
+def join(state: RingState, new_ids: jax.Array,
+         mask: Optional[jax.Array] = None
          ) -> Tuple[RingState, jax.Array]:
     """Batched join of K new peers (ref Join + JoinHandler + Notify,
     abstract_chord_peer.cpp:83-190).
 
-    new_ids: [K, 4] u32.
+    new_ids: [K, 4] u32. `mask` ([K] bool, optional) marks which lanes
+    are real join requests: masked-out lanes are treated exactly like
+    rejected lanes (row -1, zero state mutation). The sort carries the
+    mask bit as a TRAILING key, so the batch stays globally id-sorted
+    (the merge searchsorted depends on that) while real lanes precede
+    masked ones within an equal-id run — and a lane only counts as an
+    intra-batch duplicate when the equal neighbor before it is a REAL
+    lane, so a masked fail/leave of id X can never shadow a real join
+    of X. This is what lets the membership churn_apply kernel run a
+    MIXED op batch through one join call.
 
     Preconditions are ENFORCED, not assumed: a lane whose id
     equals an ALIVE table row, or an earlier lane of the same batch, is
@@ -235,22 +262,39 @@ def join(state: RingState, new_ids: jax.Array
     k = new_ids.shape[0]
 
     # Sort the incoming batch (lexicographic over lanes, msb first).
-    sort_ops = [new_ids[:, 3], new_ids[:, 2], new_ids[:, 1], new_ids[:, 0],
-                jnp.arange(k, dtype=jnp.int32)]
-    *_, perm = jax.lax.sort(sort_ops, num_keys=4)
+    # With a mask, ~mask rides as a FIFTH key: ids stay globally sorted
+    # and real lanes sort before masked lanes of the same id.
+    if mask is None:
+        sort_ops = [new_ids[:, 3], new_ids[:, 2], new_ids[:, 1],
+                    new_ids[:, 0], jnp.arange(k, dtype=jnp.int32)]
+        *_, perm = jax.lax.sort(sort_ops, num_keys=4)
+    else:
+        sort_ops = [new_ids[:, 3], new_ids[:, 2], new_ids[:, 1],
+                    new_ids[:, 0], (~mask).astype(jnp.int32),
+                    jnp.arange(k, dtype=jnp.int32)]
+        *_, perm = jax.lax.sort(sort_ops, num_keys=5)
     new_sorted = new_ids[perm]
+    mask_sorted = (jnp.ones((k,), bool) if mask is None
+                   else mask[perm])
+    # A lane's duplicate-predecessor only counts when it is REAL: a
+    # masked lane between two real duplicates cannot occur (reals sort
+    # first within an equal-id run), and a masked lane never shadows a
+    # real join. Shift via roll (GSPMD-safe; a concat of a slice is
+    # the jax-0.4.x partitioner miscompile class, see module notes).
+    prev_real = jnp.roll(mask_sorted, 1).at[0].set(False)
 
     # Lane triage: insert (fresh id) / resurrect (matches a dead table
     # row) / reject (matches an alive row or an earlier lane). The table
     # probe is a searchsorted + one K-sized gather — never a
     # capacity-sized gather (the TPU compile cliff, see leave()).
     intra_dup = jnp.concatenate(
-        [jnp.zeros((1,), bool), u128.eq(new_sorted[1:], new_sorted[:-1])])
+        [jnp.zeros((1,), bool),
+         u128.eq(new_sorted[1:], new_sorted[:-1])]) & prev_real
     pos = u128.searchsorted(state.ids, new_sorted, state.n_valid)  # [K]
     pos_c = jnp.minimum(pos, n - 1)
     in_table = (pos < state.n_valid) & u128.eq(state.ids[pos_c], new_sorted)
-    resurrect = in_table & ~state.alive[pos_c] & ~intra_dup
-    insert = ~in_table & ~intra_dup
+    resurrect = in_table & ~state.alive[pos_c] & ~intra_dup & mask_sorted
+    insert = ~in_table & ~intra_dup & mask_sorted
     # Capacity guard: only as many inserts as the table has padding rows
     # are admitted (in sorted order); the rest are rejected (-1) like
     # duplicates. Without this, a full table EVICTS its highest-id

@@ -74,11 +74,13 @@ _FALLBACK_KINDS = frozenset({"find_successor", "finger_index"})
 FINGER_RING_ID = "__finger__"
 
 #: Wire commands install_gateway_handlers registers. SYNC_RANGE and
-#: REPAIR_STATUS are the chordax-repair control verbs (ISSUE 6): one
-#: on-demand anti-entropy round between two named rings, and the
-#: replication/scheduler observability snapshot.
+#: REPAIR_STATUS are the chordax-repair control verbs (ISSUE 6);
+#: JOIN_RING / HEARTBEAT / MEMBER_STATUS are the chordax-membership
+#: control verbs (ISSUE 7): admission-bounded join intake, the failure
+#: detector's liveness signal, and the per-ring membership snapshot.
 GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
-                    "SYNC_RANGE", "REPAIR_STATUS")
+                    "SYNC_RANGE", "REPAIR_STATUS", "JOIN_RING",
+                    "HEARTBEAT", "MEMBER_STATUS")
 
 
 def _key_int(v) -> int:
@@ -116,6 +118,12 @@ class Gateway:
         self._repl_policy = None
         self._repl_writer = None
         self._repair_scheds: List[Any] = []
+        # chordax-membership wiring (ISSUE 7): per-ring managers (the
+        # JOIN_RING / HEARTBEAT / MEMBER_STATUS verbs' dispatch table)
+        # and the optional auto-enrolling repair scheduler that router
+        # hot add/remove keeps in sync with the registered store rings.
+        self._memberships: Dict[str, Any] = {}
+        self._auto_repair: Optional[Any] = None
 
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
@@ -166,6 +174,131 @@ class Gateway:
             "schedulers": [s.status() for s in scheds],
             "counters": self.metrics.base.counters_with_prefix("repair."),
         }
+
+    # -- membership control plane (chordax-membership, ISSUE 7) --------------
+    def attach_membership(self, manager) -> None:
+        """Register a MembershipManager as its ring's churn authority:
+        the JOIN_RING / HEARTBEAT / MEMBER_STATUS verbs dispatch to it
+        and close() tears it down with the gateway."""
+        with self._rings_lock:
+            self._memberships[manager.ring_id] = manager
+
+    def membership_for(self, ring_id: str):
+        with self._rings_lock:
+            return self._memberships.get(ring_id)
+
+    def _membership_required(self, ring_id: Optional[str]):
+        with self._rings_lock:
+            if ring_id is not None:
+                mgr = self._memberships.get(str(ring_id))
+            elif len(self._memberships) == 1:
+                mgr = next(iter(self._memberships.values()))
+            else:
+                mgr = None
+        if mgr is None:
+            raise UnknownRingError(
+                f"no membership manager for ring {ring_id!r} (elastic "
+                f"rings need an attached MembershipManager)")
+        return mgr
+
+    def churn_apply_many(self, entries: Sequence[tuple], *, ring_id: str,
+                         timeout: Optional[float] = None,
+                         deadline: Optional[Deadline] = None
+                         ) -> List[bool]:
+        """Apply [(op_code, member_id)] membership rows against one
+        named ring as one engine batch — FIFO-ordered with in-flight
+        lookups/puts, epoch-rolled-back on failure, never replicated
+        (membership is per-ring by definition)."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        payloads = [(int(op), _key_int(member)) for op, member in entries]
+        return self._serve_many(backend, "churn_apply", payloads, dl)
+
+    def stabilize_ring(self, ring_id: str, *,
+                       timeout: Optional[float] = None,
+                       deadline: Optional[Deadline] = None) -> bool:
+        """One whole-ring stabilize/rectify sweep through the named
+        ring's engine; returns the post-sweep placement_converged
+        verdict."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        return bool(self._serve_many(backend, "stabilize_sweep", [()],
+                                     dl)[0])
+
+    def dhash_maintain(self, ring_id: str, *,
+                       timeout: Optional[float] = None,
+                       deadline: Optional[Deadline] = None) -> int:
+        """One local-maintenance pass on the named ring's store (purge
+        dead-held rows, regenerate missing fragments from >= m
+        survivors); returns the regenerated-row count. The purge makes
+        holder-death visible to the content-level Merkle digests, so
+        the cross-ring repair pairs can heal what regeneration
+        couldn't."""
+        dl = deadline if deadline is not None \
+            else Deadline.from_timeout(timeout)
+        backend = self.router.get(ring_id)
+        return int(self._serve_many(backend, "dhash_maintain", [()],
+                                    dl)[0])
+
+    def nudge_repair(self, ring_id: str) -> int:
+        """Wake the repair pairs covering `ring_id` (their loops drop
+        converged/stalled and resume active pacing) — how an applied
+        churn batch's transferred ranges enqueue targeted heals.
+        Returns the number of pair loops nudged."""
+        with self._rings_lock:
+            scheds = list(self._repair_scheds)
+        n = 0
+        for sched in scheds:
+            nudge = getattr(sched, "nudge", None)
+            if nudge is not None:
+                n += nudge(ring_id)
+        return n
+
+    def enable_auto_repair(self, **sched_kwargs):
+        """Create (once) the DYNAMIC repair scheduler that router hot
+        add/remove keeps enrolled: every store ring added after this
+        call pairs with every other registered store ring, and
+        remove_ring retires its pairs — no manual attach_repair per
+        ring (the PR-6 open item). kwargs pass through to
+        RepairScheduler. Returns the scheduler."""
+        from p2p_dhts_tpu.repair.scheduler import RepairScheduler
+        with self._rings_lock:
+            if self._auto_repair is not None:
+                return self._auto_repair
+        sched = RepairScheduler(self, [], dynamic=True,
+                                metrics=self.metrics.base,
+                                **sched_kwargs)
+        with self._rings_lock:
+            if self._auto_repair is None:
+                self._auto_repair = sched
+                self._repair_scheds.append(sched)
+            sched = self._auto_repair
+        # Rings registered before enable_auto_repair enroll now.
+        for backend in self.router.snapshot()[0]:
+            self._auto_enroll(backend)
+        return sched
+
+    def _store_ring_ids(self) -> List[str]:
+        return [b.ring_id for b in self.router.snapshot()[0]
+                if getattr(b.engine, "has_store", False)]
+
+    def _auto_enroll(self, backend: RingBackend) -> None:
+        with self._rings_lock:
+            sched = self._auto_repair
+        if sched is None or not getattr(backend.engine, "has_store",
+                                        False):
+            return
+        for other in self._store_ring_ids():
+            if other != backend.ring_id:
+                sched.add_pair((other, backend.ring_id))
+
+    def _auto_retire(self, ring_id: str) -> None:
+        with self._rings_lock:
+            sched = self._auto_repair
+        if sched is not None:
+            sched.remove_ring(ring_id)
 
     def add_ring(self, ring_id: str, state=None, store=None, *,
                  key_range: Optional[Tuple[int, int]] = None,
@@ -218,15 +351,24 @@ class Gateway:
                 engine.close(drain=False)
             raise
         self.metrics.gauge_health(backend.ring_id, backend.state)
+        # Hot add auto-enrolls the new store ring's repair pairs (the
+        # PR-6 open item): no manual attach_repair per ring.
+        self._auto_enroll(backend)
         return backend
 
     def remove_ring(self, ring_id: str, drain: bool = True,
                     close_engine: bool = True) -> RingBackend:
         """Unregister a ring; in-flight requests finish (the engine
-        drains outside every gateway lock)."""
+        drains outside every gateway lock). Auto-enrolled repair pairs
+        covering the ring retire first so no heal round lands on a
+        closing engine."""
+        self._auto_retire(ring_id)
         backend = self.router.remove_ring(ring_id)
         with self._rings_lock:
             self._admission.pop(ring_id, None)
+            mgr = self._memberships.pop(ring_id, None)
+        if mgr is not None:
+            mgr.close()
         if close_engine:
             backend.engine.close(drain=drain)
         return backend
@@ -401,6 +543,18 @@ class Gateway:
                 dist = (int(key_int) - int(start_int)) % KEYS_IN_RING
                 out.append(dist.bit_length() - 1 if dist else -1)
             return out
+        # find_successor during an ownership-handoff window: the
+        # backend's ring_state snapshot may predate the in-flight churn
+        # batch, so serve from the membership manager's HOST MIRROR
+        # closed form instead (counted, never wrong — the mirror is the
+        # applied-batches fixpoint; the omniscient resolution costs 0
+        # hops, like core.ring.owner_of).
+        mgr = backend.membership
+        if mgr is not None and backend.in_handoff:
+            self.metrics.base.inc(
+                f"membership.handoff_failover.{backend.ring_id}",
+                len(payloads))
+            return [(mgr.owner_row(int(p[0])), 0) for p in payloads]
         # find_successor, directly against the backend's RingState.
         if backend.ring_state is None:
             raise RingUnavailableError(
@@ -510,12 +664,69 @@ class Gateway:
 
     def dhash_get(self, key, *, ring_id: Optional[str] = None,
                   timeout: Optional[float] = None,
-                  deadline: Optional[Deadline] = None):
+                  deadline: Optional[Deadline] = None,
+                  failover: Optional[bool] = None):
+        """Read one block. REPLICA-AWARE by default when a replication
+        policy is installed and no ring is named: the read tries the
+        fastest healthy replica first (the routed primary among the
+        healthy rings, then the rest in target order) and fails over
+        to the next replica on a miss, a busy ring, or a ring-level
+        failure — counted `repair.read_failover.<ring>` per replica
+        moved past — instead of demanding an explicit ring_id. A
+        truly-absent key therefore costs one read PER replica (a miss
+        on one replica is not authoritative while replicas can lag —
+        that is the semantics the failover exists for); negative-
+        lookup-heavy callers who prefer the single probe pass
+        failover=False or an explicit ring_id. failover=True demands
+        a policy."""
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
         k = _key_int(key)
-        backend = self.router.route(key_int=k, ring_id=ring_id)
-        return self._serve_many(backend, "dhash_get", [(k,)], dl)[0]
+        writer = self._writer()
+        if failover and ring_id is not None:
+            raise ValueError("failover=True and an explicit ring_id "
+                             "are contradictory; drop one")
+        use_fo = (failover if failover is not None
+                  else (writer is not None and ring_id is None))
+        if not use_fo:
+            backend = self.router.route(key_int=k, ring_id=ring_id)
+            return self._serve_many(backend, "dhash_get", [(k,)], dl)[0]
+        if writer is None:
+            raise ValueError("failover=True but no replication policy "
+                             "is set (Gateway.set_replication)")
+        # Health-ordered replica set: healthy rings keep their
+        # primary-first target order; degraded/ejected rings move to
+        # the back (they would only cost a failed attempt first).
+        from p2p_dhts_tpu.gateway.router import HEALTHY
+        targets = sorted(writer.targets_for(k),
+                         key=lambda b: 0 if b.state == HEALTHY else 1)
+        miss = None
+        last_exc: Optional[BaseException] = None
+        for j, backend in enumerate(targets):
+            if dl.expired():
+                raise DeadlineExpiredError(
+                    "replica-aware GET: deadline lapsed between "
+                    "replicas")
+            try:
+                seg, ok = self._serve_many(backend, "dhash_get",
+                                           [(k,)], dl)[0]
+            except (RingUnavailableError, RingBusyError) as exc:
+                last_exc = exc
+                self.metrics.base.inc(
+                    f"repair.read_failover.{backend.ring_id}")
+                continue
+            if ok:
+                return seg, ok
+            miss = (seg, ok)
+            if j < len(targets) - 1:
+                self.metrics.base.inc(
+                    f"repair.read_failover.{backend.ring_id}")
+        if miss is not None:
+            return miss  # readable nowhere: a plain miss, not an error
+        assert last_exc is not None
+        raise RingUnavailableError(
+            f"replica-aware GET: every replica failed "
+            f"({type(last_exc).__name__}: {last_exc})") from last_exc
 
     def dhash_put(self, key, segments, length: int, start_row: int = 0, *,
                   ring_id: Optional[str] = None,
@@ -600,6 +811,10 @@ class Gateway:
         out = self.metrics.snapshot(ring_ids)
         out["health"] = self.router.health_snapshot()
         out["default_ring"] = self.router.default_ring_id
+        with self._rings_lock:
+            managers = list(self._memberships.values())
+        if managers:
+            out["membership"] = {m.ring_id: m.status() for m in managers}
         return out
 
     # -- RPC handlers (net/rpc.py Server command surface) --------------------
@@ -786,6 +1001,44 @@ class Gateway:
     def handle_repair_status(self, req: dict) -> dict:
         return {"STATUS": self.repair_status()}
 
+    # -- membership verbs (chordax-membership, ISSUE 7) ----------------------
+    def handle_join_ring(self, req: dict) -> dict:
+        """Admission-bounded join intake. MEMBER is the joining peer's
+        128-bit id (hex or int); alternatively IP + PORT derive the
+        reference's SHA1("ip:port") id (abstract_chord_peer.cpp:13-28).
+        ACCEPTED=false is the visible admission refusal, not an RPC
+        error — the joiner backs off and retries."""
+        mgr = self._membership_required(req.get("RING"))
+        if "MEMBER" in req:
+            member = _key_int(req["MEMBER"])
+        elif "IP" in req and "PORT" in req:
+            from p2p_dhts_tpu.keyspace import peer_id
+            member = peer_id(str(req["IP"]), int(req["PORT"]))
+        else:
+            raise ValueError("JOIN_RING needs MEMBER or IP+PORT")
+        accepted = mgr.request_join(member)
+        return {"ACCEPTED": bool(accepted), "RING": mgr.ring_id,
+                "MEMBER": format(member, "x"),
+                "HEARTBEAT_S": mgr.heartbeat_interval_s}
+
+    def handle_heartbeat(self, req: dict) -> dict:
+        """The failure detector's liveness signal: a member the
+        manager knows refreshes its phi clock; KNOWN=false tells a
+        restarted peer to JOIN_RING again."""
+        mgr = self._membership_required(req.get("RING"))
+        known = mgr.heartbeat(_key_int(req["MEMBER"]))
+        return {"KNOWN": bool(known), "RING": mgr.ring_id}
+
+    def handle_member_status(self, req: dict) -> dict:
+        """Membership observability: one ring's status, or every
+        attached manager's when RING is omitted."""
+        ring_id = req.get("RING")
+        if ring_id is not None:
+            return {"STATUS": self._membership_required(ring_id).status()}
+        with self._rings_lock:
+            managers = list(self._memberships.values())
+        return {"STATUS": {m.ring_id: m.status() for m in managers}}
+
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         if "KEYS" in req:
@@ -807,8 +1060,14 @@ class Gateway:
         with self._rings_lock:
             scheds = list(self._repair_scheds)
             self._repair_scheds.clear()
+            self._auto_repair = None
+            managers = list(self._memberships.values())
+            self._memberships.clear()
             writer, self._repl_writer = self._repl_writer, None
             self._repl_policy = None
+        # Membership loops stop FIRST (they submit churn batches and
+        # nudge schedulers); then repair, then the writer.
+        scheds = managers + scheds
         # A wedged scheduler/writer must not abort the rest of the
         # teardown (leaked engines + pool threads outlive one stuck
         # pair loop); remember the first error, finish, then re-raise.
@@ -861,5 +1120,8 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "FINGER_INDEX": gw.handle_finger_index,
         "SYNC_RANGE": gw.handle_sync_range,
         "REPAIR_STATUS": gw.handle_repair_status,
+        "JOIN_RING": gw.handle_join_ring,
+        "HEARTBEAT": gw.handle_heartbeat,
+        "MEMBER_STATUS": gw.handle_member_status,
     })
     return gw

@@ -124,12 +124,44 @@ class RingBackend:
         self._retry_at = 0.0
         self._probe_inflight = False
         self._degraded_logged = False
+        #: Attached MembershipManager (chordax-membership, ISSUE 7):
+        #: set by the manager's constructor. While present, the
+        #: fallback find_successor path during a handoff window serves
+        #: from the manager's host mirror instead of the (possibly
+        #: stale) ring_state snapshot.
+        self.membership = None
+        # Ownership-handoff window depth: >0 while a churn batch is in
+        # flight between the engine and the metadata updates
+        # (ring_state swap + mirror). Guarded by _health_lock (a leaf;
+        # begin/end never nest with anything).
+        self._handoff_depth = 0
 
     # -- routing -------------------------------------------------------------
     def owns_key(self, key_int: int) -> bool:
         if self.key_range is None:
             return False
         return key_in_range(key_int, *self.key_range)
+
+    # -- elasticity (chordax-membership) --------------------------------------
+    def set_ring_state(self, state) -> None:
+        """Atomic swap of the fallback-path RingState (one reference
+        assignment) — the membership manager installs the post-churn
+        snapshot here after each applied batch so a degraded-ring
+        direct dispatch never resolves against a retired table."""
+        self.ring_state = state
+
+    def begin_handoff(self) -> None:
+        with self._health_lock:
+            self._handoff_depth += 1
+
+    def end_handoff(self) -> None:
+        with self._health_lock:
+            self._handoff_depth = max(self._handoff_depth - 1, 0)
+
+    @property
+    def in_handoff(self) -> bool:
+        with self._health_lock:
+            return self._handoff_depth > 0
 
     # -- health machine ------------------------------------------------------
     @property
@@ -245,6 +277,22 @@ class RingRouter:
         if backend is None:
             raise UnknownRingError(f"no ring {ring_id!r}")
         return backend
+
+    def set_key_range(self, ring_id: str,
+                      key_range: Optional[Tuple[int, int]]) -> None:
+        """Atomically update one ring's key-range ownership entry
+        while traffic flows (elastic re-partitioning: a membership
+        change that re-splits the keyspace across rings lands as one
+        reference swap — a concurrent route() sees either the old
+        complete range or the new one, never a torn pair)."""
+        with self._lock:
+            backend = self._rings.get(ring_id)
+            if backend is None:
+                raise UnknownRingError(f"no ring {ring_id!r}")
+            backend.key_range = (
+                (int(key_range[0]) % KEYS_IN_RING,
+                 int(key_range[1]) % KEYS_IN_RING)
+                if key_range is not None else None)
 
     def route(self, key_int: Optional[int] = None,
               ring_id: Optional[str] = None) -> RingBackend:

@@ -1,0 +1,52 @@
+"""chordax-membership: the live churn/elasticity control plane
+(ISSUE 7).
+
+The reference's defining runtime behavior — peers join, crash, and
+stabilize continuously (Stoica et al. 2001; Zave's rectify) — as a
+first-class subsystem over the PR-4 gateway and the PR-2 ServeEngine:
+
+  mutable rings  capacity-padded RingStates (power-of-two capacity,
+                 alive mask) churn through the engine's store-chaining
+                 "churn_apply" / "stabilize_sweep" kinds — FIFO-ordered
+                 with in-flight lookups/puts, epoch-rolled-back on
+                 failure, zero steady-state retraces
+                 (membership/kernels.py + serve.py).
+  manager        a per-ring background loop: heartbeat-driven
+                 phi-accrual-style failure detection, bounded join
+                 admission, token-bucket-paced churn batches and
+                 stabilize rounds with jittered backoff, pre-dispatch
+                 deadline shedding and stall detection — the PR-6
+                 scheduler discipline (membership/manager.py).
+  integration    JOIN_RING / HEARTBEAT / MEMBER_STATUS wire verbs on
+                 every gateway server; ownership handoff windows whose
+                 fallback lookups serve from the manager's host mirror
+                 (counted, never wrong); lost ranges nudge the repair
+                 scheduler; router hot add/remove auto-enrolls and
+                 retires repair pairs (gateway/frontend.py).
+
+Importing this package pulls the gateway/serve stack but never
+initializes a jax backend; device work happens only once churn flows.
+"""
+
+#: Membership op codes (the churn_apply lane vocabulary). Plain ints,
+#: defined BEFORE the manager import so membership/kernels.py and
+#: membership/manager.py can both import them from here without a
+#: cycle.
+OP_NOOP = 0
+OP_JOIN = 1
+OP_LEAVE = 2
+OP_FAIL = 3
+
+#: The ops serve.ServeEngine accepts in a churn_apply payload (OP_NOOP
+#: lanes are legal no-ops so callers can pad their own batches).
+VALID_OPS = frozenset({OP_NOOP, OP_JOIN, OP_LEAVE, OP_FAIL})
+
+from p2p_dhts_tpu.membership.manager import (  # noqa: E402,F401
+    MembershipManager,
+    overlay_join_executor,
+)
+
+__all__ = [
+    "MembershipManager", "OP_FAIL", "OP_JOIN", "OP_LEAVE", "OP_NOOP",
+    "VALID_OPS", "overlay_join_executor",
+]

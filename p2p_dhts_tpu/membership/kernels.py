@@ -1,0 +1,205 @@
+"""chordax-membership device kernels: batched mixed-op churn + the
+paced stabilize round, as single XLA programs over a capacity-padded
+RingState.
+
+The reference mutates membership one RPC at a time (Join / Leave /
+Fail + the per-peer 5 s StabilizeLoop); chordax already batched each
+op (core/churn.py) but nothing APPLIED them behind live traffic. These
+two kernels are the device half of that control plane:
+
+  * `churn_apply` — one [B]-lane batch of heterogeneous membership ops
+    (op code + 128-bit member id per lane) applied in a fixed
+    fail -> leave -> join order. Leave/fail lanes resolve their id to a
+    table row by searchsorted (never a capacity-sized gather — the TPU
+    compile-cliff rule from churn.leave); lanes whose id is unknown,
+    dead, duplicated, or beyond the table's padding capacity come back
+    applied=False with ZERO state mutation. Shape-stable by
+    construction: the ring's capacity is fixed (power-of-two >= N,
+    `padded_capacity`), so every batch bucket hits one cached program
+    and the serve loop's zero-retrace contract extends to churn.
+  * `stabilize_round` — one whole-ring stabilize/rectify sweep
+    (core.churn.stabilize_sweep) plus the placement_converged verdict,
+    so the MembershipManager can pace sweeps and stop when the ring
+    has re-tiled its custody boundaries.
+
+Padding discipline (the serve engine replicates a batch's first
+request into pad lanes): a replicated JOIN is an intra-batch duplicate
+(rejected), a replicated FAIL/LEAVE is an idempotent re-kill whose
+scatters agree with the original lane — padding can never introduce a
+new membership action, the same obligation serve.py's module doc pins
+for puts.
+
+Trace accounting mirrors repair/kernels.py: TRACE_COUNTS bumps at
+trace time; the standalone jitted forms exist for tests and the GSPMD
+registry, while serve.ServeEngine wraps the `_impl` bodies with its
+own per-kind counters.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.core import churn
+from p2p_dhts_tpu.core.ring import RingState, placement_converged
+from p2p_dhts_tpu.membership import OP_FAIL, OP_JOIN, OP_LEAVE, OP_NOOP
+from p2p_dhts_tpu.ops import u128
+
+#: Traces per kernel since process start (repair/kernels.py pattern).
+TRACE_COUNTS: Dict[str, int] = {"churn_apply": 0, "stabilize_round": 0}
+
+
+def _count(kernel: str) -> None:
+    TRACE_COUNTS[kernel] += 1
+
+
+def trace_snapshot() -> Dict[str, int]:
+    return dict(TRACE_COUNTS)
+
+
+def retraces_since(snapshot: Dict[str, int]) -> int:
+    return sum(TRACE_COUNTS.values()) - sum(snapshot.values())
+
+
+def padded_capacity(n: int, minimum: int = 8) -> int:
+    """The fixed table capacity an elastic ring is built with: the
+    smallest power of two >= max(n, minimum). Every churn op on a
+    capacity-padded ring is shape-stable (the alive mask absorbs
+    membership change; array shapes never move), which is what keeps
+    the serve loop's pre-traced buckets valid across a churn storm."""
+    cap = int(minimum)
+    n = max(int(n), 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _sorted_to_lane_order(values: jax.Array, perm: jax.Array
+                          ) -> jax.Array:
+    """Scatter sorted-batch-aligned values back to original lane order
+    (sorted slot s holds original lane perm[s])."""
+    out = jnp.zeros_like(values)
+    return out.at[perm].set(values)
+
+
+def churn_apply_impl(state: RingState, ops: jax.Array,
+                     lanes: jax.Array, store=None):
+    """Apply one mixed membership batch; returns (new state, applied)
+    — or (new state, new store, applied) when a FragmentStore rides
+    along. `applied` is [B] bool aligned to the INPUT lane order.
+
+    ops:   [B] i32 of OP_NOOP / OP_JOIN / OP_LEAVE / OP_FAIL
+    lanes: [B, 4] u32 member ids
+
+    Order within the batch is fixed and documented: fails first, then
+    leaves, then joins — so a fail+join of the same id in one batch is
+    a restart (the id's row dies, then resurrects), matching the
+    reference's kill-then-rejoin lifecycle. Leave/fail rows are
+    resolved against the PRE-batch table (row indices are stable under
+    fail/leave; join runs last precisely because it remaps rows).
+
+    With a store, churn is STORE-MUTATING in the same program — the
+    two row-indirection fixups that keep the serving store coherent
+    with the new table happen atomically with the membership change:
+      * graceful leavers hand their fragments to the alive ring
+        successor (dhash.maintenance.leave_handover — the reference's
+        LeaveHandler key transfer; a FAILED peer's fragments die with
+        it, a LEAVING peer's do not), and
+      * every holder row index is re-resolved through its peer id
+        after the join shifted the table layout
+        (dhash.maintenance.remap_holders) — without this, reads would
+        consult the WRONG row's alive bit the moment a join inserts
+        below a holder.
+    Dead-held purging/regeneration is deliberately NOT here: it is
+    unbounded decode work, paced separately (the "dhash_maintain"
+    engine kind).
+    """
+    n = state.ids.shape[0]
+    old_ids = state.ids  # pre-join table, for the holder remap
+
+    # Resolve leave/fail ids -> rows (searchsorted + one B-sized
+    # gather; the table-sized-gather compile cliff rule).
+    pos = u128.searchsorted(state.ids, lanes, state.n_valid)
+    pos_c = jnp.minimum(pos, n - 1)
+    found = (pos < state.n_valid) & u128.eq(state.ids[pos_c], lanes) \
+        & state.alive[pos_c]
+    fail_rows = jnp.where((ops == OP_FAIL) & found, pos_c, n)
+    leave_rows = jnp.where((ops == OP_LEAVE) & found, pos_c, n)
+    state = churn.fail(state, fail_rows)
+    state = churn.leave(state, leave_rows)
+    if store is not None:
+        # Handover BEFORE join: leaver rows are pre-join coordinates.
+        from p2p_dhts_tpu.dhash.maintenance import (_handover_holders,
+                                                    _remapped_holders)
+        from p2p_dhts_tpu.core.ring import next_alive_map
+        new_holder = _handover_holders(store.holder, store.used,
+                                       next_alive_map(state),
+                                       jnp.sort(leave_rows), n)
+        store = store._replace(holder=new_holder)
+
+    join_mask = ops == OP_JOIN
+    state, jrows = churn.join(state, lanes, mask=join_mask)
+    if store is not None:
+        store = store._replace(
+            holder=_remapped_holders(store.holder, old_ids, state))
+
+    # join's rows are aligned to its SORTED batch (public contract kept
+    # for existing callers); replay the identical deterministic sort —
+    # the masked form's 5-key (ids, ~mask, lane) sort — to route the
+    # admitted flags back to input lane order.
+    k = lanes.shape[0]
+    sort_ops = [lanes[:, 3], lanes[:, 2], lanes[:, 1], lanes[:, 0],
+                (~join_mask).astype(jnp.int32),
+                jnp.arange(k, dtype=jnp.int32)]
+    *_, perm = jax.lax.sort(sort_ops, num_keys=5)
+    join_applied = _sorted_to_lane_order(jrows >= 0, perm)
+
+    applied = jnp.where(join_mask, join_applied,
+                        ((ops == OP_LEAVE) | (ops == OP_FAIL)) & found)
+    if store is not None:
+        return state, store, applied
+    return state, applied
+
+
+@jax.jit
+def churn_apply(state: RingState, ops: jax.Array, lanes: jax.Array
+                ) -> Tuple[RingState, jax.Array]:
+    """Jitted standalone form (tests, the GSPMD registry); the serve
+    engine's "churn_apply" kind wraps the impl with the engine's own
+    per-kind trace counter instead."""
+    _count("churn_apply")
+    return churn_apply_impl(state, ops, lanes)
+
+
+@jax.jit
+def churn_apply_store(state: RingState, ops: jax.Array,
+                      lanes: jax.Array, store):
+    """Standalone jitted form of the store-carrying churn batch."""
+    _count("churn_apply")
+    return churn_apply_impl(state, ops, lanes, store)
+
+
+def stabilize_round_impl(state: RingState
+                         ) -> Tuple[RingState, jax.Array]:
+    """One whole-ring maintenance sweep + convergence verdict:
+    (swept state, placement_converged(swept state))."""
+    swept = churn.stabilize_sweep(state)
+    return swept, placement_converged(swept)
+
+
+@jax.jit
+def stabilize_round(state: RingState) -> Tuple[RingState, jax.Array]:
+    """Jitted standalone form of stabilize_round_impl."""
+    _count("stabilize_round")
+    return stabilize_round_impl(state)
+
+
+__all__ = [
+    "OP_FAIL", "OP_JOIN", "OP_LEAVE", "OP_NOOP", "TRACE_COUNTS",
+    "churn_apply", "churn_apply_impl", "padded_capacity",
+    "retraces_since", "stabilize_round", "stabilize_round_impl",
+    "trace_snapshot",
+]

@@ -1,0 +1,662 @@
+"""MembershipManager: the per-ring churn control loop.
+
+The reference keeps rings alive with one maintenance thread per peer
+(StabilizeLoop, chord_peer.cpp:213-240) and detects death by TCP
+connect probes. Here ONE background loop per device ring drives the
+whole lifecycle against the batched kernels:
+
+  heartbeats -> failure detection -> churn batch -> stabilize rounds
+                                   (engine "churn_apply")  ("stabilize_sweep")
+
+  * FAILURE DETECTION — phi-accrual style (Hayashibara et al. 2004,
+    simplified to a normalized-staleness score): each member's
+    heartbeat inter-arrival time is EWMA-tracked, and
+    phi = elapsed / max(ewma_interval, heartbeat_interval_s). A member
+    is SUSPECTED at phi >= phi_threshold / 2 and FAILED (an OP_FAIL
+    row enqueued) at phi >= phi_threshold — but never before
+    `min_heartbeats` samples exist, so a slow-but-alive peer whose
+    cadence the EWMA has adapted to is not failed early (the
+    false-positive obligation tests pin). A heartbeat from a suspect
+    clears the suspicion.
+  * ADMISSION — joins are bounded per ring (`max_pending_joins`); an
+    over-budget JOIN_RING is rejected visibly (counted), never queued
+    without limit — the RingAdmission philosophy applied to
+    membership.
+  * PACING — the PR-6 scheduler discipline: a token bucket bounds
+    churn rows/second (take / refund, non-blocking), each batch runs
+    under a round deadline that the gateway threads into the engine
+    (expired churn work is shed BEFORE device dispatch), failed rounds
+    requeue their rows and back off exponentially WITH JITTER, and two
+    consecutive rounds that apply nothing while work pends flip a
+    visible `stalled` flag (counted) and drop to idle pacing.
+  * OWNERSHIP HANDOFF — while a batch is in flight the backend is
+    marked in-handoff: gateway fallback lookups serve from this
+    manager's HOST MIRROR (closed form over the mirrored table —
+    counted, never wrong) instead of the stale device snapshot; after
+    the batch applies, the mirror, the backend's fallback RingState,
+    and the transfer log all update before the window closes. Lost
+    rows (fail/leave) nudge the attached repair scheduler so the
+    transferred ranges heal from replicas at the repair cadence.
+
+The host mirror is the exact twin of the device table (ids sorted
+ascending including dead rows, parallel alive flags): it is updated
+ONLY from the per-lane applied flags the churn kernel returns, so
+mirror row i IS device row i — the oracle-parity property
+tests/test_membership.py pins against a downloaded RingState.
+
+Detection scope, deliberate: the phi detector covers REGISTERED
+members — peers that came through request_join/JOIN_RING and
+heartbeat. Rows seeded from the ring's initial table have no cadence
+to model (failing them for never heartbeating would mass-fail a
+healthy seed ring at startup), so they stay undetected until they
+register (JOIN_RING on an alive id is an idempotent accept that
+starts tracking) or an operator calls fail_member.
+
+LOCK ORDER: `MembershipManager._lock` is a LEAF — never held across a
+gateway/engine call, a device sync, or a sleep; the loop sleeps on an
+Event holding nothing (the repair scheduler's rule).
+
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import logging
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.membership import OP_FAIL, OP_JOIN, OP_LEAVE
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.repair.scheduler import TokenBucket
+
+logger = logging.getLogger(__name__)
+
+#: Member lifecycle states.
+JOINING = "joining"
+ALIVE = "alive"
+SUSPECT = "suspect"
+FAILED = "failed"
+LEFT = "left"
+
+
+class _Member:
+    __slots__ = ("member_id", "state", "last_heard", "mean_interval_s",
+                 "n_heartbeats")
+
+    def __init__(self, member_id: int, state: str, now: float):
+        self.member_id = member_id
+        self.state = state
+        self.last_heard = now
+        self.mean_interval_s: Optional[float] = None
+        self.n_heartbeats = 0
+
+
+class MembershipManager:
+    """Live churn/elasticity control plane for one registered ring."""
+
+    def __init__(self, gateway, ring_id: str, *,
+                 heartbeat_interval_s: float = 1.0,
+                 phi_threshold: float = 4.0,
+                 min_heartbeats: int = 3,
+                 interval_s: float = 0.05,
+                 interval_idle_s: float = 1.0,
+                 max_batch: int = 256,
+                 max_pending_joins: int = 1024,
+                 rate_rows_s: float = 4096.0,
+                 burst_rows: float = 8192.0,
+                 round_timeout_s: Optional[float] = 30.0,
+                 backoff_base_s: float = 0.25,
+                 backoff_cap_s: float = 10.0,
+                 sweep_max_rounds: int = 8,
+                 metrics: Optional[Metrics] = None):
+        import numpy as np
+
+        from p2p_dhts_tpu.keyspace import lanes_to_ints
+
+        self.gateway = gateway
+        self.ring_id = str(ring_id)
+        self.backend = gateway.router.get(self.ring_id)
+        self.engine = self.backend.engine
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.phi_threshold = float(phi_threshold)
+        self.min_heartbeats = int(min_heartbeats)
+        self.interval_s = float(interval_s)
+        self.interval_idle_s = float(interval_idle_s)
+        self.max_batch = int(max_batch)
+        self.max_pending_joins = int(max_pending_joins)
+        self.round_timeout_s = round_timeout_s
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.sweep_max_rounds = int(sweep_max_rounds)
+        if metrics is None:
+            # Default to the gateway's registry so membership.* counters
+            # land next to the gateway.*/repair.* families it reports.
+            metrics = getattr(getattr(gateway, "metrics", None),
+                              "base", None)
+        self.metrics = metrics if metrics is not None else METRICS
+        self.bucket = TokenBucket(rate_rows_s, burst_rows)
+
+        self._lock = threading.Lock()
+        self._pending: Deque[Tuple[int, int]] = collections.deque()
+        self._pending_joins = 0
+        self._members: Dict[int, _Member] = {}
+        self._recent_transfers: Deque[Tuple[int, int]] = \
+            collections.deque(maxlen=64)
+
+        # Host mirror of the device table: ALL table ids (sorted
+        # ascending, dead rows included) + parallel alive flags, seeded
+        # from one download of the engine's current chained state.
+        state = self.engine.ring_snapshot()
+        if state is None:
+            raise ValueError(f"ring {ring_id!r} has no RingState; a "
+                             f"membership manager needs a device ring")
+        ids_np = np.asarray(state.ids)
+        alive_np = np.asarray(state.alive)
+        nv = int(state.n_valid)
+        self._mirror_ids: List[int] = lanes_to_ints(ids_np[:nv])
+        self._mirror_alive: List[bool] = [bool(a) for a in alive_np[:nv]]
+        self.capacity = int(ids_np.shape[0])
+
+        # Loop state (written by step()/the loop thread).
+        self.rounds = 0
+        self.batches_applied = 0
+        self.rows_applied = 0
+        self.sweep_rounds = 0
+        self.rows_regenerated = 0
+        self.converged = True
+        self.stalled = False
+        self._noop_rounds = 0
+        self._maintain_due = False
+        self.failures = 0
+        self.backoff_s = 0.0
+        self.last_error: Optional[str] = None
+
+        self._stop = threading.Event()
+        self._started = False
+        self._thread: Optional[threading.Thread] = None
+
+        # Attach: the gateway's handoff-failover path and the wire
+        # verbs (JOIN_RING / HEARTBEAT / MEMBER_STATUS) find us here.
+        self.backend.membership = self
+        gateway.attach_membership(self)
+
+    # -- wire-facing membership API ------------------------------------------
+    def request_join(self, member_id: int) -> bool:
+        """Admit one join (JOIN_RING): bounded per-ring admission —
+        an over-budget request is refused visibly, never queued
+        without limit. Returns acceptance; the id enters the ring at
+        the next applied churn batch."""
+        member_id = int(member_id) % KEYS_IN_RING
+        now = time.monotonic()
+        with self._lock:
+            if self._pending_joins >= self.max_pending_joins:
+                self.metrics.inc(
+                    f"membership.join_rejected.{self.ring_id}")
+                return False
+            i = bisect.bisect_left(self._mirror_ids, member_id)
+            already = (i < len(self._mirror_ids)
+                       and self._mirror_ids[i] == member_id
+                       and self._mirror_alive[i])
+            m = self._members.get(member_id)
+            if already and (m is None or m.state in (ALIVE, SUSPECT)):
+                # Already a live member: idempotent accept, nothing to
+                # enqueue (the reference's rejoin-under-same-id mode
+                # only matters for DEAD rows). This is also how a
+                # member SEEDED from the ring's initial table opts into
+                # failure detection: registering here creates its
+                # tracking entry, and its heartbeats take over.
+                self._members.setdefault(
+                    member_id, _Member(member_id, ALIVE, now))
+                return True
+            if m is not None and m.state == JOINING:
+                # A retry racing the still-pending first row: one
+                # OP_JOIN lane is enough — a duplicate would be
+                # device-rejected and miscounted as an admission
+                # refusal, and would burn token budget in a storm.
+                return True
+            self._members[member_id] = _Member(member_id, JOINING, now)
+            self._pending.append((OP_JOIN, member_id))
+            self._pending_joins += 1
+        self.metrics.inc(f"membership.join_requests.{self.ring_id}")
+        return True
+
+    def heartbeat(self, member_id: int) -> bool:
+        """Record one heartbeat; returns False for unknown members
+        (they must JOIN_RING first — counted, not an error)."""
+        member_id = int(member_id) % KEYS_IN_RING
+        now = time.monotonic()
+        with self._lock:
+            m = self._members.get(member_id)
+            if m is None or m.state in (FAILED, LEFT):
+                self.metrics.inc(
+                    f"membership.heartbeat_unknown.{self.ring_id}")
+                return False
+            dt = now - m.last_heard
+            if m.n_heartbeats > 0:
+                m.mean_interval_s = (dt if m.mean_interval_s is None
+                                     else 0.8 * m.mean_interval_s
+                                     + 0.2 * dt)
+            m.n_heartbeats += 1
+            m.last_heard = now
+            if m.state == SUSPECT:
+                m.state = ALIVE
+                self.metrics.inc(
+                    f"membership.suspicion_cleared.{self.ring_id}")
+        self.metrics.inc(f"membership.heartbeats.{self.ring_id}")
+        return True
+
+    def request_leave(self, member_id: int) -> bool:
+        """Graceful leave: custody hands to the successor at the next
+        applied batch (core.churn.leave semantics)."""
+        return self._enqueue_departure(member_id, OP_LEAVE)
+
+    def fail_member(self, member_id: int) -> bool:
+        """Explicit failure injection (the detector's path, exposed for
+        tests/benches and operator kill)."""
+        return self._enqueue_departure(member_id, OP_FAIL)
+
+    def _enqueue_departure(self, member_id: int, op: int) -> bool:
+        member_id = int(member_id) % KEYS_IN_RING
+        now = time.monotonic()
+        with self._lock:
+            i = bisect.bisect_left(self._mirror_ids, member_id)
+            known = (i < len(self._mirror_ids)
+                     and self._mirror_ids[i] == member_id
+                     and self._mirror_alive[i])
+            if not known:
+                return False
+            m = self._members.get(member_id)
+            if m is not None and m.state in (FAILED, LEFT):
+                # Already departing (e.g. the detector's OP_FAIL racing
+                # an operator kill): one row is enough — duplicates
+                # would double-count lost_rows and burn tokens.
+                return True
+            m = self._members.setdefault(
+                member_id, _Member(member_id, ALIVE, now))
+            m.state = LEFT if op == OP_LEAVE else FAILED
+            self._pending.append((op, member_id))
+        return True
+
+    # -- failure detection ----------------------------------------------------
+    def _phi(self, m: _Member, now: float) -> float:
+        scale = max(m.mean_interval_s or 0.0, self.heartbeat_interval_s)
+        return (now - m.last_heard) / scale
+
+    def _detect_failures_locked(self, now: float) -> int:
+        """Scan members, enqueue OP_FAIL for those past the suspicion
+        threshold. Caller holds the lock."""
+        enqueued = 0
+        for m in self._members.values():
+            if m.state not in (ALIVE, SUSPECT):
+                continue
+            if m.n_heartbeats < self.min_heartbeats:
+                # Not enough evidence to model this member's cadence —
+                # the no-premature-verdict rule.
+                continue
+            phi = self._phi(m, now)
+            if phi >= self.phi_threshold:
+                m.state = FAILED
+                self._pending.append((OP_FAIL, m.member_id))
+                self.metrics.inc(
+                    f"membership.failures_detected.{self.ring_id}")
+                enqueued += 1
+            elif phi >= self.phi_threshold / 2 and m.state == ALIVE:
+                m.state = SUSPECT
+                self.metrics.inc(
+                    f"membership.suspects.{self.ring_id}")
+        return enqueued
+
+    # -- the control round ----------------------------------------------------
+    def step(self) -> dict:
+        """One foreground control round (the deterministic form tests,
+        the bench, and the dryrun drive; the background loop calls the
+        same thing). Detect -> batch -> apply -> sweep."""
+        from p2p_dhts_tpu.gateway.admission import Deadline
+
+        now = time.monotonic()
+        with self._lock:
+            self._detect_failures_locked(now)
+        granted = self.bucket.take(self.max_batch)
+        batch: List[Tuple[int, int]] = []
+        with self._lock:
+            while self._pending and len(batch) < granted:
+                batch.append(self._pending.popleft())
+            for op, _ in batch:
+                if op == OP_JOIN:
+                    self._pending_joins -= 1
+        self.bucket.refund(granted - len(batch))
+
+        applied_n = 0
+        lost_rows = 0
+        if batch:
+            dl = Deadline.from_timeout(self.round_timeout_s)
+            self.backend.begin_handoff()
+            try:
+                flags = self.gateway.churn_apply_many(
+                    batch, ring_id=self.ring_id, deadline=dl)
+                with self._lock:
+                    applied_n, lost_rows = self._apply_to_mirror_locked(
+                        batch, flags, time.monotonic())
+                # Fallback-path snapshot: the engine's chained state
+                # already includes this batch (FIFO), so the swap and
+                # the mirror update close the handoff window together.
+                self.backend.set_ring_state(self.engine.ring_snapshot())
+            except BaseException:
+                # Nothing applied: the rows go back to the FRONT of
+                # the queue (order preserved) and their tokens return.
+                with self._lock:
+                    self._pending.extendleft(reversed(batch))
+                    self._pending_joins += sum(
+                        1 for op, _ in batch if op == OP_JOIN)
+                self.bucket.refund(len(batch))
+                raise
+            finally:
+                self.backend.end_handoff()
+            self.metrics.inc(f"membership.batches.{self.ring_id}")
+            self.metrics.inc(f"membership.rows_applied.{self.ring_id}",
+                             applied_n)
+            self.batches_applied += 1
+            self.rows_applied += applied_n
+            self.converged = False
+            self._maintain_due = self._maintain_due or lost_rows > 0
+
+        # Stabilize pacing: one sweep per round while unconverged,
+        # bounded per step so a wedged ring cannot monopolize the loop.
+        sweeps = 0
+        while not self.converged and sweeps < self.sweep_max_rounds:
+            dl = Deadline.from_timeout(self.round_timeout_s)
+            self.converged = bool(self.gateway.stabilize_ring(
+                self.ring_id, deadline=dl))
+            self.sweep_rounds += 1
+            sweeps += 1
+            if not batch and sweeps >= 1:
+                break  # idle rounds sweep at most once
+
+        # Targeted heals for the transferred ranges, once the sweep has
+        # re-tiled custody: one paced local-maintenance pass purges the
+        # dead-held rows and regenerates every >= m-survivor block
+        # in-ring; the purge makes the loss digest-visible, and the
+        # nudged repair pairs heal the rest from replicas.
+        regenerated = 0
+        if self._maintain_due and self.converged:
+            dl = Deadline.from_timeout(self.round_timeout_s)
+            if getattr(self.engine, "has_store", False):
+                regenerated = self.gateway.dhash_maintain(
+                    self.ring_id, deadline=dl)
+                self.rows_regenerated += regenerated
+                if regenerated:
+                    self.metrics.inc(
+                        f"membership.rows_regenerated.{self.ring_id}",
+                        regenerated)
+            self._maintain_due = False
+            nudged = self.gateway.nudge_repair(self.ring_id)
+            if nudged:
+                self.metrics.inc(
+                    f"membership.heal_enqueued.{self.ring_id}", nudged)
+
+        # Stall detection (the PR-6 rule): work pends but two
+        # consecutive rounds applied nothing — flip visible, idle-pace.
+        if batch and applied_n == 0:
+            self._noop_rounds += 1
+            self.metrics.inc(
+                f"membership.stalled_rounds.{self.ring_id}")
+        elif batch:
+            self._noop_rounds = 0
+        self.stalled = self._noop_rounds >= 2
+
+        self.rounds += 1
+        with self._lock:
+            pending = len(self._pending)
+            alive = sum(1 for a in self._mirror_alive if a)
+        self.metrics.gauge(f"membership.pending.{self.ring_id}", pending)
+        self.metrics.gauge(f"membership.members_alive.{self.ring_id}",
+                           alive)
+        self.metrics.gauge(f"membership.converged.{self.ring_id}",
+                           1.0 if self.converged else 0.0)
+        return {"batched": len(batch), "applied": applied_n,
+                "lost_rows": lost_rows, "pending": pending,
+                "converged": self.converged, "sweeps": sweeps,
+                "regenerated": regenerated,
+                "maintain_due": self._maintain_due,
+                "alive": alive, "stalled": self.stalled}
+
+    def _apply_to_mirror_locked(self, batch: Sequence[Tuple[int, int]],
+                                flags: Sequence[bool], now: float
+                                ) -> Tuple[int, int]:
+        """Mirror the kernel's per-lane outcomes onto the host table.
+        Returns (applied rows, lost rows i.e. applied fails+leaves).
+        Caller holds the lock."""
+        applied = 0
+        lost = 0
+        for (op, member_id), ok in zip(batch, flags):
+            m = self._members.get(member_id)
+            if not ok:
+                if op == OP_JOIN:
+                    # Rejected by the device (duplicate / capacity):
+                    # visible, and the member entry does not linger as
+                    # a zombie the detector would later "fail".
+                    self.metrics.inc(
+                        f"membership.join_rejected.{self.ring_id}")
+                    if m is not None and m.state == JOINING:
+                        del self._members[member_id]
+                continue
+            applied += 1
+            i = bisect.bisect_left(self._mirror_ids, member_id)
+            present = (i < len(self._mirror_ids)
+                       and self._mirror_ids[i] == member_id)
+            if op == OP_JOIN:
+                if present:
+                    self._mirror_alive[i] = True   # rejoin/resurrect
+                else:
+                    self._mirror_ids.insert(i, member_id)
+                    self._mirror_alive.insert(i, True)
+                if m is not None:
+                    m.state = ALIVE
+                    m.last_heard = now  # grace until first heartbeat
+                self._recent_transfers.append(
+                    self._owned_range_locked(member_id))
+            else:
+                if present:
+                    self._mirror_alive[i] = False
+                # Departed entries leave the member table once applied:
+                # the detector never re-scans them, heartbeats answer
+                # KNOWN:false (rejoin), and the table stays bounded by
+                # the ACTIVE membership under unbounded churn of
+                # unique ids.
+                self._members.pop(member_id, None)
+                self._recent_transfers.append(
+                    self._owned_range_locked(member_id))
+                lost += 1
+        if applied:
+            self.metrics.inc(
+                f"membership.ranges_transferred.{self.ring_id}", applied)
+        return applied, lost
+
+    def _owned_range_locked(self, member_id: int) -> Tuple[int, int]:
+        """[pred_alive_id + 1, member_id]: the key range whose custody
+        the op transferred (to the member on join, to its successor on
+        fail/leave)."""
+        n = len(self._mirror_ids)
+        i = bisect.bisect_left(self._mirror_ids, member_id)
+        j = (i - 1) % n if n else 0
+        for _ in range(max(n - 1, 0)):
+            if self._mirror_alive[j] and self._mirror_ids[j] != member_id:
+                break
+            j = (j - 1) % n
+        lo = (self._mirror_ids[j] + 1) % KEYS_IN_RING if n else 0
+        return (lo, member_id)
+
+    # -- host-mirror resolution (the handoff closed form) ---------------------
+    def owner_row(self, key_int: int) -> int:
+        """Device row of the alive ring successor of `key_int`,
+        resolved on the HOST mirror (bisect + alive scan). Mirror row
+        indices ARE device rows (same sorted table, dead rows kept), so
+        this is the closed-form twin of core.ring.owner_of — the
+        never-wrong answer the gateway serves during a handoff window.
+        -1 when no member is alive."""
+        key_int = int(key_int) % KEYS_IN_RING
+        with self._lock:
+            n = len(self._mirror_ids)
+            if n == 0:
+                return -1
+            i = bisect.bisect_left(self._mirror_ids, key_int)
+            for k in range(n):
+                j = (i + k) % n
+                if self._mirror_alive[j]:
+                    return j
+        return -1
+
+    def alive_ids(self) -> List[int]:
+        with self._lock:
+            return [pid for pid, a in zip(self._mirror_ids,
+                                          self._mirror_alive) if a]
+
+    def mirror_snapshot(self) -> Tuple[List[int], List[bool]]:
+        with self._lock:
+            return list(self._mirror_ids), list(self._mirror_alive)
+
+    @property
+    def pending_ops(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def recent_transfers(self) -> List[Tuple[int, int]]:
+        with self._lock:
+            return list(self._recent_transfers)
+
+    # -- foreground driving ---------------------------------------------------
+    def quiesce(self, max_rounds: int = 64) -> dict:
+        """Drive step() until nothing pends and the ring converged —
+        the bounded post-storm convergence the bench smoke asserts.
+        Raises on stall or budget exhaustion."""
+        last: dict = {}
+        for _ in range(int(max_rounds)):
+            last = self.step()
+            if self.stalled:
+                raise RuntimeError(
+                    f"membership ring {self.ring_id!r} STALLED: "
+                    f"{last['pending']} ops pend but rounds apply "
+                    f"nothing (capacity full? duplicate storm?)")
+            if last["pending"] == 0 and last["batched"] == 0 \
+                    and last["converged"] and not last["maintain_due"]:
+                return last
+        raise RuntimeError(
+            f"membership ring {self.ring_id!r} did not quiesce within "
+            f"{max_rounds} rounds ({last})")
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "MembershipManager":
+        with self._lock:
+            if self._started:
+                return self
+            if self._stop.is_set():
+                raise RuntimeError("MembershipManager is closed")
+            self._started = True
+        self._thread = threading.Thread(
+            target=self._run, name=f"membership-{self.ring_id}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        # Jittered start: N managers must not batch in lockstep.
+        self._stop.wait(random.uniform(0, self.interval_s))
+        while not self._stop.is_set():
+            busy = False
+            try:
+                summary = self.step()
+                busy = summary["batched"] > 0 or not summary["converged"]
+                self.failures = 0
+                self.backoff_s = 0.0
+                self.last_error = None
+            # chordax-lint: disable=bare-except -- the control loop must survive any round failure; it is counted, logged and backed off
+            except Exception as exc:  # noqa: BLE001 — backoff + retry
+                self.failures += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                self.metrics.inc(
+                    f"membership.round_failures.{self.ring_id}")
+                base = min(self.backoff_base_s * (2 ** (self.failures - 1)),
+                           self.backoff_cap_s)
+                self.backoff_s = random.uniform(base * 0.5, base)
+                logger.warning("membership ring %r round failed (%s); "
+                               "backing off %.2fs", self.ring_id,
+                               self.last_error, self.backoff_s,
+                               exc_info=exc)
+            wait = self.backoff_s if self.backoff_s else (
+                self.interval_s if busy and not self.stalled
+                else self.interval_idle_s)
+            self._stop.wait(wait)
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"membership loop {self.ring_id!r} did not stop "
+                    f"within {timeout}s")
+
+    def __enter__(self) -> "MembershipManager":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- observability --------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            by_state: Dict[str, int] = {}
+            for m in self._members.values():
+                by_state[m.state] = by_state.get(m.state, 0) + 1
+            pending = len(self._pending)
+            alive = sum(1 for a in self._mirror_alive if a)
+            table = len(self._mirror_ids)
+        return {
+            "ring": self.ring_id,
+            "capacity": self.capacity,
+            "table_rows": table,
+            "alive": alive,
+            "members": by_state,
+            "pending_ops": pending,
+            "rounds": self.rounds,
+            "batches_applied": self.batches_applied,
+            "rows_applied": self.rows_applied,
+            "sweep_rounds": self.sweep_rounds,
+            "rows_regenerated": self.rows_regenerated,
+            "converged": self.converged,
+            "stalled": self.stalled,
+            "failures": self.failures,
+            "backoff_s": round(self.backoff_s, 3),
+            "last_error": self.last_error,
+            "tokens": round(self.bucket.tokens, 1),
+        }
+
+
+# ---------------------------------------------------------------------------
+# host-overlay join pool (the chord_peer mass-churn wedge fix)
+# ---------------------------------------------------------------------------
+
+_JOIN_POOL_LOCK = threading.Lock()
+_JOIN_POOL: Optional[ThreadPoolExecutor] = None
+
+
+def overlay_join_executor() -> ThreadPoolExecutor:
+    """The process-wide pool JOIN handlers defer their recursive
+    pred-resolution onto (net.rpc.DeferredResponse): a storm of
+    simultaneous joiners occupies THIS pool while the server's 3
+    reference workers stay free to answer the nested GET_PRED/GET_SUCC
+    requests the join work itself issues — the mass-churn wedge
+    (overlay/chord_peer.py) dissolves instead of timing out."""
+    global _JOIN_POOL
+    with _JOIN_POOL_LOCK:
+        if _JOIN_POOL is None:
+            _JOIN_POOL = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="membership-join")
+        return _JOIN_POOL

@@ -45,6 +45,31 @@ class RpcError(RuntimeError):
     """Transport- or protocol-level RPC failure."""
 
 
+class DeferredResponse:
+    """Handler return marker: finish this request OFF the server's
+    worker pool.
+
+    A handler that must issue nested RPCs (the JOIN handler's
+    recursive pred-resolution) returning one of these frees its server
+    worker immediately: the connection's ownership moves to `executor`,
+    which runs `fn(request)`, wraps the result in the normal
+    SUCCESS/ERRORS envelope, and sends the reply. With the reference's
+    3 io workers per server (server.h:294-307), >3 simultaneous JOINs
+    used to occupy every worker while each join's nested GET_PRED to
+    the same server starved behind them — a wedge the reference sleeps
+    out (sleep(20)/sleep(40) in its tests) and this dissolves.
+
+    Only servers advertising `supports_deferred` honor it (the native
+    C++ engine's dispatch is synchronous); handlers must check before
+    returning one."""
+
+    __slots__ = ("fn", "executor")
+
+    def __init__(self, fn: Handler, executor):
+        self.fn = fn
+        self.executor = executor
+
+
 def sanitize_json(payload: str) -> str:
     """Drop garbage after the final '}' (ref SanitizeJson,
     client.cpp:36-49). The C++ version appends '}' per split chunk — which
@@ -219,6 +244,10 @@ class Client:
 class Server:
     """Threaded request server (ref class Server, server.h:216-431)."""
 
+    #: This server honors DeferredResponse handler returns (the native
+    #: C++ server does not — its dispatch callback is synchronous).
+    supports_deferred = True
+
     def __init__(self, port: int, handlers: Dict[str, Handler],
                  num_threads: int = 3, logging_enabled: bool = False,
                  host: str = "127.0.0.1"):
@@ -379,36 +408,80 @@ class Server:
                 return  # pool shut down
 
     def _serve_connection(self, conn: socket.socket) -> None:
+        deferred = False
         try:
-            with conn:
-                conn.settimeout(DEFAULT_TIMEOUT_S)
-                chunks = []
-                while True:
-                    chunk = conn.recv(65536)
-                    if not chunk:
-                        break
-                    chunks.append(chunk)
-                raw = b"".join(chunks).decode("utf-8", errors="replace")
-                resp: JsonObj
+            conn.settimeout(DEFAULT_TIMEOUT_S)
+            chunks = []
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+            raw = b"".join(chunks).decode("utf-8", errors="replace")
+            resp: JsonObj
+            req: Optional[JsonObj] = None
+            try:
+                req = json.loads(raw)
+            except json.JSONDecodeError as exc:
+                resp = {"SUCCESS": False, "ERRORS": str(exc)}
+            else:
+                if self.logging_enabled:
+                    self.request_log.push_back(req)
+                resp = self._process(req)
+            if isinstance(resp, DeferredResponse):
+                # Connection ownership moves to the deferred executor;
+                # THIS worker is free for the next request (the nested
+                # RPCs the deferred work issues may land right here).
+                deferred = True
                 try:
-                    req = json.loads(raw)
-                except json.JSONDecodeError as exc:
-                    resp = {"SUCCESS": False, "ERRORS": str(exc)}
-                else:
-                    if self.logging_enabled:
-                        self.request_log.push_back(req)
-                    resp = self._process(req)
-                conn.sendall(json.dumps(
-                    resp, separators=(",", ":")).encode())
-                try:
-                    conn.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
+                    resp.executor.submit(self._finish_deferred, conn,
+                                         req, resp.fn)
+                except RuntimeError:
+                    # Executor shut down (teardown race): finish
+                    # inline — slower, but the caller still gets its
+                    # reply and the connection never leaks.
+                    self._finish_deferred(conn, req, resp.fn)
+                return
+            self._send_reply(conn, resp)
         except OSError:
             pass  # connection dropped; one-shot protocol, nothing to do
         finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
+            if not deferred:
+                self._release_conn(conn)
+
+    def _send_reply(self, conn: socket.socket, resp: JsonObj) -> None:
+        conn.sendall(json.dumps(resp, separators=(",", ":")).encode())
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def _release_conn(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _finish_deferred(self, conn: socket.socket, req: JsonObj,
+                         fn: Handler) -> None:
+        """Run a deferred handler on its executor thread and complete
+        the envelope + reply (the tail of _process/_serve_connection,
+        off the worker pool)."""
+        try:
+            try:
+                resp = fn(req) or {}
+                resp["SUCCESS"] = True
+            # chordax-lint: disable=bare-except -- reference envelope parity, the _process rule applied to deferred completion
+            except Exception as exc:
+                METRICS.inc("rpc.server.handler_error")
+                resp = {"SUCCESS": False, "ERRORS": str(exc)}
+            self._send_reply(conn, resp)
+        except OSError:
+            pass  # client went away; one-shot protocol
+        finally:
+            self._release_conn(conn)
 
     def _process(self, req: JsonObj) -> JsonObj:
         """Dispatch + envelope (ref Session::HandleRead/ProcessRequest,
@@ -436,6 +509,10 @@ class Server:
                 if handler is None:
                     raise RuntimeError("Invalid command.")
                 resp = handler(req) or {}
+            if isinstance(resp, DeferredResponse):
+                # Envelope + send happen in _finish_deferred on the
+                # deferred executor; the caller routes the connection.
+                return resp
             resp["SUCCESS"] = True
             return resp
         # chordax-lint: disable=bare-except -- reference envelope parity: handler errors become SUCCESS:false (server.h:151-165)

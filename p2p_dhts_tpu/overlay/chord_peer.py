@@ -159,8 +159,28 @@ class AbstractChordPeer:
         self.fix_other_fingers(self.id)
         self.start_maintenance()
 
-    def join_handler(self, req: JsonObj) -> JsonObj:
-        """ref JoinHandler (abstract_chord_peer.cpp:119-136)."""
+    def join_handler(self, req: JsonObj):
+        """ref JoinHandler (abstract_chord_peer.cpp:119-136).
+
+        Mass-churn wedge fix (ISSUE 7): the handler's recursive
+        pred-resolution (get_predecessor -> GET_PRED/GET_SUCC chains)
+        used to run ON a server worker — with the reference's 3-worker
+        pool, >3 simultaneous joiners occupied every worker while each
+        join's nested RPCs to this same server starved behind them,
+        wedging until the client timeout. The join work now hands off
+        to the membership join pool (net.rpc.DeferredResponse): the
+        worker frees immediately and the nested lookups land on it.
+        Servers without deferred support (the native C++ engine) keep
+        the reference-faithful inline path."""
+        if getattr(self.server, "supports_deferred", False):
+            from p2p_dhts_tpu.membership.manager import \
+                overlay_join_executor
+            from p2p_dhts_tpu.net.rpc import DeferredResponse
+            return DeferredResponse(self._join_handler_impl,
+                                    overlay_join_executor())
+        return self._join_handler_impl(req)
+
+    def _join_handler_impl(self, req: JsonObj) -> JsonObj:
         new_peer = RemotePeer.from_json(req["NEW_PEER"])
         new_peer_pred = self.get_predecessor(new_peer.id)
         self.finger_table.adjust_fingers(new_peer)

@@ -33,14 +33,16 @@ from p2p_dhts_tpu.repair.replication import (  # noqa: F401
     ReplicationPolicy,
 )
 from p2p_dhts_tpu.repair.scheduler import (  # noqa: F401
+    DriftRoundResult,
     RepairScheduler,
     RoundResult,
     TokenBucket,
+    run_drift_round,
     run_sync_round,
 )
 
 __all__ = [
-    "PutOutcome", "QuorumWriteError", "RepairScheduler",
-    "ReplicatedWriter", "ReplicationPolicy", "RoundResult", "TokenBucket",
-    "run_sync_round",
+    "DriftRoundResult", "PutOutcome", "QuorumWriteError",
+    "RepairScheduler", "ReplicatedWriter", "ReplicationPolicy",
+    "RoundResult", "TokenBucket", "run_drift_round", "run_sync_round",
 ]

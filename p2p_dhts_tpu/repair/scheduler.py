@@ -263,6 +263,118 @@ def run_sync_round(gateway, ring_a: str, ring_b: str, *,
                        unhealable, deferred)
 
 
+class DriftRoundResult(NamedTuple):
+    ring: str
+    converged: bool          # nothing left to restore this round
+    leaf_diffs: int          # differing buckets vs the baseline index
+    candidates: int          # baseline keys in differing buckets
+    healed: int              # keys re-put onto the live ring
+    unhealable: int          # unreadable in the baseline too
+    deferred: int            # token/bound-shed candidates
+
+
+def run_drift_round(gateway, ring_id: str, baseline_store, *,
+                    max_keys: int = 256,
+                    max_heal: Optional[int] = None,
+                    deadline=None,
+                    metrics: Optional[Metrics] = None
+                    ) -> DriftRoundResult:
+    """One INTRA-ring anti-entropy round: the live store against a
+    reference FragmentStore (typically a checkpoint restore,
+    checkpoint.py) — the scheduler-driven form of
+    dhash.antientropy.reconcile's drift-repair use case. Keys the
+    baseline holds in differing leaf buckets that the live ring can no
+    longer read are decoded FROM THE BASELINE (content-level,
+    liveness-forced like store_index's contract) and re-put through
+    the gateway, so checkpoint drift heals on the same engine-ordered
+    path — and, under RepairScheduler.add_drift, the same token-bucket
+    cadence — as cross-ring repair. One-directional on purpose: keys
+    created since the checkpoint differ too but need no restore, so
+    convergence means "nothing left to heal", not "digests equal"."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from p2p_dhts_tpu.dhash.antientropy import store_index
+    from p2p_dhts_tpu.dhash.merkle import MerkleIndex
+    from p2p_dhts_tpu.dhash.store import read_batch
+    from p2p_dhts_tpu.gateway.admission import NO_DEADLINE
+    from p2p_dhts_tpu.keyspace import ints_to_lanes, lanes_to_ints
+    from p2p_dhts_tpu.repair import kernels
+
+    mets = metrics if metrics is not None else METRICS
+    dl = deadline if deadline is not None else NO_DEADLINE
+    backend = gateway.router.get(ring_id)
+    depth, fanout_bits = getattr(backend.engine, "merkle_shape", (4, 3))
+    mets.inc("repair.drift_rounds")
+
+    live = gateway.sync_digest(ring_id, deadline=dl)  # engine-ordered
+    ia = MerkleIndex(levels=tuple(jnp.asarray(l) for l in live.levels),
+                     counts=jnp.asarray(live.counts))
+    ib = store_index(baseline_store, depth, fanout_bits)
+    leaf_diff, _nodes = kernels.merkle_diff(ia, ib)
+    leaf_diffs = int(jnp.sum(leaf_diff))
+    if leaf_diffs == 0:
+        return DriftRoundResult(ring_id, True, 0, 0, 0, 0, 0)
+
+    cand, ok = kernels.delta_scan(baseline_store, leaf_diff, depth,
+                                  fanout_bits, max_keys)
+    ok_np = np.asarray(ok)
+    cand_ints = [k for j, k in enumerate(lanes_to_ints(np.asarray(cand)))
+                 if ok_np[j]]
+    candidates = len(cand_ints)
+    heal_n = candidates if max_heal is None else min(candidates,
+                                                    int(max_heal))
+    deferred = candidates - heal_n
+    probe = cand_ints[:heal_n]
+    healed = unhealable = 0
+    if probe:
+        reads = gateway.dhash_get_many(probe, ring_id=ring_id,
+                                       deadline=dl)
+        missing = [k for k, (_, live_ok) in zip(probe, reads)
+                   if not bool(live_ok)]
+        if missing:
+            # Decode the missing blocks from the BASELINE store. The
+            # batch pads to max_keys (one traced program per drift
+            # config) and the ring view forces every valid row alive:
+            # a checkpoint's holders may have died since, but the
+            # content is exactly what the restore is for
+            # (antientropy.store_index's liveness-agnostic rule).
+            state = backend.engine.ring_snapshot()
+            if state is None:
+                state = backend.ring_state
+            if state is None:
+                raise RuntimeError(
+                    f"ring {ring_id!r} has no RingState for a drift "
+                    f"decode")
+            rows = jnp.arange(state.ids.shape[0], dtype=jnp.int32)
+            all_alive = state._replace(alive=rows < state.n_valid)
+            n, m, p = backend.engine.ida_params
+            padded = missing + [missing[0]] * (max_keys - len(missing))
+            segs, ok_b = read_batch(all_alive, baseline_store,
+                                    jnp.asarray(ints_to_lanes(padded)),
+                                    n, m, p)
+            segs, ok_b = np.asarray(segs), np.asarray(ok_b)
+            entries = []
+            for j, k in enumerate(missing):
+                if not ok_b[j]:
+                    unhealable += 1
+                    continue
+                seg = segs[j]  # [S, m] decoded block
+                entries.append((k, seg, _derived_length(seg), 0))
+            if entries:
+                oks = gateway.dhash_put_many(entries, ring_id=ring_id,
+                                             deadline=dl)
+                healed = sum(1 for v in oks if v)
+                if healed:
+                    mets.inc(f"repair.drift_healed.{ring_id}", healed)
+            if unhealable:
+                mets.inc("repair.drift_unhealable", unhealable)
+    converged = healed == 0 and deferred == 0
+    return DriftRoundResult(ring_id, converged, leaf_diffs, candidates,
+                            healed, unhealable, deferred)
+
+
 class _PairLoop:
     """One ring pair's background loop + pacing state."""
 
@@ -271,6 +383,10 @@ class _PairLoop:
         self.sched = sched
         self.pair = pair
         self.bucket = TokenBucket(sched.rate_keys_s, sched.burst_keys)
+        # Per-loop stop: hot remove_ring retires ONE pair while the
+        # scheduler (and its other loops) keep running; sched.close()
+        # sets every loop's event.
+        self._stop_ev = threading.Event()
         self.rounds = 0
         self.failures = 0
         self.backoff_s = 0.0
@@ -292,8 +408,8 @@ class _PairLoop:
     def _run(self) -> None:
         sched = self.sched
         # Jittered start so N pair loops never digest in lockstep.
-        sched._stop.wait(random.uniform(0, sched.interval_s))
-        while not sched._stop.is_set():
+        self._stop_ev.wait(random.uniform(0, sched.interval_s))
+        while not (sched._stop.is_set() or self._stop_ev.is_set()):
             try:
                 self.run_once()
                 self.failures = 0
@@ -315,7 +431,15 @@ class _PairLoop:
             wait = self.backoff_s if self.backoff_s else (
                 sched.interval_idle_s if (self.converged or self.stalled)
                 else sched.interval_s)
-            sched._stop.wait(wait)
+            self._stop_ev.wait(wait)
+
+    def nudge(self) -> None:
+        """Drop converged/stalled so the next round runs at active
+        cadence — an applied churn batch's transferred ranges become
+        this loop's work without waiting out the idle interval."""
+        self.converged = False
+        self.stalled = False
+        self._stall_rounds = 0
 
     def run_once(self) -> RoundResult:
         """One paced round (also the deterministic entry tests and the
@@ -398,6 +522,107 @@ class _PairLoop:
         }
 
 
+class _DriftLoop:
+    """One ring's intra-ring drift loop (live store vs a baseline
+    FragmentStore): the _PairLoop pacing discipline — token bucket,
+    jittered backoff, stall-as-converged idling — around
+    run_drift_round. Duck-types _PairLoop where the scheduler's
+    lifecycle and run_until_converged need it."""
+
+    def __init__(self, sched: "RepairScheduler", ring_id: str,
+                 baseline) -> None:
+        self.sched = sched
+        self.ring_id = str(ring_id)
+        self.pair = (self.ring_id, "__baseline__")
+        self._baseline = baseline  # FragmentStore or () -> FragmentStore
+        self.bucket = TokenBucket(sched.rate_keys_s, sched.burst_keys)
+        self._stop_ev = threading.Event()
+        self.rounds = 0
+        self.failures = 0
+        self.backoff_s = 0.0
+        self.converged = False
+        self.stalled = False
+        self.last: Optional[DriftRoundResult] = None
+        self.last_error: Optional[str] = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"repair-drift-{ring_id}",
+            daemon=True)
+
+    def _baseline_store(self):
+        return self._baseline() if callable(self._baseline) \
+            else self._baseline
+
+    def _run(self) -> None:
+        sched = self.sched
+        self._stop_ev.wait(random.uniform(0, sched.interval_s))
+        while not (sched._stop.is_set() or self._stop_ev.is_set()):
+            try:
+                self.run_once()
+                self.failures = 0
+                self.backoff_s = 0.0
+                self.last_error = None
+            # chordax-lint: disable=bare-except -- the drift loop must survive any round failure; it is counted, logged and backed off
+            except Exception as exc:  # noqa: BLE001 — backoff + retry
+                self.failures += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                sched.metrics.inc(
+                    f"repair.round_failures.{self.ring_id}-drift")
+                base = min(sched.backoff_base_s * (2 ** (self.failures - 1)),
+                           sched.backoff_cap_s)
+                self.backoff_s = random.uniform(base * 0.5, base)
+                logger.warning("drift loop %s round failed (%s); "
+                               "backing off %.2fs", self.ring_id,
+                               self.last_error, self.backoff_s,
+                               exc_info=exc)
+            wait = self.backoff_s if self.backoff_s else (
+                sched.interval_idle_s if self.converged
+                else sched.interval_s)
+            self._stop_ev.wait(wait)
+
+    def run_once(self) -> DriftRoundResult:
+        sched = self.sched
+        granted = self.bucket.take(sched.max_keys_round)
+        try:
+            res = run_drift_round(
+                sched.gateway, self.ring_id, self._baseline_store(),
+                max_keys=sched.max_keys_round, max_heal=granted,
+                deadline=sched._round_deadline(), metrics=sched.metrics)
+        except BaseException:
+            self.bucket.refund(granted)
+            raise
+        self.bucket.refund(granted - res.healed)
+        self.rounds += 1
+        self.last = res
+        self.converged = res.converged
+        sched.metrics.gauge(f"repair.converged.{self.ring_id}-drift",
+                            1.0 if res.converged else 0.0)
+        return res
+
+    def nudge(self) -> None:
+        self.converged = False
+        self.stalled = False
+
+    def status(self) -> dict:
+        last = self.last
+        return {
+            "pair": list(self.pair),
+            "rounds": self.rounds,
+            "converged": self.converged,
+            "stalled": self.stalled,
+            "failures": self.failures,
+            "backoff_s": round(self.backoff_s, 3),
+            "tokens": round(self.bucket.tokens, 1),
+            "last_error": self.last_error,
+            "last_round": None if last is None else {
+                "leaf_diffs": last.leaf_diffs,
+                "candidates": last.candidates,
+                "healed": last.healed,
+                "unhealable": last.unhealable,
+                "deferred": last.deferred,
+            },
+        }
+
+
 class RepairScheduler:
     """Background anti-entropy over a set of ring pairs.
 
@@ -416,9 +641,13 @@ class RepairScheduler:
                  backoff_base_s: float = 0.5,
                  backoff_cap_s: float = 30.0,
                  reindex: bool = True,
+                 dynamic: bool = False,
                  metrics: Optional[Metrics] = None):
-        if not pairs:
-            raise ValueError("RepairScheduler needs at least one ring pair")
+        if not pairs and not dynamic:
+            raise ValueError("RepairScheduler needs at least one ring "
+                             "pair (or dynamic=True for hot-enrolled "
+                             "pairs)")
+        self.dynamic = bool(dynamic)
         self.gateway = gateway
         self.interval_s = float(interval_s)
         self.interval_idle_s = float(interval_idle_s)
@@ -439,6 +668,70 @@ class RepairScheduler:
         from p2p_dhts_tpu.gateway.admission import Deadline
         return Deadline.from_timeout(self.round_timeout_s)
 
+    # -- hot pair management (router add/remove auto-enrollment) -------------
+    def add_pair(self, pair: Tuple[str, str]) -> bool:
+        """Enroll one ring pair while the scheduler runs (idempotent,
+        unordered: (a, b) == (b, a)). Started schedulers spawn the new
+        loop's thread immediately. Returns whether a loop was added."""
+        a, b = str(pair[0]), str(pair[1])
+        if a == b:
+            raise ValueError(f"a repair pair needs two distinct rings, "
+                             f"got {pair}")
+        with self._lock:
+            for loop in self.loops:
+                if set(loop.pair) == {a, b}:
+                    return False
+            loop = _PairLoop(self, (a, b))
+            self.loops.append(loop)
+            started = self._started
+        self.metrics.inc("repair.pairs_enrolled")
+        if started:
+            loop.thread.start()
+        return True
+
+    def remove_ring(self, ring_id: str, timeout: float = 30.0) -> int:
+        """Retire every loop covering `ring_id` (hot remove_ring): the
+        loops stop, join, and leave the set. Returns how many retired."""
+        ring_id = str(ring_id)
+        with self._lock:
+            victims = [l for l in self.loops if ring_id in l.pair]
+            self.loops = [l for l in self.loops if ring_id not in l.pair]
+            started = self._started
+        for loop in victims:
+            loop._stop_ev.set()
+        if started:
+            for loop in victims:
+                if loop.thread.is_alive():
+                    loop.thread.join(timeout)
+        if victims:
+            self.metrics.inc("repair.pairs_retired", len(victims))
+        return len(victims)
+
+    def nudge(self, ring_id: str) -> int:
+        """Wake the loops covering `ring_id` out of converged/stalled
+        idling (the membership control plane's targeted-heal enqueue).
+        Returns the number of loops nudged."""
+        ring_id = str(ring_id)
+        with self._lock:
+            loops = [l for l in self.loops if ring_id in l.pair]
+        for loop in loops:
+            loop.nudge()
+        return len(loops)
+
+    def add_drift(self, ring_id: str, baseline) -> "_DriftLoop":
+        """Enroll one INTRA-ring drift loop: the named ring's live
+        store reconciles against `baseline` (a FragmentStore, or a
+        zero-arg callable returning one — e.g. a checkpoint restore)
+        on the same token-bucket cadence as the cross-ring pairs."""
+        loop = _DriftLoop(self, ring_id, baseline)
+        with self._lock:
+            self.loops.append(loop)
+            started = self._started
+        self.metrics.inc("repair.drift_enrolled")
+        if started:
+            loop.thread.start()
+        return loop
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "RepairScheduler":
         with self._lock:
@@ -447,7 +740,8 @@ class RepairScheduler:
             if self._stop.is_set():
                 raise RuntimeError("RepairScheduler is closed")
             self._started = True
-        for loop in self.loops:
+            loops = list(self.loops)
+        for loop in loops:
             loop.thread.start()
         return self
 
@@ -455,9 +749,14 @@ class RepairScheduler:
         self._stop.set()
         with self._lock:
             started = self._started
+            loops = list(self.loops)
+        for loop in loops:
+            loop._stop_ev.set()
         if not started:
             return
-        for loop in self.loops:
+        for loop in loops:
+            if not loop.thread.is_alive() and loop.thread.ident is None:
+                continue  # enrolled after close raced start; never ran
             loop.thread.join(timeout)
             if loop.thread.is_alive():
                 raise TimeoutError(

@@ -70,6 +70,32 @@ Request kinds:
     means a digest observes every put submitted before it, and the
     reindex store-swap chains/rolls back exactly like a put batch — a
     repair op can never race or fork the serving store.
+  * "churn_apply" / "stabilize_sweep" — the chordax-membership control
+    plane's ops (ISSUE 7): the engine's RingState becomes MUTABLE
+    behind live traffic. churn_apply (payload (op_code, member_id))
+    applies one membership op per lane — batched join/leave/fail rows
+    (membership.kernels.churn_apply_impl) — and returns whether the
+    lane's op was admitted; stabilize_sweep (payload ()) runs one
+    whole-ring maintenance sweep and returns the placement_converged
+    verdict. Both are RING-state mutators: they chain the state and
+    epoch-roll-back on failure exactly like a put batch does the
+    store, and they ride the FIFO queue so a lookup NEVER observes a
+    half-applied membership change — a request submitted before a
+    churn batch resolves against the pre-churn ring, one submitted
+    after it against the post-churn ring, with zero retraces either
+    way (the ring's capacity padding keeps every shape fixed). On a
+    store-carrying engine churn_apply is ALSO store-mutating: graceful
+    leavers hand their fragments to the alive successor and every
+    holder row remaps through its peer id in the same program, so the
+    state and store can never disagree about who holds what.
+  * "dhash_maintain" — dhash.maintenance.local_maintenance as an
+    engine kind: purge dead-held rows, regenerate missing fragments of
+    every block with >= m survivors onto their designated alive
+    holders. Store-mutating (chains + rolls back like a put). The
+    membership manager paces this after lossy churn batches; the purge
+    is what makes holder-death visible to the (content-level) Merkle
+    digests, so cross-ring anti-entropy can heal the blocks that fell
+    below m.
 
 Per-stage metrics (queue depth, batch fill, window size, request
 latency) record into `p2p_dhts_tpu.metrics` gauges/histograms under
@@ -88,7 +114,15 @@ from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
 KINDS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
-         "sync_digest", "repair_reindex")
+         "sync_digest", "repair_reindex", "churn_apply",
+         "stabilize_sweep", "dhash_maintain")
+
+#: Kinds that mutate the engine's store or ring state: they stay off
+#: the caller-inline fast path (their read-modify-write must never
+#: race a concurrently-dispatched mutator) and chain + epoch-roll-back
+#: through the dispatcher.
+_MUTATOR_KINDS = ("dhash_put", "repair_reindex", "churn_apply",
+                  "stabilize_sweep", "dhash_maintain")
 
 _SENTINEL = object()
 
@@ -225,6 +259,11 @@ class ServeEngine:
         # rollback already discarded (skip — completions are FIFO, so
         # the chain's first failure did the restore).
         self._store_epoch = 0
+        # Ring-state chaining (the membership control plane): churn
+        # kinds swap self._state exactly like puts swap the store;
+        # _ring_epoch is the state's rollback epoch, same discipline as
+        # _store_epoch above.
+        self._ring_epoch = 0
         # True while the dispatcher is between popping a batch and
         # finishing its launch (for puts: the store swap). The
         # caller-inline fast path must not run then — a fast-path get
@@ -335,10 +374,12 @@ class ServeEngine:
         requests under overload)."""
         if kind not in KINDS:
             raise ValueError(f"unknown request kind {kind!r}")
-        if kind == "find_successor" and self._state is None:
-            raise ValueError("engine has no RingState; find_successor "
+        if kind in ("find_successor", "churn_apply",
+                    "stabilize_sweep") and self._state is None:
+            raise ValueError(f"engine has no RingState; {kind} "
                              "requests need one")
-        if kind in ("dhash_get", "dhash_put", "repair_reindex") and (
+        if kind in ("dhash_get", "dhash_put", "repair_reindex",
+                    "dhash_maintain") and (
                 self._state is None or self._store is None):
             raise ValueError(f"engine has no RingState+FragmentStore; "
                              f"{kind} requests need both")
@@ -363,6 +404,20 @@ class ServeEngine:
                         f"got {seg.shape}")
                 normalized.append((payload[0], seg) + tuple(payload[2:]))
             payloads = normalized
+        if kind == "churn_apply":
+            # Same submitting-thread rule as dhash_put: a malformed op
+            # failing at batch-build time would fail every innocent
+            # request coalesced into the same batch.
+            from p2p_dhts_tpu.membership import VALID_OPS
+            normalized = []
+            for payload in payloads:
+                op = int(payload[0])
+                if op not in VALID_OPS:
+                    raise ValueError(
+                        f"churn_apply op must be one of {sorted(VALID_OPS)},"
+                        f" got {op}")
+                normalized.append((op, int(payload[1]) % KEYS_IN_RING))
+            payloads = normalized
         if not self._started:
             self.start()
         slots = [_Slot(kind, p, deadline) for p in payloads]
@@ -376,11 +431,10 @@ class ServeEngine:
         # idle engine (nothing pending or in flight, window at zero) is
         # dispatched and completed on the SUBMITTING thread — the
         # legacy bridge's leader model without the sleep, and without
-        # the two pipeline handoffs. dhash_put (and repair_reindex, the
-        # other store mutator) stays on the dispatcher: its
-        # read-modify-write of the store must never race a
-        # concurrently-dispatched put batch.
-        if len(slots) == 1 and kind not in ("dhash_put", "repair_reindex"):
+        # the two pipeline handoffs. The store/ring mutators stay on
+        # the dispatcher: their read-modify-write must never race a
+        # concurrently-dispatched mutator batch.
+        if len(slots) == 1 and kind not in _MUTATOR_KINDS:
             with self._lock:
                 fast = (not self._pending and self._inflight_n == 0
                         and not self._dispatching
@@ -475,6 +529,26 @@ class ServeEngine:
         returns the number of rows rewritten to missing indices."""
         return self.submit("repair_reindex", ()).wait(timeout)
 
+    def apply_churn(self, entries: Sequence[Tuple[int, int]],
+                    timeout: Optional[float] = None) -> List[bool]:
+        """Apply a batch of membership ops ([(op_code, member_id)],
+        membership.OP_*) in one contiguous submission; returns the
+        per-op applied flags. FIFO with every other kind: lookups
+        submitted before this batch see the pre-churn ring."""
+        slots = self.submit_many("churn_apply", [tuple(e) for e in entries])
+        return [s.wait(timeout) for s in slots]
+
+    def stabilize_round(self, timeout: Optional[float] = None) -> bool:
+        """One whole-ring stabilize/rectify sweep through the queue;
+        returns the post-sweep placement_converged verdict."""
+        return self.submit("stabilize_sweep", ()).wait(timeout)
+
+    def dhash_maintain(self, timeout: Optional[float] = None) -> int:
+        """One local-maintenance pass on the engine's store (purge
+        dead-held rows + regenerate missing fragments); returns the
+        regenerated-row count."""
+        return self.submit("dhash_maintain", ()).wait(timeout)
+
     # -- store introspection (the repair control plane's view) --------------
 
     @property
@@ -498,6 +572,14 @@ class ServeEngine:
         delta scan reads this, never the live attribute."""
         with self._lock:
             return self._store
+
+    def ring_snapshot(self):
+        """The current chained RingState value — every launched churn
+        batch is sequenced into it device-side. The membership manager
+        reads this after each applied batch to refresh the gateway
+        backend's fallback-path state."""
+        with self._lock:
+            return self._state
 
     # -- warmup / recompile accounting -------------------------------------
 
@@ -526,7 +608,7 @@ class ServeEngine:
     def _kind_available(self, kind: str) -> bool:
         if kind == "finger_index":
             return True
-        if kind == "find_successor":
+        if kind in ("find_successor", "churn_apply", "stabilize_sweep"):
             return self._state is not None
         if kind == "sync_digest":
             return self._store is not None
@@ -574,6 +656,33 @@ class ServeEngine:
                                  int(self._store.max_segments))
             _, stats = kern["repair_reindex"](self._state, shadow)
             np.asarray(stats.rewritten)
+        elif kind == "churn_apply":
+            # All-lanes OP_FAIL of the all-ones sentinel id: not found,
+            # so the kernel is a structural no-op — same compiled
+            # program, zero membership change; the new state/store are
+            # simply dropped (never installed).
+            from p2p_dhts_tpu.membership import OP_FAIL
+            ops = kern["jnp"].asarray(np.full((b,), OP_FAIL, np.int32))
+            lanes = kern["jnp"].asarray(
+                np.full((b, 4), 0xFFFFFFFF, np.uint32))
+            if self._store is not None:
+                _, _, applied = kern["churn_apply_store"](
+                    self._state, ops, lanes, self._store)
+            else:
+                _, applied = kern["churn_apply"](self._state, ops, lanes)
+            np.asarray(applied)
+        elif kind == "stabilize_sweep":
+            # Pure function of the state; the swept output is dropped
+            # (warmup never mutates). One program regardless of bucket,
+            # like sync_digest.
+            _, conv = kern["stabilize_sweep"](self._state)
+            np.asarray(conv)
+        elif kind == "dhash_maintain":
+            from p2p_dhts_tpu.dhash.store import empty_store
+            shadow = empty_store(int(self._store.capacity),
+                                 int(self._store.max_segments))
+            _, repaired = kern["dhash_maintain"](self._state, shadow)
+            np.asarray(repaired)
 
     @property
     def trace_counts(self) -> Dict[str, int]:
@@ -712,6 +821,29 @@ class ServeEngine:
                 return repair_mod.reindex_duplicates_impl(
                     state, store, n, m, p)
 
+            from p2p_dhts_tpu.membership import kernels as member_mod
+
+            def churn_apply(state, ops, lanes):
+                count("churn_apply")
+                return member_mod.churn_apply_impl(state, ops, lanes)
+
+            def churn_apply_store(state, ops, lanes, store):
+                count("churn_apply")
+                return member_mod.churn_apply_impl(state, ops, lanes,
+                                                   store)
+
+            def stabilize_sweep(state):
+                count("stabilize_sweep")
+                return member_mod.stabilize_round_impl(state)
+
+            from p2p_dhts_tpu.dhash import maintenance as maint_mod
+
+            def dhash_maintain(state, store):
+                count("dhash_maintain")
+                starts = jnp.zeros((store.keys.shape[0],), jnp.int32)
+                return maint_mod.local_maintenance(state, store, starts,
+                                                   n, m, p)
+
             self._kernels = {
                 "jnp": jnp,
                 "np": np,
@@ -731,6 +863,12 @@ class ServeEngine:
                 # reads the live store, the reindex chains it like a put.
                 "sync_digest": jax.jit(sync_digest),
                 "repair_reindex": jax.jit(repair_reindex),
+                # Membership kinds: the state chains like the store (no
+                # donation — rollback needs the previous value intact).
+                "churn_apply": jax.jit(churn_apply),
+                "churn_apply_store": jax.jit(churn_apply_store),
+                "stabilize_sweep": jax.jit(stabilize_sweep),
+                "dhash_maintain": jax.jit(dhash_maintain),
             }
         return self._kernels
 
@@ -944,6 +1082,65 @@ class ServeEngine:
                     self._store = new_store
             return ("repair_reindex", stats, prev_store, epoch)
 
+        if kind == "churn_apply":
+            # RING-state (and, with a store, STORE) mutator: chains
+            # both with their rollback epochs — the dhash_put
+            # discipline applied to membership. Pad lanes replicate the
+            # first op, which can never be a NEW membership action: a
+            # replicated join is an intra-batch duplicate (rejected by
+            # the kernel), a replicated leave/fail is an idempotent
+            # re-kill whose scatters agree with the original lane.
+            with self._lock:
+                prev_state = self._state
+                prev_store = self._store
+                repoch = self._ring_epoch
+                sepoch = self._store_epoch
+            op_ints = [s.payload[0] for s in batch]
+            key_ints = [s.payload[1] for s in batch]
+            op_ints += [op_ints[0]] * pad
+            key_ints += [key_ints[0]] * pad
+            ops = jnp.asarray(np.asarray(op_ints, np.int32))
+            lanes = jnp.asarray(keyspace.ints_to_lanes(key_ints))
+            if prev_store is not None:
+                new_state, new_store, applied = kern["churn_apply_store"](
+                    prev_state, ops, lanes, prev_store)
+            else:
+                new_state, applied = kern["churn_apply"](prev_state, ops,
+                                                         lanes)
+                new_store = None
+            with self._lock:
+                if repoch == self._ring_epoch:
+                    self._state = new_state
+                if new_store is not None and sepoch == self._store_epoch:
+                    self._store = new_store
+            return ("churn_apply", applied, prev_state, repoch,
+                    prev_store, sepoch)
+
+        if kind == "stabilize_sweep":
+            # A pure ring mutator (one sweep per batch — no per-lane
+            # input, so a padded batch costs exactly one sweep).
+            with self._lock:
+                prev_state = self._state
+                epoch = self._ring_epoch
+            new_state, conv = kern["stabilize_sweep"](prev_state)
+            with self._lock:
+                if epoch == self._ring_epoch:
+                    self._state = new_state
+            return ("stabilize_sweep", conv, prev_state, epoch)
+
+        if kind == "dhash_maintain":
+            # Store mutator (purge + regenerate): chains/rolls back
+            # like a put; one kernel call serves the whole batch.
+            with self._lock:
+                prev_store = self._store
+                epoch = self._store_epoch
+            new_store, repaired = kern["dhash_maintain"](self._state,
+                                                         prev_store)
+            with self._lock:
+                if epoch == self._store_epoch:
+                    self._store = new_store
+            return ("dhash_maintain", repaired, prev_store, epoch)
+
         # dhash_put: payload (key, segments [S, m] i32, length, start).
         with self._lock:
             prev_store = self._store
@@ -1030,12 +1227,25 @@ class ServeEngine:
                 rewritten = int(np.asarray(handle[1].rewritten))
                 for slot in batch:
                     slot.result = rewritten
+            elif kind == "churn_apply":
+                applied = np.asarray(handle[1])
+                for j, slot in enumerate(batch):
+                    slot.result = bool(applied[j])
+            elif kind == "stabilize_sweep":
+                conv = bool(np.asarray(handle[1]))
+                for slot in batch:
+                    slot.result = conv
+            elif kind == "dhash_maintain":
+                repaired = int(np.asarray(handle[1]))
+                for slot in batch:
+                    slot.result = repaired
             else:  # dhash_put
                 ok = np.asarray(handle[1])
                 for j, slot in enumerate(batch):
                     slot.result = bool(ok[j])
         except BaseException as exc:  # noqa: BLE001 — fanned out
-            if handle[0] in ("dhash_put", "repair_reindex"):
+            if handle[0] in ("dhash_put", "repair_reindex",
+                             "dhash_maintain"):
                 # The device computation failed AFTER self._store was
                 # swapped to its (poisoned) output; restore the last
                 # good store. A launch from the CURRENT epoch chained
@@ -1057,6 +1267,27 @@ class ServeEngine:
                     if epoch == self._store_epoch:
                         self._store = prev_store
                         self._store_epoch += 1
+            if handle[0] in ("churn_apply", "stabilize_sweep"):
+                # The ring-state twin of the store rollback above: the
+                # failed batch's (poisoned) state output was installed
+                # at launch; restore the last good RingState and bump
+                # the ring epoch so stale pipelined launches skip
+                # their install. churn_apply on a store-carrying
+                # engine also swapped the store (holder fixups) — both
+                # revert, under their own epochs. Same double-fault
+                # residual as puts.
+                prev_state, repoch = handle[2], handle[3]
+                with self._lock:
+                    if repoch == self._ring_epoch:
+                        self._state = prev_state
+                        self._ring_epoch += 1
+                if handle[0] == "churn_apply":
+                    prev_store, sepoch = handle[4], handle[5]
+                    if prev_store is not None:
+                        with self._lock:
+                            if sepoch == self._store_epoch:
+                                self._store = prev_store
+                                self._store_epoch += 1
             self._deliver_error(batch, exc)
             return
         now = time.perf_counter()

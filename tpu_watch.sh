@@ -1,25 +1,24 @@
 #!/bin/bash
-# TPU recovery watcher, round 6: the round-5 six plus the serving-stack
-# configs (serve/gateway, ISSUE 4 follow-through) and the chordax-repair
-# config (ISSUE 6) all want on-chip records. Wait for the chip to be
+# TPU recovery watcher, round 7: the round-6 nine plus the
+# chordax-membership config (ISSUE 7) all want on-chip records. Wait for the chip to be
 # free, probe the remote-compile service (dead since round 4:
 # connection-refused on its port while cached programs kept executing),
 # and when it answers, run the configs without a green record one at a
-# time into BENCH_ATTEMPT_r06.jsonl (bench's _record_lkg promotes each
+# time into BENCH_ATTEMPT_r07.jsonl (bench's _record_lkg promotes each
 # green on-chip record into BENCH_LKG.json). Never kills anything
 # mid-TPU-work; every probe and bench attempt runs to completion (a
 # blocked fresh-shape jit takes ~25 min to fail — that is the probe's
 # cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-6 watcher start (core + serve/gateway/repair configs)"
+log "round-7 watcher start (core + serve/gateway/repair/membership configs)"
 
-needed() {  # configs without a green r06 record yet
+needed() {  # configs without a green r07 record yet
   python - <<'EOF'
 import json
 ok = set()
 try:
-    for line in open("BENCH_ATTEMPT_r06.jsonl"):
+    for line in open("BENCH_ATTEMPT_r07.jsonl"):
         try:
             rec = json.loads(line)
         except ValueError:
@@ -29,7 +28,7 @@ try:
 except FileNotFoundError:
     pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
-        "sweep_10m", "serve", "gateway", "repair"]
+        "sweep_10m", "serve", "gateway", "repair", "membership"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -41,7 +40,7 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all six configs recorded green — done"
+    log "all ten configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
@@ -75,6 +74,15 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Membership smoke (ISSUE 7): >=99% availability through the churn
+  # storm, zero churn-path retraces, bounded convergence and oracle
+  # ownership parity must hold on CPU before anything claims the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config membership --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "membership smoke FAILED - fix the churn plane before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -87,7 +95,7 @@ EOF
   then
     for c in $CONFIGS; do
       log "running --config $c"
-      python bench.py --config "$c" >> BENCH_ATTEMPT_r06.jsonl 2>> BENCH_ATTEMPT_r06.err
+      python bench.py --config "$c" >> BENCH_ATTEMPT_r07.jsonl 2>> BENCH_ATTEMPT_r07.err
       log "config $c rc=$?"
     done
   else
