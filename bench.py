@@ -988,22 +988,32 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
         "fixed-window bridge")
 
     # -- closed loop: fixed concurrency -------------------------------
+    # ONE worker body serves both the untraced measurement and the
+    # tracing-overhead re-run below — the 10% comparison must measure
+    # the identical workload.
+    from p2p_dhts_tpu import trace as trace_mod
+
     closed_lats: list = []
     lat_lock = threading.Lock()
 
-    def closed_worker(seed):
+    def closed_worker(seed, out, traced=False):
         wrng = np.random.RandomState(seed)
         mine = []
         for _ in range(closed_reqs_each):
             k = int.from_bytes(wrng.bytes(16), "little")
+            start = int(wrng.randint(n_valid))
             t0 = time.perf_counter()
-            engine.find_successor(k, int(wrng.randint(n_valid)),
-                                  timeout=600)
+            if traced:
+                with trace_mod.span("bench.request", cat="bench"):
+                    engine.find_successor(k, start, timeout=600)
+            else:
+                engine.find_successor(k, start, timeout=600)
             mine.append(time.perf_counter() - t0)
         with lat_lock:
-            closed_lats.extend(mine)
+            out.extend(mine)
 
-    threads = [threading.Thread(target=closed_worker, args=(j,))
+    threads = [threading.Thread(target=closed_worker,
+                                args=(j, closed_lats))
                for j in range(closed_workers)]
     t0 = time.perf_counter()
     for t in threads:
@@ -1013,6 +1023,46 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
     closed_wall = time.perf_counter() - t0
     closed_rps = closed_workers * closed_reqs_each / closed_wall
     closed_p50, closed_p99 = _p50_p99(closed_lats)
+
+    # -- chordax-scope: the SAME closed loop with tracing ENABLED ------
+    # Hard assertions: traced p50 within 10% of the untraced loop just
+    # measured (small absolute slack for 1-core timer noise), the
+    # export is valid Chrome trace-event JSON, and a sampled request's
+    # span chains bench.request -> serve.request -> (linked)
+    # serve.batch with the fan-in link pointing back.
+    traced_lats: list = []
+    with trace_mod.tracing(capacity=65536) as tstore:
+        threads = [threading.Thread(target=closed_worker,
+                                    args=(500 + j, traced_lats, True))
+                   for j in range(closed_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    traced_p50, traced_p99 = _p50_p99(traced_lats)
+    trace_overhead_x = traced_p50 / closed_p50 if closed_p50 else None
+    assert traced_p50 <= closed_p50 * 1.10 + 2.5e-4, (
+        f"tracing-enabled closed-loop p50 {traced_p50 * 1e3:.3f} ms is "
+        f"not within 10% of the tracing-disabled "
+        f"{closed_p50 * 1e3:.3f} ms")
+    chrome = json.loads(tstore.export_chrome())
+    events = chrome["traceEvents"]
+    assert events and all(
+        set(("name", "ph", "ts", "dur", "pid", "tid")) <= set(ev)
+        and ev["ph"] == "X" for ev in events), \
+        "trace export is not valid Chrome trace-event JSON"
+    spans = tstore.spans()
+    chain = trace_mod.find_chain(spans, "serve.request.find_successor")
+    assert [s["name"] for s in chain] == \
+        ["serve.request.find_successor", "bench.request"], (
+        f"request span chain broken: {[s['name'] for s in chain]}")
+    req_span = chain[0]
+    by_id = {s["span_id"]: s for s in spans}
+    batch_ids = [l for l in req_span["links"] if l in by_id]
+    assert batch_ids and by_id[batch_ids[0]]["name"].startswith(
+        "serve.batch.find_successor"), "request->batch fan-in link missing"
+    assert req_span["span_id"] in by_id[batch_ids[0]]["links"], \
+        "batch->request fan-in link missing"
 
     # -- open loop: fixed arrival rate, paced submissions --------------
     open_slots = []
@@ -1062,6 +1112,15 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
             if open_p50 is not None else None,
             "p99_ms": round(open_p99 * 1e3, 3)
             if open_p99 is not None else None,
+        },
+        "tracing": {
+            "traced_p50_ms": round(traced_p50 * 1e3, 3),
+            "traced_p99_ms": round(traced_p99 * 1e3, 3),
+            "overhead_x": round(trace_overhead_x, 3)
+            if trace_overhead_x is not None else None,
+            "spans": len(spans),
+            "chain": "ok (bench.request -> serve.request -> "
+                     "serve.batch fan-in)",
         },
         "solo_finger_p50_ms": round(solo_fi_p50 * 1e3, 3),
         "solo_finger_p99_ms": round(solo_fi_p99 * 1e3, 3),
@@ -1163,6 +1222,16 @@ def bench_gateway(n_peers_a: int = 65536, n_peers_b: int = 16384,
         "gateway_overhead_x": round(
             stats["direct_keys_s"] / stats["rpc_keys_s"], 2)
         if stats["rpc_keys_s"] else None,
+        "tracing": {
+            "traced_p50_ms": round(stats["traced_p50"] * 1e3, 3),
+            "traced_p99_ms": round(stats["traced_p99"] * 1e3, 3),
+            "overhead_x": round(
+                stats["traced_p50"] / stats["rpc_p50"], 3)
+            if stats["rpc_p50"] else None,
+            "spans": stats["traced_spans"],
+            "chain": "ok (rpc.client -> rpc.server -> gateway -> "
+                     "serve.request -> serve.batch fan-in)",
+        },
         "steady_state_retraces": 0,
         "slow_ring_isolation": {
             "b_state_under_hold": stats["b_state"],
@@ -1193,11 +1262,15 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
         return nearest_rank(s, 0.5), nearest_rank(s, 0.99)
 
     # Closed loop over TCP: each request carries a vector of keys.
+    # ONE worker body serves both the untraced measurement and the
+    # tracing-overhead re-run (tracing is ambient: Client.make_request
+    # opens the root span itself while trace.enable is on) — the 10%
+    # comparison must measure the identical workload.
     lats: list = []
     lat_lock = threading.Lock()
     errors: list = []
 
-    def worker(seed):
+    def worker(seed, out, errs):
         wrng = np.random.RandomState(seed)
         mine = []
         for _ in range(rpc_reqs_each):
@@ -1210,11 +1283,11 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
                  "DEADLINE_MS": 60000.0}, timeout=120.0)
             mine.append(time.perf_counter() - t0)
             if not resp.get("SUCCESS") or -1 in resp["OWNERS"]:
-                errors.append(resp)
+                errs.append(resp)
         with lat_lock:
-            lats.extend(mine)
+            out.extend(mine)
 
-    threads = [threading.Thread(target=worker, args=(j,))
+    threads = [threading.Thread(target=worker, args=(j, lats, errors))
                for j in range(rpc_workers)]
     t0 = time.perf_counter()
     for t in threads:
@@ -1228,6 +1301,54 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
     rpc_req_s = rpc_workers * rpc_reqs_each / rpc_wall
     rpc_p50, rpc_p99 = _p50_p99(lats)
 
+    # -- chordax-scope: the SAME RPC closed loop with tracing ENABLED --
+    # The client opens the root span and rides the context on the wire;
+    # hard assertions: traced p50 within 10% of the untraced loop (1 ms
+    # absolute slack for TCP jitter on this 1-core host), the export is
+    # valid Chrome trace-event JSON, and one sampled request chains
+    # rpc.client -> rpc.server -> gateway -> serve.request -> (linked)
+    # serve.batch end to end.
+    from p2p_dhts_tpu import trace as trace_mod
+    tlats: list = []
+    terrors: list = []
+    with trace_mod.tracing(capacity=65536) as tstore:
+        tthreads = [threading.Thread(target=worker,
+                                     args=(700 + j, tlats, terrors))
+                    for j in range(rpc_workers)]
+        for t in tthreads:
+            t.start()
+        for t in tthreads:
+            t.join()
+    assert not terrors, f"traced RPC-path failures: {terrors[:3]}"
+    traced_p50, traced_p99 = _p50_p99(tlats)
+    assert traced_p50 <= rpc_p50 * 1.10 + 1e-3, (
+        f"tracing-enabled RPC closed-loop p50 {traced_p50 * 1e3:.3f} ms "
+        f"is not within 10% of the tracing-disabled "
+        f"{rpc_p50 * 1e3:.3f} ms")
+    import json as _json
+    chrome = _json.loads(tstore.export_chrome())
+    assert chrome["traceEvents"] and all(
+        set(("name", "ph", "ts", "dur", "pid", "tid")) <= set(ev)
+        for ev in chrome["traceEvents"]), \
+        "trace export is not valid Chrome trace-event JSON"
+    spans = tstore.spans()
+    chain = trace_mod.find_chain(spans, "serve.request.find_successor")
+    names = [s["name"] for s in chain]
+    assert (len(names) == 4
+            and names[0] == "serve.request.find_successor"
+            and names[1] == "gateway.find_successor"
+            and names[2] == "rpc.server.FIND_SUCCESSOR"
+            and names[3] == "rpc.client.FIND_SUCCESSOR"), (
+        f"RPC->gateway->engine span chain broken: {names}")
+    by_id = {s["span_id"]: s for s in spans}
+    req_span = chain[0]
+    batch_ids = [l for l in req_span["links"] if l in by_id]
+    assert batch_ids and by_id[batch_ids[0]]["name"].startswith(
+        "serve.batch.find_successor") and \
+        req_span["span_id"] in by_id[batch_ids[0]]["links"], \
+        "request<->batch fan-in links missing through the RPC path"
+
+
     # Direct-engine comparison (the --config serve path, same keys/s
     # basis): submit the identical vectors straight into ring a's
     # engine — the gateway/RPC overhead is the difference.
@@ -1239,6 +1360,7 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
     direct_keys_s = total_keys / (time.perf_counter() - t0)
 
     # -- zero steady-state retraces through the RPC path ---------------
+    # (covers the traced loop above too: tracing must not retrace.)
     eng_a.assert_no_retraces()
     eng_b.assert_no_retraces()
 
@@ -1287,6 +1409,9 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
         "rpc_p50": rpc_p50,
         "rpc_p99": rpc_p99,
         "direct_keys_s": direct_keys_s,
+        "traced_p50": traced_p50,
+        "traced_p99": traced_p99,
+        "traced_spans": len(spans),
         "b_state": b_state,
         "b_outcomes": b_outcomes,
         "a_p99": a_p99,
@@ -1831,6 +1956,13 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — deliberate firewall
             import traceback
             traceback.print_exc()
+            # chordax-scope: replay the flight recorder's tail next to
+            # the traceback — the structured context of the failure.
+            from p2p_dhts_tpu.health import FLIGHT
+            tail = FLIGHT.dump_text(40)
+            if tail:
+                print(f"# flight recorder tail ({name}):\n{tail}",
+                      file=sys.stderr)
             failrec = {
                 "config": name, "metric": f"{name} FAILED",
                 "value": None, "unit": None, "vs_baseline": None,

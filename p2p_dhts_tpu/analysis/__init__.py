@@ -15,6 +15,11 @@ classes, with a CLI (`python -m p2p_dhts_tpu.analysis`) and CI gates.
                            audit over the threaded serving layer; an
                            opt-in runtime watchdog (CHORDAX_LOCK_CHECK=1)
                            verifies the order during soaks.
+  Pass 4  metrics          metric-key doc-drift gate (chordax-scope):
+                           every dotted key recorded in code must
+                           appear in README.md's metric-key inventory
+                           table, and every inventory row must still
+                           have a recording site.
 
 Inline suppressions: `# chordax-lint: disable=<rule> -- <reason>`
 (reason mandatory; see analysis.common). `run_all` is the library
@@ -39,7 +44,7 @@ from p2p_dhts_tpu.analysis.common import (  # noqa: F401
     render_report,
 )
 
-ALL_PASSES = ("trace", "gspmd", "locks")
+ALL_PASSES = ("trace", "gspmd", "locks", "metrics")
 
 
 def default_root() -> str:
@@ -77,6 +82,9 @@ def run_all(root: Optional[str] = None,
     if "gspmd" in passes:
         from p2p_dhts_tpu.analysis import gspmd
         raw.extend(gspmd.run_default(root))
+    if "metrics" in passes:
+        from p2p_dhts_tpu.analysis import metric_keys
+        raw.extend(metric_keys.run_default(root))
     # Index EVERY scanned file up front, not just files with findings:
     # a reasonless or unknown-rule suppression in an otherwise-clean
     # file must still surface as a lint-suppression finding, or stale

@@ -39,8 +39,9 @@ def main(argv=None) -> int:
     parser.add_argument("--json", metavar="PATH", default=None,
                         help="write the machine-readable report here "
                              "('-' for stdout)")
-    parser.add_argument("--passes", default="trace,gspmd,locks",
-                        help="comma list from {trace,gspmd,locks}")
+    parser.add_argument("--passes", default="trace,gspmd,locks,metrics",
+                        help="comma list from {trace,gspmd,locks,"
+                             "metrics}")
     parser.add_argument("--root", default=None,
                         help="repo root (default: the checkout this "
                              "package lives in)")

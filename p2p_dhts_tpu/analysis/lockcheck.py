@@ -66,6 +66,8 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "repair", "scheduler.py"),
     os.path.join("p2p_dhts_tpu", "repair", "replication.py"),
     os.path.join("p2p_dhts_tpu", "membership", "manager.py"),
+    os.path.join("p2p_dhts_tpu", "trace.py"),
+    os.path.join("p2p_dhts_tpu", "health.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

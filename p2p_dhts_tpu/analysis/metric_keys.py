@@ -1,0 +1,174 @@
+"""Pass 4 (metrics): the metric-key doc-drift gate (chordax-scope).
+
+Four subsystems record dotted metric keys (`serve.*`, `gateway.*`,
+`repair.*`, `membership.*`, `rpc.*`) and dashboards/tests read them by
+name; nothing used to stop a new key (or a renamed one) from silently
+forking the namespace. This pass pins code and docs to each other:
+
+  * CODE -> DOC: every dotted key recorded in the shipped tree
+    (literal or f-string first argument to a Metrics recorder —
+    inc / gauge / observe / observe_hist / observe_hist_many) must
+    appear in README.md's "Metric-key inventory" table, with f-string
+    interpolations normalized to one `<*>` wildcard segment (so
+    ``f"gateway.requests.{op}.{rid}"`` matches the documented
+    ``gateway.requests.<op>.<ring>``).
+  * DOC -> CODE: every inventory row must still have a recording site,
+    so the table cannot rot into folklore.
+
+Non-literal key arguments (a plain variable) are out of scope by
+construction — the registry's own internals pass names through — and
+the scan only considers keys with at least one dot, which is the
+package's universal key shape.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from p2p_dhts_tpu.analysis.common import (Finding, KNOWN_RULES,
+                                          package_files, repo_rel)
+
+PASS = "metrics"
+
+KNOWN_RULES.add("metric-key-undocumented")
+KNOWN_RULES.add("metric-key-stale")
+
+#: Metrics recorder method names whose first argument is a key
+#: (`timed` is the context-manager form of `observe`).
+RECORDERS = ("inc", "gauge", "observe", "observe_hist",
+             "observe_hist_many", "timed")
+
+#: The README heading the inventory table lives under.
+INVENTORY_HEADING = "### Metric-key inventory"
+
+#: One wildcard segment in a normalized pattern.
+WILD = "<*>"
+
+_PLACEHOLDER_RE = re.compile(r"<[^<>]*>")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _literal_pattern(node: ast.AST) -> Optional[str]:
+    """The normalized key pattern of a recorder's first argument:
+    a str constant verbatim, an f-string with every interpolation
+    replaced by `<*>`, None for anything unresolvable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant) and \
+                    isinstance(piece.value, str):
+                parts.append(piece.value)
+            elif isinstance(piece, ast.FormattedValue):
+                parts.append(WILD)
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def extract_code_patterns(path: str) -> List[Tuple[str, int]]:
+    """(pattern, line) per recorder call with a resolvable dotted key
+    in one file. Self-scan exclusions: the Metrics class itself (whose
+    internals pass caller-supplied names through) is in metrics.py,
+    where every recorder's first parameter is `name` — those sites
+    have non-literal args and drop out naturally."""
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in RECORDERS \
+                and node.args:
+            pattern = _literal_pattern(node.args[0])
+            if pattern is not None and "." in pattern:
+                out.append((pattern, node.lineno))
+        # PacedLoop sites hand their round-failure counter key to the
+        # base as `failure_metric=...` — the base records it through a
+        # variable, so the key's ONE literal home is the kwarg.
+        for kw in node.keywords:
+            if kw.arg != "failure_metric":
+                continue
+            pattern = _literal_pattern(kw.value)
+            if pattern is not None and "." in pattern:
+                out.append((pattern, node.lineno))
+    return out
+
+
+def normalize_doc_pattern(key: str) -> str:
+    """`gateway.requests.<op>.<ring>` -> `gateway.requests.<*>.<*>`."""
+    return _PLACEHOLDER_RE.sub(WILD, key)
+
+
+def inventory_patterns(readme_path: str) -> Dict[str, int]:
+    """{normalized pattern: line} from the README inventory table
+    (first backticked cell of each table row under the inventory
+    heading, up to the next heading)."""
+    out: Dict[str, int] = {}
+    try:
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return out
+    in_section = False
+    for i, line in enumerate(lines, 1):
+        if line.strip().startswith("#"):
+            in_section = line.strip() == INVENTORY_HEADING
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        m = _BACKTICK_RE.search(line)
+        if m is None:
+            continue
+        key = m.group(1).strip()
+        if "." not in key:
+            continue
+        out.setdefault(normalize_doc_pattern(key), i)
+    return out
+
+
+def run(files, root: str) -> List[Finding]:
+    readme = os.path.join(root, "README.md")
+    documented = inventory_patterns(readme)
+    findings: List[Finding] = []
+    if not documented:
+        findings.append(Finding(
+            path="README.md", line=1, rule="metric-key-stale",
+            message=f"no {INVENTORY_HEADING!r} table found — the "
+                    f"metric-key namespace has no inventory to gate "
+                    f"against", pass_name=PASS))
+        return findings
+    seen_patterns: Dict[str, Tuple[str, int]] = {}
+    for path in files:
+        for pattern, line in extract_code_patterns(path):
+            seen_patterns.setdefault(pattern, (path, line))
+            if pattern not in documented:
+                findings.append(Finding(
+                    path=repo_rel(path, root), line=line,
+                    rule="metric-key-undocumented",
+                    message=f"metric key {pattern!r} is recorded here "
+                            f"but missing from README.md's metric-key "
+                            f"inventory", pass_name=PASS))
+    for pattern, line in sorted(documented.items(),
+                                key=lambda kv: kv[1]):
+        if pattern not in seen_patterns:
+            findings.append(Finding(
+                path="README.md", line=line, rule="metric-key-stale",
+                message=f"inventory row {pattern!r} has no recording "
+                        f"site left in the shipped tree — drop the row "
+                        f"or restore the key", pass_name=PASS))
+    return findings
+
+
+def run_default(root: str) -> List[Finding]:
+    return run(package_files(root), root)

@@ -122,24 +122,37 @@ class RingAdmission:
                 f"ring {self.ring_id!r}: batch of {n} exceeds the "
                 f"admission budget ({self.max_inflight})")
         wait_until = time.perf_counter() + self.max_wait_s
-        with self._cond:
-            while self._inflight + n > self.max_inflight:
-                if deadline.expired():
-                    raise DeadlineExpiredError(
-                        f"ring {self.ring_id!r}: deadline passed while "
-                        f"waiting for admission")
-                now = time.perf_counter()
-                if now >= wait_until:
-                    raise RingBusyError(
-                        f"ring {self.ring_id!r}: admission budget "
-                        f"({self.max_inflight}) full for "
-                        f"{self.max_wait_s:.3f}s")
-                slice_s = wait_until - now
-                rem = deadline.remaining()
-                if rem is not None:
-                    slice_s = min(slice_s, rem)
-                self._cond.wait(max(slice_s, 0.0))
-            self._inflight += n
+        try:
+            with self._cond:
+                while self._inflight + n > self.max_inflight:
+                    if deadline.expired():
+                        raise DeadlineExpiredError(
+                            f"ring {self.ring_id!r}: deadline passed "
+                            f"while waiting for admission")
+                    now = time.perf_counter()
+                    if now >= wait_until:
+                        raise RingBusyError(
+                            f"ring {self.ring_id!r}: admission budget "
+                            f"({self.max_inflight}) full for "
+                            f"{self.max_wait_s:.3f}s")
+                    slice_s = wait_until - now
+                    rem = deadline.remaining()
+                    if rem is not None:
+                        slice_s = min(slice_s, rem)
+                    self._cond.wait(max(slice_s, 0.0))
+                self._inflight += n
+        except RingBusyError:
+            # chordax-scope: a budget-full rejection is a first-class
+            # incident event — recorded at the source, OUTSIDE the
+            # condition lock (leaf discipline; the recorder has its
+            # own leaf lock). Lazy import: admission must stay
+            # importable without the health plane loaded.
+            from p2p_dhts_tpu.health import FLIGHT
+            FLIGHT.record("gateway", "admission_full",
+                          ring=self.ring_id, n=n,
+                          max_inflight=self.max_inflight,
+                          waited_s=round(self.max_wait_s, 3))
+            raise
 
     def release(self, n: int = 1) -> None:
         with self._cond:

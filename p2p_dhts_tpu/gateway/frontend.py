@@ -60,8 +60,10 @@ from p2p_dhts_tpu.gateway.metrics_ext import GatewayMetrics
 from p2p_dhts_tpu.gateway.router import (RingBackend, RingRouter,
                                          RingUnavailableError,
                                          UnknownRingError)
+from p2p_dhts_tpu.health import FLIGHT
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.serve import DeadlineExpiredError, ServeEngine
 
 #: Ops that may serve through the fallback path while a ring is
@@ -78,9 +80,14 @@ FINGER_RING_ID = "__finger__"
 #: JOIN_RING / HEARTBEAT / MEMBER_STATUS are the chordax-membership
 #: control verbs (ISSUE 7): admission-bounded join intake, the failure
 #: detector's liveness signal, and the per-ring membership snapshot.
+#: METRICS / TRACE_STATUS / HEALTH are the chordax-scope introspection
+#: verbs (ISSUE 8): the whole metrics registry, the tracing plane's
+#: status/spans, and the unified loop-health snapshot — all queryable
+#: over the wire on every gateway server.
 GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
                     "SYNC_RANGE", "REPAIR_STATUS", "JOIN_RING",
-                    "HEARTBEAT", "MEMBER_STATUS")
+                    "HEARTBEAT", "MEMBER_STATUS", "METRICS",
+                    "TRACE_STATUS", "HEALTH")
 
 
 def _key_int(v) -> int:
@@ -371,6 +378,10 @@ class Gateway:
             mgr.close()
         if close_engine:
             backend.engine.close(drain=drain)
+        # Stale-telemetry hygiene (chordax-scope): a retired ring's
+        # per-ring counters/gauges/hists leave the registry with it, so
+        # dashboards and the METRICS verb never read a dead ring.
+        self.metrics.retire_ring(ring_id)
         return backend
 
     def _admission_for(self, ring_id: str) -> RingAdmission:
@@ -417,7 +428,22 @@ class Gateway:
                     payloads: Sequence[tuple],
                     deadline: Deadline = NO_DEADLINE) -> List[Any]:
         """Health -> admission -> engine (or fallback) for one same-kind
-        run routed to one ring. Returns per-request results in order."""
+        run routed to one ring. Returns per-request results in order.
+        chordax-scope: while tracing, the whole pass records as a
+        `gateway.<kind>` span (child of the RPC server span when the
+        request came over the wire; the engine's request spans parent
+        under it)."""
+        if not trace_mod.enabled():
+            return self._serve_many_inner(backend, kind, payloads,
+                                          deadline)
+        with trace_mod.span(f"gateway.{kind}", cat="gateway",
+                            ring=backend.ring_id, n=len(payloads)):
+            return self._serve_many_inner(backend, kind, payloads,
+                                          deadline)
+
+    def _serve_many_inner(self, backend: RingBackend, kind: str,
+                          payloads: Sequence[tuple],
+                          deadline: Deadline = NO_DEADLINE) -> List[Any]:
         rid = backend.ring_id
         n = len(payloads)
         t0 = time.perf_counter()
@@ -428,13 +454,21 @@ class Gateway:
         verdict = backend.admit_device_path()
         if verdict == "ejected":
             self.metrics.count_ejected_fastfail(rid, n)
+            FLIGHT.record("gateway", "ejected_fastfail", ring=rid, n=n)
             raise RingUnavailableError(
                 f"ring {rid!r} is ejected (re-probe pending)")
         probing = verdict == "probe"
         adm = self._admission_for(rid)
         try:
-            adm.acquire(n, deadline)
+            if trace_mod.enabled():
+                with trace_mod.span("gateway.admission", cat="gateway",
+                                    ring=rid):
+                    adm.acquire(n, deadline)
+            else:
+                adm.acquire(n, deadline)
         except RingBusyError:
+            # (admission.py records the budget-full flight event at
+            # the source, with occupancy attached.)
             if probing:
                 backend.probe_release()
             self.metrics.count_rejected(rid, n)
@@ -1039,6 +1073,54 @@ class Gateway:
             managers = list(self._memberships.values())
         return {"STATUS": {m.ring_id: m.status() for m in managers}}
 
+    # -- introspection verbs (chordax-scope, ISSUE 8) ------------------------
+    def handle_metrics(self, req: dict) -> dict:
+        """The metrics registry over the wire: the full snapshot, or —
+        with PREFIX — the bounded counter family under one dotted
+        prefix (the cheap periodic-poll form)."""
+        base = self.metrics.base
+        prefix = req.get("PREFIX")
+        if prefix is not None:
+            return {"COUNTERS": base.counters_with_prefix(str(prefix))}
+        return {"METRICS": base.snapshot()}
+
+    def handle_trace_status(self, req: dict) -> dict:
+        """The tracing plane's status (enabled flag, span-store
+        occupancy/evictions, distinct traces); with TRACE_ID, that
+        trace's retained spans; with EXPORT, the Chrome trace-event
+        JSON document (parsed, so the reply stays one JSON value)."""
+        import json as _json
+        out: dict = {"STATUS": trace_mod.status()}
+        tid = req.get("TRACE_ID")
+        if tid is not None:
+            spans = []
+            for s in trace_mod.store().spans(str(tid)):
+                row = dict(s)
+                row["args"] = dict(s["args"]) if s.get("args") else {}
+                row["links"] = list(s.get("links") or ())
+                spans.append(row)
+            out["SPANS"] = spans
+        if req.get("EXPORT"):
+            out["CHROME"] = _json.loads(trace_mod.store().export_chrome())
+        return out
+
+    def handle_health(self, req: dict) -> dict:
+        """The unified health plane in one verb: every registered
+        background loop's run/backoff/stall snapshot (HealthRegistry),
+        this gateway's per-ring health machine states, and the flight
+        recorder's occupancy (TAIL > 0 inlines that many events)."""
+        from p2p_dhts_tpu.health import FLIGHT as _FLIGHT, HEALTH
+        out = {
+            "LOOPS": HEALTH.snapshot(),
+            "RINGS": self.router.health_snapshot(),
+            "FLIGHT": {"events": len(_FLIGHT),
+                       "recorded": _FLIGHT.recorded},
+        }
+        tail = int(req.get("TAIL", 0) or 0)
+        if tail > 0:
+            out["FLIGHT"]["tail"] = _FLIGHT.recent(tail)
+        return {"HEALTH": out}
+
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         if "KEYS" in req:
@@ -1123,5 +1205,8 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "JOIN_RING": gw.handle_join_ring,
         "HEARTBEAT": gw.handle_heartbeat,
         "MEMBER_STATUS": gw.handle_member_status,
+        "METRICS": gw.handle_metrics,
+        "TRACE_STATUS": gw.handle_trace_status,
+        "HEALTH": gw.handle_health,
     })
     return gw

@@ -35,6 +35,25 @@ from p2p_dhts_tpu.metrics import METRICS, Metrics
 OPS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
        "sync_digest", "repair_reindex")
 
+#: Every per-ring membership key family (membership.<fam>.<ring> —
+#: manager.py's schema, mirrored in README's metric-key inventory).
+#: retire_ring enumerates this so a removed ring's membership
+#: telemetry leaves the registry with its manager.
+MEMBERSHIP_FAMS = (
+    "join_requests", "join_rejected", "heartbeats", "heartbeat_unknown",
+    "suspects", "suspicion_cleared", "failures_detected", "batches",
+    "rows_applied", "rows_regenerated", "ranges_transferred",
+    "heal_enqueued", "stalled_rounds", "round_failures",
+    "handoff_failover", "pending", "members_alive", "converged")
+
+#: Per-ring repair key families (repair.<fam>.<ring> /
+#: repair.replication.<fam>.<ring>). Pair-keyed repair telemetry
+#: (backlog/converged/tokens/round_ms.<a>-<b>) retires with its loop
+#: in RepairScheduler.remove_ring; these are the RING-keyed leftovers.
+REPAIR_RING_FAMS = ("keys_healed", "reindexed", "read_failover",
+                    "drift_healed")
+REPAIR_REPLICATION_FAMS = ("lag_ms", "replica_ok", "replica_failed")
+
 
 class GatewayMetrics:
     """Namespaced recording + per-ring summary over a Metrics registry."""
@@ -77,6 +96,34 @@ class GatewayMetrics:
         self.base.observe_hist_many(
             f"gateway.latency_ms.{op}.{ring_id}",
             [v * 1e3 for v in latencies_s])
+
+    # -- retirement ----------------------------------------------------------
+    def retire_ring(self, ring_id: str) -> int:
+        """Drop every per-ring key a removed ring left behind —
+        counters, gauges AND hists, across the gateway.* AND
+        membership.* families (the ring's manager closes with it).
+        Bounded enumeration over the fixed key schema;
+        Metrics.remove_prefix is dotted-segment-exact, so ring "a" can
+        never collaterally retire ring "ab". Returns keys removed."""
+        removed = 0
+        for fam in ("requests", "errors", "fallback", "latency_ms"):
+            for op in OPS:
+                removed += self.base.remove_prefix(
+                    f"gateway.{fam}.{op}.{ring_id}")
+        for fam in ("deadline_dropped", "rejected", "ejected_fastfail",
+                    "health", "inflight"):
+            removed += self.base.remove_prefix(
+                f"gateway.{fam}.{ring_id}")
+        for fam in MEMBERSHIP_FAMS:
+            removed += self.base.remove_prefix(
+                f"membership.{fam}.{ring_id}")
+        for fam in REPAIR_RING_FAMS:
+            removed += self.base.remove_prefix(
+                f"repair.{fam}.{ring_id}")
+        for fam in REPAIR_REPLICATION_FAMS:
+            removed += self.base.remove_prefix(
+                f"repair.replication.{fam}.{ring_id}")
+        return removed
 
     # -- summary views -------------------------------------------------------
     def ring_stats(self, ring_id: str) -> Dict[str, object]:

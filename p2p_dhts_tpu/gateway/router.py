@@ -201,8 +201,11 @@ class RingBackend:
                 self._degraded_logged = False
                 fire = HEALTHY
             self._consecutive_failures = 0
-        if fire is not None and self._on_state_change is not None:
-            self._on_state_change(self.ring_id, fire)
+        if fire is not None:
+            from p2p_dhts_tpu.health import FLIGHT
+            FLIGHT.record("gateway", "ring_recovered", ring=self.ring_id)
+            if self._on_state_change is not None:
+                self._on_state_change(self.ring_id, fire)
 
     def record_failure(self, exc: Optional[BaseException] = None,
                        probing: bool = False) -> str:
@@ -230,8 +233,16 @@ class RingBackend:
                 self._state = new_state
                 fire = new_state
             state = self._state
-        if fire is not None and self._on_state_change is not None:
-            self._on_state_change(self.ring_id, fire)
+        if fire is not None:
+            # Health transitions are exactly the events an incident
+            # replay needs first — feed the flight recorder outside
+            # the health lock (leaf discipline).
+            from p2p_dhts_tpu.health import FLIGHT
+            FLIGHT.record(
+                "gateway", "ring_state", ring=self.ring_id, state=fire,
+                error=type(exc).__name__ if exc is not None else None)
+            if self._on_state_change is not None:
+                self._on_state_change(self.ring_id, fire)
         return state
 
     def probe_release(self) -> None:

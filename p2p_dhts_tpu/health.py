@@ -1,0 +1,379 @@
+"""chordax-scope: unified health/introspection plane for background loops.
+
+Three pieces, each answering one operability question:
+
+  * `PacedLoop` — THE shared run/backoff/stall base for every paced
+    background control loop (the ROADMAP PR-7 open item): jittered
+    start, one round per wake, jittered exponential backoff on a failed
+    round, converged/stalled-aware idle pacing, and an interruptible
+    Event wait holding no locks. `repair/scheduler.py`'s
+    `_PairLoop`/`_DriftLoop` and `membership/manager.py`'s
+    `MembershipManager` are all subclasses — one loop body, three
+    subsystems, no behavior change (their pre-consolidation tests are
+    the regression net). Every PacedLoop self-registers (weakly) in the
+    HealthRegistry at construction.
+  * `HealthRegistry` — "is this background loop healthy?" in ONE call:
+    `snapshot()` reports every live loop's rounds, failure count,
+    backoff state, token-bucket level, converged/stalled flags and
+    last-round age. Weak references: a loop that was never closed (test
+    debris) disappears from the snapshot with its last reference
+    instead of pinning the registry forever. The gateway's HEALTH wire
+    verb serves this remotely.
+  * `FlightRecorder` — a bounded structured event ring (the
+    reference's 32-entry RequestLog generalized): subsystems append
+    {timestamp, subsystem, event, fields} dicts at notable moments
+    (handler errors, admission rejections, ring health transitions,
+    loop round failures), and `dump_on_error()` / `dump_text()` replay
+    the tail when something goes wrong — the first stack frame of any
+    incident. tests/conftest.py attaches the tail to failed tests;
+    bench.py's per-config firewall prints it.
+
+LOCK ORDER: `HealthRegistry._lock` and `FlightRecorder._lock` are
+LEAVES — never held across any call out of this module; `PacedLoop`
+adds only `_life_lock` (start/close bookkeeping, leaf). This module
+never imports jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Dict, List, Optional
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+logger = logging.getLogger(__name__)
+
+
+class HealthRegistry:
+    """Weak registry of live PacedLoops; snapshot() is the one-call
+    health view (and the HEALTH wire verb's payload)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loops: Dict[int, "weakref.ref[PacedLoop]"] = {}
+
+    def register(self, loop: "PacedLoop") -> None:
+        with self._lock:
+            self._loops[id(loop)] = weakref.ref(loop)
+
+    def unregister(self, loop: "PacedLoop") -> None:
+        with self._lock:
+            self._loops.pop(id(loop), None)
+
+    def loops(self) -> List["PacedLoop"]:
+        with self._lock:
+            refs = list(self._loops.items())
+        out = []
+        dead = []
+        for key, ref in refs:
+            loop = ref()
+            if loop is None:
+                dead.append(key)
+            else:
+                out.append(loop)
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._loops.pop(key, None)
+        return out
+
+    def snapshot(self) -> Dict[str, dict]:
+        """{unique loop name: health dict}. Name collisions (two
+        schedulers over the same pair in one process) disambiguate
+        with a #k suffix instead of silently shadowing."""
+        out: Dict[str, dict] = {}
+        for loop in self.loops():
+            name = loop.name
+            k = 2
+            while name in out:
+                name = f"{loop.name}#{k}"
+                k += 1
+            out[name] = loop.health()
+        return out
+
+
+#: The process-wide registry the HEALTH verb serves (loops register
+#: here by default; tests may construct their own).
+HEALTH = HealthRegistry()
+
+
+class PacedLoop:
+    """Base for one background control loop: run / backoff / stall.
+
+    Subclasses implement `_round()` (one unit of work; exceptions are
+    counted, logged, and backed off) and may override `_busy()` (True
+    -> active `interval_s` pacing, False -> `interval_idle_s`). The
+    base owns: the thread (created at construction, started by
+    `start()`), the jittered start, the failure/backoff accounting
+    (`failures`, `backoff_s`, `last_error`), the `converged`/`stalled`
+    flags idle pacing reads, and health snapshotting. `extra_stop` is
+    a second Event that also stops the loop (a scheduler's global stop
+    next to the loop's own)."""
+
+    def __init__(self, *, name: str, kind: str,
+                 interval_s: float, interval_idle_s: float,
+                 backoff_base_s: float, backoff_cap_s: float,
+                 metrics: Optional[Metrics] = None,
+                 failure_metric: Optional[str] = None,
+                 extra_stop: Optional[threading.Event] = None,
+                 bucket=None, thread_name: Optional[str] = None,
+                 registry: Optional[HealthRegistry] = None):
+        self.name = str(name)
+        self.loop_kind = str(kind)
+        self.interval_s = float(interval_s)
+        self.interval_idle_s = float(interval_idle_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.metrics = metrics if metrics is not None else METRICS
+        self.failure_metric = failure_metric
+        self.bucket = bucket  # TokenBucket or None (health reports it)
+        self._stop_ev = threading.Event()
+        self._extra_stop = extra_stop
+        self._life_lock = threading.Lock()
+        self._loop_started = False
+        self.failures = 0
+        self.backoff_s = 0.0
+        self.last_error: Optional[str] = None
+        self.rounds = 0
+        self.converged = False
+        self.stalled = False
+        self._last_round_t: Optional[float] = None
+        self.thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=thread_name if thread_name is not None else self.name)
+        self._registry = registry if registry is not None else HEALTH
+        self._registry.register(self)
+
+    # -- subclass hooks ------------------------------------------------------
+    def _round(self) -> None:
+        raise NotImplementedError
+
+    def _busy(self) -> bool:
+        """Active-pacing predicate the post-round wait reads; the
+        default idles a converged or stalled loop."""
+        return not (self.converged or self.stalled)
+
+    # -- pacing core ---------------------------------------------------------
+    def _should_stop(self) -> bool:
+        return self._stop_ev.is_set() or (
+            self._extra_stop is not None and self._extra_stop.is_set())
+
+    def _wait_s(self) -> float:
+        if self.backoff_s:
+            return self.backoff_s
+        return self.interval_s if self._busy() else self.interval_idle_s
+
+    def mark_round(self) -> None:
+        """Stamp a completed round (foreground drivers — run_once /
+        step — call this so health's last-round age is honest even
+        when the background thread never runs)."""
+        self._last_round_t = time.monotonic()
+
+    def _record_failure(self, exc: BaseException) -> None:
+        self.failures += 1
+        self.last_error = f"{type(exc).__name__}: {exc}"
+        if self.failure_metric:
+            self.metrics.inc(self.failure_metric)
+        base = min(self.backoff_base_s * (2 ** (self.failures - 1)),
+                   self.backoff_cap_s)
+        # Jittered, never fixed: N loops that saw the same failure must
+        # not re-converge in lockstep (the net/rpc.py retry rule).
+        self.backoff_s = random.uniform(base * 0.5, base)
+        FLIGHT.record(self.loop_kind, "round_failure", loop=self.name,
+                      failures=self.failures, error=self.last_error,
+                      backoff_s=round(self.backoff_s, 3))
+        logger.warning("%s loop %s round failed (%s); backing off %.2fs",
+                       self.loop_kind, self.name, self.last_error,
+                       self.backoff_s, exc_info=exc)
+
+    def _run(self) -> None:
+        # Jittered start so N loops never fire in lockstep.
+        self._stop_ev.wait(random.uniform(0, self.interval_s))
+        while not self._should_stop():
+            try:
+                self._round()
+                self.failures = 0
+                self.backoff_s = 0.0
+                self.last_error = None
+            # chordax-lint: disable=bare-except -- the control loop must survive any round failure; it is counted, logged and backed off
+            except Exception as exc:  # noqa: BLE001 — backoff + retry
+                self._record_failure(exc)
+            self.mark_round()
+            self._stop_ev.wait(self._wait_s())
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "PacedLoop":
+        with self._life_lock:
+            if self._loop_started:
+                return self
+            if self._stop_ev.is_set():
+                raise RuntimeError(f"{self.name} loop is closed")
+            self._loop_started = True
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Signal the loop to exit (non-blocking) and drop it from the
+        health registry."""
+        self._stop_ev.set()
+        self._registry.unregister(self)
+
+    def close(self, timeout: float = 30.0) -> None:
+        self.stop()
+        if self.thread.is_alive():
+            self.thread.join(timeout)
+            if self.thread.is_alive():
+                raise TimeoutError(
+                    f"{self.loop_kind} loop {self.name!r} did not stop "
+                    f"within {timeout}s")
+
+    # -- introspection -------------------------------------------------------
+    def health(self) -> dict:
+        """One loop's health row: the unified plane's unit record."""
+        age = (round(time.monotonic() - self._last_round_t, 3)
+               if self._last_round_t is not None else None)
+        return {
+            "kind": self.loop_kind,
+            "running": self.thread.is_alive(),
+            "rounds": self.rounds,
+            "failures": self.failures,
+            "backoff_s": round(self.backoff_s, 3),
+            "converged": self.converged,
+            "stalled": self.stalled,
+            "tokens": (round(self.bucket.tokens, 1)
+                       if self.bucket is not None else None),
+            "last_error": self.last_error,
+            "last_round_age_s": age,
+        }
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded structured event ring: the RequestLog generalized from
+    "last 32 parsed requests on one server" to "last N notable events
+    across every subsystem in the process".
+
+    TWO rings, by signal class: `record()` feeds the MAIN ring
+    (incidents — handler errors, health transitions, rejections, loop
+    failures); `record_routine()` feeds a smaller CHATTER ring (per-
+    request traffic, e.g. a logging-enabled server's request feed), so
+    a few thousand routine rows can never evict the incident context
+    dump-on-error exists to replay."""
+
+    #: Retained incident events (newest win); small enough to read whole.
+    DEFAULT_CAPACITY = 1024
+    #: Retained routine/chatter events.
+    CHATTER_CAPACITY = 128
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 chatter_capacity: int = CHATTER_CAPACITY):
+        self._buf: deque = deque(maxlen=int(capacity))
+        self._chatter: deque = deque(maxlen=int(chatter_capacity))
+        self._lock = threading.Lock()
+        self._recorded = 0
+        self._routine_recorded = 0
+
+    def _item(self, subsystem: str, event: str, fields: dict) -> dict:
+        item = {"t": time.time(), "subsystem": str(subsystem),
+                "event": str(event)}
+        if fields:
+            item.update(fields)
+        return item
+
+    def record(self, subsystem: str, event: str, **fields) -> None:
+        item = self._item(subsystem, event, fields)
+        with self._lock:
+            self._recorded += 1
+            self._buf.append(item)
+
+    def record_routine(self, subsystem: str, event: str,
+                       **fields) -> None:
+        """Per-request / high-volume chatter: retained separately so
+        it cannot evict incident events."""
+        item = self._item(subsystem, event, fields)
+        with self._lock:
+            self._routine_recorded += 1
+            self._chatter.append(item)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def recorded(self) -> int:
+        """Total MAIN-ring events ever recorded (eviction-independent)."""
+        with self._lock:
+            return self._recorded
+
+    @property
+    def routine_recorded(self) -> int:
+        with self._lock:
+            return self._routine_recorded
+
+    def recent(self, n: Optional[int] = None,
+               subsystem: Optional[str] = None,
+               routine: bool = False) -> List[dict]:
+        with self._lock:
+            out = list(self._chatter if routine else self._buf)
+        if subsystem is not None:
+            out = [e for e in out if e["subsystem"] == subsystem]
+        return out if n is None else out[-int(n):]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._chatter.clear()
+
+    def dump_text(self, n: int = 50) -> str:
+        """Human-readable tail, newest last — what dump-on-error
+        prints."""
+        lines = []
+        for e in self.recent(n):
+            extra = " ".join(
+                f"{k}={e[k]!r}" for k in e
+                if k not in ("t", "subsystem", "event"))
+            stamp = time.strftime("%H:%M:%S", time.localtime(e["t"]))
+            lines.append(f"{stamp} [{e['subsystem']}] {e['event']}"
+                         + (f" {extra}" if extra else ""))
+        return "\n".join(lines)
+
+
+#: The process-wide recorder every subsystem feeds.
+FLIGHT = FlightRecorder()
+
+
+class dump_on_error:
+    """Context manager: on ANY exception, print the flight recorder's
+    tail (label + last `n` events) to `stream` before re-raising — the
+    bench firewall's and the tests' incident dump."""
+
+    def __init__(self, label: str = "", n: int = 50, stream=None,
+                 recorder: Optional[FlightRecorder] = None):
+        self.label = label
+        self.n = int(n)
+        self.stream = stream
+        self.recorder = recorder if recorder is not None else FLIGHT
+
+    def __enter__(self) -> "dump_on_error":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            out = self.stream if self.stream is not None else sys.stderr
+            tail = self.recorder.dump_text(self.n)
+            print(f"# chordax flight recorder"
+                  + (f" ({self.label})" if self.label else "")
+                  + f" — last {min(self.n, len(self.recorder))} "
+                  f"events:", file=out)
+            if tail:
+                print(tail, file=out)
+        return False  # never suppress

@@ -63,19 +63,16 @@ from __future__ import annotations
 
 import bisect
 import collections
-import logging
-import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
+from p2p_dhts_tpu.health import PacedLoop
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.membership import OP_FAIL, OP_JOIN, OP_LEAVE
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 from p2p_dhts_tpu.repair.scheduler import TokenBucket
-
-logger = logging.getLogger(__name__)
 
 #: Member lifecycle states.
 JOINING = "joining"
@@ -97,8 +94,15 @@ class _Member:
         self.n_heartbeats = 0
 
 
-class MembershipManager:
-    """Live churn/elasticity control plane for one registered ring."""
+class MembershipManager(PacedLoop):
+    """Live churn/elasticity control plane for one registered ring.
+
+    A PacedLoop (ISSUE 8's consolidation): the background thread,
+    jittered start, failure backoff and stall-aware pacing live in
+    health.PacedLoop; this class owns the membership round itself
+    (`step()`) and overrides `_busy()` with the membership rule — a
+    round that batched rows or left the ring unconverged keeps active
+    pacing unless stalled."""
 
     def __init__(self, gateway, ring_id: str, *,
                  heartbeat_interval_s: float = 1.0,
@@ -126,21 +130,26 @@ class MembershipManager:
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.phi_threshold = float(phi_threshold)
         self.min_heartbeats = int(min_heartbeats)
-        self.interval_s = float(interval_s)
-        self.interval_idle_s = float(interval_idle_s)
         self.max_batch = int(max_batch)
         self.max_pending_joins = int(max_pending_joins)
         self.round_timeout_s = round_timeout_s
-        self.backoff_base_s = float(backoff_base_s)
-        self.backoff_cap_s = float(backoff_cap_s)
         self.sweep_max_rounds = int(sweep_max_rounds)
         if metrics is None:
             # Default to the gateway's registry so membership.* counters
             # land next to the gateway.*/repair.* families it reports.
             metrics = getattr(getattr(gateway, "metrics", None),
                               "base", None)
-        self.metrics = metrics if metrics is not None else METRICS
-        self.bucket = TokenBucket(rate_rows_s, burst_rows)
+        # PacedLoop owns interval_s/interval_idle_s/backoff_*/metrics,
+        # the stop event, the thread, and the failure/backoff/stall
+        # bookkeeping (the PR-6 discipline, now the one shared base).
+        PacedLoop.__init__(
+            self, name=f"membership:{self.ring_id}", kind="membership",
+            interval_s=interval_s, interval_idle_s=interval_idle_s,
+            backoff_base_s=backoff_base_s, backoff_cap_s=backoff_cap_s,
+            metrics=metrics if metrics is not None else METRICS,
+            failure_metric=f"membership.round_failures.{self.ring_id}",
+            bucket=TokenBucket(rate_rows_s, burst_rows),
+            thread_name=f"membership-{self.ring_id}")
 
         self._lock = threading.Lock()
         self._pending: Deque[Tuple[int, int]] = collections.deque()
@@ -163,23 +172,17 @@ class MembershipManager:
         self._mirror_alive: List[bool] = [bool(a) for a in alive_np[:nv]]
         self.capacity = int(ids_np.shape[0])
 
-        # Loop state (written by step()/the loop thread).
-        self.rounds = 0
+        # Loop state (written by step()/the loop thread); rounds /
+        # failures / backoff_s / stalled / last_error live on the
+        # PacedLoop base. A fresh ring starts converged.
         self.batches_applied = 0
         self.rows_applied = 0
         self.sweep_rounds = 0
         self.rows_regenerated = 0
         self.converged = True
-        self.stalled = False
         self._noop_rounds = 0
         self._maintain_due = False
-        self.failures = 0
-        self.backoff_s = 0.0
-        self.last_error: Optional[str] = None
-
-        self._stop = threading.Event()
-        self._started = False
-        self._thread: Optional[threading.Thread] = None
+        self._loop_busy = False
 
         # Attach: the gateway's handoff-failover path and the wire
         # verbs (JOIN_RING / HEARTBEAT / MEMBER_STATUS) find us here.
@@ -411,6 +414,7 @@ class MembershipManager:
         self.stalled = self._noop_rounds >= 2
 
         self.rounds += 1
+        self.mark_round()
         with self._lock:
             pending = len(self._pending)
             alive = sum(1 for a in self._mirror_alive if a)
@@ -551,60 +555,23 @@ class MembershipManager:
             f"{max_rounds} rounds ({last})")
 
     # -- lifecycle ------------------------------------------------------------
-    def start(self) -> "MembershipManager":
-        with self._lock:
-            if self._started:
-                return self
-            if self._stop.is_set():
-                raise RuntimeError("MembershipManager is closed")
-            self._started = True
-        self._thread = threading.Thread(
-            target=self._run, name=f"membership-{self.ring_id}",
-            daemon=True)
-        self._thread.start()
-        return self
+    # start()/close() and the background thread come from PacedLoop;
+    # the two hooks below are the membership-specific pacing policy.
 
-    def _run(self) -> None:
-        # Jittered start: N managers must not batch in lockstep.
-        self._stop.wait(random.uniform(0, self.interval_s))
-        while not self._stop.is_set():
-            busy = False
-            try:
-                summary = self.step()
-                busy = summary["batched"] > 0 or not summary["converged"]
-                self.failures = 0
-                self.backoff_s = 0.0
-                self.last_error = None
-            # chordax-lint: disable=bare-except -- the control loop must survive any round failure; it is counted, logged and backed off
-            except Exception as exc:  # noqa: BLE001 — backoff + retry
-                self.failures += 1
-                self.last_error = f"{type(exc).__name__}: {exc}"
-                self.metrics.inc(
-                    f"membership.round_failures.{self.ring_id}")
-                base = min(self.backoff_base_s * (2 ** (self.failures - 1)),
-                           self.backoff_cap_s)
-                self.backoff_s = random.uniform(base * 0.5, base)
-                logger.warning("membership ring %r round failed (%s); "
-                               "backing off %.2fs", self.ring_id,
-                               self.last_error, self.backoff_s,
-                               exc_info=exc)
-            wait = self.backoff_s if self.backoff_s else (
-                self.interval_s if busy and not self.stalled
-                else self.interval_idle_s)
-            self._stop.wait(wait)
+    def _round(self) -> None:
+        summary = self.step()
+        self._loop_busy = (summary["batched"] > 0
+                           or not summary["converged"])
 
-    def close(self, timeout: float = 30.0) -> None:
-        self._stop.set()
-        t = self._thread
-        if t is not None:
-            t.join(timeout)
-            if t.is_alive():
-                raise TimeoutError(
-                    f"membership loop {self.ring_id!r} did not stop "
-                    f"within {timeout}s")
+    def _busy(self) -> bool:
+        # A round that batched rows or left the ring unconverged keeps
+        # the active interval — unless the loop stalled (work pends but
+        # rounds apply nothing), which idles it visibly.
+        return self._loop_busy and not self.stalled
 
     def __enter__(self) -> "MembershipManager":
-        return self.start()
+        self.start()
+        return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.close()

@@ -95,6 +95,27 @@ class Metrics:
             return {k: v for k, v in self._counters.items()
                     if k.startswith(prefix)}
 
+    def remove_prefix(self, prefix: str) -> int:
+        """Delete every counter/timer/gauge/hist under a dotted prefix
+        (the key itself, or any `prefix.`-extended key — so removing
+        "gateway.health.a" can never collaterally remove
+        "gateway.health.ab"). Ring retirement calls this so a removed
+        ring's per-ring gauges and hists stop haunting dashboards.
+        Returns the number of keys removed."""
+        dotted = prefix + "."
+
+        def _match(k: str) -> bool:
+            return k == prefix or k.startswith(dotted)
+
+        removed = 0
+        with self._lock:
+            for fam in (self._counters, self._timers, self._gauges,
+                        self._hists):
+                for k in [k for k in fam if _match(k)]:
+                    del fam[k]
+                    removed += 1
+        return removed
+
     def observe_hist(self, name: str, value: float) -> None:
         """Append one sample to a bounded reservoir histogram."""
         with self._lock:

@@ -32,6 +32,8 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.health import FLIGHT
 from p2p_dhts_tpu.metrics import METRICS
 
 JsonObj = dict
@@ -147,7 +149,35 @@ class Client:
         clamped to the remaining budget, backoff sleeps never overrun
         it, and an expired deadline raises RpcError immediately — this
         is the client half of the gateway's deadline propagation
-        (client timeout -> gateway budget -> engine slot)."""
+        (client timeout -> gateway budget -> engine slot).
+
+        chordax-scope: while tracing is enabled, this call opens the
+        request's ROOT span and rides the context in the request's
+        TRACE field, so the server/gateway/engine spans of this request
+        share one trace_id (the caller's request dict is never
+        mutated)."""
+        if trace_mod.enabled():
+            with trace_mod.span(
+                    f"rpc.client.{request.get('COMMAND', '')}",
+                    cat="rpc", peer=f"{ip_addr}:{port}") as ctx:
+                # ctx is None if tracing was disabled between the check
+                # above and span() re-reading the flag — the request
+                # must degrade to untraced, never error.
+                if ctx is not None:
+                    request = dict(request)
+                    request[trace_mod.WIRE_KEY] = ctx.to_wire()
+                return Client._request_with_retries(
+                    ip_addr, port, request, timeout,
+                    retries=retries, deadline=deadline)
+        return Client._request_with_retries(
+            ip_addr, port, request, timeout,
+            retries=retries, deadline=deadline)
+
+    @staticmethod
+    def _request_with_retries(ip_addr: str, port: int, request: JsonObj,
+                              timeout: Optional[float] = None, *,
+                              retries: int = 0,
+                              deadline: Optional[float] = None) -> JsonObj:
         # Default resolved at CALL time so a harness can lower
         # rpc.DEFAULT_TIMEOUT_S process-wide: deep recursive handler
         # chains right after mass churn can exhaust the 3-per-server
@@ -427,6 +457,16 @@ class Server:
             else:
                 if self.logging_enabled:
                     self.request_log.push_back(req)
+                    # chordax-scope: the flight recorder subsumes the
+                    # reference's 32-entry RequestLog — same opt-in
+                    # flag, but the events land in the process-wide
+                    # ring the HEALTH plane and dump-on-error read.
+                    # Routine per-request chatter goes to the CHATTER
+                    # ring so it can never evict incident events.
+                    FLIGHT.record_routine(
+                        "rpc", "request", port=self.port,
+                        command=req.get("COMMAND", "")
+                        if isinstance(req, dict) else "?")
                 resp = self._process(req)
             if isinstance(resp, DeferredResponse):
                 # Connection ownership moves to the deferred executor;
@@ -476,6 +516,10 @@ class Server:
             # chordax-lint: disable=bare-except -- reference envelope parity, the _process rule applied to deferred completion
             except Exception as exc:
                 METRICS.inc("rpc.server.handler_error")
+                FLIGHT.record("rpc", "handler_error", port=self.port,
+                              command=req.get("COMMAND", "")
+                              if isinstance(req, dict) else "?",
+                              deferred=True, error=str(exc))
                 resp = {"SUCCESS": False, "ERRORS": str(exc)}
             self._send_reply(conn, resp)
         except OSError:
@@ -508,7 +552,7 @@ class Server:
                 handler = handlers.get(command)
                 if handler is None:
                     raise RuntimeError("Invalid command.")
-                resp = handler(req) or {}
+                resp = self._dispatch_traced(handler, req, command)
             if isinstance(resp, DeferredResponse):
                 # Envelope + send happen in _finish_deferred on the
                 # deferred executor; the caller routes the connection.
@@ -518,4 +562,52 @@ class Server:
         # chordax-lint: disable=bare-except -- reference envelope parity: handler errors become SUCCESS:false (server.h:151-165)
         except Exception as exc:  # handler errors -> SUCCESS false
             METRICS.inc("rpc.server.handler_error")
+            FLIGHT.record("rpc", "handler_error", port=self.port,
+                          command=req.get("COMMAND", "")
+                          if isinstance(req, dict) else "?",
+                          error=str(exc))
             return {"SUCCESS": False, "ERRORS": str(exc)}
+
+    def _dispatch_traced(self, handler: Handler, req: JsonObj,
+                         command: str):
+        """Run one handler, re-activating a wire-carried trace context
+        (chordax-scope): the server span chains under the client's root
+        span, and everything the handler does — gateway routing, engine
+        submission — parents under the server span. Untraced requests
+        (or tracing off) dispatch with zero extra work."""
+        if trace_mod.enabled():
+            ctx = trace_mod.TraceContext.from_wire(
+                req.get(trace_mod.WIRE_KEY))
+            if ctx is not None:
+                with trace_mod.activate(ctx):
+                    with trace_mod.span(f"rpc.server.{command}",
+                                        cat="rpc", port=self.port) as sctx:
+                        resp = handler(req) or {}
+                        if isinstance(resp, DeferredResponse) \
+                                and sctx is not None:
+                            # The real work happens later on the
+                            # deferred executor (another thread): carry
+                            # the SERVER span's context there so the
+                            # continuation's spans stay in this trace
+                            # instead of orphaning into fresh ids.
+                            resp = self._defer_traced(resp, sctx,
+                                                      command)
+                        return resp
+        return handler(req) or {}
+
+    def _defer_traced(self, resp: DeferredResponse,
+                      sctx: "trace_mod.TraceContext",
+                      command: str) -> DeferredResponse:
+        """Wrap a deferred continuation so it re-activates the server
+        span's trace context on the executor thread and records its own
+        `rpc.server.<CMD>.deferred` span (the server span itself only
+        covers the synchronous dispatch)."""
+        inner = resp.fn
+
+        def traced_fn(r):
+            with trace_mod.activate(sctx):
+                with trace_mod.span(f"rpc.server.{command}.deferred",
+                                    cat="rpc", port=self.port):
+                    return inner(r)
+
+        return DeferredResponse(traced_fn, resp.executor)

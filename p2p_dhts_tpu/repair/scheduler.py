@@ -49,15 +49,12 @@ close) holding nothing.
 
 from __future__ import annotations
 
-import logging
-import random
 import threading
 import time
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+from p2p_dhts_tpu.health import PacedLoop
 from p2p_dhts_tpu.metrics import METRICS, Metrics
-
-logger = logging.getLogger(__name__)
 
 
 class TokenBucket:
@@ -375,63 +372,45 @@ def run_drift_round(gateway, ring_id: str, baseline_store, *,
                             healed, unhealable, deferred)
 
 
-class _PairLoop:
-    """One ring pair's background loop + pacing state."""
+class _PairLoop(PacedLoop):
+    """One ring pair's background loop + pacing state.
+
+    The run/backoff/stall body lives in health.PacedLoop (ISSUE 8's
+    consolidation of the three paced-loop bodies): jittered start, one
+    `run_once()` per wake, jittered exponential backoff on failure,
+    idle pacing while converged OR stalled (the base's default `_busy`
+    predicate), and the scheduler's global `_stop` as the extra stop
+    event. `_stop_ev` stays per-loop: hot remove_ring retires ONE pair
+    while the scheduler (and its other loops) keep running."""
 
     def __init__(self, sched: "RepairScheduler",
                  pair: Tuple[str, str]) -> None:
         self.sched = sched
         self.pair = pair
-        self.bucket = TokenBucket(sched.rate_keys_s, sched.burst_keys)
-        # Per-loop stop: hot remove_ring retires ONE pair while the
-        # scheduler (and its other loops) keep running; sched.close()
-        # sets every loop's event.
-        self._stop_ev = threading.Event()
-        self.rounds = 0
-        self.failures = 0
-        self.backoff_s = 0.0
-        self.converged = False
-        #: True when consecutive rounds make NO progress on a residual
-        #: diff (e.g. one ring structurally cannot hold a key's full
-        #: fragment multiset — fewer than n alive peers): the loop
-        #: drops to the idle interval instead of re-putting the same
-        #: keys at full rate forever. Any progress clears it.
-        self.stalled = False
+        super().__init__(
+            name=f"repair:{pair[0]}-{pair[1]}", kind="repair",
+            interval_s=sched.interval_s,
+            interval_idle_s=sched.interval_idle_s,
+            backoff_base_s=sched.backoff_base_s,
+            backoff_cap_s=sched.backoff_cap_s,
+            metrics=sched.metrics,
+            failure_metric=f"repair.round_failures."
+                           f"{pair[0]}-{pair[1]}",
+            extra_stop=sched._stop,
+            bucket=TokenBucket(sched.rate_keys_s, sched.burst_keys),
+            thread_name=f"repair-{pair[0]}-{pair[1]}")
+        #: stalled (from PacedLoop): True when consecutive rounds make
+        #: NO progress on a residual diff (e.g. one ring structurally
+        #: cannot hold a key's full fragment multiset — fewer than n
+        #: alive peers): the loop drops to the idle interval instead of
+        #: re-putting the same keys at full rate forever. Any progress
+        #: clears it.
         self._stall_rounds = 0
         self.last: Optional[RoundResult] = None
-        self.last_error: Optional[str] = None
         self._diverged_at: Optional[float] = None
-        self.thread = threading.Thread(
-            target=self._run, name=f"repair-{pair[0]}-{pair[1]}",
-            daemon=True)
 
-    def _run(self) -> None:
-        sched = self.sched
-        # Jittered start so N pair loops never digest in lockstep.
-        self._stop_ev.wait(random.uniform(0, sched.interval_s))
-        while not (sched._stop.is_set() or self._stop_ev.is_set()):
-            try:
-                self.run_once()
-                self.failures = 0
-                self.backoff_s = 0.0
-                self.last_error = None
-            # chordax-lint: disable=bare-except -- the pair loop must survive any round failure; it is counted, logged and backed off
-            except Exception as exc:  # noqa: BLE001 — backoff + retry
-                self.failures += 1
-                self.last_error = f"{type(exc).__name__}: {exc}"
-                sched.metrics.inc(
-                    f"repair.round_failures.{self.pair[0]}-{self.pair[1]}")
-                base = min(sched.backoff_base_s * (2 ** (self.failures - 1)),
-                           sched.backoff_cap_s)
-                self.backoff_s = random.uniform(base * 0.5, base)
-                logger.warning("repair pair %s round failed (%s); "
-                               "backing off %.2fs", self.pair,
-                               self.last_error, self.backoff_s,
-                               exc_info=exc)
-            wait = self.backoff_s if self.backoff_s else (
-                sched.interval_idle_s if (self.converged or self.stalled)
-                else sched.interval_s)
-            self._stop_ev.wait(wait)
+    def _round(self) -> None:
+        self.run_once()
 
     def nudge(self) -> None:
         """Drop converged/stalled so the next round runs at active
@@ -458,6 +437,7 @@ class _PairLoop:
             raise
         self.bucket.refund(granted - res.examined)
         self.rounds += 1
+        self.mark_round()
         prev = self.last
         self.last = res
         # Stall detection: an unconverged round whose only action was
@@ -522,12 +502,14 @@ class _PairLoop:
         }
 
 
-class _DriftLoop:
+class _DriftLoop(PacedLoop):
     """One ring's intra-ring drift loop (live store vs a baseline
-    FragmentStore): the _PairLoop pacing discipline — token bucket,
-    jittered backoff, stall-as-converged idling — around
+    FragmentStore): the same PacedLoop pacing discipline — token
+    bucket, jittered backoff, converged idling — around
     run_drift_round. Duck-types _PairLoop where the scheduler's
-    lifecycle and run_until_converged need it."""
+    lifecycle and run_until_converged need it (stalled stays False, so
+    the base's converged-or-stalled idle predicate reduces to the
+    drift loop's converged-only rule)."""
 
     def __init__(self, sched: "RepairScheduler", ring_id: str,
                  baseline) -> None:
@@ -535,49 +517,25 @@ class _DriftLoop:
         self.ring_id = str(ring_id)
         self.pair = (self.ring_id, "__baseline__")
         self._baseline = baseline  # FragmentStore or () -> FragmentStore
-        self.bucket = TokenBucket(sched.rate_keys_s, sched.burst_keys)
-        self._stop_ev = threading.Event()
-        self.rounds = 0
-        self.failures = 0
-        self.backoff_s = 0.0
-        self.converged = False
-        self.stalled = False
+        super().__init__(
+            name=f"repair-drift:{ring_id}", kind="repair-drift",
+            interval_s=sched.interval_s,
+            interval_idle_s=sched.interval_idle_s,
+            backoff_base_s=sched.backoff_base_s,
+            backoff_cap_s=sched.backoff_cap_s,
+            metrics=sched.metrics,
+            failure_metric=f"repair.round_failures.{self.ring_id}-drift",
+            extra_stop=sched._stop,
+            bucket=TokenBucket(sched.rate_keys_s, sched.burst_keys),
+            thread_name=f"repair-drift-{ring_id}")
         self.last: Optional[DriftRoundResult] = None
-        self.last_error: Optional[str] = None
-        self.thread = threading.Thread(
-            target=self._run, name=f"repair-drift-{ring_id}",
-            daemon=True)
 
     def _baseline_store(self):
         return self._baseline() if callable(self._baseline) \
             else self._baseline
 
-    def _run(self) -> None:
-        sched = self.sched
-        self._stop_ev.wait(random.uniform(0, sched.interval_s))
-        while not (sched._stop.is_set() or self._stop_ev.is_set()):
-            try:
-                self.run_once()
-                self.failures = 0
-                self.backoff_s = 0.0
-                self.last_error = None
-            # chordax-lint: disable=bare-except -- the drift loop must survive any round failure; it is counted, logged and backed off
-            except Exception as exc:  # noqa: BLE001 — backoff + retry
-                self.failures += 1
-                self.last_error = f"{type(exc).__name__}: {exc}"
-                sched.metrics.inc(
-                    f"repair.round_failures.{self.ring_id}-drift")
-                base = min(sched.backoff_base_s * (2 ** (self.failures - 1)),
-                           sched.backoff_cap_s)
-                self.backoff_s = random.uniform(base * 0.5, base)
-                logger.warning("drift loop %s round failed (%s); "
-                               "backing off %.2fs", self.ring_id,
-                               self.last_error, self.backoff_s,
-                               exc_info=exc)
-            wait = self.backoff_s if self.backoff_s else (
-                sched.interval_idle_s if self.converged
-                else sched.interval_s)
-            self._stop_ev.wait(wait)
+    def _round(self) -> None:
+        self.run_once()
 
     def run_once(self) -> DriftRoundResult:
         sched = self.sched
@@ -592,6 +550,7 @@ class _DriftLoop:
             raise
         self.bucket.refund(granted - res.healed)
         self.rounds += 1
+        self.mark_round()
         self.last = res
         self.converged = res.converged
         sched.metrics.gauge(f"repair.converged.{self.ring_id}-drift",
@@ -698,13 +657,26 @@ class RepairScheduler:
             self.loops = [l for l in self.loops if ring_id not in l.pair]
             started = self._started
         for loop in victims:
-            loop._stop_ev.set()
+            loop.stop()  # signals the loop AND drops it from HEALTH
         if started:
             for loop in victims:
                 if loop.thread.is_alive():
                     loop.thread.join(timeout)
         if victims:
             self.metrics.inc("repair.pairs_retired", len(victims))
+            # Stale-telemetry hygiene (chordax-scope): a retired
+            # pair's last-write-wins gauges and round hists must not
+            # haunt dashboards forever.
+            for loop in victims:
+                if isinstance(loop, _DriftLoop):
+                    self.metrics.remove_prefix(
+                        f"repair.converged.{loop.ring_id}-drift")
+                    continue
+                pair_key = f"{loop.pair[0]}-{loop.pair[1]}"
+                for fam in ("backlog", "converged", "tokens",
+                            "round_ms"):
+                    self.metrics.remove_prefix(
+                        f"repair.{fam}.{pair_key}")
         return len(victims)
 
     def nudge(self, ring_id: str) -> int:
@@ -751,7 +723,7 @@ class RepairScheduler:
             started = self._started
             loops = list(self.loops)
         for loop in loops:
-            loop._stop_ev.set()
+            loop.stop()  # signals the loop AND drops it from HEALTH
         if not started:
             return
         for loop in loops:

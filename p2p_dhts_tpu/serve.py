@@ -110,6 +110,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
@@ -147,7 +148,7 @@ class _Slot:
     dispatch instead of burning a batch lane on an abandoned answer."""
 
     __slots__ = ("kind", "payload", "t_submit", "result", "error", "ev",
-                 "deadline")
+                 "deadline", "trace")
 
     def __init__(self, kind: str, payload: tuple,
                  deadline: Optional[float] = None):
@@ -158,6 +159,10 @@ class _Slot:
         self.error: Optional[BaseException] = None
         self.ev = threading.Event()
         self.deadline = deadline
+        #: chordax-scope: the submitter's TraceContext (None when
+        #: tracing is off or the caller carries no trace) — the engine
+        #: parents this request's span under it at fan-out.
+        self.trace = None
 
     def wait(self, timeout: Optional[float] = None):
         if not self.ev.wait(timeout):
@@ -166,6 +171,20 @@ class _Slot:
         if self.error is not None:
             raise self.error
         return self.result
+
+
+class _BatchTrace:
+    """chordax-scope: one dispatched batch's stage timestamps (built
+    only while tracing is enabled; None rides the pipeline otherwise,
+    so the untraced hot path pays a single flag read)."""
+
+    __slots__ = ("t_w0", "t_w1", "t_launch0", "t_launch1", "t_sync0",
+                 "t_results")
+
+    def __init__(self) -> None:
+        self.t_w0 = self.t_w1 = 0.0
+        self.t_launch0 = self.t_launch1 = 0.0
+        self.t_sync0 = self.t_results = 0.0
 
 
 def _buckets_between(lo: int, hi: int) -> List[int]:
@@ -421,6 +440,11 @@ class ServeEngine:
         if not self._started:
             self.start()
         slots = [_Slot(kind, p, deadline) for p in payloads]
+        if trace_mod.enabled():
+            tctx = trace_mod.current()
+            if tctx is not None:
+                for slot in slots:
+                    slot.trace = tctx
         if deadline is not None and time.perf_counter() >= deadline:
             # Already expired at submission: fail out without touching
             # the queue (the cheapest possible drop, and it keeps the
@@ -444,9 +468,19 @@ class ServeEngine:
                 if fast:
                     self._fast_busy = True
             if fast:
+                btr = None
+                if trace_mod.enabled():
+                    # Fast path has no queue or window: the coalesce
+                    # stage is empty by construction.
+                    btr = _BatchTrace()
+                    btr.t_w0 = btr.t_w1 = slots[0].t_submit
                 try:
+                    if btr is not None:
+                        btr.t_launch0 = time.perf_counter()
                     handle = self._launch(slots)
-                    self._complete_one(slots, handle)
+                    if btr is not None:
+                        btr.t_launch1 = time.perf_counter()
+                    self._complete_one(slots, handle, btr)
                 except BaseException as exc:  # noqa: BLE001 — fanned out
                     self._deliver_error(slots, exc)
                 finally:
@@ -892,8 +926,14 @@ class ServeEngine:
                         break
                 while self._test_hold.is_set() and not self._closing:
                     time.sleep(0.001)
+                btr = None
+                if trace_mod.enabled():
+                    btr = _BatchTrace()
+                    btr.t_w0 = time.perf_counter()
                 self._collect_window()
                 batch = self._pop_batch()
+                if btr is not None:
+                    btr.t_w1 = time.perf_counter()
                 if not batch:
                     continue
                 # Deadline shedding BEFORE device dispatch: an expired
@@ -918,7 +958,11 @@ class ServeEngine:
                 try:
                     self._adapt_window(batch)
                     try:
+                        if btr is not None:
+                            btr.t_launch0 = time.perf_counter()
                         handle = self._launch(batch)
+                        if btr is not None:
+                            btr.t_launch1 = time.perf_counter()
                     except BaseException as exc:  # noqa: BLE001 — fanned
                         self._deliver_error(batch, exc)
                         batch = []
@@ -937,9 +981,9 @@ class ServeEngine:
                     # out right here instead of paying a thread handoff
                     # (the uncontended-latency path). Under load the
                     # handoff buys pipelining, so it stays.
-                    self._complete_one(batch, handle)
+                    self._complete_one(batch, handle, btr)
                 else:
-                    self._inflight.put((batch, handle))
+                    self._inflight.put((batch, handle, btr))
                 batch = []  # handed off; not ours to fail anymore
         except BaseException as exc:  # noqa: BLE001 — engine is wedged
             self._late_errors.append(exc)
@@ -1035,6 +1079,11 @@ class ServeEngine:
         self._metrics.inc(f"serve.requests.{kind}", size)
         self._metrics.inc("serve.batches")
         self._metrics.gauge("serve.batch_fill", size / bucket)
+        # Per-kind batch occupancy (chordax-scope): the gauge above is
+        # last-write-wins across ALL kinds; this histogram answers "how
+        # full do churn batches actually run?" per kind.
+        self._metrics.observe_hist(f"serve.batch_occupancy.{kind}",
+                                   size / bucket)
 
         if kind == "finger_index":
             key_ints = [s.payload[0] for s in batch]
@@ -1187,18 +1236,21 @@ class ServeEngine:
             item = self._inflight.get()
             if item is _SENTINEL:
                 return
-            batch, handle = item
+            batch, handle, btr = item
             try:
-                self._complete_one(batch, handle)
+                self._complete_one(batch, handle, btr)
             finally:
                 with self._lock:
                     self._inflight_n -= 1
 
-    def _complete_one(self, batch: List[_Slot], handle) -> None:
+    def _complete_one(self, batch: List[_Slot], handle,
+                      btr: Optional[_BatchTrace] = None) -> None:
         """Device->host sync + fan-out for one launched batch (runs on
         the completion thread, or inline on the dispatcher when the
         engine is idle)."""
         import numpy as np
+        if btr is not None:
+            btr.t_sync0 = time.perf_counter()
         try:
             kind = handle[0]
             if kind == "finger_index":
@@ -1291,14 +1343,79 @@ class ServeEngine:
             self._deliver_error(batch, exc)
             return
         now = time.perf_counter()
+        if btr is not None:
+            btr.t_results = now
         kind = batch[0].kind
         lats = [now - slot.t_submit for slot in batch]
         with self._lock:
             self._lat[kind].extend(lats)
         self._metrics.observe_hist_many(
             f"serve.latency_ms.{kind}", [v * 1e3 for v in lats])
+        # Spans land BEFORE the waiters wake: a caller that returns
+        # from wait() and immediately reads the span store must find
+        # its request's spans (the dryrun and the TRACE_STATUS verb
+        # both do exactly that).
+        if btr is not None and trace_mod.enabled():
+            self._record_batch_spans(batch, btr, kind)
         for slot in batch:
             slot.ev.set()
+
+    def _record_batch_spans(self, batch: List[_Slot], btr: _BatchTrace,
+                            kind: str) -> None:
+        """chordax-scope span assembly for one completed batch: a
+        batch span (coalesce / bucket-pad / device-dispatch / deliver
+        sub-spans) fan-in-linked to a request span per traced slot
+        (with its own queue-wait sub-span). Runs OFF the submit path
+        (completion thread or dispatcher idle-completion), just BEFORE
+        the waiters are released so a completed request's spans are
+        always visible to its caller."""
+        t_end = time.perf_counter()
+        size = len(batch)
+        bucket = self._bucket_for(size)
+        # One batch span PER DISTINCT TRACE the batch carries: a trace
+        # queried alone (TRACE_STATUS TRACE_ID / export_chrome filter)
+        # must resolve its requests' fan-in links without reaching into
+        # other traces. A batch usually carries one trace (one caller's
+        # vector), so the duplication is bounded by genuine
+        # cross-client coalescing.
+        groups: Dict[str, List] = {}
+        for slot in batch:
+            if slot.trace is not None:
+                groups.setdefault(slot.trace.trace_id, []).append(slot)
+        batch_sids = {tid: trace_mod.new_span_id() for tid in groups}
+        if not groups:
+            # No slot carried a trace: the batch's occupancy/stage
+            # decomposition still stands alone under its own trace id.
+            tid = trace_mod.new_trace_id()
+            groups[tid] = []
+            batch_sids[tid] = trace_mod.new_span_id()
+        for tid, slots in groups.items():
+            batch_sid = batch_sids[tid]
+            req_ids = []
+            for slot in slots:
+                ctx = slot.trace
+                sid = trace_mod.record_span(
+                    f"serve.request.{kind}", slot.t_submit, t_end,
+                    trace_id=tid, parent_id=ctx.span_id,
+                    cat="serve", links=(batch_sid,), engine=self._name)
+                req_ids.append(sid)
+                trace_mod.record_span(
+                    "serve.queue_wait", slot.t_submit,
+                    max(btr.t_w0, slot.t_submit),
+                    trace_id=tid, parent_id=sid, cat="serve")
+            trace_mod.record_span(
+                f"serve.batch.{kind}", btr.t_w0, t_end, trace_id=tid,
+                span_id=batch_sid, cat="serve", links=tuple(req_ids),
+                engine=self._name, size=size, bucket=bucket,
+                fill=round(size / bucket, 4))
+            for name, t0, t1 in (
+                    ("serve.coalesce", btr.t_w0, btr.t_w1),
+                    ("serve.bucket_pad", btr.t_launch0, btr.t_launch1),
+                    ("serve.device_dispatch", btr.t_sync0,
+                     btr.t_results),
+                    ("serve.deliver", btr.t_results, t_end)):
+                trace_mod.record_span(name, t0, t1, trace_id=tid,
+                                      parent_id=batch_sid, cat="serve")
 
     def _drop_expired(self, slots: List[_Slot]) -> None:
         """Fail slots whose deadline passed before dispatch. Distinct
@@ -1327,6 +1444,11 @@ class ServeEngine:
                 slot.ev.set()
                 delivered += 1
         self._metrics.inc("serve.errors")
+        from p2p_dhts_tpu.health import FLIGHT
+        FLIGHT.record("serve", "batch_error", engine=self._name,
+                      kind=batch[0].kind if batch else "?",
+                      n=len(batch), delivered=delivered,
+                      error=f"{type(exc).__name__}: {exc}")
         if delivered == 0:
             self._late_errors.append(exc)
 

@@ -1,0 +1,319 @@
+"""chordax-scope: end-to-end request tracing (Dapper-style spans).
+
+The reference's only request visibility is a stdout line per op plus a
+32-entry request ring (SURVEY.md §5.1); `metrics.py` added aggregate
+counters/hists but nothing ties ONE request's journey together across
+the serving layers. This module adds the missing spine:
+
+  * `TraceContext` — (trace_id, span_id) carried on a thread-local and,
+    over the wire, in the RPC request's ``TRACE`` field
+    (``{"ID": <32-hex>, "SPAN": <16-hex>}``). The RPC client opens the
+    root span and injects the context; the server re-activates it, so
+    the server/gateway/engine spans of one request all share a trace_id
+    and chain by parent_id: RPC client -> rpc.server.<CMD> ->
+    gateway.<kind> -> serve.request.<kind> -> (linked) serve.batch.
+  * `span(name, **args)` — context manager recording one timed span
+    under the ACTIVE context (becoming the new current context inside
+    the block). When tracing is disabled it yields None after ONE flag
+    read — the serve hot path's overhead bound (tested).
+  * `SpanStore` — a bounded in-process ring of finished spans (newest
+    `DEFAULT_CAPACITY` win; eviction is counted, never silent), with
+    `export_chrome()` producing Chrome trace-event JSON
+    (``{"traceEvents": [...]}``, ``ph: "X"`` complete events carrying
+    trace/span/parent ids and fan-in links in ``args``) that
+    `metrics.device_trace` profiles can sit alongside.
+  * `record_span(...)` — the non-contextmanager form the serve engine
+    uses to assemble spans from timestamps after the fact (request
+    sub-spans + batch spans with fan-in links).
+
+Everything is stdlib; recording a span is one dict append under one
+leaf lock (never held across any call out of this module). Tracing is
+OFF by default: `enable()` flips one module-global flag, and every
+instrumentation site checks it before doing any work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+#: Bound on retained finished spans (newest win). Sized for a traced
+#: bench phase: ~8 spans per request x ~1k requests.
+DEFAULT_CAPACITY = 8192
+
+#: Wire field name on RPC requests (net/rpc.py injects/extracts it).
+WIRE_KEY = "TRACE"
+
+
+def new_trace_id() -> str:
+    return format(random.getrandbits(128), "032x")
+
+
+def new_span_id() -> str:
+    return format(random.getrandbits(64), "016x")
+
+
+class TraceContext:
+    """One position in a trace: the ids a child span parents under."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+
+    def to_wire(self) -> Dict[str, str]:
+        return {"ID": self.trace_id, "SPAN": self.span_id}
+
+    @classmethod
+    def from_wire(cls, obj) -> Optional["TraceContext"]:
+        """Parse the RPC ``TRACE`` field; None for anything malformed
+        (a garbled peer must degrade to an untraced request, never an
+        RPC error)."""
+        if not isinstance(obj, dict):
+            return None
+        tid, sid = obj.get("ID"), obj.get("SPAN")
+        if not isinstance(tid, str) or not isinstance(sid, str):
+            return None
+        return cls(tid, sid)
+
+
+class SpanStore:
+    """Bounded thread-safe ring of finished spans (plain dicts)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._buf: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._evicted = 0
+
+    def add(self, span: dict) -> None:
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self._evicted += 1
+            self._buf.append(span)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def evicted(self) -> int:
+        with self._lock:
+            return self._evicted
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._evicted = 0
+
+    def spans(self, trace_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._buf)
+        if trace_id is not None:
+            out = [s for s in out if s["trace_id"] == trace_id]
+        return out
+
+    def trace_ids(self) -> List[str]:
+        """Distinct trace ids currently retained, oldest first."""
+        seen: Dict[str, None] = {}
+        for s in self.spans():
+            seen.setdefault(s["trace_id"])
+        return list(seen)
+
+    def export_chrome(self, trace_id: Optional[str] = None) -> str:
+        """Chrome trace-event JSON (the chrome://tracing / Perfetto
+        format): one ``ph: "X"`` complete event per span, ts/dur in
+        microseconds on a common perf_counter timeline, trace/span/
+        parent ids and fan-in ``links`` carried in ``args``."""
+        # Anchor on the EARLIEST retained t0 (spans land at completion,
+        # so insertion order is finish order — the first-added span may
+        # start later than one added after it, and ts must stay >= 0).
+        all_spans = self.spans()
+        base = min((s["t0"] for s in all_spans), default=0.0)
+        events = []
+        for s in (all_spans if trace_id is None
+                  else [x for x in all_spans
+                        if x["trace_id"] == trace_id]):
+            args = dict(s.get("args") or {})
+            args["trace_id"] = s["trace_id"]
+            args["span_id"] = s["span_id"]
+            if s.get("parent_id"):
+                args["parent_id"] = s["parent_id"]
+            if s.get("links"):
+                args["links"] = list(s["links"])
+            events.append({
+                "name": s["name"],
+                "cat": s.get("cat") or "chordax",
+                "ph": "X",
+                "ts": round((s["t0"] - base) * 1e6, 1),
+                "dur": round(max(s["t1"] - s["t0"], 0.0) * 1e6, 1),
+                "pid": os.getpid(),
+                "tid": s.get("tid", 0),
+                "args": args,
+            })
+        return json.dumps({"traceEvents": events,
+                           "displayTimeUnit": "ms"})
+
+
+class _State:
+    __slots__ = ("on",)
+
+    def __init__(self) -> None:
+        self.on = False
+
+
+_STATE = _State()
+_TLS = threading.local()
+_STORE_LOCK = threading.Lock()
+_STORE = SpanStore()
+
+
+def enabled() -> bool:
+    """ONE attribute read — the hot-path gate every instrumentation
+    site checks before doing any tracing work."""
+    return _STATE.on
+
+
+def enable(on: bool = True) -> None:
+    _STATE.on = bool(on)
+
+
+def store() -> SpanStore:
+    with _STORE_LOCK:
+        return _STORE
+
+
+def set_store(new: SpanStore) -> SpanStore:
+    """Swap the process span store (tests isolate themselves with
+    this); returns the previous store."""
+    global _STORE
+    with _STORE_LOCK:
+        old, _STORE = _STORE, new
+    return old
+
+
+@contextlib.contextmanager
+def tracing(capacity: int = DEFAULT_CAPACITY) -> Iterator[SpanStore]:
+    """Test/bench helper: enable tracing into a FRESH store for the
+    block, restoring the previous store + flag on exit."""
+    new = SpanStore(capacity)
+    old = set_store(new)
+    was = _STATE.on
+    _STATE.on = True
+    try:
+        yield new
+    finally:
+        _STATE.on = was
+        set_store(old)
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_TLS, "ctx", None)
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Make `ctx` the thread's current context for the block (the RPC
+    server's re-activation of a wire-carried context)."""
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = ctx
+    try:
+        yield
+    finally:
+        _TLS.ctx = prev
+
+
+def record_span(name: str, t0: float, t1: float, *, trace_id: str,
+                span_id: Optional[str] = None,
+                parent_id: Optional[str] = None, cat: str = "",
+                links: Sequence[str] = (),
+                **args: Any) -> str:
+    """Append one finished span (perf_counter instants). Returns the
+    span id — the engine's after-the-fact assembly path."""
+    sid = span_id if span_id is not None else new_span_id()
+    store().add({
+        "name": str(name),
+        "cat": cat,
+        "trace_id": trace_id,
+        "span_id": sid,
+        "parent_id": parent_id,
+        "t0": float(t0),
+        "t1": float(t1),
+        "tid": threading.get_ident() & 0xFFFFFFFF,
+        "links": list(links) if links else (),
+        "args": args or (),
+    })
+    return sid
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "", **args: Any
+         ) -> Iterator[Optional[TraceContext]]:
+    """Record one timed span under the active context; inside the
+    block the span IS the current context (children parent to it).
+    Disabled tracing yields None after one flag read."""
+    if not _STATE.on:
+        yield None
+        return
+    parent = getattr(_TLS, "ctx", None)
+    ctx = TraceContext(
+        parent.trace_id if parent is not None else new_trace_id(),
+        new_span_id())
+    _TLS.ctx = ctx
+    t0 = time.perf_counter()
+    err: Optional[str] = None
+    try:
+        yield ctx
+    except BaseException as exc:
+        err = type(exc).__name__
+        raise
+    finally:
+        _TLS.ctx = parent
+        if err is not None:
+            args = dict(args)
+            args["error"] = err
+        record_span(name, t0, time.perf_counter(),
+                    trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    parent_id=parent.span_id if parent is not None
+                    else None,
+                    cat=cat, **args)
+
+
+def status() -> dict:
+    """The TRACE_STATUS wire verb's payload: flag + store occupancy."""
+    st = store()
+    return {
+        "enabled": _STATE.on,
+        "spans": len(st),
+        "capacity": st._buf.maxlen,
+        "evicted": st.evicted,
+        "traces": len(st.trace_ids()),
+    }
+
+
+def find_chain(spans: Sequence[dict], leaf_name_prefix: str
+               ) -> List[dict]:
+    """Walk parent_id links from the first span whose name starts with
+    `leaf_name_prefix` up to its root; returns [leaf..root] (empty if
+    no such span). The bench's linked-chain assertion uses this."""
+    by_id = {s["span_id"]: s for s in spans}
+    leaf = next((s for s in spans
+                 if s["name"].startswith(leaf_name_prefix)), None)
+    if leaf is None:
+        return []
+    chain = [leaf]
+    seen = {leaf["span_id"]}
+    cur = leaf
+    while cur.get("parent_id") and cur["parent_id"] in by_id:
+        cur = by_id[cur["parent_id"]]
+        if cur["span_id"] in seen:  # defensive: a cycle ends the walk
+            break
+        seen.add(cur["span_id"])
+        chain.append(cur)
+    return chain

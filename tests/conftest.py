@@ -162,6 +162,19 @@ def _soak_budget(request):
 def pytest_runtest_makereport(item, call):
     outcome = yield
     report = outcome.get_result()
+    # chordax-scope dump-on-error: a failed test carries the flight
+    # recorder's tail as a report section — the structured context
+    # (handler errors, ring health flips, loop round failures) that
+    # the bare assertion message lacks.
+    if call.when == "call" and report.failed:
+        try:
+            from p2p_dhts_tpu.health import FLIGHT
+            tail = FLIGHT.dump_text(30)
+            if tail:
+                report.sections.append(
+                    ("chordax flight recorder (tail)", tail))
+        except Exception:  # noqa: BLE001 — reporting must not mask the failure
+            pass
     if item.get_closest_marker("soak") is None:
         return
     # Record the call phase, and ALSO setup-phase skips — the session

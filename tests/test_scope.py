@@ -1,0 +1,527 @@
+"""chordax-scope tests (ISSUE 8): end-to-end tracing, the flight
+recorder, the unified health plane, the introspection wire verbs, the
+PacedLoop consolidation semantics, and the telemetry-hygiene
+satellites (Metrics.remove_prefix / ring retirement, metric-key
+doc-drift gate)."""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu import trace
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+from p2p_dhts_tpu.health import (FLIGHT, FlightRecorder, HealthRegistry,
+                                 PacedLoop, dump_on_error)
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.net.rpc import Client, Server
+
+pytestmark = pytest.mark.scope
+
+
+def _ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+def _mk_gateway(rng, n_peers=16, store=False, **ring_kw):
+    gw = Gateway(metrics=Metrics(), name="scope-test")
+    state = build_ring(_ids(rng, n_peers),
+                       RingConfig(finger_mode="materialized"), **ring_kw)
+    gw.add_ring("s1", state, empty_store(256, 4) if store else None,
+                default=True, bucket_min=8, bucket_max=8)
+    return gw
+
+
+# ---------------------------------------------------------------------------
+# tracing: span-tree assembly
+# ---------------------------------------------------------------------------
+
+def test_span_chain_rpc_gateway_engine_batch(rng):
+    """One wire FIND_SUCCESSOR while tracing: the span tree chains
+    rpc.client -> rpc.server -> gateway -> serve.request, the request
+    and its batch fan-in link BOTH ways, and the batch decomposes into
+    the four stage sub-spans."""
+    gw = _mk_gateway(rng)
+    srv = Server(0, {})
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        with trace.tracing() as store:
+            resp = Client.make_request(
+                "127.0.0.1", srv.port,
+                {"COMMAND": "FIND_SUCCESSOR",
+                 "KEY": format(_ids(rng, 1)[0], "x")})
+            assert resp["SUCCESS"] and resp["OWNER"] >= 0
+            spans = store.spans()
+        chain = trace.find_chain(spans, "serve.request.find_successor")
+        names = [s["name"] for s in chain]
+        assert names == ["serve.request.find_successor",
+                         "gateway.find_successor",
+                         "rpc.server.FIND_SUCCESSOR",
+                         "rpc.client.FIND_SUCCESSOR"], names
+        assert len({s["trace_id"] for s in chain}) == 1, \
+            "chain spans do not share one trace_id"
+        by_id = {s["span_id"]: s for s in spans}
+        req = chain[0]
+        batch_ids = [l for l in req["links"] if l in by_id]
+        assert batch_ids, "request span carries no batch link"
+        batch = by_id[batch_ids[0]]
+        assert batch["name"] == "serve.batch.find_successor"
+        assert req["span_id"] in batch["links"], \
+            "batch span does not fan-in-link the request span"
+        assert batch["args"]["size"] >= 1 and batch["args"]["bucket"] == 8
+        subs = {s["name"] for s in spans
+                if s.get("parent_id") == batch["span_id"]}
+        assert {"serve.coalesce", "serve.bucket_pad",
+                "serve.device_dispatch", "serve.deliver"} <= subs, subs
+        qw = [s for s in spans if s["name"] == "serve.queue_wait"
+              and s.get("parent_id") == req["span_id"]]
+        assert qw, "request span has no queue-wait sub-span"
+        # Admission recorded under the gateway span.
+        adm = [s for s in spans if s["name"] == "gateway.admission"]
+        assert adm and adm[0]["parent_id"] == chain[1]["span_id"]
+    finally:
+        srv.kill()
+        gw.close()
+
+
+def test_trace_export_is_valid_chrome_json(rng):
+    gw = _mk_gateway(rng)
+    try:
+        with trace.tracing() as store:
+            with trace.span("client"):
+                gw.find_successor(_ids(rng, 1)[0], 0)
+            doc = json.loads(store.export_chrome())
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        for ev in doc["traceEvents"]:
+            assert ev["ph"] == "X"
+            for field in ("name", "cat", "ts", "dur", "pid", "tid",
+                          "args"):
+                assert field in ev
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+            assert "trace_id" in ev["args"] and "span_id" in ev["args"]
+    finally:
+        gw.close()
+
+
+def test_tracing_disabled_is_inert_and_cheap():
+    """The serve hot path's overhead bound: with tracing off, span()
+    is a no-op yielding None, nothing ever lands in the store, and the
+    per-call cost stays far below a request's latency floor."""
+    assert not trace.enabled()
+    before = len(trace.store())
+    with trace.span("x") as ctx:
+        assert ctx is None
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("x", cat="bench"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-5, \
+        f"disabled span() costs {per_call * 1e6:.1f} us/call"
+    assert len(trace.store()) == before
+    # The engine records nothing either (slot.trace stays None).
+    from p2p_dhts_tpu.serve import ServeEngine
+    eng = ServeEngine(bucket_min=8, bucket_max=8, name="scope-inert")
+    try:
+        assert eng.finger_index(123, 1) >= -1
+    finally:
+        eng.close()
+    assert len(trace.store()) == before
+
+
+def test_span_store_bounded_and_evictions_counted():
+    store = trace.SpanStore(capacity=4)
+    for j in range(7):
+        store.add({"name": f"s{j}", "cat": "", "trace_id": "t",
+                   "span_id": str(j), "parent_id": None,
+                   "t0": float(j), "t1": float(j), "tid": 0,
+                   "links": (), "args": ()})
+    assert len(store) == 4 and store.evicted == 3
+    assert [s["name"] for s in store.spans()] == ["s3", "s4", "s5", "s6"]
+
+
+def test_trace_context_wire_roundtrip_and_garbage():
+    ctx = trace.TraceContext("ab" * 16, "cd" * 8)
+    back = trace.TraceContext.from_wire(ctx.to_wire())
+    assert back.trace_id == ctx.trace_id and back.span_id == ctx.span_id
+    for garbage in (None, 7, "x", {}, {"ID": 3}, {"ID": "a"},
+                    {"SPAN": "b"}, {"ID": None, "SPAN": None}):
+        assert trace.TraceContext.from_wire(garbage) is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_bounded_ring_and_dump_on_error():
+    rec = FlightRecorder(capacity=8)
+    for j in range(12):
+        rec.record("unit", f"e{j}", j=j)
+    assert len(rec) == 8 and rec.recorded == 12
+    assert [e["event"] for e in rec.recent(2)] == ["e10", "e11"]
+    buf = io.StringIO()
+    with pytest.raises(ValueError, match="boom"):
+        with dump_on_error("unit-test", stream=buf, recorder=rec):
+            raise ValueError("boom")
+    out = buf.getvalue()
+    assert "flight recorder" in out and "unit-test" in out
+    assert "e11" in out and "e3" not in out  # evicted stays evicted
+    # The no-error path prints nothing.
+    buf2 = io.StringIO()
+    with dump_on_error(stream=buf2, recorder=rec):
+        pass
+    assert buf2.getvalue() == ""
+
+
+def test_rpc_layer_feeds_flight_recorder():
+    """The recorder subsumes RequestLog: logged requests land in the
+    CHATTER ring (routine traffic must never evict incidents), handler
+    errors in the incident ring."""
+    def boom(req):
+        raise RuntimeError("scope-boom")
+
+    srv = Server(0, {"BOOM": boom}, logging_enabled=True)
+    srv.run_in_background()
+    n0 = FLIGHT.recorded
+    r0 = FLIGHT.routine_recorded
+    try:
+        resp = Client.make_request("127.0.0.1", srv.port,
+                                   {"COMMAND": "BOOM"})
+        assert resp["SUCCESS"] is False
+    finally:
+        srv.kill()
+    chatter = [e for e in FLIGHT.recent(50, routine=True)
+               if e["subsystem"] == "rpc" and e.get("port") == srv.port]
+    assert any(e["event"] == "request" and e["command"] == "BOOM"
+               for e in chatter), chatter
+    events = [e for e in FLIGHT.recent(50)
+              if e["subsystem"] == "rpc" and e.get("port") == srv.port]
+    assert all(e["event"] != "request" for e in events), \
+        "routine request chatter leaked into the incident ring"
+    assert any(e["event"] == "handler_error"
+               and "scope-boom" in e["error"] for e in events), events
+    assert FLIGHT.recorded > n0
+    assert FLIGHT.routine_recorded > r0
+
+
+def test_deferred_dispatch_stays_in_trace():
+    """A deferring handler (DeferredResponse) must not orphan its
+    continuation's spans: the continuation re-activates the server
+    span's context on the deferred executor, so its work records
+    `rpc.server.<CMD>.deferred` in the SAME trace as the client root
+    instead of starting a fresh trace id."""
+    from concurrent.futures import ThreadPoolExecutor
+    from p2p_dhts_tpu.net.rpc import DeferredResponse
+
+    pool = ThreadPoolExecutor(max_workers=1)
+
+    def slow(req):
+        def finish(r):
+            with trace.span("deferred.work"):
+                pass
+            return {"DONE": True}
+        return DeferredResponse(finish, pool)
+
+    srv = Server(0, {"SLOW": slow})
+    srv.run_in_background()
+    try:
+        with trace.tracing() as store:
+            resp = Client.make_request("127.0.0.1", srv.port,
+                                       {"COMMAND": "SLOW"}, 5.0)
+            assert resp["SUCCESS"] and resp["DONE"]
+            spans = store.spans()
+        chain = trace.find_chain(spans, "deferred.work")
+        names = [s["name"] for s in chain]
+        assert names == ["deferred.work", "rpc.server.SLOW.deferred",
+                         "rpc.server.SLOW", "rpc.client.SLOW"], names
+        assert len({s["trace_id"] for s in chain}) == 1, \
+            "deferred continuation escaped the request's trace"
+    finally:
+        srv.kill()
+        pool.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# PacedLoop + HealthRegistry
+# ---------------------------------------------------------------------------
+
+class _FailLoop(PacedLoop):
+    def __init__(self, registry, fail_until=10**9):
+        self.calls = 0
+        self.fail_until = fail_until
+        super().__init__(name="scope:fail", kind="test",
+                         interval_s=0.005, interval_idle_s=0.05,
+                         backoff_base_s=0.01, backoff_cap_s=0.04,
+                         metrics=Metrics(), failure_metric="test.fail",
+                         registry=registry)
+
+    def _round(self):
+        self.calls += 1
+        if self.calls <= self.fail_until:
+            raise RuntimeError(f"round {self.calls} failed")
+
+
+def _wait_for(cond, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_paced_loop_backoff_grows_jittered_and_clears():
+    reg = HealthRegistry()
+    loop = _FailLoop(reg, fail_until=3)
+    loop.start()
+    try:
+        assert _wait_for(lambda: loop.calls >= 2), "loop never ran"
+        assert _wait_for(lambda: loop.calls > 3 and loop.failures == 0
+                         and loop.backoff_s == 0.0
+                         and loop.last_error is None), \
+            "success after failures did not clear the backoff state"
+    finally:
+        loop.close()
+    # Deterministic backoff math on a fresh loop (foreground).
+    l2 = _FailLoop(reg)
+    try:
+        l2._record_failure(RuntimeError("a"))
+        first = l2.backoff_s
+        assert 0.005 <= first <= 0.01, first  # base/2 .. base, jittered
+        l2._record_failure(RuntimeError("b"))
+        second = l2.backoff_s
+        assert 0.01 <= second <= 0.02, second  # doubled band
+        for _ in range(6):
+            l2._record_failure(RuntimeError("c"))
+        assert l2.backoff_s <= 0.04, "backoff exceeded its cap"
+        assert l2.failures == 8 and "c" in l2.last_error
+    finally:
+        l2.stop()
+
+
+def test_paced_loop_stall_and_idle_pacing():
+    reg = HealthRegistry()
+    loop = _FailLoop(reg, fail_until=0)
+    try:
+        # Default predicate: converged or stalled -> idle interval.
+        assert loop._wait_s() == loop.interval_s
+        loop.stalled = True
+        assert loop._wait_s() == loop.interval_idle_s
+        loop.stalled = False
+        loop.converged = True
+        assert loop._wait_s() == loop.interval_idle_s
+        # Backoff dominates pacing.
+        loop.backoff_s = 0.123
+        assert loop._wait_s() == 0.123
+        row = reg.snapshot()["scope:fail"]
+        assert row["stalled"] is False and row["converged"] is True
+        assert row["running"] is False  # never started
+    finally:
+        loop.stop()
+    assert "scope:fail" not in reg.snapshot(), \
+        "stop() did not unregister the loop"
+
+
+def test_health_registry_reports_repair_and_membership_loops(rng):
+    """The acceptance shape: every running repair and membership loop
+    shows up in HEALTH with its stall/backoff state."""
+    from p2p_dhts_tpu.health import HEALTH
+    from p2p_dhts_tpu.membership import MembershipManager
+    from p2p_dhts_tpu.repair import RepairScheduler
+
+    gw = Gateway(metrics=Metrics(), name="scope-health")
+    for rid, default in (("h1", True), ("h2", False)):
+        gw.add_ring(rid, build_ring(_ids(rng, 16),
+                                    RingConfig(finger_mode="materialized")),
+                    empty_store(256, 4), default=default,
+                    bucket_min=8, bucket_max=8)
+    sched = RepairScheduler(gw, [("h1", "h2")], interval_s=0.05,
+                            interval_idle_s=0.2, round_timeout_s=60.0,
+                            metrics=gw.metrics.base)
+    gw.attach_repair(sched)
+    mgr = MembershipManager(gw, "h1", interval_s=0.05,
+                            interval_idle_s=0.2, round_timeout_s=60.0,
+                            metrics=gw.metrics.base)
+    try:
+        sched.start()
+        mgr.start()
+        snap = HEALTH.snapshot()
+        assert "repair:h1-h2" in snap, sorted(snap)
+        assert "membership:h1" in snap, sorted(snap)
+        for name in ("repair:h1-h2", "membership:h1"):
+            row = snap[name]
+            for field in ("stalled", "backoff_s", "failures",
+                          "converged", "rounds", "running", "tokens",
+                          "last_round_age_s"):
+                assert field in row, (name, field, row)
+        assert snap["repair:h1-h2"]["kind"] == "repair"
+        assert snap["membership:h1"]["kind"] == "membership"
+        assert snap["repair:h1-h2"]["tokens"] is not None
+        assert _wait_for(
+            lambda: HEALTH.snapshot()["membership:h1"]["running"])
+    finally:
+        gw.close()
+    snap = HEALTH.snapshot()
+    assert "repair:h1-h2" not in snap and "membership:h1" not in snap, \
+        "closed loops still registered in HEALTH"
+
+
+# ---------------------------------------------------------------------------
+# wire verbs
+# ---------------------------------------------------------------------------
+
+def test_metrics_trace_status_health_verbs_live_server(rng):
+    from p2p_dhts_tpu.repair import RepairScheduler
+
+    gw = _mk_gateway(rng, store=True)
+    gw.add_ring("s2", build_ring(_ids(rng, 16),
+                                 RingConfig(finger_mode="materialized")),
+                empty_store(256, 4), bucket_min=8, bucket_max=8)
+    sched = RepairScheduler(gw, [("s1", "s2")], round_timeout_s=60.0,
+                            metrics=gw.metrics.base)
+    gw.attach_repair(sched)
+    srv = Server(0, {})
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        # Some traffic so counters exist.
+        gw.find_successor(_ids(rng, 1)[0], 0)
+
+        resp = Client.make_request("127.0.0.1", srv.port,
+                                   {"COMMAND": "METRICS"})
+        assert resp["SUCCESS"]
+        counters = resp["METRICS"]["counters"]
+        assert any(k.startswith("gateway.requests.") for k in counters)
+        resp = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "METRICS", "PREFIX": "gateway."})
+        assert resp["SUCCESS"] and resp["COUNTERS"]
+        assert all(k.startswith("gateway.") for k in resp["COUNTERS"])
+
+        with trace.tracing() as store:
+            Client.make_request(
+                "127.0.0.1", srv.port,
+                {"COMMAND": "FIND_SUCCESSOR",
+                 "KEY": format(_ids(rng, 1)[0], "x")})
+            resp = Client.make_request("127.0.0.1", srv.port,
+                                       {"COMMAND": "TRACE_STATUS"})
+            assert resp["SUCCESS"] and resp["STATUS"]["enabled"]
+            assert resp["STATUS"]["spans"] > 0
+            tid = store.trace_ids()[0]
+            resp = Client.make_request(
+                "127.0.0.1", srv.port,
+                {"COMMAND": "TRACE_STATUS", "TRACE_ID": tid,
+                 "EXPORT": True})
+            assert resp["SUCCESS"]
+            assert all(s["trace_id"] == tid for s in resp["SPANS"])
+            assert resp["SPANS"], "no spans returned for a live trace"
+            assert resp["CHROME"]["traceEvents"]
+        resp = Client.make_request("127.0.0.1", srv.port,
+                                   {"COMMAND": "TRACE_STATUS"})
+        assert resp["STATUS"]["enabled"] is False
+
+        resp = Client.make_request("127.0.0.1", srv.port,
+                                   {"COMMAND": "HEALTH", "TAIL": 5})
+        assert resp["SUCCESS"]
+        assert "repair:s1-s2" in resp["HEALTH"]["LOOPS"]
+        row = resp["HEALTH"]["LOOPS"]["repair:s1-s2"]
+        assert "stalled" in row and "backoff_s" in row
+        rings = resp["HEALTH"]["RINGS"]
+        assert rings["s1"]["state"] == "healthy"
+        assert resp["HEALTH"]["FLIGHT"]["recorded"] >= 0
+        assert isinstance(resp["HEALTH"]["FLIGHT"]["tail"], list)
+    finally:
+        srv.kill()
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry hygiene
+# ---------------------------------------------------------------------------
+
+def test_remove_prefix_is_segment_exact():
+    m = Metrics()
+    m.inc("gateway.health.a")
+    m.inc("gateway.health.ab")          # must survive prefix "…a"
+    m.gauge("gateway.health.a.sub", 1)
+    m.observe("gateway.health.a", 0.1)  # timer family too
+    m.observe_hist("gateway.health.a", 1.0)
+    assert m.remove_prefix("gateway.health.a") == 4
+    snap = m.snapshot()
+    assert snap["counters"] == {"gateway.health.ab": 1}
+    assert "gauges" not in snap and "hists" not in snap
+    assert m.remove_prefix("nothing.here") == 0
+
+
+def test_remove_ring_retires_per_ring_telemetry(rng):
+    mets = Metrics()
+    gw = Gateway(metrics=mets, name="scope-retire")
+    half = 1 << 127
+    for rid, kr, default in (("ra", (0, half - 1), True),
+                             ("rb", (half, 2 ** 128 - 1), False)):
+        gw.add_ring(rid, build_ring(_ids(rng, 16),
+                                    RingConfig(finger_mode="materialized")),
+                    key_range=kr, default=default,
+                    bucket_min=8, bucket_max=8)
+    try:
+        gw.find_successor(1234, 0, ring_id="ra")
+        gw.find_successor(half + 99, 0, ring_id="rb")
+        assert any(k.endswith(".rb") for k in
+                   mets.counters_with_prefix("gateway."))
+        # The ring's membership telemetry retires with it too (the
+        # manager closes inside remove_ring).
+        mets.gauge("membership.pending.rb", 3)
+        mets.inc("membership.heartbeats.rb")
+        gw.remove_ring("rb")
+        assert mets.counter("membership.heartbeats.rb") == 0
+        assert "membership.pending.rb" not in \
+            mets.snapshot().get("gauges", {})
+        left = mets.counters_with_prefix("gateway.")
+        assert not any(k.endswith(".rb") for k in left), left
+        snap = mets.snapshot()
+        assert not any(k.endswith(".rb") for k in
+                       snap.get("gauges", {})), snap.get("gauges")
+        assert not any(k.endswith(".rb") for k in
+                       snap.get("hists", {})), "rb hists survived"
+        # The surviving ring's telemetry is untouched.
+        assert any(k.endswith(".ra") for k in left)
+        assert gw.find_successor(1234, 0, ring_id="ra")[0] >= 0
+    finally:
+        gw.close()
+
+
+def test_metric_key_doc_drift_gate(tmp_path):
+    from p2p_dhts_tpu.analysis import metric_keys as mk
+
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "# x\n\n### Metric-key inventory\n\n"
+        "| Key | Type | Meaning |\n|---|---|---|\n"
+        "| `a.b.<ring>` | counter | fine |\n"
+        "| `gone.key` | counter | no site left |\n\n## next\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "def f(m, rid):\n"
+        "    m.inc(f'a.b.{rid}')\n"
+        "    m.gauge('c.d', 1)\n"
+        "    m.inc(name_var)\n")
+    findings = mk.run([str(mod)], str(tmp_path))
+    rules = sorted((f.rule, f.path) for f in findings)
+    assert rules == [("metric-key-stale", "README.md"),
+                     ("metric-key-undocumented", "mod.py")], findings
+    # The shipped tree itself must be drift-free (the gate's contract).
+    assert mk.run_default(".") == []
+
+
+def test_metric_key_gate_wired_into_run_all():
+    from p2p_dhts_tpu import analysis
+    assert "metrics" in analysis.ALL_PASSES
+    findings, _ = analysis.run_all(passes=("metrics",))
+    assert findings == []

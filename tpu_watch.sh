@@ -1,32 +1,37 @@
 #!/bin/bash
-# TPU recovery watcher, round 7: the round-6 nine plus the
-# chordax-membership config (ISSUE 7) all want on-chip records. Wait for the chip to be
+# TPU recovery watcher, round 8: the round-7 ten configs still want
+# on-chip records (greens from r07 carry over). Wait for the chip to be
 # free, probe the remote-compile service (dead since round 4:
 # connection-refused on its port while cached programs kept executing),
 # and when it answers, run the configs without a green record one at a
-# time into BENCH_ATTEMPT_r07.jsonl (bench's _record_lkg promotes each
-# green on-chip record into BENCH_LKG.json). Never kills anything
-# mid-TPU-work; every probe and bench attempt runs to completion (a
-# blocked fresh-shape jit takes ~25 min to fail — that is the probe's
-# cost when the service is down, accepted).
+# time into BENCH_ATTEMPT_r08.jsonl (bench's _record_lkg promotes each
+# green on-chip record into BENCH_LKG.json). NEW in round 8
+# (chordax-scope): every on-chip attempt runs under --trace, archiving
+# a jax.profiler device-trace timeline into BENCH_TRACE_r08/<config>
+# next to the record — watcher rounds leave a timeline, not just
+# numbers. Never kills anything mid-TPU-work; every probe and bench
+# attempt runs to completion (a blocked fresh-shape jit takes ~25 min
+# to fail — that is the probe's cost when the service is down,
+# accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-7 watcher start (core + serve/gateway/repair/membership configs)"
+log "round-8 watcher start (ten configs + device-trace artifacts)"
 
-needed() {  # configs without a green r07 record yet
+needed() {  # configs without a green record yet (r07 greens count)
   python - <<'EOF'
 import json
 ok = set()
-try:
-    for line in open("BENCH_ATTEMPT_r07.jsonl"):
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        if rec.get("config") and rec.get("value") is not None:
-            ok.add(rec["config"])
-except FileNotFoundError:
-    pass
+for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl"):
+    try:
+        for line in open(attempt):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("config") and rec.get("value") is not None:
+                ok.add(rec["config"])
+    except FileNotFoundError:
+        pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership"]
 print(" ".join(c for c in want if c not in ok))
@@ -44,10 +49,11 @@ for i in $(seq 1 80); do
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
-  # chordax-lint gate (ISSUE 3): a finding means this tree is not the
-  # code we want hardware evidence for — fail the cycle before any
-  # bench touches the chip. CPU-pinned so the gate never claims the
-  # TPU (same etiquette as the dryrun respawn).
+  # chordax-lint gate (ISSUE 3; now four passes incl. the metric-key
+  # doc-drift gate): a finding means this tree is not the code we want
+  # hardware evidence for — fail the cycle before any bench touches
+  # the chip. CPU-pinned so the gate never claims the TPU (same
+  # etiquette as the dryrun respawn).
   if ! JAX_PLATFORMS=cpu \
       XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       python -m p2p_dhts_tpu.analysis --strict >> tpu_watch.log 2>&1; then
@@ -55,10 +61,11 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
-  # Gateway smoke (ISSUE 4): the RPC->gateway->engine front door must
-  # pass its CPU smoke (1000-key parity, zero retraces, slow-ring
-  # isolation) before any bench touches the chip — same etiquette as
-  # the lint gate above (CPU-pinned, never claims the TPU).
+  # Gateway smoke (ISSUE 4 + ISSUE 8): the RPC->gateway->engine front
+  # door must pass its CPU smoke — now including the tracing-enabled
+  # closed loop (p50 within 10% of untraced) and the linked
+  # RPC->gateway->engine->batch span-chain export — before any bench
+  # touches the chip.
   if ! JAX_PLATFORMS=cpu python bench.py --config gateway --smoke \
       >> tpu_watch.log 2>&1; then
     log "gateway smoke FAILED - fix the front door before benching"
@@ -93,9 +100,11 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
+    mkdir -p BENCH_TRACE_r08
     for c in $CONFIGS; do
-      log "running --config $c"
-      python bench.py --config "$c" >> BENCH_ATTEMPT_r07.jsonl 2>> BENCH_ATTEMPT_r07.err
+      log "running --config $c (device trace -> BENCH_TRACE_r08/$c)"
+      python bench.py --config "$c" --trace "BENCH_TRACE_r08" \
+        >> BENCH_ATTEMPT_r08.jsonl 2>> BENCH_ATTEMPT_r08.err
       log "config $c rc=$?"
     done
   else
