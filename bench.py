@@ -1085,6 +1085,33 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
     open_p50, open_p99 = _p50_p99(
         engine.recent_latencies("find_successor", open_reqs))
 
+    # -- chordax-wire: the engine behind the RPC front door, both ------
+    # transports side by side (ISSUE 9). Same closed-loop shape at a
+    # reduced size; the retrace invariant below covers this phase too,
+    # so the binary side's numbers can never come from skipped
+    # compiles. Informational here — the hard transport gate lives in
+    # bench_gateway's wire-isolated phase.
+    from p2p_dhts_tpu.net.rpc import Server as _RpcServer
+
+    def _rpc_fs(req):
+        ks = [int(k, 16) if isinstance(k, str) else int(k)
+              for k in req["KEYS"]]
+        slots = engine.submit_many("find_successor",
+                                   [(k, 0) for k in ks])
+        res = [s.wait(600) for s in slots]
+        return {"OWNERS": np.asarray([r[0] for r in res], np.int64),
+                "HOPS": np.asarray([r[1] for r in res], np.int32)}
+
+    rpc_srv = _RpcServer(0, {"FIND_SUCCESSOR": _rpc_fs}, num_threads=3)
+    rpc_srv.run_in_background()
+    try:
+        rpc_transports = _bench_rpc_transports(
+            rpc_srv.port, rpc_workers=min(closed_workers, 4),
+            rpc_reqs_each=max(closed_reqs_each // 10, 10),
+            vector_keys=min(bucket_max, 64), seed0=7000)
+    finally:
+        rpc_srv.kill()
+
     # -- invariants over the whole mixed-size workload -----------------
     engine.assert_no_retraces()
     stats = engine.stats()
@@ -1122,6 +1149,23 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
             "chain": "ok (bench.request -> serve.request -> "
                      "serve.batch fan-in)",
         },
+        "transports": {
+            "json": {
+                "keys_s": round(rpc_transports["json"]["keys_s"], 1),
+                "p50_ms": round(rpc_transports["json"]["p50"] * 1e3, 3),
+                "p99_ms": round(rpc_transports["json"]["p99"] * 1e3, 3),
+            },
+            "binary": {
+                "keys_s": round(rpc_transports["binary"]["keys_s"], 1),
+                "p50_ms": round(
+                    rpc_transports["binary"]["p50"] * 1e3, 3),
+                "p99_ms": round(
+                    rpc_transports["binary"]["p99"] * 1e3, 3),
+            },
+            "binary_vs_json_keys_s_x":
+                rpc_transports["binary_vs_json_keys_s_x"],
+            "note": rpc_transports["note"],
+        },
         "solo_finger_p50_ms": round(solo_fi_p50 * 1e3, 3),
         "solo_finger_p99_ms": round(solo_fi_p99 * 1e3, 3),
         "solo_find_successor_p50_ms": round(solo_fs_p50 * 1e3, 3),
@@ -1134,6 +1178,175 @@ def bench_serve(n_peers: int = 65536, closed_workers: int = 16,
         "parity": "ok (exact, 1000 keys engine vs direct)",
         "device": str(jax.devices()[0]),
     })
+
+
+# ---------------------------------------------------------------------------
+# shared: chordax-wire transport side-by-side (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _prebuild_key_payloads(transport: str, n_reqs: int, vector_keys: int,
+                           seed: int, key_mod=None):
+    """Per-request KEYS payloads in the transport's native wire form
+    (packed little-endian u128 runs over chordax-wire, hex-string lists
+    over the reference JSON form), built BEFORE the clock starts: the
+    measured loops must time the transport, not np.random + per-int
+    formatting."""
+    from p2p_dhts_tpu.net import wire
+
+    wrng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_reqs):
+        ints = [int.from_bytes(wrng.bytes(16), "little")
+                for _ in range(vector_keys)]
+        if key_mod is not None:
+            ints = [k % key_mod for k in ints]
+        out.append(wire.U128Keys(ints) if transport == "binary"
+                   else [format(k, "x") for k in ints])
+    return out
+
+
+def _transport_loop(srv_port: int, transport: str, rpc_workers: int,
+                    rpc_reqs_each: int, vector_keys: int, seed_base: int,
+                    command: str, check, key_mod=None) -> dict:
+    """One closed-loop measurement over one transport: pre-built
+    per-worker payloads, an untimed warm pass (dial/negotiate the pool,
+    touch the already-traced shapes), then the timed run. `check(resp)`
+    returns False for a bad reply."""
+    import threading
+
+    from p2p_dhts_tpu.metrics import nearest_rank
+    from p2p_dhts_tpu.net import wire
+    from p2p_dhts_tpu.net.rpc import Client
+
+    payloads = [_prebuild_key_payloads(transport, rpc_reqs_each,
+                                       vector_keys, seed_base + j, key_mod)
+                for j in range(rpc_workers + 1)]
+    lats: list = []
+    lock = threading.Lock()
+    errors: list = []
+
+    def worker(j):
+        mine = []
+        for keys in payloads[j]:
+            req = {"COMMAND": command, "KEYS": keys,
+                   "DEADLINE_MS": 60000.0}
+            t0 = time.perf_counter()
+            resp = Client.make_request("127.0.0.1", srv_port, req,
+                                       timeout=120.0)
+            mine.append(time.perf_counter() - t0)
+            if not check(resp):
+                errors.append(resp)
+        with lock:
+            lats.extend(mine)
+
+    with wire.forced(transport):
+        worker(rpc_workers)  # untimed warm pass (the extra payload set)
+        lats.clear()
+        threads = [threading.Thread(target=worker, args=(j,))
+                   for j in range(rpc_workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    assert not errors, \
+        f"{transport} transport RPC failures: {errors[:3]}"
+    total_keys = rpc_workers * rpc_reqs_each * vector_keys
+    s = sorted(lats)
+    return {
+        "keys_s": total_keys / wall,
+        "req_s": rpc_workers * rpc_reqs_each / wall,
+        "p50": nearest_rank(s, 0.5),
+        "p99": nearest_rank(s, 0.99),
+    }
+
+
+def _bench_rpc_transports(srv_port: int, rpc_workers: int,
+                          rpc_reqs_each: int, vector_keys: int,
+                          seed0: int, key_mod=None,
+                          command: str = "FIND_SUCCESSOR") -> dict:
+    """Closed-loop batched requests over BOTH client transports against
+    one live server — the same worker count, request count, and key
+    vectors, each transport speaking its native encoding. Reports
+    keys/s + p50/p99 side by side. INFORMATIONAL, no transport gate:
+    this loop includes the device-engine path, which dominates the
+    closed loop on a 1-core CPU smoke host for both transports alike —
+    the hard chordax-wire gate lives in _bench_wire_isolated, which
+    measures the path the transport actually owns. The caller owns the
+    retrace assertion (these loops reuse the already-warmed shapes, so
+    binary-side speed can never come from skipped compiles)."""
+    def check(resp):
+        return bool(resp.get("SUCCESS")) and \
+            -1 not in np.asarray(resp["OWNERS"])
+
+    json_side = _transport_loop(srv_port, "json", rpc_workers,
+                                rpc_reqs_each, vector_keys, seed0,
+                                command, check, key_mod)
+    binary_side = _transport_loop(srv_port, "binary", rpc_workers,
+                                  rpc_reqs_each, vector_keys,
+                                  seed0 + 1000, command, check, key_mod)
+    speedup = binary_side["keys_s"] / json_side["keys_s"] \
+        if json_side["keys_s"] else float("inf")
+    return {
+        "json": {k: round(v, 6) for k, v in json_side.items()},
+        "binary": {k: round(v, 6) for k, v in binary_side.items()},
+        "binary_vs_json_keys_s_x": round(speedup, 2),
+        "note": "engine-in-the-loop closed loop, informational; the "
+                "hard transport gate is wire_isolated",
+    }
+
+
+def _bench_wire_isolated(srv, rpc_workers: int, rpc_reqs_each: int,
+                         vector_keys: int, seg_keys: int = 64) -> dict:
+    """The transport's OWN batched path, hard-gated: a zero-device-work
+    echo handler registered on the SAME live server answers each
+    vector_keys-key request with the gateway's serving response shapes
+    — full-length OWNERS/HOPS vectors plus `seg_keys` IDA fragment
+    matrices (the vector-GET bulk payload, which the legacy transport
+    ships as nested JSON lists and chordax-wire ships as raw buffers).
+    Same workers/requests/vectors on both transports; HARD asserts the
+    ISSUE-9 acceptance bar on what the wire owns: binary >= 3x the JSON
+    keys/s at <= 1/2 the JSON p50."""
+    rng = np.random.RandomState(20260804)
+    seg = rng.rand(32, 8)  # one per-key fragment matrix (segments x width)
+
+    def wire_echo(req):
+        n = len(req["KEYS"])
+        return {"OWNERS": np.zeros(n, np.int64),
+                "HOPS": np.zeros(n, np.int32),
+                "SEGMENTS": [seg] * min(n, seg_keys)}
+
+    srv.update_handlers({"WIRE_BENCH_ECHO": wire_echo})
+
+    def check(resp):
+        return bool(resp.get("SUCCESS"))
+
+    json_side = _transport_loop(srv.port, "json", rpc_workers,
+                                rpc_reqs_each, vector_keys, 500,
+                                "WIRE_BENCH_ECHO", check)
+    binary_side = _transport_loop(srv.port, "binary", rpc_workers,
+                                  rpc_reqs_each, vector_keys, 1500,
+                                  "WIRE_BENCH_ECHO", check)
+    speedup = binary_side["keys_s"] / json_side["keys_s"] \
+        if json_side["keys_s"] else float("inf")
+    assert binary_side["keys_s"] >= 3.0 * json_side["keys_s"], (
+        f"chordax-wire regression: binary transport "
+        f"{binary_side['keys_s']:.0f} keys/s is not >= 3x the JSON "
+        f"transport's {json_side['keys_s']:.0f} keys/s on the "
+        f"wire-isolated batched path")
+    assert binary_side["p50"] <= 0.5 * json_side["p50"], (
+        f"chordax-wire regression: binary p50 "
+        f"{binary_side['p50'] * 1e3:.3f} ms is not <= 1/2 the JSON "
+        f"p50 {json_side['p50'] * 1e3:.3f} ms on the wire-isolated "
+        f"batched path")
+    return {
+        "json": {k: round(v, 6) for k, v in json_side.items()},
+        "binary": {k: round(v, 6) for k, v in binary_side.items()},
+        "binary_vs_json_keys_s_x": round(speedup, 2),
+        "assert": "binary >= 3x keys/s and <= 1/2 p50 (hard; "
+                  "zero-device-work echo, gateway response shapes)",
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1209,7 +1422,8 @@ def bench_gateway(n_peers_a: int = 65536, n_peers_b: int = 16384,
     return _emit({
         "config": "gateway",
         "metric": f"RPC->gateway->engine find_successor keys/sec "
-                  f"(2 rings {n_peers_a}+{n_peers_b} peers, "
+                  f"(chordax-wire binary transport; 2 rings "
+                  f"{n_peers_a}+{n_peers_b} peers, "
                   f"{rpc_workers} TCP workers x {vector_keys}-key "
                   f"vectors)",
         "value": round(stats["rpc_keys_s"], 1),
@@ -1218,6 +1432,28 @@ def bench_gateway(n_peers_a: int = 65536, n_peers_b: int = 16384,
         "rpc_req_s": round(stats["rpc_req_s"], 1),
         "rpc_p50_ms": round(stats["rpc_p50"] * 1e3, 3),
         "rpc_p99_ms": round(stats["rpc_p99"] * 1e3, 3),
+        "transports": {
+            "json": {
+                "keys_s": round(stats["transports"]["json"]["keys_s"], 1),
+                "p50_ms": round(
+                    stats["transports"]["json"]["p50"] * 1e3, 3),
+                "p99_ms": round(
+                    stats["transports"]["json"]["p99"] * 1e3, 3),
+            },
+            "binary": {
+                "keys_s": round(
+                    stats["transports"]["binary"]["keys_s"], 1),
+                "p50_ms": round(
+                    stats["transports"]["binary"]["p50"] * 1e3, 3),
+                "p99_ms": round(
+                    stats["transports"]["binary"]["p99"] * 1e3, 3),
+            },
+            "binary_vs_json_keys_s_x":
+                stats["transports"]["binary_vs_json_keys_s_x"],
+            "note": stats["transports"]["note"],
+            "wire_isolated": stats["transports"]["wire_isolated"],
+            "rpc_parity": "ok (1000 keys, binary transport vs direct)",
+        },
         "direct_engine_keys_s": round(stats["direct_keys_s"], 1),
         "gateway_overhead_x": round(
             stats["direct_keys_s"] / stats["rpc_keys_s"], 2)
@@ -1249,11 +1485,12 @@ def bench_gateway(n_peers_a: int = 65536, n_peers_b: int = 16384,
 
 def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
                           rpc_workers, rpc_reqs_each, vector_keys) -> dict:
-    """The measured phases of bench_gateway (closed-loop RPC, direct
-    comparison, retrace check, slow-ring isolation); split out so the
-    caller's try/finally owns ALL teardown."""
+    """The measured phases of bench_gateway (both-transport closed-loop
+    RPC, direct comparison, retrace check, slow-ring isolation); split
+    out so the caller's try/finally owns ALL teardown."""
     import threading
 
+    from p2p_dhts_tpu.net import wire
     from p2p_dhts_tpu.net.rpc import Client
     from p2p_dhts_tpu.metrics import nearest_rank
 
@@ -1261,45 +1498,60 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
         s = sorted(samples)
         return nearest_rank(s, 0.5), nearest_rank(s, 0.99)
 
-    # Closed loop over TCP: each request carries a vector of keys.
-    # ONE worker body serves both the untraced measurement and the
-    # tracing-overhead re-run (tracing is ambient: Client.make_request
-    # opens the root span itself while trace.enable is on) — the 10%
-    # comparison must measure the identical workload.
-    lats: list = []
-    lat_lock = threading.Lock()
-    errors: list = []
+    # -- RPC-path 1000-key parity over the BINARY transport ------------
+    # The same pkeys the direct-call parity gate used, once through the
+    # whole wire: packed u128 KEYS -> frames -> gateway -> engine ->
+    # raw OWNERS/HOPS buffers back. Byte-identical answers or the
+    # transport is wrong, however fast.
+    direct_res = gw.find_successor_many([(k, 0) for k in pkeys],
+                                        timeout=600)
+    with wire.forced("binary"):
+        bresp = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "FIND_SUCCESSOR",
+             "KEYS": wire.U128Keys([int(k) for k in pkeys]),
+             "DEADLINE_MS": 60000.0}, timeout=120.0)
+    assert bresp.get("SUCCESS"), bresp.get("ERRORS")
+    b_owners = np.asarray(bresp["OWNERS"]).tolist()
+    b_hops = np.asarray(bresp["HOPS"]).tolist()
+    assert b_owners == [r[0] for r in direct_res] and \
+        b_hops == [r[1] for r in direct_res], \
+        "binary-transport RPC parity FAIL over 1000 keys"
 
-    def worker(seed, out, errs):
-        wrng = np.random.RandomState(seed)
+    # -- the chordax-wire side-by-side (ISSUE 9) -----------------------
+    # Engine-in-the-loop closed loop over each transport's native
+    # encoding (informational side-by-side), then the HARD gate on the
+    # wire-isolated batched path: 1000-key vectors against a
+    # zero-device-work echo with the gateway's response shapes —
+    # binary >= 3x JSON keys/s at <= 1/2 the JSON p50, same run.
+    transports = _bench_rpc_transports(
+        srv.port, rpc_workers, rpc_reqs_each, vector_keys, seed0=0)
+    transports["wire_isolated"] = _bench_wire_isolated(
+        srv, rpc_workers, min(rpc_reqs_each, 25), vector_keys=1000)
+    rpc_keys_s = transports["binary"]["keys_s"]
+    rpc_req_s = transports["binary"]["req_s"]
+    rpc_p50 = transports["binary"]["p50"]
+    rpc_p99 = transports["binary"]["p99"]
+
+    # The traced re-run below must measure the IDENTICAL workload shape
+    # as the binary side of the comparison.
+    lat_lock = threading.Lock()
+
+    def worker(payload_list, out, errs):
+        # Payloads pre-built OUTSIDE the timed loop — the same basis as
+        # the untraced transport measurement this re-run compares to.
         mine = []
-        for _ in range(rpc_reqs_each):
-            keys = [format(int.from_bytes(wrng.bytes(16), "little"), "x")
-                    for _ in range(vector_keys)]
+        for keys in payload_list:
             t0 = time.perf_counter()
             resp = Client.make_request(
                 "127.0.0.1", srv.port,
                 {"COMMAND": "FIND_SUCCESSOR", "KEYS": keys,
                  "DEADLINE_MS": 60000.0}, timeout=120.0)
             mine.append(time.perf_counter() - t0)
-            if not resp.get("SUCCESS") or -1 in resp["OWNERS"]:
+            if not resp.get("SUCCESS") or -1 in np.asarray(resp["OWNERS"]):
                 errs.append(resp)
         with lat_lock:
             out.extend(mine)
-
-    threads = [threading.Thread(target=worker, args=(j, lats, errors))
-               for j in range(rpc_workers)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    rpc_wall = time.perf_counter() - t0
-    assert not errors, f"RPC-path failures: {errors[:3]}"
-    total_keys = rpc_workers * rpc_reqs_each * vector_keys
-    rpc_keys_s = total_keys / rpc_wall
-    rpc_req_s = rpc_workers * rpc_reqs_each / rpc_wall
-    rpc_p50, rpc_p99 = _p50_p99(lats)
 
     # -- chordax-scope: the SAME RPC closed loop with tracing ENABLED --
     # The client opens the root span and rides the context on the wire;
@@ -1311,9 +1563,13 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
     from p2p_dhts_tpu import trace as trace_mod
     tlats: list = []
     terrors: list = []
-    with trace_mod.tracing(capacity=65536) as tstore:
+    tpayloads = [_prebuild_key_payloads("binary", rpc_reqs_each,
+                                        vector_keys, 700 + j)
+                 for j in range(rpc_workers)]
+    with trace_mod.tracing(capacity=65536) as tstore, \
+            wire.forced("binary"):
         tthreads = [threading.Thread(target=worker,
-                                     args=(700 + j, tlats, terrors))
+                                     args=(tpayloads[j], tlats, terrors))
                     for j in range(rpc_workers)]
         for t in tthreads:
             t.start()
@@ -1352,6 +1608,7 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
     # Direct-engine comparison (the --config serve path, same keys/s
     # basis): submit the identical vectors straight into ring a's
     # engine — the gateway/RPC overhead is the difference.
+    total_keys = rpc_workers * rpc_reqs_each * vector_keys
     dkeys = _rand_ids(rng, total_keys)
     t0 = time.perf_counter()
     slots = eng_a.submit_many("find_successor", [(k, 0) for k in dkeys])
@@ -1408,6 +1665,7 @@ def _bench_gateway_phases(gw, srv, eng_a, eng_b, rng, pkeys, half,
         "rpc_req_s": rpc_req_s,
         "rpc_p50": rpc_p50,
         "rpc_p99": rpc_p99,
+        "transports": transports,
         "direct_keys_s": direct_keys_s,
         "traced_p50": traced_p50,
         "traced_p99": traced_p99,

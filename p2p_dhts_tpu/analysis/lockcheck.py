@@ -57,6 +57,7 @@ PASS = "lock-discipline"
 DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "serve.py"),
     os.path.join("p2p_dhts_tpu", "net", "rpc.py"),
+    os.path.join("p2p_dhts_tpu", "net", "wire.py"),
     os.path.join("p2p_dhts_tpu", "overlay", "finger_table.py"),
     os.path.join("p2p_dhts_tpu", "overlay", "jax_bridge.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "router.py"),

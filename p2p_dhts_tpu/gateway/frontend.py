@@ -852,18 +852,32 @@ class Gateway:
         return out
 
     # -- RPC handlers (net/rpc.py Server command surface) --------------------
+    # chordax-wire note: vector fields arrive as hex-string lists over
+    # the legacy JSON transport and as packed binary (wire.U128Keys /
+    # numpy views) over the binary transport — _key_int and len() serve
+    # both shapes, so ONE handler body answers both wires. Vector
+    # RESULTS stay numpy: the binary transport ships them as raw
+    # buffers and the JSON encoder (rpc._json_default) lowers them to
+    # the exact nested lists the legacy envelope always carried.
     def handle_find_successor(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
         if "KEYS" in req:
             keys = [_key_int(k) for k in req["KEYS"]]
-            starts = req.get("STARTS") or [0] * len(keys)
+            # No `or`-fallback: a numpy STARTS vector has no truth
+            # value (the binary transport delivers one).
+            starts = req.get("STARTS")
+            if starts is None or len(starts) == 0:
+                starts = [0] * len(keys)
             if len(starts) != len(keys):
                 raise ValueError("STARTS length must match KEYS")
             res = self.find_successor_many(
                 list(zip(keys, starts)), ring_id=ring_id, deadline=dl)
-            return {"OWNERS": [r[0] for r in res],
-                    "HOPS": [r[1] for r in res],
+            import numpy as np
+            return {"OWNERS": np.asarray([r[0] for r in res],
+                                         dtype=np.int64),
+                    "HOPS": np.asarray([r[1] for r in res],
+                                       dtype=np.int32),
                     "RINGS": [r[2] for r in res]}
         key = _key_int(req["KEY"])
         backend = self.router.route(key_int=key, ring_id=ring_id)
@@ -925,7 +939,11 @@ class Gateway:
                     ring_errors[rid] = str(exc)
                     continue
                 for i, (seg, ok) in zip(idxs, res):
-                    segs_out[i] = seg.tolist()
+                    # numpy stays numpy (chordax-wire): the binary
+                    # transport ships the fragment matrix as one raw
+                    # buffer; the JSON encoder lowers it to the legacy
+                    # nested lists at serialization time.
+                    segs_out[i] = seg
                     ok_out[i] = bool(ok)
             out = {"SEGMENTS": segs_out, "OK": ok_out,
                    "RINGS": rings_out}
@@ -933,7 +951,7 @@ class Gateway:
                 out["RING_ERRORS"] = ring_errors
             return out
         segs, ok = self.dhash_get(req["KEY"], ring_id=ring_id, deadline=dl)
-        return {"SEGMENTS": segs.tolist(), "OK": bool(ok)}
+        return {"SEGMENTS": segs, "OK": bool(ok)}
 
     def handle_put(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
@@ -1125,12 +1143,17 @@ class Gateway:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         if "KEYS" in req:
             keys = req["KEYS"]
-            starts = req.get("TABLE_STARTS") or [0] * len(keys)
+            # Explicit None/empty check: numpy TABLE_STARTS (binary
+            # transport) has no truth value.
+            starts = req.get("TABLE_STARTS")
+            if starts is None or len(starts) == 0:
+                starts = [0] * len(keys)
             if len(starts) != len(keys):
                 raise ValueError("TABLE_STARTS length must match KEYS")
             idx = self.finger_index_many(list(zip(keys, starts)),
                                          deadline=dl)
-            return {"INDICES": idx}
+            import numpy as np
+            return {"INDICES": np.asarray(idx, dtype=np.int32)}
         return {"INDEX": self.finger_index(
             req["KEY"], req.get("TABLE_START", 0), deadline=dl)}
 

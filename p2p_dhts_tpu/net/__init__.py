@@ -1,4 +1,6 @@
-"""Host JSON-RPC wire layer (reference parity: src/networking)."""
+"""Host RPC wire layer: reference-parity one-shot JSON
+(src/networking) + the chordax-wire persistent multiplexed binary
+transport (net/wire.py, negotiated per connection)."""
 
 from p2p_dhts_tpu.net.rpc import (  # noqa: F401
     Client,
@@ -7,3 +9,4 @@ from p2p_dhts_tpu.net.rpc import (  # noqa: F401
     Server,
     sanitize_json,
 )
+from p2p_dhts_tpu.net import wire  # noqa: F401

@@ -38,7 +38,7 @@ from typing import Callable, Dict, List, Optional
 
 from p2p_dhts_tpu.metrics import METRICS
 from p2p_dhts_tpu.net.rpc import (DEFAULT_TIMEOUT_S, JsonObj, RpcError,
-                                  parse_reply)
+                                  _json_default, parse_reply)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SOURCES = ("rpc_engine.cc", "chord_peer.cc", "engine.h", "ida.h",
@@ -255,7 +255,12 @@ class NativeServer:
                 handler = self.handlers[cmd]
                 req = json.loads(request_json.decode("utf-8"))
                 resp = handler(req) or {}
-            body = json.dumps(resp, separators=(",", ":")).encode()
+            # chordax-wire: handlers keep bulk vectors numpy-native;
+            # rpc._json_default lowers them to the legacy nested
+            # lists, so a native-backend peer serving the gateway
+            # verbs answers the same bytes rpc.Server would.
+            body = json.dumps(resp, separators=(",", ":"),
+                              default=_json_default).encode()
             self._lib.ns_respond(slot, body)
         # chordax-lint: disable=bare-except -- reference envelope parity: handler errors become SUCCESS:false
         except Exception as exc:  # -> SUCCESS:false envelope, like rpc.py

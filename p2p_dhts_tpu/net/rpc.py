@@ -1,7 +1,8 @@
-"""One-shot TCP JSON-RPC client + threaded server, wire-parity with the
-reference (src/networking/client.{h,cpp}, server.h).
+"""TCP RPC client + server: legacy one-shot JSON (wire-parity with the
+reference src/networking/client.{h,cpp}, server.h) plus the chordax-wire
+persistent multiplexed binary transport (net/wire.py, ISSUE 9).
 
-Protocol (exactly the reference's):
+Legacy protocol (exactly the reference's):
   * request: one minified JSON object; client half-closes its send side
     after writing (client.cpp:60-65); server reads to EOF.
   * dispatch on req["COMMAND"] against a handler map; unknown command ->
@@ -16,15 +17,33 @@ Protocol (exactly the reference's):
   * optional request logging into a bounded ring buffer of 32 entries
     (server.h:119-121,242,364-378).
 
+chordax-wire (ISSUE 9): the SAME server port also speaks the binary
+framing protocol — the first byte of a connection decides (`{` = legacy
+JSON, handled exactly as above; the wire HELLO = a persistent
+multiplexed binary session; see net/wire.py for the frame layout and
+negotiation rule). The server's connection handling is now a
+selector-driven reader: ONE thread owns accept + every connection's
+socket readiness, accumulates bytes, and hands COMPLETE requests
+(legacy EOF / binary frame completion) to the worker pool — so idle
+persistent connections stop pinning the 3 worker threads, and both
+transports parse each request exactly once, on completion (the seed's
+risk of re-parsing an accumulating buffer per 64 KiB chunk is
+structurally gone). Client.make_request routes through the pooled
+binary transport by default (wire.set_transport / CHORDAX_WIRE=json
+select the legacy one-shot path) and falls back per destination when
+negotiation says the peer is legacy — the native C++ server and old
+peers keep working untouched.
+
 The reference runs 3 io_context worker threads per server
 (server.h:294-307); here a thread pool of the same default size serves
-parsed connections, with one acceptor thread.
+parsed requests.
 """
 
 from __future__ import annotations
 
 import json
 import random
+import selectors
 import socket
 import threading
 import time
@@ -32,9 +51,12 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.health import FLIGHT
 from p2p_dhts_tpu.metrics import METRICS
+from p2p_dhts_tpu.net import wire
 
 JsonObj = dict
 Handler = Callable[[JsonObj], JsonObj]
@@ -53,13 +75,15 @@ class DeferredResponse:
 
     A handler that must issue nested RPCs (the JOIN handler's
     recursive pred-resolution) returning one of these frees its server
-    worker immediately: the connection's ownership moves to `executor`,
+    worker immediately: the request's completion moves to `executor`,
     which runs `fn(request)`, wraps the result in the normal
     SUCCESS/ERRORS envelope, and sends the reply. With the reference's
     3 io workers per server (server.h:294-307), >3 simultaneous JOINs
     used to occupy every worker while each join's nested GET_PRED to
     the same server starved behind them — a wedge the reference sleeps
-    out (sleep(20)/sleep(40) in its tests) and this dissolves.
+    out (sleep(20)/sleep(40) in its tests) and this dissolves. On a
+    chordax-wire binary connection the continuation simply answers its
+    frame id later while the connection keeps serving other requests.
 
     Only servers advertising `supports_deferred` honor it (the native
     C++ engine's dispatch is synchronous); handlers must check before
@@ -83,15 +107,38 @@ def sanitize_json(payload: str) -> str:
 
 
 def parse_reply(raw: str) -> JsonObj:
-    """Reply-path parse: sanitize, then take the first JSON value ignoring
-    trailing bytes (JsonCpp failIfExtra=false behavior). The single home of
-    this rule — rpc.Client and native_rpc.NativeClient both route through
-    it, so the wire-parity contract cannot silently fork."""
+    """Reply-path parse: take the first JSON value ignoring trailing
+    bytes (JsonCpp failIfExtra=false behavior). The single home of this
+    rule — rpc.Client and native_rpc.NativeClient both route through
+    it, so the wire-parity contract cannot silently fork. raw_decode
+    already ignores trailing garbage, so the common case parses the
+    buffer ONCE with no sanitize copy; the sanitize pass runs only as
+    a fallback for payloads raw_decode alone rejects."""
+    try:
+        obj, _ = json.JSONDecoder().raw_decode(raw)
+        return obj
+    except json.JSONDecodeError:
+        pass
     try:
         obj, _ = json.JSONDecoder().raw_decode(sanitize_json(raw))
         return obj
     except json.JSONDecodeError as exc:
         raise RpcError(f"Error parsing response: {exc}") from exc
+
+
+def _json_default(value):
+    """json.dumps default for handler results that keep bulk vectors
+    binary-native (chordax-wire): numpy arrays/scalars serialize as the
+    nested lists / plain scalars the legacy JSON transport always
+    carried, so one handler return shape serves both transports."""
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, wire.U128Keys):
+        return [format(v, "x") for v in value]
+    raise TypeError(
+        f"Object of type {type(value).__name__} is not JSON serializable")
 
 
 class RequestLog:
@@ -124,7 +171,10 @@ class RequestLog:
 
 
 class Client:
-    """One-shot request client (ref class Client, client.h:24-46)."""
+    """Request client. One surface, two transports: the pooled
+    multiplexed binary transport (default) and the reference's
+    one-shot JSON form (ref class Client, client.h:24-46), selected by
+    net/wire.py's transport switch and per-destination negotiation."""
 
     #: Retry backoff base. The k-th retry sleeps a JITTERED slice of
     #: base * 2^k: N clients that all saw the same failure at the same
@@ -138,7 +188,7 @@ class Client:
                      timeout: Optional[float] = None, *,
                      retries: int = 0,
                      deadline: Optional[float] = None) -> JsonObj:
-        """One-shot request, optionally retried.
+        """One request, optionally retried.
 
         `retries=0` (the default) is the reference behavior: one
         attempt, transport failure raises RpcError. With retries > 0,
@@ -155,17 +205,23 @@ class Client:
         request's ROOT span and rides the context in the request's
         TRACE field, so the server/gateway/engine spans of this request
         share one trace_id (the caller's request dict is never
-        mutated)."""
+        mutated). Under span sampling, an unsampled root rides an
+        explicit not-sampled marker instead, so no downstream layer
+        starts a fresh trace for a request whose root said no."""
         if trace_mod.enabled():
             with trace_mod.span(
                     f"rpc.client.{request.get('COMMAND', '')}",
                     cat="rpc", peer=f"{ip_addr}:{port}") as ctx:
-                # ctx is None if tracing was disabled between the check
-                # above and span() re-reading the flag — the request
-                # must degrade to untraced, never error.
                 if ctx is not None:
                     request = dict(request)
                     request[trace_mod.WIRE_KEY] = ctx.to_wire()
+                elif trace_mod.enabled():
+                    # Unsampled root (or tracing raced off): carry the
+                    # whole-trace NO downstream (coherent sampling —
+                    # the decision is made once, at the root).
+                    request = dict(request)
+                    request[trace_mod.WIRE_KEY] = \
+                        trace_mod.UNSAMPLED_WIRE
                 return Client._request_with_retries(
                     ip_addr, port, request, timeout,
                     retries=retries, deadline=deadline)
@@ -197,16 +253,10 @@ class Client:
             else:
                 eff_timeout = timeout
             METRICS.inc("rpc.client.requests")
-            t0 = time.perf_counter()
             try:
                 resp = Client._make_request_inner(ip_addr, port, request,
                                                   eff_timeout)
             except RpcError:
-                # Observe the ATTEMPT's latency before any backoff
-                # sleep — the histogram measures requests, not the
-                # retry policy's deliberate waiting.
-                METRICS.observe("rpc.client.request",
-                                time.perf_counter() - t0)
                 METRICS.inc("rpc.client.errors")
                 if attempt >= retries:
                     raise
@@ -226,14 +276,50 @@ class Client:
                 if delay > 0:
                     time.sleep(delay)
             else:
-                METRICS.observe("rpc.client.request",
-                                time.perf_counter() - t0)
                 return resp
 
     @staticmethod
     def _make_request_inner(ip_addr: str, port: int, request: JsonObj,
                             timeout: float) -> JsonObj:
-        payload = json.dumps(request, separators=(",", ":")).encode()
+        """One attempt over the selected transport. The binary path
+        falls back to legacy JSON when negotiation says the
+        destination is a close-delimited server (cached per
+        destination by the pool)."""
+        if wire.transport() == "binary":
+            try:
+                return Client._wire_request_inner(ip_addr, port,
+                                                  request, timeout)
+            except wire.NegotiationFallback:
+                pass
+        return Client._json_request_inner(ip_addr, port, request, timeout)
+
+    @staticmethod
+    def _wire_request_inner(ip_addr: str, port: int, request: JsonObj,
+                            timeout: float) -> JsonObj:
+        # rpc.client.request is observed INSIDE wire.request, wrapped
+        # around the frame round-trip only — dial/negotiation time
+        # records under rpc.client.connect at the dial site, and a
+        # NegotiationFallback records nothing (the JSON path about to
+        # run records the one true sample), so the pooled and one-shot
+        # transports' request histograms stay comparable.
+        # (NegotiationFallback subclasses Exception directly, so it
+        # propagates past the transport-failure clauses below to the
+        # caller's fallback routing untouched.)
+        try:
+            return wire.request(ip_addr, port, request, timeout)
+        except TimeoutError:
+            raise RpcError("RPC reply timed out") from None
+        except (OSError, RuntimeError) as exc:
+            msg = str(exc)
+            if not msg.startswith("RPC transport failure"):
+                msg = f"RPC transport failure: {msg}"
+            raise RpcError(msg) from exc
+
+    @staticmethod
+    def _json_request_inner(ip_addr: str, port: int, request: JsonObj,
+                            timeout: float) -> JsonObj:
+        payload = json.dumps(request, separators=(",", ":"),
+                             default=_json_default).encode()
         # Every transport failure surfaces as RpcError (a RuntimeError):
         # the reference throws boost::system::system_error, which IS-A
         # std::runtime_error, so its catch(runtime_error) recovery paths
@@ -241,20 +327,32 @@ class Client:
         # ConnectionRefused/ResetError here would bypass every
         # `except RuntimeError` in the overlay and crash stabilize().
         try:
+            t_dial = time.perf_counter()
             with socket.create_connection((ip_addr, port),
                                           timeout=timeout) as sock:
-                sock.sendall(payload)
-                sock.shutdown(socket.SHUT_WR)
-                sock.settimeout(timeout)
-                chunks = []
+                # Connection-setup time is its OWN observation: the
+                # request histogram must measure requests, so a pooled
+                # transport's zero dials and this path's per-request
+                # dial stay comparable (ISSUE 9 satellite).
+                METRICS.observe_hist("rpc.client.connect",
+                                     time.perf_counter() - t_dial)
+                t0 = time.perf_counter()
                 try:
-                    while True:
-                        chunk = sock.recv(65536)
-                        if not chunk:
-                            break
-                        chunks.append(chunk)
-                except socket.timeout:
-                    raise RpcError("RPC reply timed out")
+                    sock.sendall(payload)
+                    sock.shutdown(socket.SHUT_WR)
+                    sock.settimeout(timeout)
+                    chunks = []
+                    try:
+                        while True:
+                            chunk = sock.recv(65536)
+                            if not chunk:
+                                break
+                            chunks.append(chunk)
+                    except socket.timeout:
+                        raise RpcError("RPC reply timed out")
+                finally:
+                    METRICS.observe("rpc.client.request",
+                                    time.perf_counter() - t0)
         except RpcError:
             raise
         except OSError as exc:
@@ -271,8 +369,30 @@ class Client:
             return False
 
 
+class _ConnState:
+    """Per-connection server state: transport mode, accumulation
+    buffer, and the send lock that keeps reply frames atomic."""
+
+    __slots__ = ("sock", "mode", "buf", "asm", "send_lock",
+                 "last_activity", "dead")
+
+    def __init__(self, sock: socket.socket, now: float):
+        self.sock = sock
+        self.mode: Optional[str] = None   # None | "legacy" | "binary"
+        self.buf = bytearray()
+        self.asm: Optional[wire.FrameAssembler] = None
+        self.send_lock = threading.Lock()
+        self.last_activity = now
+        self.dead = False
+
+
 class Server:
-    """Threaded request server (ref class Server, server.h:216-431)."""
+    """Threaded request server (ref class Server, server.h:216-431),
+    selector-driven (chordax-wire): one reader thread owns accept and
+    every connection's readiness; complete requests dispatch on the
+    worker pool. Speaks both transports on one port — first byte `{`
+    is a legacy close-delimited JSON request, the wire HELLO opens a
+    persistent multiplexed binary session."""
 
     #: This server honors DeferredResponse handler returns (the native
     #: C++ server does not — its dispatch callback is synchronous).
@@ -301,31 +421,34 @@ class Server:
             self.port = self._sock.getsockname()[1]
         self._alive = True
         self._accept_thread: Optional[threading.Thread] = None
-        self._conns: set = set()
+        self._conns: Dict[socket.socket, _ConnState] = {}
         self._conns_lock = threading.Lock()
+        # Waker pair: worker threads poke the selector loop (dead-
+        # connection drops) without touching the selector themselves.
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
 
     # -- lifecycle ---------------------------------------------------------
     def run_in_background(self) -> None:
         """ref Server::RunInBackground (server.h:312-320)."""
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True,
+            target=self._select_loop, daemon=True,
             name=f"rpc-server-{self.port}")
         self._accept_thread.start()
 
     def kill(self) -> None:
         """Close the acceptor and all in-flight sessions (ref Server::Kill,
-        server.h:354-361). Deterministic: after kill() returns, the accept
-        thread has exited and no socket owned by this server is open, so a
-        connect probe gets an immediate refusal rather than racing a
-        half-dead acceptor."""
+        server.h:354-361). Deterministic: after kill() returns, the
+        selector thread has exited and no socket owned by this server is
+        open for business, so a connect probe gets an immediate refusal
+        rather than racing a half-dead acceptor."""
         if not self._alive:
             return
         self._alive = False
         try:
-            # shutdown() wakes a thread blocked in accept(2) — close()
-            # alone does NOT on Linux (the blocked syscall pins the open
-            # file description), which would leave a zombie accept that
-            # consumes the first post-kill connect probe.
+            # shutdown() wakes anything blocked on the listener —
+            # close() alone does NOT on Linux (a blocked syscall pins
+            # the open file description).
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass  # ENOTCONN on some platforms; close still follows
@@ -333,22 +456,37 @@ class Server:
             self._sock.close()
         except OSError:
             pass
+        self._wake()
         if self._accept_thread is not None and \
                 self._accept_thread is not threading.current_thread():
             self._accept_thread.join(timeout=DEFAULT_TIMEOUT_S)
-        with self._conns_lock:
-            conns = list(self._conns)
-        for c in conns:
+        if self._accept_thread is None:
+            # run_in_background() never ran, so the selector loop's
+            # finally (the usual owner) will never close the waker
+            # pair — close it here or every construct-then-kill cycle
+            # leaks two fds.
             try:
-                # shutdown(), not close(): close() from this thread leaves
-                # a worker blocked in recv() (same accept(2) fact as above)
-                # and frees the fd number for reuse by another server in
-                # this process; shutdown() wakes the worker and lets its
-                # own `with conn:` do the close.
-                c.shutdown(socket.SHUT_RDWR)
+                self._waker_r.close()
+                self._waker_w.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            states = list(self._conns.values())
+        for st in states:
+            try:
+                # shutdown(), not close(): a worker may be mid-sendall
+                # on this socket; shutdown wakes it and the selector
+                # teardown (or the worker's error path) owns the close.
+                st.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
         self._pool.shutdown(wait=False)
+
+    def _wake(self) -> None:
+        try:
+            self._waker_w.send(b"x")
+        except OSError:
+            pass
 
     def install_signal_handlers(self) -> Callable[[], None]:
         """Kill this server gracefully on SIGINT/SIGTERM/SIGQUIT, then
@@ -420,34 +558,214 @@ class Server:
         """ref Server::GetLog (server.h:399-402)."""
         return self.request_log.get_buffer()
 
-    # -- internals ---------------------------------------------------------
-    def _accept_loop(self) -> None:
-        while self._alive:
+    # -- the selector loop -------------------------------------------------
+    def _select_loop(self) -> None:
+        """ONE thread: accept, per-connection byte accumulation,
+        transport sniffing, frame/EOF completion detection. Workers
+        only ever see COMPLETE requests — an idle persistent
+        connection costs a selector registration, not a worker."""
+        sel = selectors.DefaultSelector()
+        try:
+            # kill() may already have closed the listener (a start/kill
+            # race in teardown-heavy tests): exit quietly, nothing to
+            # serve — closing the waker pair here too, since this
+            # early return skips the main finally that usually owns it.
+            self._sock.setblocking(False)
+            sel.register(self._sock, selectors.EVENT_READ, "accept")
+            sel.register(self._waker_r, selectors.EVENT_READ, "waker")
+        except (OSError, ValueError):
+            sel.close()
+            try:
+                self._waker_r.close()
+                self._waker_w.close()
+            except OSError:
+                pass
+            return
+        try:
+            while self._alive:
+                try:
+                    events = sel.select(timeout=0.5)
+                except OSError:
+                    break
+                now = time.monotonic()
+                for key, _mask in events:
+                    if key.data == "accept":
+                        self._accept_ready(sel, now)
+                    elif key.data == "waker":
+                        try:
+                            while self._waker_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    else:
+                        self._conn_readable(sel, key.data, now)
+                self._sweep(sel, now)
+        finally:
+            for key in list(sel.get_map().values()):
+                if isinstance(key.data, _ConnState):
+                    self._drop(sel, key.data)
+            sel.close()
+            try:
+                self._waker_r.close()
+                self._waker_w.close()
+            except OSError:
+                pass
+
+    def _accept_ready(self, sel, now: float) -> None:
+        while True:
             try:
                 conn, _ = self._sock.accept()
-            except OSError:
-                return  # killed
-            with self._conns_lock:
-                self._conns.add(conn)
+            except (BlockingIOError, OSError):
+                return
+            # Blocking socket + level-triggered readiness: recv only
+            # runs after the selector reports data, sendall may block a
+            # WORKER (bounded by the timeout below) but never the
+            # selector loop.
+            conn.settimeout(DEFAULT_TIMEOUT_S)
             try:
-                self._pool.submit(self._serve_connection, conn)
-            except RuntimeError:
-                with self._conns_lock:
-                    self._conns.discard(conn)
-                conn.close()
-                return  # pool shut down
+                # Reply frames are small and latency-bound: without
+                # NODELAY, Nagle holds a pipelined response behind the
+                # previous one's ACK and the persistent transport
+                # LOSES to one-shot JSON at high concurrency.
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # non-TCP test doubles (socketpair) lack the opt
+            st = _ConnState(conn, now)
+            with self._conns_lock:
+                self._conns[conn] = st
+            try:
+                sel.register(conn, selectors.EVENT_READ, st)
+            except (OSError, ValueError):
+                self._drop(sel, st, unregister=False)
 
-    def _serve_connection(self, conn: socket.socket) -> None:
+    def _conn_readable(self, sel, st: _ConnState, now: float) -> None:
+        if st.dead:
+            self._drop(sel, st)
+            return
+        try:
+            data = st.sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(sel, st)
+            return
+        if not data:
+            if st.mode in (None, "legacy") and st.buf:
+                # EOF completes a close-delimited legacy request:
+                # parse ONCE, on the worker pool, now that the full
+                # payload has arrived.
+                raw = bytes(st.buf)
+                st.buf = bytearray()
+                sel.unregister(st.sock)
+                try:
+                    self._pool.submit(self._serve_legacy, st, raw)
+                except RuntimeError:
+                    self._release_conn(st)
+                return
+            self._drop(sel, st)
+            return
+        st.last_activity = now
+        if st.mode is None:
+            st.buf.extend(data)
+            if st.buf[0:1] == wire.HELLO[:1]:
+                if len(st.buf) < len(wire.HELLO):
+                    return  # await the rest of a possible hello
+                if bytes(st.buf[:len(wire.HELLO)]) == wire.HELLO:
+                    st.mode = "binary"
+                    st.asm = wire.FrameAssembler()
+                    leftover = bytes(st.buf[len(wire.HELLO):])
+                    st.buf = bytearray()
+                    try:
+                        with st.send_lock:
+                            st.sock.sendall(wire.HELLO)
+                    except OSError:
+                        self._drop(sel, st)
+                        return
+                    METRICS.inc("rpc.wire.server.connections")
+                    if leftover:
+                        self._feed_binary(sel, st, leftover)
+                    return
+            # Anything else — `{`, garbage, a C-prefixed non-hello —
+            # is a legacy close-delimited request (garbage gets the
+            # reference's parse-error envelope at EOF, exactly as
+            # before).
+            st.mode = "legacy"
+            return
+        if st.mode == "legacy":
+            st.buf.extend(data)
+            if len(st.buf) > wire.MAX_FRAME_BYTES:
+                self._drop(sel, st)
+            return
+        self._feed_binary(sel, st, data)
+
+    def _feed_binary(self, sel, st: _ConnState, data: bytes) -> None:
+        try:
+            frames = st.asm.feed(data)
+        except wire.WireProtocolError:
+            self._drop(sel, st)
+            return
+        for body in frames:
+            METRICS.inc("rpc.wire.server.frames")
+            try:
+                self._pool.submit(self._serve_frame, st, body)
+            except RuntimeError:
+                self._drop(sel, st)
+                return
+
+    def _sweep(self, sel, now: float) -> None:
+        """Enforce the legacy read timeout (a half-sent request must
+        not hold a connection forever — the settimeout(5) analog) and
+        collect worker-flagged dead connections. Binary sessions are
+        persistent by design: only death, not idleness, ends them."""
+        for key in list(sel.get_map().values()):
+            st = key.data
+            if not isinstance(st, _ConnState):
+                continue
+            if st.dead:
+                self._drop(sel, st)
+            elif st.mode in (None, "legacy") and \
+                    now - st.last_activity > DEFAULT_TIMEOUT_S:
+                self._drop(sel, st)
+
+    def _drop(self, sel, st: _ConnState, unregister: bool = True) -> None:
+        if unregister:
+            try:
+                sel.unregister(st.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+        self._release_conn(st)
+
+    def _mark_dead(self, st: _ConnState) -> None:
+        """Worker-side connection failure: flag it and poke the
+        selector loop, which owns unregistration (selectors are not
+        safe to mutate from other threads)."""
+        st.dead = True
+        self._wake()
+
+    def _release_conn(self, st: _ConnState) -> None:
+        st.dead = True
+        with self._conns_lock:
+            self._conns.pop(st.sock, None)
+        # shutdown(), NOT close(): a worker or deferred continuation
+        # may be concurrently inside sendall on this socket, and
+        # close() frees the fd number for reuse — the next accept()
+        # could hand the same fd to a NEW client and the straggler's
+        # write would corrupt that unrelated stream. shutdown wakes
+        # the writer with an error while keeping the fd reserved; the
+        # OS socket closes when the last reference (this state, any
+        # in-flight worker) is dropped.
+        try:
+            st.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    # -- request serving ---------------------------------------------------
+    def _serve_legacy(self, st: _ConnState, raw_bytes: bytes) -> None:
+        """One complete close-delimited JSON request: parse (once),
+        dispatch, reply, close — the reference protocol end to end."""
+        raw = raw_bytes.decode("utf-8", errors="replace")
         deferred = False
         try:
-            conn.settimeout(DEFAULT_TIMEOUT_S)
-            chunks = []
-            while True:
-                chunk = conn.recv(65536)
-                if not chunk:
-                    break
-                chunks.append(chunk)
-            raw = b"".join(chunks).decode("utf-8", errors="replace")
             resp: JsonObj
             req: Optional[JsonObj] = None
             try:
@@ -455,18 +773,7 @@ class Server:
             except json.JSONDecodeError as exc:
                 resp = {"SUCCESS": False, "ERRORS": str(exc)}
             else:
-                if self.logging_enabled:
-                    self.request_log.push_back(req)
-                    # chordax-scope: the flight recorder subsumes the
-                    # reference's 32-entry RequestLog — same opt-in
-                    # flag, but the events land in the process-wide
-                    # ring the HEALTH plane and dump-on-error read.
-                    # Routine per-request chatter goes to the CHATTER
-                    # ring so it can never evict incident events.
-                    FLIGHT.record_routine(
-                        "rpc", "request", port=self.port,
-                        command=req.get("COMMAND", "")
-                        if isinstance(req, dict) else "?")
+                self._log_request(req)
                 resp = self._process(req)
             if isinstance(resp, DeferredResponse):
                 # Connection ownership moves to the deferred executor;
@@ -474,58 +781,122 @@ class Server:
                 # RPCs the deferred work issues may land right here).
                 deferred = True
                 try:
-                    resp.executor.submit(self._finish_deferred, conn,
+                    resp.executor.submit(self._finish_deferred, st,
                                          req, resp.fn)
                 except RuntimeError:
                     # Executor shut down (teardown race): finish
                     # inline — slower, but the caller still gets its
                     # reply and the connection never leaks.
-                    self._finish_deferred(conn, req, resp.fn)
+                    self._finish_deferred(st, req, resp.fn)
                 return
-            self._send_reply(conn, resp)
+            self._send_reply(st.sock, resp)
         except OSError:
             pass  # connection dropped; one-shot protocol, nothing to do
         finally:
             if not deferred:
-                self._release_conn(conn)
+                self._release_conn(st)
+
+    def _serve_frame(self, st: _ConnState, body: bytes) -> None:
+        """One complete binary frame: decode (once — the assembler
+        only releases finished frames), dispatch, answer the frame id.
+        The connection keeps serving other requests throughout."""
+        try:
+            ftype, req_id, req = wire.decode_frame(memoryview(body))
+        except wire.WireProtocolError:
+            self._mark_dead(st)
+            return
+        if ftype != wire.FRAME_REQUEST:
+            self._mark_dead(st)
+            return
+        if not isinstance(req, dict):
+            self._send_frame(st, req_id,
+                             {"SUCCESS": False,
+                              "ERRORS": "request is not an object"})
+            return
+        self._log_request(req)
+        resp = self._process(req)
+        if isinstance(resp, DeferredResponse):
+            # The continuation answers THIS frame id later; the
+            # connection (and this worker) move on immediately —
+            # persistent-connection deferred completion.
+            try:
+                resp.executor.submit(self._finish_deferred_frame, st,
+                                     req, resp.fn, req_id)
+            except RuntimeError:
+                self._finish_deferred_frame(st, req, resp.fn, req_id)
+            return
+        self._send_frame(st, req_id, resp)
+
+    def _log_request(self, req: JsonObj) -> None:
+        if not self.logging_enabled:
+            return
+        self.request_log.push_back(req)
+        # chordax-scope: the flight recorder subsumes the reference's
+        # 32-entry RequestLog — same opt-in flag, but the events land
+        # in the process-wide ring the HEALTH plane and dump-on-error
+        # read. Routine per-request chatter goes to the CHATTER ring
+        # so it can never evict incident events.
+        FLIGHT.record_routine(
+            "rpc", "request", port=self.port,
+            command=req.get("COMMAND", "")
+            if isinstance(req, dict) else "?")
 
     def _send_reply(self, conn: socket.socket, resp: JsonObj) -> None:
-        conn.sendall(json.dumps(resp, separators=(",", ":")).encode())
+        conn.sendall(json.dumps(resp, separators=(",", ":"),
+                                default=_json_default).encode())
         try:
             conn.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
 
-    def _release_conn(self, conn: socket.socket) -> None:
-        with self._conns_lock:
-            self._conns.discard(conn)
+    def _send_frame(self, st: _ConnState, req_id: int,
+                    resp: JsonObj) -> None:
         try:
-            conn.close()
+            frame = wire.encode_frame(wire.FRAME_RESPONSE, req_id, resp)
+        # chordax-lint: disable=bare-except -- an unencodable handler result must become the error envelope, not a silently dropped reply
+        except Exception as exc:
+            frame = wire.encode_frame(
+                wire.FRAME_RESPONSE, req_id,
+                {"SUCCESS": False,
+                 "ERRORS": f"unencodable response: {exc}"})
+        try:
+            with st.send_lock:
+                st.sock.sendall(frame)
         except OSError:
-            pass
+            self._mark_dead(st)
 
-    def _finish_deferred(self, conn: socket.socket, req: JsonObj,
+    def _finish_deferred(self, st: _ConnState, req: JsonObj,
                          fn: Handler) -> None:
         """Run a deferred handler on its executor thread and complete
-        the envelope + reply (the tail of _process/_serve_connection,
-        off the worker pool)."""
+        the envelope + reply (the tail of _process/_serve_legacy, off
+        the worker pool) — legacy one-shot form."""
         try:
-            try:
-                resp = fn(req) or {}
-                resp["SUCCESS"] = True
-            # chordax-lint: disable=bare-except -- reference envelope parity, the _process rule applied to deferred completion
-            except Exception as exc:
-                METRICS.inc("rpc.server.handler_error")
-                FLIGHT.record("rpc", "handler_error", port=self.port,
-                              command=req.get("COMMAND", "")
-                              if isinstance(req, dict) else "?",
-                              deferred=True, error=str(exc))
-                resp = {"SUCCESS": False, "ERRORS": str(exc)}
-            self._send_reply(conn, resp)
+            self._send_reply(st.sock, self._run_deferred(req, fn))
         except OSError:
             pass  # client went away; one-shot protocol
         finally:
-            self._release_conn(conn)
+            self._release_conn(st)
+
+    def _finish_deferred_frame(self, st: _ConnState, req: JsonObj,
+                               fn: Handler, req_id: int) -> None:
+        """Deferred completion on a PERSISTENT binary connection: the
+        continuation answers its own frame id; the connection stays
+        open and keeps serving."""
+        self._send_frame(st, req_id, self._run_deferred(req, fn))
+
+    def _run_deferred(self, req: JsonObj, fn: Handler) -> JsonObj:
+        try:
+            resp = fn(req) or {}
+            resp["SUCCESS"] = True
+            return resp
+        # chordax-lint: disable=bare-except -- reference envelope parity, the _process rule applied to deferred completion
+        except Exception as exc:
+            METRICS.inc("rpc.server.handler_error")
+            FLIGHT.record("rpc", "handler_error", port=self.port,
+                          command=req.get("COMMAND", "")
+                          if isinstance(req, dict) else "?",
+                          deferred=True, error=str(exc))
+            return {"SUCCESS": False, "ERRORS": str(exc)}
 
     def _process(self, req: JsonObj) -> JsonObj:
         """Dispatch + envelope (ref Session::HandleRead/ProcessRequest,
@@ -554,8 +925,8 @@ class Server:
                     raise RuntimeError("Invalid command.")
                 resp = self._dispatch_traced(handler, req, command)
             if isinstance(resp, DeferredResponse):
-                # Envelope + send happen in _finish_deferred on the
-                # deferred executor; the caller routes the connection.
+                # Envelope + send happen in the deferred completion on
+                # the deferred executor; the caller routes the reply.
                 return resp
             resp["SUCCESS"] = True
             return resp
@@ -574,12 +945,31 @@ class Server:
         (chordax-scope): the server span chains under the client's root
         span, and everything the handler does — gateway routing, engine
         submission — parents under the server span. Untraced requests
-        (or tracing off) dispatch with zero extra work."""
+        (or tracing off) dispatch with zero extra work; a request whose
+        root span was SAMPLED OUT re-activates the not-sampled sentinel
+        so no layer below starts a fresh trace for it."""
         if trace_mod.enabled():
             ctx = trace_mod.TraceContext.from_wire(
                 req.get(trace_mod.WIRE_KEY))
             if ctx is not None:
                 with trace_mod.activate(ctx):
+                    if ctx is trace_mod.UNSAMPLED:
+                        resp = handler(req) or {}
+                        if isinstance(resp, DeferredResponse):
+                            # The continuation runs on another thread:
+                            # carry the sampled-OUT verdict there too,
+                            # or its nested RPCs would roll fresh root
+                            # traces for a request whose root said no.
+                            inner = resp.fn
+
+                            def unsampled_fn(r, _inner=inner):
+                                with trace_mod.activate(
+                                        trace_mod.UNSAMPLED):
+                                    return _inner(r)
+
+                            resp = DeferredResponse(unsampled_fn,
+                                                    resp.executor)
+                        return resp
                     with trace_mod.span(f"rpc.server.{command}",
                                         cat="rpc", port=self.port) as sctx:
                         resp = handler(req) or {}

@@ -1385,7 +1385,11 @@ class ServeEngine:
         batch_sids = {tid: trace_mod.new_span_id() for tid in groups}
         if not groups:
             # No slot carried a trace: the batch's occupancy/stage
-            # decomposition still stands alone under its own trace id.
+            # decomposition still stands alone under its own trace id —
+            # as a ROOT, so it takes the whole-trace sampling roll (a
+            # sustained sampled window must not record every batch).
+            if not trace_mod.sample_root():
+                return
             tid = trace_mod.new_trace_id()
             groups[tid] = []
             batch_sids[tid] = trace_mod.new_span_id()

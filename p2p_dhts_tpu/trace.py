@@ -75,13 +75,29 @@ class TraceContext:
     def from_wire(cls, obj) -> Optional["TraceContext"]:
         """Parse the RPC ``TRACE`` field; None for anything malformed
         (a garbled peer must degrade to an untraced request, never an
-        RPC error)."""
+        RPC error). The explicit not-sampled marker ``{"S": 0}``
+        resolves to the UNSAMPLED sentinel: the root span already
+        decided this whole trace is out, and no downstream layer may
+        start a fresh trace for it (coherent whole-trace sampling)."""
         if not isinstance(obj, dict):
             return None
+        if obj.get("S") == 0:
+            return UNSAMPLED
         tid, sid = obj.get("ID"), obj.get("SPAN")
         if not isinstance(tid, str) or not isinstance(sid, str):
             return None
         return cls(tid, sid)
+
+
+#: Sentinel context: "this request's ROOT span was sampled OUT". While
+#: it is the thread's active context, span() is a cheap no-op — the
+#: whole trace stays coherent (all spans or none), decided once at the
+#: root. Identity-compared everywhere; never recorded.
+UNSAMPLED = TraceContext("", "")
+
+#: The wire form of the sampled-out decision (rides the TRACE field so
+#: the server side inherits the root's verdict instead of re-rolling).
+UNSAMPLED_WIRE: Dict[str, int] = {"S": 0}
 
 
 class SpanStore:
@@ -162,10 +178,11 @@ class SpanStore:
 
 
 class _State:
-    __slots__ = ("on",)
+    __slots__ = ("on", "sample_rate")
 
     def __init__(self) -> None:
         self.on = False
+        self.sample_rate = 1.0
 
 
 _STATE = _State()
@@ -180,8 +197,33 @@ def enabled() -> bool:
     return _STATE.on
 
 
-def enable(on: bool = True) -> None:
+def enable(on: bool = True, *,
+           sample_rate: Optional[float] = None) -> None:
+    """Flip the tracing flag; optionally set the WHOLE-TRACE sampling
+    rate (ISSUE 9 satellite). The rate is decided once, at each ROOT
+    span: a sampled root records normally and propagates its context
+    (wire included); an unsampled root suppresses every descendant
+    span — in-process and across the RPC hop — so a sustained
+    production window at sample_rate=0.01 pays ~1% of full tracing's
+    span volume and near-zero per-request overhead on the other 99%
+    (bound-tested). The rate persists across enable() calls until set
+    again; it initializes to 1.0 (trace everything — the bench/debug
+    behavior this satellite generalizes)."""
     _STATE.on = bool(on)
+    if sample_rate is not None:
+        _STATE.sample_rate = min(max(float(sample_rate), 0.0), 1.0)
+
+
+def sample_rate() -> float:
+    return _STATE.sample_rate
+
+
+def sample_root() -> bool:
+    """Roll the root-span sampling decision (standalone-root
+    instrumentation sites — e.g. the serve engine's untraced-batch
+    spans — share the same verdict distribution as span() roots)."""
+    rate = _STATE.sample_rate
+    return rate >= 1.0 or random.random() < rate
 
 
 def store() -> SpanStore:
@@ -199,22 +241,31 @@ def set_store(new: SpanStore) -> SpanStore:
 
 
 @contextlib.contextmanager
-def tracing(capacity: int = DEFAULT_CAPACITY) -> Iterator[SpanStore]:
+def tracing(capacity: int = DEFAULT_CAPACITY,
+            sample_rate: float = 1.0) -> Iterator[SpanStore]:
     """Test/bench helper: enable tracing into a FRESH store for the
-    block, restoring the previous store + flag on exit."""
+    block (at `sample_rate`, default trace-everything), restoring the
+    previous store + flag + rate on exit."""
     new = SpanStore(capacity)
     old = set_store(new)
-    was = _STATE.on
+    was, was_rate = _STATE.on, _STATE.sample_rate
     _STATE.on = True
+    _STATE.sample_rate = min(max(float(sample_rate), 0.0), 1.0)
     try:
         yield new
     finally:
         _STATE.on = was
+        _STATE.sample_rate = was_rate
         set_store(old)
 
 
 def current() -> Optional[TraceContext]:
-    return getattr(_TLS, "ctx", None)
+    """The thread's active context, or None. The UNSAMPLED sentinel
+    reads as None here: capture sites (the serve engine's slot-context
+    grab) must treat a sampled-out request exactly like an untraced
+    one."""
+    ctx = getattr(_TLS, "ctx", None)
+    return None if ctx is UNSAMPLED else ctx
 
 
 @contextlib.contextmanager
@@ -257,11 +308,25 @@ def span(name: str, cat: str = "", **args: Any
          ) -> Iterator[Optional[TraceContext]]:
     """Record one timed span under the active context; inside the
     block the span IS the current context (children parent to it).
-    Disabled tracing yields None after one flag read."""
+    Disabled tracing yields None after one flag read. A ROOT span (no
+    active context) rolls the whole-trace sampling decision: sampled
+    out yields None and suppresses every descendant for the block —
+    one random() and two TLS touches, the affordable-production-
+    tracing overhead bound."""
     if not _STATE.on:
         yield None
         return
     parent = getattr(_TLS, "ctx", None)
+    if parent is UNSAMPLED:
+        yield None
+        return
+    if parent is None and not sample_root():
+        _TLS.ctx = UNSAMPLED
+        try:
+            yield None
+        finally:
+            _TLS.ctx = None
+        return
     ctx = TraceContext(
         parent.trace_id if parent is not None else new_trace_id(),
         new_span_id())
@@ -290,6 +355,7 @@ def status() -> dict:
     st = store()
     return {
         "enabled": _STATE.on,
+        "sample_rate": _STATE.sample_rate,
         "spans": len(st),
         "capacity": st._buf.maxlen,
         "evicted": st.evicted,

@@ -523,9 +523,14 @@ def test_deferred_response_frees_worker_pool():
         pool.shutdown(wait=False)
 
 
-def test_mass_join_regression_over_3_simultaneous():
+@pytest.mark.parametrize("transport", ["json", "binary"])
+def test_mass_join_regression_over_3_simultaneous(transport):
     """>3 simultaneous overlay JOINs against one 3-worker peer all
-    complete and leave every joiner wired into the ring.
+    complete and leave every joiner wired into the ring — over BOTH
+    client transports (ISSUE 9: on a chordax-wire persistent binary
+    connection the deferred JOIN continuation answers its frame id
+    later while the connection keeps serving; the legacy one-shot
+    JSON form must keep the same no-wedge guarantee).
 
     The contract the fix guarantees — and this test asserts — is that
     >3 simultaneous JOIN requests against one 3-worker peer are ALL
@@ -540,11 +545,15 @@ def test_mass_join_regression_over_3_simultaneous():
     repairs — and its stabilize pred-walk can even livelock on such a
     ring (chord_peer.py:225-238, SURVEY quirks) — which is churn
     behavior outside this satellite's scope."""
+    from p2p_dhts_tpu.net import wire
     from p2p_dhts_tpu.overlay.chord_peer import ChordPeer
-    g = ChordPeer("127.0.0.1", 0, num_succs=3, maintenance_interval=None)
-    g.start_chord()
+    _prev = wire.set_transport(transport)
+    g = None
     seed, joiners = [], []
     try:
+        g = ChordPeer("127.0.0.1", 0, num_succs=3,
+                      maintenance_interval=None)
+        g.start_chord()
         for _ in range(3):  # establish a ring first, sequentially
             p = ChordPeer("127.0.0.1", 0, 3, maintenance_interval=None)
             p.join("127.0.0.1", g.port)
@@ -581,8 +590,10 @@ def test_mass_join_regression_over_3_simultaneous():
             f"concurrent JOINs stalled {wall:.2f}s — the worker pool " \
             f"wedged (pre-fix this hits the 5 s reply timeout)"
     finally:
-        for p in joiners + seed + [g]:
+        for p in joiners + seed + ([g] if g is not None else []):
             p.fail()
+        wire.set_transport(_prev)  # restored even on setup failure
+        wire.reset_pool()  # drop pooled connections to the dead peers
 
 
 # ---------------------------------------------------------------------------

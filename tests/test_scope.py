@@ -154,6 +154,101 @@ def test_trace_context_wire_roundtrip_and_garbage():
     for garbage in (None, 7, "x", {}, {"ID": 3}, {"ID": "a"},
                     {"SPAN": "b"}, {"ID": None, "SPAN": None}):
         assert trace.TraceContext.from_wire(garbage) is None
+    # The explicit not-sampled marker resolves to the UNSAMPLED
+    # sentinel — a sampled-out root's verdict, not garbage.
+    assert trace.TraceContext.from_wire(trace.UNSAMPLED_WIRE) \
+        is trace.UNSAMPLED
+
+
+# ---------------------------------------------------------------------------
+# span sampling (ISSUE 9 satellite): coherent whole-trace decisions
+# ---------------------------------------------------------------------------
+
+def test_sample_rate_zero_suppresses_whole_traces_end_to_end(rng):
+    """sample_rate=0: every root rolls NO, the verdict rides the wire,
+    and neither the client, the server, the gateway, nor the engine
+    records a single span — while requests keep serving normally."""
+    gw = _mk_gateway(rng)
+    srv = Server(0, {})
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        with trace.tracing(sample_rate=0.0) as store:
+            assert trace.sample_rate() == 0.0
+            for _ in range(3):
+                resp = Client.make_request(
+                    "127.0.0.1", srv.port,
+                    {"COMMAND": "FIND_SUCCESSOR",
+                     "KEY": format(_ids(rng, 1)[0], "x")})
+                assert resp["SUCCESS"] and resp["OWNER"] >= 0
+            # In-process too: the sampled-out root reads as "no active
+            # context" to capture sites.
+            with trace.span("root") as ctx:
+                assert ctx is None
+                assert trace.current() is None
+                with trace.span("child") as cctx:
+                    assert cctx is None
+            assert len(store) == 0, \
+                [s["name"] for s in store.spans()]
+    finally:
+        srv.kill()
+        gw.close()
+
+
+def test_sampled_traces_are_all_or_nothing():
+    """At a partial rate every recorded trace is COMPLETE (root +
+    descendants) and every unsampled trace is absent entirely — the
+    decision is made once, at the root, never per span."""
+    import random as _random
+    _random.seed(20260804)  # the roll source trace.sample_root uses
+    n = 200
+    with trace.tracing(sample_rate=0.4) as store:
+        for j in range(n):
+            with trace.span(f"root{j}") as ctx:
+                with trace.span("child"):
+                    pass
+                # Sampled root sees its context; unsampled sees None.
+                assert (ctx is None) or ctx.trace_id
+        spans = store.spans()
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s["name"])
+    assert 0 < len(by_trace) < n, \
+        f"{len(by_trace)} sampled of {n}: not a partial rate"
+    for tid, names in by_trace.items():
+        assert len(names) == 2 and "child" in names, (
+            f"trace {tid} is partial: {names} — whole-trace "
+            f"coherence broken")
+    k = len(by_trace)
+    assert 0.2 * n <= k <= 0.6 * n, \
+        f"sampled {k}/{n} at rate 0.4 — roll source skewed"
+
+
+def test_sampling_overhead_bound():
+    """The affordable-production-tracing bound: a sampled-OUT root
+    span costs one roll + two TLS touches — the same order as tracing
+    disabled outright, and nothing ever lands in the store."""
+    n = 20000
+    with trace.tracing(sample_rate=0.0) as store:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with trace.span("x", cat="bench"):
+                pass
+        per_call = (time.perf_counter() - t0) / n
+        assert len(store) == 0
+    assert per_call < 5e-5, \
+        f"sampled-out span() costs {per_call * 1e6:.1f} us/call"
+    # The rate persists across enable() calls until set again, and
+    # clamps to [0, 1].
+    trace.enable(True, sample_rate=3.0)
+    try:
+        assert trace.sample_rate() == 1.0
+        trace.enable(False)
+        assert trace.sample_rate() == 1.0
+        trace.enable(True, sample_rate=-1.0)
+        assert trace.sample_rate() == 0.0
+    finally:
+        trace.enable(False, sample_rate=1.0)
 
 
 # ---------------------------------------------------------------------------

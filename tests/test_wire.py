@@ -1,0 +1,593 @@
+"""chordax-wire: the persistent multiplexed binary transport (ISSUE 9).
+
+Pins the transport's contracts:
+
+  * codec — numpy arrays / packed-u128 key runs survive the frame
+    round-trip with dtype+shape intact, zero-copy on decode; the frame
+    assembler releases only COMPLETE frames (the parse-once rule) under
+    arbitrary chunking.
+  * JSON <-> binary parity — every gateway verb answers byte-identical
+    decoded payloads over both transports (canonical-JSON comparison
+    after numpy normalization); volatile verbs (live counters/clocks)
+    answer the identical structure.
+  * pipelining — multiple outstanding requests share one connection and
+    complete OUT OF ORDER: a slow request never holds a fast one's
+    reply (the head-of-line lockstep the one-shot design imposed).
+  * negotiation — the binary client discovers a legacy close-delimited
+    server (the native C++ engine) by probe, falls back to the JSON
+    form, and caches the verdict; old raw-socket clients are served by
+    the new server unchanged.
+  * pooling — connections are reused across requests, dead ones are
+    evicted and the request retried on a fresh dial.
+  * DeferredResponse — a deferred continuation answers its own frame id
+    later while the SAME persistent connection keeps serving.
+"""
+
+import json
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client, DeferredResponse, Server
+
+pytestmark = pytest.mark.wire
+
+HALF = KEYS_IN_RING // 2
+IDA_M = 10
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    """Each test starts with no pooled connections and no cached
+    negotiation verdicts (servers come and go per test)."""
+    wire.reset_pool()
+    yield
+    wire.reset_pool()
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_u128keys_sequence_contract():
+    rng = np.random.RandomState(0)
+    ints = _rand_ids(rng, 37)
+    u = wire.U128Keys(ints)
+    assert len(u) == 37
+    assert list(u) == ints and u.ints() == ints
+    assert u[0] == ints[0] and u[-1] == ints[-1]
+    assert u == ints  # list-equality contract
+    assert wire.U128Keys(u.tobytes()) == u
+    with pytest.raises(IndexError):
+        u[37]
+    with pytest.raises(wire.WireProtocolError):
+        wire.U128Keys(b"\x00" * 15)  # not 16-aligned
+
+
+def test_codec_roundtrip_preserves_dtype_shape_and_nesting():
+    rng = np.random.RandomState(1)
+    obj = {
+        "COMMAND": "X",
+        "KEYS": wire.U128Keys(_rand_ids(rng, 9)),
+        "A": np.arange(12, dtype=np.int64).reshape(3, 4),
+        "B": rng.rand(2, 5).astype(np.float32),
+        "NESTED": {"C": np.asarray([1, 2, 3], np.int32),
+                   "L": [np.asarray([7], np.uint8), "txt", 4.5, None]},
+        "SCALAR": np.int64(42),
+        "PLAIN": [1, "two", {"three": 3}],
+    }
+    body = wire.encode_frame(wire.FRAME_REQUEST, 77, obj)
+    ftype, req_id, dec = wire.decode_frame(memoryview(body[4:]))
+    assert (ftype, req_id) == (wire.FRAME_REQUEST, 77)
+    assert dec["COMMAND"] == "X" and dec["PLAIN"] == obj["PLAIN"]
+    assert isinstance(dec["KEYS"], wire.U128Keys)
+    assert dec["KEYS"] == obj["KEYS"]
+    for path, want in (("A", obj["A"]), ("B", obj["B"])):
+        got = dec[path]
+        assert got.dtype == want.dtype and got.shape == want.shape
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(dec["NESTED"]["C"], obj["NESTED"]["C"])
+    np.testing.assert_array_equal(dec["NESTED"]["L"][0],
+                                  obj["NESTED"]["L"][0])
+    assert dec["NESTED"]["L"][1:] == ["txt", 4.5, None]
+    # np.generic lowers to a plain int (JSON-native header field).
+    assert dec["SCALAR"] == 42 and not isinstance(dec["SCALAR"], np.generic)
+    # Zero-copy decode is read-only by contract.
+    with pytest.raises(ValueError):
+        dec["A"][0, 0] = 9
+
+
+def test_frame_assembler_arbitrary_chunking():
+    objs = [{"I": i, "V": np.full(17, i, np.int32)} for i in range(5)]
+    stream = b"".join(wire.encode_frame(wire.FRAME_RESPONSE, i, o)
+                      for i, o in enumerate(objs))
+    for chunk in (1, 3, 7, 64, len(stream)):
+        asm = wire.FrameAssembler()
+        got = []
+        for off in range(0, len(stream), chunk):
+            got.extend(asm.feed(stream[off:off + chunk]))
+        assert asm.pending_bytes() == 0
+        assert len(got) == 5
+        for i, body in enumerate(got):
+            ftype, rid, dec = wire.decode_frame(memoryview(body))
+            assert (ftype, rid) == (wire.FRAME_RESPONSE, i)
+            assert dec["I"] == i
+            np.testing.assert_array_equal(dec["V"], objs[i]["V"])
+
+
+def test_frame_assembler_rejects_oversize_frame():
+    asm = wire.FrameAssembler(max_frame=64)
+    with pytest.raises(wire.WireProtocolError):
+        asm.feed((1 << 20).to_bytes(4, "little") + b"x" * 8)
+
+
+def test_decode_rejects_truncated_and_garbage():
+    frame = wire.encode_frame(wire.FRAME_REQUEST, 1, {"A": np.arange(8)})
+    body = frame[4:]
+    with pytest.raises(wire.WireProtocolError):
+        wire.decode_frame(memoryview(body[:12]))  # section overrun
+    with pytest.raises(wire.WireProtocolError):
+        wire.decode_payload(memoryview(b"\xff\xff\xff\x7fnope"))
+    # decode_payload is TOTAL over malformed peer input: descriptor
+    # with a missing field / bogus dtype / out-of-range section index
+    # must surface as WireProtocolError, never a bare KeyError that
+    # would die silently on a server worker.
+    def _payload(header: dict, tail: bytes = b"") -> memoryview:
+        h = json.dumps(header, separators=(",", ":")).encode()
+        return memoryview(len(h).to_bytes(4, "little") + h + tail)
+
+    for bad in (
+        {wire.SECTIONS_KEY: [{"k": "nd", "sh": [1]}]},          # no "n"
+        {wire.SECTIONS_KEY: [{"k": "nd", "n": 4, "dt": "??",
+                              "sh": [1]}]},                     # bad dtype
+        {wire.SECTIONS_KEY: [{"k": "nd", "n": 4, "dt": "<i4",
+                              "sh": [3]}]},                     # bad shape
+        {"X": {"__wire_bin__": 5}},                             # bad index
+        {wire.SECTIONS_KEY: "nope"},                            # not a list
+        {wire.SECTIONS_KEY: [{"k": "u128", "n": -16}]},         # negative n
+    ):
+        with pytest.raises(wire.WireProtocolError):
+            wire.decode_payload(_payload(bad, b"\x00" * 8))
+
+
+def test_native_server_serializes_numpy_handler_results():
+    """A native-backend peer serving gateway-style handlers (numpy
+    vector results) answers the same nested-list JSON rpc.Server
+    would — the one-handler-body-two-wires contract holds on the
+    native serving path too."""
+    native_rpc = pytest.importorskip("p2p_dhts_tpu.net.native_rpc")
+
+    def vec(req):
+        n = int(req["N"])
+        return {"OWNERS": np.arange(n, dtype=np.int64),
+                "KEYS": wire.U128Keys([7, 9])}
+
+    srv = native_rpc.NativeServer(0, {"VEC": vec}, num_threads=3)
+    srv.run_in_background()
+    try:
+        with wire.forced("json"):
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "VEC", "N": 4},
+                                    timeout=30)
+        assert r["SUCCESS"] and r["OWNERS"] == [0, 1, 2, 3]
+        assert r["KEYS"] == ["7", "9"]
+    finally:
+        srv.kill()
+
+
+# ---------------------------------------------------------------------------
+# gateway-verb parity: both transports, byte-identical decoded payloads
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gateway():
+    rng = np.random.RandomState(20260804)
+    lo = build_ring(_rand_ids(rng, 16),
+                    RingConfig(finger_mode="materialized"))
+    hi = build_ring(_rand_ids(rng, 8),
+                    RingConfig(finger_mode="materialized"))
+    gw = Gateway(metrics=Metrics(), name="wire-test")
+    gw.add_ring("lo", lo, empty_store(capacity=1024, max_segments=4),
+                key_range=(0, HALF - 1), default=True,
+                bucket_min=4, bucket_max=16,
+                warmup=["find_successor", "dhash_get", "dhash_put"])
+    gw.add_ring("hi", hi, empty_store(capacity=1024, max_segments=4),
+                key_range=(HALF, KEYS_IN_RING - 1),
+                bucket_min=4, bucket_max=16,
+                warmup=["find_successor", "dhash_get", "dhash_put"])
+    yield gw
+    gw.close()
+
+
+@pytest.fixture(scope="module")
+def rpc_server(gateway):
+    srv = Server(0, {}, num_threads=6)
+    install_gateway_handlers(srv, gateway)
+    srv.run_in_background()
+    yield srv
+    srv.kill()
+
+
+def _normalize(v):
+    """Decoded payload -> canonical JSON-native form: numpy arrays to
+    nested lists, U128Keys to int lists — what "the decoded payload"
+    means independently of the wire's vector representation."""
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, wire.U128Keys):
+        return v.ints()
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, dict):
+        return {k: _normalize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_normalize(x) for x in v]
+    return v
+
+
+def _structure(v):
+    """Shape-of-the-payload skeleton (keys + container/leaf types) for
+    verbs whose VALUES are live counters/clocks."""
+    if isinstance(v, dict):
+        return {k: _structure(x) for k, x in sorted(v.items())}
+    if isinstance(v, (list, tuple)):
+        return [_structure(x) for x in v[:1]] if v else []
+    return type(_normalize(v)).__name__
+
+
+def _both(srv, req):
+    out = {}
+    for transport in ("json", "binary"):
+        with wire.forced(transport):
+            out[transport] = Client.make_request("127.0.0.1", srv.port,
+                                                 dict(req), timeout=30)
+    return out["json"], out["binary"]
+
+
+def test_every_gateway_verb_parity_both_transports(rpc_server, gateway):
+    rng = np.random.RandomState(5)
+    klo = [k % HALF for k in _rand_ids(rng, 6)]
+    khi = [HALF + k % HALF for k in _rand_ids(rng, 6)]
+    seg = [[7] * IDA_M, [9] * IDA_M]
+
+    # Seed both stores, then drive anti-entropy to a fixpoint so the
+    # SYNC_RANGE parity pair below answers identical (converged) dicts.
+    for k in klo[:2]:
+        gateway.dhash_put(k, seg, 2, 0, ring_id="lo", timeout=600)
+    with wire.forced("binary"):
+        Client.make_request("127.0.0.1", rpc_server.port,
+                            {"COMMAND": "SYNC_RANGE", "RING_A": "lo",
+                             "RING_B": "hi", "MAX_KEYS": 16,
+                             "REINDEX": False}, timeout=60)
+
+    exact_verbs = [
+        {"COMMAND": "FIND_SUCCESSOR", "KEY": format(klo[0], "x"),
+         "START": 1},
+        {"COMMAND": "FIND_SUCCESSOR",
+         "KEYS": [format(k, "x") for k in klo + khi]},
+        {"COMMAND": "FINGER_INDEX", "KEY": format(klo[1], "x"),
+         "TABLE_START": 0},
+        {"COMMAND": "FINGER_INDEX",
+         "KEYS": [format(k, "x") for k in klo[:4]]},
+        {"COMMAND": "PUT", "KEY": format(klo[2], "x"), "SEGMENTS": seg,
+         "LENGTH": 2, "START": 0},
+        {"COMMAND": "PUT", "ENTRIES": [
+            {"KEY": format(klo[3], "x"), "SEGMENTS": seg, "LENGTH": 2}]},
+        {"COMMAND": "GET", "KEY": format(klo[2], "x")},
+        {"COMMAND": "GET",
+         "KEYS": [format(klo[2], "x"), format(klo[3], "x")]},
+        {"COMMAND": "SYNC_RANGE", "RING_A": "lo", "RING_B": "hi",
+         "MAX_KEYS": 16, "REINDEX": False},
+        # No membership manager attached: the deterministic error
+        # envelope IS the parity payload for these two.
+        {"COMMAND": "JOIN_RING", "MEMBER": format(khi[0], "x")},
+        {"COMMAND": "HEARTBEAT", "MEMBER": format(khi[0], "x")},
+    ]
+    for req in exact_verbs:
+        j, b = _both(rpc_server, req)
+        jn = json.dumps(_normalize(j), sort_keys=True).encode()
+        bn = json.dumps(_normalize(b), sort_keys=True).encode()
+        assert jn == bn, (
+            f"{req['COMMAND']} decoded payloads differ across "
+            f"transports:\n json:   {jn[:400]}\n binary: {bn[:400]}")
+
+    # Volatile verbs: live counters/clock values change between the two
+    # calls (the first call itself increments rpc.server counters), so
+    # parity is the full payload STRUCTURE.
+    for req in ({"COMMAND": "METRICS"}, {"COMMAND": "REPAIR_STATUS"},
+                {"COMMAND": "MEMBER_STATUS"}, {"COMMAND": "TRACE_STATUS"},
+                {"COMMAND": "HEALTH"}):
+        j, b = _both(rpc_server, req)
+        assert j.get("SUCCESS") == b.get("SUCCESS"), req["COMMAND"]
+        assert _structure(j) == _structure(b), (
+            f"{req['COMMAND']} payload structure differs across "
+            f"transports")
+
+
+def test_binary_vector_forms_native_encoding(rpc_server, gateway):
+    """The binary transport's NATIVE vector encodings (packed u128
+    KEYS, numpy SEGMENTS) decode to the same answers the hex/list
+    forms produce."""
+    rng = np.random.RandomState(6)
+    keys = [k % HALF for k in _rand_ids(rng, 8)]
+    with wire.forced("binary"):
+        rb = Client.make_request(
+            "127.0.0.1", rpc_server.port,
+            {"COMMAND": "FIND_SUCCESSOR", "KEYS": wire.U128Keys(keys),
+             "STARTS": np.zeros(len(keys), np.int32)}, timeout=30)
+    with wire.forced("json"):
+        rj = Client.make_request(
+            "127.0.0.1", rpc_server.port,
+            {"COMMAND": "FIND_SUCCESSOR",
+             "KEYS": [format(k, "x") for k in keys]}, timeout=30)
+    assert rb["SUCCESS"] and rj["SUCCESS"]
+    assert _normalize(rb["OWNERS"]) == _normalize(rj["OWNERS"])
+    assert _normalize(rb["HOPS"]) == _normalize(rj["HOPS"])
+
+    seg = np.asarray([[3] * IDA_M, [5] * IDA_M], np.float32)
+    k = keys[0]
+    with wire.forced("binary"):
+        rp = Client.make_request(
+            "127.0.0.1", rpc_server.port,
+            {"COMMAND": "PUT", "KEY": format(k, "x"),
+             "SEGMENTS": seg, "LENGTH": 2, "START": 0}, timeout=30)
+        rg = Client.make_request(
+            "127.0.0.1", rpc_server.port,
+            {"COMMAND": "GET", "KEY": format(k, "x")}, timeout=30)
+    assert rp["SUCCESS"] and rp["OK"] is True
+    assert rg["SUCCESS"] and rg["OK"] is True
+    assert np.asarray(rg["SEGMENTS"])[:2].tolist() == seg.tolist()
+
+
+# ---------------------------------------------------------------------------
+# pipelining: out-of-order completion on one connection
+# ---------------------------------------------------------------------------
+
+def test_pipelining_out_of_order_completion():
+    order = []
+    order_lock = threading.Lock()
+
+    def slow(req):
+        time.sleep(float(req.get("DELAY_S", 0)))
+        with order_lock:
+            order.append(req["TAG"])
+        return {"TAG": req["TAG"]}
+
+    srv = Server(0, {"SLOW": slow}, num_threads=3)
+    srv.run_in_background()
+    try:
+        results = {}
+        errs = []
+
+        def call(tag, delay):
+            try:
+                with wire.forced("binary"):
+                    results[tag] = Client.make_request(
+                        "127.0.0.1", srv.port,
+                        {"COMMAND": "SLOW", "TAG": tag,
+                         "DELAY_S": delay}, timeout=30)
+            except BaseException as exc:  # noqa: BLE001 — recorded
+                errs.append(exc)
+
+        # Prime ONE pooled connection, then interleave a slow and two
+        # fast requests over it concurrently.
+        call("warm", 0.0)
+        threads = [threading.Thread(target=call, args=args)
+                   for args in (("slow", 0.8), ("fast1", 0.0),
+                                ("fast2", 0.0))]
+        threads[0].start()
+        time.sleep(0.1)  # the slow frame is in flight first
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs, errs[:2]
+        assert all(results[t]["TAG"] == t
+                   for t in ("slow", "fast1", "fast2"))
+        # Out-of-order completion: both fast requests finished while
+        # the earlier slow frame was still being served.
+        assert order.index("slow") > order.index("fast1")
+        assert order.index("slow") > order.index("fast2")
+        # And they shared the pool's connections rather than dialing
+        # one per request (the one-shot design).
+        assert wire.pool().stats()["connections"] <= wire.MAX_CONNS_PER_DEST
+        assert METRICS.counter("rpc.wire.reuse") > 0
+    finally:
+        srv.kill()
+
+
+# ---------------------------------------------------------------------------
+# negotiation: legacy servers, legacy clients
+# ---------------------------------------------------------------------------
+
+def test_negotiation_fallback_against_native_cpp_server():
+    """A binary-transport client discovers the native C++ engine is a
+    close-delimited JSON server, falls back transparently, and caches
+    the verdict — one probe per destination, not one per request."""
+    native_rpc = pytest.importorskip("p2p_dhts_tpu.net.native_rpc")
+
+    def add(req):
+        return {"SUM": int(req["A"]) + int(req["B"])}
+
+    srv = native_rpc.NativeServer(0, {"ADD": add}, num_threads=3)
+    srv.run_in_background()
+    try:
+        before = METRICS.counter("rpc.wire.negotiation_fallback")
+        with wire.forced("binary"):
+            r1 = Client.make_request("127.0.0.1", srv.port,
+                                     {"COMMAND": "ADD", "A": 2, "B": 3},
+                                     timeout=30)
+            r2 = Client.make_request("127.0.0.1", srv.port,
+                                     {"COMMAND": "ADD", "A": 5, "B": 8},
+                                     timeout=30)
+        assert r1["SUCCESS"] and r1["SUM"] == 5
+        assert r2["SUCCESS"] and r2["SUM"] == 13
+        after = METRICS.counter("rpc.wire.negotiation_fallback")
+        assert after == before + 1, (
+            "legacy verdict not cached: probed "
+            f"{after - before} times for two requests")
+        assert wire.pool().stats()["legacy_cached"] == 1
+    finally:
+        srv.kill()
+
+
+def test_legacy_raw_socket_client_served_unchanged():
+    """An old client (close-delimited JSON, reads to EOF) works against
+    the dual-transport server byte-for-byte as before."""
+    srv = Server(0, {"ECHO": lambda req: {"GOT": req["X"]}},
+                 num_threads=3)
+    srv.run_in_background()
+    try:
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=10) as s:
+            s.sendall(json.dumps({"COMMAND": "ECHO", "X": "old"},
+                                 separators=(",", ":")).encode())
+            s.shutdown(socket.SHUT_WR)
+            raw = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+        assert json.loads(raw) == {"GOT": "old", "SUCCESS": True}
+
+        # Garbage that LOOKS like it might be a hello ("C"-prefixed but
+        # not the hello) is a legacy request: parse-error envelope.
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=10) as s:
+            s.sendall(b"CWXgarbage-not-a-hello")
+            s.shutdown(socket.SHUT_WR)
+            raw = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+        resp = json.loads(raw)
+        assert resp["SUCCESS"] is False and "ERRORS" in resp
+    finally:
+        srv.kill()
+
+
+def test_json_transport_forced_still_one_shot():
+    """CHORDAX_WIRE=json semantics: the legacy client path works
+    against the new server and pools nothing."""
+    srv = Server(0, {"PING": lambda req: {"PONG": True}}, num_threads=3)
+    srv.run_in_background()
+    try:
+        with wire.forced("json"):
+            for _ in range(3):
+                r = Client.make_request("127.0.0.1", srv.port,
+                                        {"COMMAND": "PING"}, timeout=10)
+                assert r["SUCCESS"] and r["PONG"] is True
+        assert wire.pool().stats()["connections"] == 0
+    finally:
+        srv.kill()
+
+
+# ---------------------------------------------------------------------------
+# pooling: reuse + dead-connection eviction
+# ---------------------------------------------------------------------------
+
+def test_pool_reuse_and_dead_connection_eviction():
+    srv = Server(0, {"PING": lambda req: {"PONG": True}}, num_threads=3)
+    srv.run_in_background()
+    port = srv.port
+    reuse0 = METRICS.counter("rpc.wire.reuse")
+    connects0 = METRICS.counter("rpc.wire.connects")
+    with wire.forced("binary"):
+        for _ in range(5):
+            assert Client.make_request("127.0.0.1", port,
+                                       {"COMMAND": "PING"},
+                                       timeout=10)["SUCCESS"]
+    assert wire.pool().stats()["connections"] == 1
+    assert METRICS.counter("rpc.wire.connects") == connects0 + 1
+    assert METRICS.counter("rpc.wire.reuse") >= reuse0 + 4
+
+    # Kill the server: the pooled connection is now dead. A new server
+    # on the SAME port must be reachable through eviction + one fresh
+    # dial, invisibly to the caller.
+    srv.kill()
+    srv2 = Server(port, {"PING": lambda req: {"PONG": 2}}, num_threads=3)
+    srv2.run_in_background()
+    try:
+        evicted0 = METRICS.counter("rpc.wire.evicted")
+        with wire.forced("binary"):
+            r = Client.make_request("127.0.0.1", port,
+                                    {"COMMAND": "PING"}, timeout=10)
+        assert r["SUCCESS"] and r["PONG"] == 2
+        assert METRICS.counter("rpc.wire.evicted") > evicted0 or \
+            wire.pool().stats()["connections"] == 1
+    finally:
+        srv2.kill()
+
+
+# ---------------------------------------------------------------------------
+# DeferredResponse on a persistent connection
+# ---------------------------------------------------------------------------
+
+def test_deferred_response_completes_on_persistent_connection():
+    pool = ThreadPoolExecutor(max_workers=2)
+
+    def outer(req):
+        def finish(r):
+            time.sleep(0.05)
+            return {"V": 7}
+        return DeferredResponse(finish, pool)
+
+    srv = Server(0, {"OUTER": outer,
+                     "PING": lambda req: {"PONG": True}}, num_threads=3)
+    srv.run_in_background()
+    try:
+        with wire.forced("binary"):
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "OUTER"}, timeout=10)
+            assert r["SUCCESS"] and r["V"] == 7
+            # The SAME connection keeps serving after the deferred
+            # completion answered its frame id.
+            assert Client.make_request("127.0.0.1", srv.port,
+                                       {"COMMAND": "PING"},
+                                       timeout=10)["SUCCESS"]
+        assert wire.pool().stats()["connections"] == 1
+    finally:
+        srv.kill()
+        pool.shutdown(wait=False)
+
+
+def test_deadline_and_unencodable_response_surface_as_envelope():
+    """A handler result the codec cannot encode becomes the error
+    envelope on the SAME frame id — never a silently dropped reply —
+    and DEADLINE_MS rides the frame header intact."""
+    class Weird:
+        pass
+
+    srv = Server(0, {"BAD": lambda req: {"X": Weird()},
+                     "DL": lambda req: {"DL": req["DEADLINE_MS"]}},
+                 num_threads=3)
+    srv.run_in_background()
+    try:
+        with wire.forced("binary"):
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "BAD"}, timeout=10)
+            assert r["SUCCESS"] is False and "unencodable" in r["ERRORS"]
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "DL",
+                                     "DEADLINE_MS": 1234.5}, timeout=10)
+            assert r["SUCCESS"] and r["DL"] == 1234.5
+    finally:
+        srv.kill()

@@ -1,27 +1,31 @@
 #!/bin/bash
-# TPU recovery watcher, round 8: the round-7 ten configs still want
-# on-chip records (greens from r07 carry over). Wait for the chip to be
+# TPU recovery watcher, round 9: the ten configs still want on-chip
+# records (greens from r07/r08 carry over). Wait for the chip to be
 # free, probe the remote-compile service (dead since round 4:
 # connection-refused on its port while cached programs kept executing),
 # and when it answers, run the configs without a green record one at a
-# time into BENCH_ATTEMPT_r08.jsonl (bench's _record_lkg promotes each
-# green on-chip record into BENCH_LKG.json). NEW in round 8
-# (chordax-scope): every on-chip attempt runs under --trace, archiving
-# a jax.profiler device-trace timeline into BENCH_TRACE_r08/<config>
-# next to the record — watcher rounds leave a timeline, not just
-# numbers. Never kills anything mid-TPU-work; every probe and bench
-# attempt runs to completion (a blocked fresh-shape jit takes ~25 min
-# to fail — that is the probe's cost when the service is down,
-# accepted).
+# time into BENCH_ATTEMPT_r09.jsonl (bench's _record_lkg promotes each
+# green on-chip record into BENCH_LKG.json). On-chip attempts keep the
+# round-8 --trace device-timeline archiving (now into BENCH_TRACE_r09).
+# NEW in round 9 (chordax-wire): the pre-bench gateway smoke now
+# hard-gates the binary transport — wire-isolated batched path at
+# >= 3x the JSON keys/s and <= 1/2 its p50, binary-transport 1000-key
+# parity, the traced rpc.client->rpc.server->gateway->serve chain over
+# the persistent connections, zero steady-state retraces — so no chip
+# time is spent on a tree whose front door regressed. Never kills
+# anything mid-TPU-work; every probe and bench attempt runs to
+# completion (a blocked fresh-shape jit takes ~25 min to fail — that
+# is the probe's cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-8 watcher start (ten configs + device-trace artifacts)"
+log "round-9 watcher start (ten configs + chordax-wire smoke gate)"
 
-needed() {  # configs without a green record yet (r07 greens count)
+needed() {  # configs without a green record yet (r07/r08 greens count)
   python - <<'EOF'
 import json
 ok = set()
-for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl"):
+for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
+                "BENCH_ATTEMPT_r09.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -61,11 +65,13 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
-  # Gateway smoke (ISSUE 4 + ISSUE 8): the RPC->gateway->engine front
-  # door must pass its CPU smoke — now including the tracing-enabled
-  # closed loop (p50 within 10% of untraced) and the linked
-  # RPC->gateway->engine->batch span-chain export — before any bench
-  # touches the chip.
+  # Gateway smoke (ISSUE 4 + ISSUE 8 + ISSUE 9): the RPC->gateway->
+  # engine front door must pass its CPU smoke — the tracing-enabled
+  # closed loop (p50 within 10% of untraced), the linked
+  # RPC->gateway->engine->batch span chain over the BINARY transport,
+  # both-transport side-by-side numbers, and the hard chordax-wire
+  # gate (wire-isolated batched path: binary >= 3x JSON keys/s at
+  # <= 1/2 p50) — before any bench touches the chip.
   if ! JAX_PLATFORMS=cpu python bench.py --config gateway --smoke \
       >> tpu_watch.log 2>&1; then
     log "gateway smoke FAILED - fix the front door before benching"
@@ -100,11 +106,11 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r08
+    mkdir -p BENCH_TRACE_r09
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r08/$c)"
-      python bench.py --config "$c" --trace "BENCH_TRACE_r08" \
-        >> BENCH_ATTEMPT_r08.jsonl 2>> BENCH_ATTEMPT_r08.err
+      log "running --config $c (device trace -> BENCH_TRACE_r09/$c)"
+      python bench.py --config "$c" --trace "BENCH_TRACE_r09" \
+        >> BENCH_ATTEMPT_r09.jsonl 2>> BENCH_ATTEMPT_r09.err
       log "config $c rc=$?"
     done
   else
