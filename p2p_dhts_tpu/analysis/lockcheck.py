@@ -69,6 +69,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "membership", "manager.py"),
     os.path.join("p2p_dhts_tpu", "trace.py"),
     os.path.join("p2p_dhts_tpu", "health.py"),
+    os.path.join("p2p_dhts_tpu", "havoc.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

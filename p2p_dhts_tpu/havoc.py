@@ -1,0 +1,382 @@
+"""chordax-havoc: seeded, deterministic fault injection for the serving
+stack (ISSUE 10).
+
+Every retry/backoff/stall/failover path in the stack — the gateway
+health machine, repair stall detection, phi-accrual failure detection,
+wire dead-conn eviction — landed exercised only by polite shutdowns and
+held locks. This module is the adversary those paths were written for:
+a process-wide `FaultPlan` that injection sites at every layer boundary
+consult, so dropped frames, mid-frame connection resets, asymmetric
+partitions, worker stalls, poisoned batches and delayed heartbeats can
+be driven ON DEMAND, deterministically, and in CI.
+
+DETERMINISM is the design center. A plan is (seed, spec); every
+injection decision is a pure function of (seed, site, n) where `n` is
+the site's own invocation counter — NOT of thread interleaving, wall
+clock, or the process-global RNG. Two runs that drive the same request
+stream through the same plan consume byte-identical fault schedules
+(`schedule_bytes()`), and any schedule can be re-materialized offline
+from the seed alone (`export_site_schedule`) — which is what makes a
+chaos failure reproducible from its log line (`describe_active()` rides
+`health.dump_on_error` and failed-test reports).
+
+Injection sites (each a one-flag check when no plan is installed —
+the `trace.enabled()` discipline; the site strings below are the spec
+keys):
+
+  * ``wire.client.frame``   — per outbound binary frame: drop / delay /
+                              corrupt / truncate / duplicate / reset
+                              (connection killed mid-frame). Key: the
+                              destination ``"ip:port"``.
+  * ``wire.client.hello``   — partial hello: the dial sends a truncated
+                              negotiation probe. Key: ``"ip:port"``.
+  * ``net.partition``       — asymmetric partition: OUTBOUND requests
+                              to a matched destination fail immediately
+                              (or are dropped into the caller timeout)
+                              while inbound traffic from that peer still
+                              flows. Key: ``"ip:port"``.
+  * ``rpc.server.stall``    — a worker sleeps ``delay_s`` before
+                              dispatch (the wedged-worker shape). Key:
+                              the COMMAND string.
+  * ``rpc.server.deferred_loss`` — a DeferredResponse continuation is
+                              dropped: the reply never comes; the
+                              caller's own deadline must bound the wait.
+  * ``serve.launch``        — the whole batch's device dispatch fails
+                              before launch. Key: the engine name.
+  * ``serve.poison``        — a batch CONTAINING a matched payload key
+                              fails dispatch — the poison-batch shape
+                              the engine's quarantine answers (matched
+                              solo retries keep failing; clean ones
+                              succeed). Key: the batch's key ints.
+  * ``membership.heartbeat`` — a member's heartbeat is dropped or
+                              arrives late. Key: the member id.
+  * ``membership.clock``    — the failure detector sees a member's
+                              clock skewed by ``skew_s``. Key: the
+                              member id.
+
+Spec shape — ``{site: rule}`` where a rule is a plain JSON-able dict:
+
+    {"rate": 0.25,                   # P(fire) per decision (default 1)
+     "actions": [{"action": "drop"},           # weighted choice
+                 {"action": "delay", "delay_s": 0.005, "weight": 2}],
+     "match": [keys...],             # fire only when the site key hits
+     "after": 0,                     # skip the first `after` decisions
+     "limit": None}                  # at most `limit` fired injections
+
+A rule with ``match`` and no ``rate`` fires on every hit (the poison /
+partition shape); a rule with ``rate`` and no ``match`` fires
+stochastically — but reproducibly — per invocation.
+
+LOCK ORDER: `FaultPlan._lock` is a LEAF — decisions are computed and
+recorded under it, and nothing inside ever calls out of this module (no
+I/O, no sleeps, no other locks). Sites that SLEEP on an injected delay
+do so in their own code, outside every lock (and outside this one).
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import random
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from p2p_dhts_tpu.metrics import METRICS
+
+#: Retained consumed-schedule entries per site (newest-win would break
+#: byte-identity, so the record TRUNCATES at the cap instead and
+#: schedule_bytes() says so — plans in tests/bench stay far below it).
+SCHEDULE_RECORD_CAP = 65536
+
+#: Known site names mapped to the action names their injection site
+#: understands ("fail" is every site's generic fire-the-default). A
+#: spec naming an unknown site — or an unknown action for a site — is
+#: almost always a typo that would otherwise surface mid-request as a
+#: raw ValueError (or silently never fire), so both are rejected at
+#: CONSTRUCTION, never on the serving path.
+SITES: Dict[str, frozenset] = {
+    "wire.client.frame": frozenset(
+        {"drop", "delay", "corrupt", "truncate", "duplicate", "reset"}),
+    "wire.client.hello": frozenset({"truncate", "fail"}),
+    "net.partition": frozenset({"block", "drop", "fail"}),
+    "rpc.server.stall": frozenset({"stall", "fail"}),
+    "rpc.server.deferred_loss": frozenset({"loss", "drop", "fail"}),
+    "serve.launch": frozenset({"fail"}),
+    "serve.poison": frozenset({"fail"}),
+    "membership.heartbeat": frozenset({"drop", "delay"}),
+    "membership.clock": frozenset({"skew", "fail"}),
+}
+
+
+class FaultPlan:
+    """One seeded, replayable fault schedule.
+
+    `decide(site, key)` is the sites' one entry point: returns the
+    action dict to apply, or None. The decision for the site's n-th
+    invocation is a pure function of (seed, site, n) (plus the key for
+    `match` rules), so the schedule a request stream consumes is
+    identical across replays regardless of thread timing."""
+
+    def __init__(self, seed: int, spec: Dict[str, dict]):
+        self.seed = int(seed)
+        for site, rule in spec.items():
+            if site not in SITES:
+                raise ValueError(f"unknown havoc site {site!r} "
+                                 f"(known: {', '.join(sorted(SITES))})")
+            if not isinstance(rule, dict):
+                raise ValueError(f"havoc rule for {site!r} must be a "
+                                 f"dict, got {type(rule).__name__}")
+            for act in rule.get("actions", ()):
+                name = act.get("action") if isinstance(act, dict) \
+                    else None
+                if name not in SITES[site]:
+                    raise ValueError(
+                        f"unknown action {name!r} for havoc site "
+                        f"{site!r} (known: "
+                        f"{', '.join(sorted(SITES[site]))})")
+        # Normalize once: match sets for O(1) hits, action lists with
+        # weights resolved. The spec itself is kept verbatim for
+        # describe()/replay.
+        self.spec = {site: dict(rule) for site, rule in spec.items()}
+        self._rules: Dict[str, dict] = {}
+        for site, rule in self.spec.items():
+            actions = [dict(a) for a in rule.get("actions",
+                                                 [{"action": "fail"}])]
+            self._rules[site] = {
+                "rate": float(rule.get("rate", 1.0)),
+                "actions": actions,
+                "weights": [float(a.pop("weight", 1.0)) for a in actions],
+                "match": (set(rule["match"])
+                          if rule.get("match") is not None else None),
+                "after": int(rule.get("after", 0)),
+                "limit": rule.get("limit"),
+            }
+        self._lock = threading.Lock()
+        self._cursors: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self._record: Dict[str, List[str]] = {}
+        self._truncated = False
+
+    # -- the decision core ---------------------------------------------------
+    def _site_rng(self, site: str, n: int) -> random.Random:
+        """The n-th decision's private RNG: derived by SHA-256, so it is
+        stable across processes, PYTHONHASHSEED values and platforms
+        (hash() is none of those)."""
+        digest = hashlib.sha256(
+            f"{self.seed}|{site}|{n}".encode()).digest()
+        return random.Random(int.from_bytes(digest[:8], "big"))
+
+    def _decide_pure(self, site: str, rule: dict, n: int, fired: int,
+                     key: Any) -> Optional[dict]:
+        if n < rule["after"]:
+            return None
+        limit = rule["limit"]
+        if limit is not None and fired >= int(limit):
+            return None
+        match = rule["match"]
+        if match is not None:
+            if key is None:
+                return None
+            keys = key if isinstance(key, (list, tuple, set, frozenset)) \
+                else (key,)
+            if not any(k in match for k in keys):
+                return None
+        rng = self._site_rng(site, n)
+        if rng.random() >= rule["rate"]:
+            return None
+        actions, weights = rule["actions"], rule["weights"]
+        if len(actions) == 1:
+            return actions[0]
+        return rng.choices(actions, weights=weights)[0]
+
+    def decide(self, site: str, key: Any = None) -> Optional[dict]:
+        """One injection decision for `site` (None = no fault). Sites
+        must call this at a boundary whose invocation count is
+        deterministic for a given request stream — e.g. once per
+        public request, NOT once per internal retry.
+
+        Cursor assignment, the decision itself, the fired-count update
+        and the schedule record all happen under ONE lock acquisition:
+        two racing decisions must serialize, or the `limit` accounting
+        and the consumed record would depend on thread interleaving —
+        exactly what the byte-identical-replay contract forbids.
+        `_decide_pure` is pure computation (no I/O, no other locks), so
+        holding the leaf lock across it is safe."""
+        rule = self._rules.get(site)
+        if rule is None:
+            return None
+        with self._lock:
+            n = self._cursors.get(site, 0)
+            self._cursors[site] = n + 1
+            fired = self._fired.get(site, 0)
+            act = self._decide_pure(site, rule, n, fired, key)
+            if act is not None:
+                self._fired[site] = fired + 1
+            rec = self._record.setdefault(site, [])
+            if n < SCHEDULE_RECORD_CAP:
+                # Under the single lock n == len(rec), so the record
+                # lands in cursor order.
+                rec.append(act["action"] if act is not None else "-")
+            else:
+                self._truncated = True
+        if act is not None:
+            METRICS.inc(f"havoc.injected.{site}")
+        return act
+
+    # -- replay / reproducibility --------------------------------------------
+    def export_site_schedule(self, site: str, n: int,
+                             key: Any = None) -> List[str]:
+        """The first `n` decisions a fresh run of this plan would make
+        at `site` — a pure function of (seed, spec), never of what this
+        instance has consumed. `key` feeds match rules (pass the value
+        the site would; None means match rules read as no-hit)."""
+        rule = self._rules.get(site)
+        if rule is None:
+            return ["-"] * int(n)
+        out = []
+        fired = 0
+        for i in range(int(n)):
+            act = self._decide_pure(site, rule, i, fired, key)
+            if act is not None:
+                fired += 1
+            out.append(act["action"] if act is not None else "-")
+        return out
+
+    def consumed_schedule(self) -> Dict[str, List[str]]:
+        """{site: [action-or-"-" per decision, in site order]} — what
+        this run actually drew. Deterministic across same-seed replays
+        of the same request stream (per-site order is the site's own
+        cursor order, independent of cross-site thread interleaving)."""
+        with self._lock:
+            return {site: list(rec)
+                    for site, rec in sorted(self._record.items())}
+
+    def schedule_bytes(self) -> bytes:
+        """Canonical serialization of the consumed schedule — the
+        byte-identity artifact the havoc bench compares across two
+        same-seed replays."""
+        doc = {"seed": self.seed, "schedule": self.consumed_schedule()}
+        if self._truncated:
+            doc["truncated"] = True
+        return json.dumps(doc, sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    def cursors(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._cursors)
+
+    def fired(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fired)
+
+    def describe(self) -> str:
+        """One log line that makes a chaos failure reproducible: the
+        seed (rebuilds the plan), each site's step cursor (locates the
+        failing decision) and fired counts."""
+        cur = self.cursors()
+        fired = self.fired()
+        sites = " ".join(
+            f"{s}={cur[s]}({fired.get(s, 0)} fired)" for s in sorted(cur))
+        return (f"chordax-havoc plan active: seed={self.seed:#x} "
+                f"cursors: {sites or '(none consumed)'}")
+
+
+# ---------------------------------------------------------------------------
+# process-wide activation (the trace.enabled() pattern)
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+#: The most recently UNINSTALLED plan: a chaos failure usually unwinds
+#: through `injected()`'s finally before the test/bench reporting hook
+#: runs, so incident reports must be able to name the plan that was
+#: live when things went wrong. Superseded on the next install.
+_LAST_PLAN: Optional[FaultPlan] = None
+_PLAN_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """ONE attribute read — the hot-path gate every injection site
+    checks before doing any havoc work (bounded like trace.enabled())."""
+    return _PLAN is not None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def decide(site: str, key: Any = None) -> Optional[dict]:
+    """Module-level convenience the sites call: the active plan's
+    decision, or None when no plan is installed."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.decide(site, key)
+
+
+def install(plan: FaultPlan) -> None:
+    """Install `plan` process-wide. Exactly one plan may be active
+    (overlapping schedules would destroy the replay story) — install
+    over a live plan raises."""
+    global _PLAN, _LAST_PLAN
+    with _PLAN_LOCK:
+        if _PLAN is not None:
+            raise RuntimeError("a havoc FaultPlan is already installed "
+                               "(uninstall it first — overlapping plans "
+                               "are not replayable)")
+        _PLAN = plan
+        _LAST_PLAN = None
+    METRICS.inc("havoc.plans_installed")
+    from p2p_dhts_tpu.health import FLIGHT
+    FLIGHT.record("havoc", "plan_installed", seed=plan.seed,
+                  sites=sorted(plan.spec))
+
+
+def uninstall() -> Optional[FaultPlan]:
+    """Remove the active plan (no-op when none); returns it so a bench
+    can read its consumed schedule after the scenario."""
+    global _PLAN, _LAST_PLAN
+    with _PLAN_LOCK:
+        plan, _PLAN = _PLAN, None
+        if plan is not None:
+            _LAST_PLAN = plan
+    if plan is not None:
+        from p2p_dhts_tpu.health import FLIGHT
+        FLIGHT.record("havoc", "plan_uninstalled", seed=plan.seed,
+                      cursors=plan.cursors())
+    return plan
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Scope a plan to a block (tests/bench scenarios): installs on
+    entry, uninstalls on exit even when the scenario raises."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+def describe_active() -> Optional[str]:
+    """The active plan's reproducibility line (None when no plan)."""
+    plan = _PLAN
+    return plan.describe() if plan is not None else None
+
+
+def describe_for_incident() -> Optional[str]:
+    """The reproducibility line incident reports want: the ACTIVE
+    plan, or — because a failure raised inside `injected()` unwinds
+    through its finally (uninstall) before any reporting hook runs —
+    the most recently uninstalled one, labeled so. None when neither
+    exists. health.dump_on_error and the failed-test report section
+    use this, so any chaos failure carries its seed + step cursors in
+    the log even after the plan's scope closed."""
+    plan = _PLAN
+    if plan is not None:
+        return plan.describe()
+    last = _LAST_PLAN
+    if last is not None:
+        return last.describe() + " [uninstalled]"
+    return None

@@ -376,4 +376,19 @@ class dump_on_error:
                   f"events:", file=out)
             if tail:
                 print(tail, file=out)
+            # chordax-havoc (ISSUE 10): if a fault plan is (or was
+            # just) active, the incident is only reproducible WITH its
+            # seed + per-site step cursors — print them next to the
+            # tail so any chaos failure can be replayed from the log
+            # alone (describe_for_incident falls back to the last
+            # UNINSTALLED plan: the failure usually unwound through
+            # `injected()`'s finally before this dump runs).
+            try:
+                from p2p_dhts_tpu import havoc as _havoc
+                line = _havoc.describe_for_incident()
+                if line:
+                    print(f"# {line}", file=out)
+            # chordax-lint: disable=bare-except -- incident reporting must never mask the original failure
+            except Exception:
+                pass
         return False  # never suppress

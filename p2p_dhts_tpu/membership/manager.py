@@ -17,7 +17,16 @@ whole lifecycle against the batched kernels:
     `min_heartbeats` samples exist, so a slow-but-alive peer whose
     cadence the EWMA has adapted to is not failed early (the
     false-positive obligation tests pin). A heartbeat from a suspect
-    clears the suspicion.
+    clears the suspicion. PARTITION-AWARE (ISSUE 10): the FAIL verdict
+    additionally needs `confirm_rounds` consecutive over-threshold
+    scans, an optional reachability `probe` can VETO it (an asymmetric
+    partition that blocks only the heartbeat path must not flap a
+    reachable peer dead/alive — vetoed candidates stay SUSPECT,
+    counted), and a heartbeat arriving while the OP_FAIL row still
+    pends CANCELS the row (flap suppression). Post-heal, a re-JOIN of
+    a dead row resurrects it and schedules the maintenance pass +
+    repair-pair nudge, so the transferred-back custody reconciles
+    rectify-style.
   * ADMISSION — joins are bounded per ring (`max_pending_joins`); an
     over-budget JOIN_RING is rejected visibly (counted), never queued
     without limit — the RingAdmission philosophy applied to
@@ -68,6 +77,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
+from p2p_dhts_tpu import havoc as havoc_mod
 from p2p_dhts_tpu.health import PacedLoop
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.membership import OP_FAIL, OP_JOIN, OP_LEAVE
@@ -84,7 +94,7 @@ LEFT = "left"
 
 class _Member:
     __slots__ = ("member_id", "state", "last_heard", "mean_interval_s",
-                 "n_heartbeats")
+                 "n_heartbeats", "over_phi_rounds")
 
     def __init__(self, member_id: int, state: str, now: float):
         self.member_id = member_id
@@ -92,6 +102,10 @@ class _Member:
         self.last_heard = now
         self.mean_interval_s: Optional[float] = None
         self.n_heartbeats = 0
+        #: Consecutive detector scans at/above the FAIL threshold —
+        #: the partition-aware confirmation counter (a single late
+        #: scan after a scheduling hiccup must not fail a peer).
+        self.over_phi_rounds = 0
 
 
 class MembershipManager(PacedLoop):
@@ -108,6 +122,8 @@ class MembershipManager(PacedLoop):
                  heartbeat_interval_s: float = 1.0,
                  phi_threshold: float = 4.0,
                  min_heartbeats: int = 3,
+                 confirm_rounds: int = 2,
+                 probe=None,
                  interval_s: float = 0.05,
                  interval_idle_s: float = 1.0,
                  max_batch: int = 256,
@@ -130,6 +146,17 @@ class MembershipManager(PacedLoop):
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.phi_threshold = float(phi_threshold)
         self.min_heartbeats = int(min_heartbeats)
+        #: Partition-aware detection (ISSUE 10): a member must sit at/
+        #: above the FAIL threshold for this many CONSECUTIVE detector
+        #: scans before OP_FAIL is even considered...
+        self.confirm_rounds = max(int(confirm_rounds), 1)
+        #: ...and when a reachability `probe(member_id) -> bool` is
+        #: provided, a confirmed candidate that still answers it is
+        #: VETOED (kept SUSPECT, counted) instead of failed — an
+        #: asymmetric partition that only blocks the heartbeat path
+        #: must not flap a slow-but-reachable peer dead/alive. The
+        #: probe runs OUTSIDE the manager lock (it may do an RPC).
+        self.probe = probe
         self.max_batch = int(max_batch)
         self.max_pending_joins = int(max_pending_joins)
         self.round_timeout_s = round_timeout_s
@@ -231,22 +258,61 @@ class MembershipManager(PacedLoop):
 
     def heartbeat(self, member_id: int) -> bool:
         """Record one heartbeat; returns False for unknown members
-        (they must JOIN_RING first — counted, not an error)."""
+        (they must JOIN_RING first — counted, not an error).
+
+        FLAP SUPPRESSION (ISSUE 10): a heartbeat from a member the
+        detector marked FAILED whose OP_FAIL row is still PENDING
+        cancels the row and restores the member — a late-but-delivered
+        heartbeat after a transient one-way cut costs nothing. Once the
+        row has been applied the member is gone from the table and must
+        JOIN_RING again (the post-heal rejoin path, which resurrects
+        the dead device row and nudges the repair pairs)."""
         member_id = int(member_id) % KEYS_IN_RING
         now = time.monotonic()
+        if havoc_mod.enabled():
+            act = havoc_mod.decide("membership.heartbeat",
+                                   key=member_id)
+            if act is not None:
+                action = act.get("action", "drop")
+                if action == "drop":
+                    # The partitioned direction: this heartbeat never
+                    # arrives. (The peer itself is untouched — the
+                    # asymmetric shape.)
+                    return False
+                if action == "delay":
+                    # Arrived LATE: the recorded arrival predates now,
+                    # so the inter-arrival model sees the gap a slow
+                    # path would have produced.
+                    now -= float(act.get("delay_s", 0.0))
         with self._lock:
             m = self._members.get(member_id)
-            if m is None or m.state in (FAILED, LEFT):
+            if m is not None and m.state == FAILED:
+                try:
+                    self._pending.remove((OP_FAIL, member_id))
+                    cancelled = True
+                except ValueError:
+                    cancelled = False  # already popped/applied
+                if cancelled:
+                    m.state = ALIVE
+                    m.over_phi_rounds = 0
+                    self.metrics.inc(
+                        f"membership.flap_suppressed.{self.ring_id}")
+                else:
+                    m = None  # fall through to the unknown path
+            if m is None or m.state == LEFT:
                 self.metrics.inc(
                     f"membership.heartbeat_unknown.{self.ring_id}")
                 return False
-            dt = now - m.last_heard
+            # An injected delay can place `now` before the last record;
+            # the model never learns a negative interval.
+            dt = max(now - m.last_heard, 0.0)
             if m.n_heartbeats > 0:
                 m.mean_interval_s = (dt if m.mean_interval_s is None
                                      else 0.8 * m.mean_interval_s
                                      + 0.2 * dt)
             m.n_heartbeats += 1
-            m.last_heard = now
+            m.last_heard = max(now, m.last_heard)
+            m.over_phi_rounds = 0
             if m.state == SUSPECT:
                 m.state = ALIVE
                 self.metrics.inc(
@@ -291,10 +357,13 @@ class MembershipManager(PacedLoop):
         scale = max(m.mean_interval_s or 0.0, self.heartbeat_interval_s)
         return (now - m.last_heard) / scale
 
-    def _detect_failures_locked(self, now: float) -> int:
-        """Scan members, enqueue OP_FAIL for those past the suspicion
-        threshold. Caller holds the lock."""
-        enqueued = 0
+    def _detect_failures_locked(self, now: float) -> List[int]:
+        """Scan members; returns the ids whose phi sat at/above the
+        FAIL threshold for `confirm_rounds` consecutive scans — the
+        CANDIDATES. Nothing is failed here: the caller confirms them
+        outside the lock (reachability probe — it may do an RPC).
+        Caller holds the lock."""
+        candidates: List[int] = []
         for m in self._members.values():
             if m.state not in (ALIVE, SUSPECT):
                 continue
@@ -302,17 +371,63 @@ class MembershipManager(PacedLoop):
                 # Not enough evidence to model this member's cadence —
                 # the no-premature-verdict rule.
                 continue
-            phi = self._phi(m, now)
+            skew = 0.0
+            if havoc_mod.enabled():
+                act = havoc_mod.decide("membership.clock",
+                                       key=m.member_id)
+                if act is not None:
+                    # Injected clock skew: the detector sees this
+                    # member's silence stretched/compressed.
+                    skew = float(act.get("skew_s", 0.0))
+            phi = self._phi(m, now + skew)
             if phi >= self.phi_threshold:
+                m.over_phi_rounds += 1
+                if m.state == ALIVE:
+                    m.state = SUSPECT
+                    self.metrics.inc(
+                        f"membership.suspects.{self.ring_id}")
+                if m.over_phi_rounds >= self.confirm_rounds:
+                    candidates.append(m.member_id)
+            elif phi >= self.phi_threshold / 2:
+                m.over_phi_rounds = 0
+                if m.state == ALIVE:
+                    m.state = SUSPECT
+                    self.metrics.inc(
+                        f"membership.suspects.{self.ring_id}")
+            else:
+                m.over_phi_rounds = 0
+        return candidates
+
+    def _confirm_failures(self, candidates: Sequence[int]) -> int:
+        """The un-locked half of detection: probe each confirmed
+        candidate (when a probe is configured) and enqueue OP_FAIL for
+        the unreachable ones. A candidate that still answers the probe
+        is an ASYMMETRIC-PARTITION suspect — heartbeats blocked, peer
+        alive — and is vetoed (counted), not failed: no dead/alive
+        flapping on a one-way network cut."""
+        enqueued = 0
+        for member_id in candidates:
+            reachable = False
+            if self.probe is not None:
+                try:
+                    reachable = bool(self.probe(member_id))
+                # chordax-lint: disable=bare-except -- a probe error is "unreachable", never a detector crash
+                except Exception:
+                    reachable = False
+            with self._lock:
+                m = self._members.get(member_id)
+                if m is None or m.state not in (ALIVE, SUSPECT):
+                    continue  # a heartbeat/departure raced the probe
+                if reachable:
+                    m.over_phi_rounds = 0
+                    self.metrics.inc(
+                        f"membership.fail_vetoed.{self.ring_id}")
+                    continue
                 m.state = FAILED
                 self._pending.append((OP_FAIL, m.member_id))
                 self.metrics.inc(
                     f"membership.failures_detected.{self.ring_id}")
                 enqueued += 1
-            elif phi >= self.phi_threshold / 2 and m.state == ALIVE:
-                m.state = SUSPECT
-                self.metrics.inc(
-                    f"membership.suspects.{self.ring_id}")
         return enqueued
 
     # -- the control round ----------------------------------------------------
@@ -324,7 +439,9 @@ class MembershipManager(PacedLoop):
 
         now = time.monotonic()
         with self._lock:
-            self._detect_failures_locked(now)
+            candidates = self._detect_failures_locked(now)
+        if candidates:
+            self._confirm_failures(candidates)
         granted = self.bucket.take(self.max_batch)
         batch: List[Tuple[int, int]] = []
         with self._lock:
@@ -337,6 +454,7 @@ class MembershipManager(PacedLoop):
 
         applied_n = 0
         lost_rows = 0
+        resurrected = 0
         if batch:
             dl = Deadline.from_timeout(self.round_timeout_s)
             self.backend.begin_handoff()
@@ -344,8 +462,9 @@ class MembershipManager(PacedLoop):
                 flags = self.gateway.churn_apply_many(
                     batch, ring_id=self.ring_id, deadline=dl)
                 with self._lock:
-                    applied_n, lost_rows = self._apply_to_mirror_locked(
-                        batch, flags, time.monotonic())
+                    applied_n, lost_rows, resurrected = \
+                        self._apply_to_mirror_locked(
+                            batch, flags, time.monotonic())
                 # Fallback-path snapshot: the engine's chained state
                 # already includes this batch (FIFO), so the swap and
                 # the mirror update close the handoff window together.
@@ -367,7 +486,11 @@ class MembershipManager(PacedLoop):
             self.batches_applied += 1
             self.rows_applied += applied_n
             self.converged = False
-            self._maintain_due = self._maintain_due or lost_rows > 0
+            # Lost rows AND post-heal resurrections re-transfer
+            # custody: both schedule the maintenance pass + repair
+            # nudge (the rectify-style post-heal reconcile).
+            self._maintain_due = (self._maintain_due or lost_rows > 0
+                                  or resurrected > 0)
 
         # Stabilize pacing: one sweep per round while unconverged,
         # bounded per step so a wedged ring cannot monopolize the loop.
@@ -432,12 +555,16 @@ class MembershipManager(PacedLoop):
 
     def _apply_to_mirror_locked(self, batch: Sequence[Tuple[int, int]],
                                 flags: Sequence[bool], now: float
-                                ) -> Tuple[int, int]:
+                                ) -> Tuple[int, int, int]:
         """Mirror the kernel's per-lane outcomes onto the host table.
-        Returns (applied rows, lost rows i.e. applied fails+leaves).
+        Returns (applied rows, lost rows i.e. applied fails+leaves,
+        resurrected rows i.e. joins that revived a dead row — the
+        post-heal rejoin shape, which re-transfers custody and so
+        wants the same maintain/repair nudge a loss does).
         Caller holds the lock."""
         applied = 0
         lost = 0
+        resurrected = 0
         for (op, member_id), ok in zip(batch, flags):
             m = self._members.get(member_id)
             if not ok:
@@ -456,6 +583,13 @@ class MembershipManager(PacedLoop):
                        and self._mirror_ids[i] == member_id)
             if op == OP_JOIN:
                 if present:
+                    if not self._mirror_alive[i]:
+                        # Post-heal rejoin: the dead row revives and
+                        # custody moves BACK — digests changed, so the
+                        # maintain/repair nudge must follow.
+                        resurrected += 1
+                        self.metrics.inc(
+                            f"membership.rejoins.{self.ring_id}")
                     self._mirror_alive[i] = True   # rejoin/resurrect
                 else:
                     self._mirror_ids.insert(i, member_id)
@@ -480,7 +614,7 @@ class MembershipManager(PacedLoop):
         if applied:
             self.metrics.inc(
                 f"membership.ranges_transferred.{self.ring_id}", applied)
-        return applied, lost
+        return applied, lost, resurrected
 
     def _owned_range_locked(self, member_id: int) -> Tuple[int, int]:
         """[pred_alive_id + 1, member_id]: the key range whose custody

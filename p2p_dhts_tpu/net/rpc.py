@@ -42,9 +42,11 @@ parsed requests.
 from __future__ import annotations
 
 import json
+import queue
 import random
 import selectors
 import socket
+import struct
 import threading
 import time
 from collections import deque
@@ -53,6 +55,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from p2p_dhts_tpu import havoc as havoc_mod
 from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.health import FLIGHT
 from p2p_dhts_tpu.metrics import METRICS
@@ -63,6 +66,21 @@ Handler = Callable[[JsonObj], JsonObj]
 
 DEFAULT_TIMEOUT_S = 5.0  # client.cpp:68
 REQUEST_LOG_SIZE = 32    # server.h:242
+
+#: Connection-level flow control (ISSUE 10, the PR-9 open item): the
+#: most requests one binary connection may have dispatched-but-
+#: unanswered before further frames are shed with a BUSY envelope
+#: instead of queued on the worker pool. A flooding (or pathological)
+#: pipelining client therefore costs bounded pool backlog — it gets
+#: BUSY frames, not a wedged selector or an unbounded executor queue.
+#: The legacy one-shot transport needs none: one request per
+#: connection is its structural bound.
+MAX_INFLIGHT_PER_CONN = 64
+
+#: Bounded BUSY-reply queue (one shed thread per server drains it).
+#: When even this overflows, the frame is dropped outright (counted):
+#: a client flooding past both bounds can wait out its own timeout.
+SHED_QUEUE_SIZE = 256
 
 
 class RpcError(RuntimeError):
@@ -285,6 +303,21 @@ class Client:
         falls back to legacy JSON when negotiation says the
         destination is a close-delimited server (cached per
         destination by the pool)."""
+        if havoc_mod.enabled():
+            act = havoc_mod.decide("net.partition",
+                                   key=f"{ip_addr}:{port}")
+            if act is not None:
+                # Injected ASYMMETRIC partition: OUTBOUND requests to
+                # this destination fail while its own inbound traffic
+                # still flows (nothing here touches the server side).
+                # "block" fails fast; "drop" burns the caller timeout
+                # first — both surface as the transport RpcError the
+                # retry/failover machinery already handles.
+                if act.get("action") == "drop":
+                    time.sleep(min(timeout,
+                                   float(act.get("delay_s", timeout))))
+                raise RpcError(f"havoc: asymmetric partition blocks "
+                               f"{ip_addr}:{port}")
         if wire.transport() == "binary":
             try:
                 return Client._wire_request_inner(ip_addr, port,
@@ -306,9 +339,20 @@ class Client:
         # propagates past the transport-failure clauses below to the
         # caller's fallback routing untouched.)
         try:
-            return wire.request(ip_addr, port, request, timeout)
+            resp = wire.request(ip_addr, port, request, timeout)
+            if isinstance(resp, dict) and resp.get("BUSY"):
+                # Flow-control shed (server at its per-connection
+                # in-flight bound): a transport-level condition, so it
+                # surfaces as a retryable RpcError — make_request's
+                # jittered backoff is exactly the right response.
+                METRICS.inc("rpc.client.busy")
+                raise RpcError("RPC server busy (connection "
+                               "flow-control shed)")
+            return resp
         except TimeoutError:
             raise RpcError("RPC reply timed out") from None
+        except RpcError:
+            raise  # the BUSY raise above — already the client's shape
         except (OSError, RuntimeError) as exc:
             msg = str(exc)
             if not msg.startswith("RPC transport failure"):
@@ -371,10 +415,12 @@ class Client:
 
 class _ConnState:
     """Per-connection server state: transport mode, accumulation
-    buffer, and the send lock that keeps reply frames atomic."""
+    buffer, and the send lock that keeps reply frames atomic.
+    `fc_lock` guards ONLY the in-flight counter (never held across
+    I/O — the selector thread increments, workers decrement)."""
 
     __slots__ = ("sock", "mode", "buf", "asm", "send_lock",
-                 "last_activity", "dead")
+                 "last_activity", "dead", "fc_lock", "inflight")
 
     def __init__(self, sock: socket.socket, now: float):
         self.sock = sock
@@ -384,6 +430,8 @@ class _ConnState:
         self.send_lock = threading.Lock()
         self.last_activity = now
         self.dead = False
+        self.fc_lock = threading.Lock()
+        self.inflight = 0
 
 
 class Server:
@@ -400,8 +448,15 @@ class Server:
 
     def __init__(self, port: int, handlers: Dict[str, Handler],
                  num_threads: int = 3, logging_enabled: bool = False,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 max_inflight_per_conn: int = MAX_INFLIGHT_PER_CONN):
         self.port = port
+        self.max_inflight_per_conn = int(max_inflight_per_conn)
+        # BUSY shedding plumbing (flow control): built lazily on the
+        # first shed — most servers never flood.
+        self._shed_q: Optional["queue.Queue"] = None
+        self._shed_thread: Optional[threading.Thread] = None
+        self._shed_lock = threading.Lock()
         # Handler map is COPY-ON-WRITE: `_handlers` is only ever
         # REPLACED (never mutated in place) under `_handlers_lock`, so
         # worker threads read one immutable snapshot per request and a
@@ -481,6 +536,13 @@ class Server:
             except OSError:
                 pass
         self._pool.shutdown(wait=False)
+        with self._shed_lock:
+            shed_q = self._shed_q
+        if shed_q is not None:
+            try:
+                shed_q.put_nowait(None)  # shed-thread stop sentinel
+            except queue.Full:
+                pass  # daemon thread; dies with the process
 
     def _wake(self) -> None:
         try:
@@ -706,11 +768,70 @@ class Server:
             return
         for body in frames:
             METRICS.inc("rpc.wire.server.frames")
+            # Connection-level flow control BEFORE the worker pool
+            # (ISSUE 10): a connection already at its in-flight bound
+            # gets a BUSY frame from the shed thread — the selector
+            # never blocks and the executor queue never grows on a
+            # flooding client's behalf.
+            with st.fc_lock:
+                shed = st.inflight >= self.max_inflight_per_conn
+                if not shed:
+                    st.inflight += 1
+            if shed:
+                self._shed_busy(st, body)
+                continue
             try:
                 self._pool.submit(self._serve_frame, st, body)
             except RuntimeError:
+                self._fc_release(st)
                 self._drop(sel, st)
                 return
+
+    def _fc_release(self, st: _ConnState) -> None:
+        with st.fc_lock:
+            st.inflight -= 1
+
+    def _shed_busy(self, st: _ConnState, body: bytes) -> None:
+        """Queue one BUSY reply for an over-inflight frame. Runs on the
+        SELECTOR thread, so it must never touch the socket itself —
+        the (lazily started) shed thread owns the sendall."""
+        if len(body) < 9:
+            self._mark_dead(st)
+            return
+        _ftype, req_id = struct.unpack_from("<BQ", body, 0)
+        with self._shed_lock:
+            if self._shed_q is None:
+                self._shed_q = queue.Queue(maxsize=SHED_QUEUE_SIZE)
+                self._shed_thread = threading.Thread(
+                    target=self._shed_loop, daemon=True,
+                    name=f"rpc-shed-{self.port}")
+                self._shed_thread.start()
+            q = self._shed_q
+        try:
+            q.put_nowait((st, int(req_id)))
+        except queue.Full:
+            # Flooding past BOTH bounds: the frame is dropped outright
+            # (visible), and the client can ride out its own timeout.
+            # NOT also busy_rejected — that counter means "got a BUSY
+            # envelope", and this frame gets none.
+            METRICS.inc("rpc.server.busy_dropped")
+        else:
+            METRICS.inc("rpc.server.busy_rejected")
+
+    def _shed_loop(self) -> None:
+        """Drains BUSY replies so shedding costs the selector nothing.
+        The envelope is a normal SUCCESS:false error plus BUSY:true —
+        the client maps it to a retryable RpcError."""
+        busy = {"SUCCESS": False, "BUSY": True,
+                "ERRORS": "server busy: connection in-flight limit "
+                          f"({self.max_inflight_per_conn}) reached"}
+        while True:
+            item = self._shed_q.get()
+            if item is None:
+                return
+            st, req_id = item
+            if not st.dead:
+                self._send_frame(st, req_id, dict(busy))
 
     def _sweep(self, sel, now: float) -> None:
         """Enforce the legacy read timeout (a half-sent request must
@@ -776,6 +897,14 @@ class Server:
                 self._log_request(req)
                 resp = self._process(req)
             if isinstance(resp, DeferredResponse):
+                if havoc_mod.enabled() and havoc_mod.decide(
+                        "rpc.server.deferred_loss",
+                        key=req.get("COMMAND", "")
+                        if isinstance(req, dict) else None) is not None:
+                    # Injected continuation loss (one-shot form): the
+                    # connection closes without a reply — the client
+                    # fails fast on the EOF instead of hanging.
+                    return
                 # Connection ownership moves to the deferred executor;
                 # THIS worker is free for the next request (the nested
                 # RPCs the deferred work issues may land right here).
@@ -799,33 +928,49 @@ class Server:
     def _serve_frame(self, st: _ConnState, body: bytes) -> None:
         """One complete binary frame: decode (once — the assembler
         only releases finished frames), dispatch, answer the frame id.
-        The connection keeps serving other requests throughout."""
+        The connection keeps serving other requests throughout. The
+        flow-control slot taken in _feed_binary is released when the
+        reply is sent (for deferred responses: by the continuation)."""
+        deferred = False
         try:
-            ftype, req_id, req = wire.decode_frame(memoryview(body))
-        except wire.WireProtocolError:
-            self._mark_dead(st)
-            return
-        if ftype != wire.FRAME_REQUEST:
-            self._mark_dead(st)
-            return
-        if not isinstance(req, dict):
-            self._send_frame(st, req_id,
-                             {"SUCCESS": False,
-                              "ERRORS": "request is not an object"})
-            return
-        self._log_request(req)
-        resp = self._process(req)
-        if isinstance(resp, DeferredResponse):
-            # The continuation answers THIS frame id later; the
-            # connection (and this worker) move on immediately —
-            # persistent-connection deferred completion.
             try:
-                resp.executor.submit(self._finish_deferred_frame, st,
-                                     req, resp.fn, req_id)
-            except RuntimeError:
-                self._finish_deferred_frame(st, req, resp.fn, req_id)
-            return
-        self._send_frame(st, req_id, resp)
+                ftype, req_id, req = wire.decode_frame(memoryview(body))
+            except wire.WireProtocolError:
+                self._mark_dead(st)
+                return
+            if ftype != wire.FRAME_REQUEST:
+                self._mark_dead(st)
+                return
+            if not isinstance(req, dict):
+                self._send_frame(st, req_id,
+                                 {"SUCCESS": False,
+                                  "ERRORS": "request is not an object"})
+                return
+            self._log_request(req)
+            resp = self._process(req)
+            if isinstance(resp, DeferredResponse):
+                if havoc_mod.enabled() and havoc_mod.decide(
+                        "rpc.server.deferred_loss",
+                        key=req.get("COMMAND", "")) is not None:
+                    # Injected continuation loss: the reply for this
+                    # frame id never comes — the CALLER's deadline must
+                    # bound the wait (tested); the connection (and its
+                    # flow-control slot) keep serving.
+                    return
+                # The continuation answers THIS frame id later; the
+                # connection (and this worker) move on immediately —
+                # persistent-connection deferred completion.
+                deferred = True
+                try:
+                    resp.executor.submit(self._finish_deferred_frame, st,
+                                         req, resp.fn, req_id)
+                except RuntimeError:
+                    self._finish_deferred_frame(st, req, resp.fn, req_id)
+                return
+            self._send_frame(st, req_id, resp)
+        finally:
+            if not deferred:
+                self._fc_release(st)
 
     def _log_request(self, req: JsonObj) -> None:
         if not self.logging_enabled:
@@ -882,7 +1027,10 @@ class Server:
         """Deferred completion on a PERSISTENT binary connection: the
         continuation answers its own frame id; the connection stays
         open and keeps serving."""
-        self._send_frame(st, req_id, self._run_deferred(req, fn))
+        try:
+            self._send_frame(st, req_id, self._run_deferred(req, fn))
+        finally:
+            self._fc_release(st)
 
     def _run_deferred(self, req: JsonObj, fn: Handler) -> JsonObj:
         try:
@@ -908,6 +1056,16 @@ class Server:
         exception-to-envelope path. Counter keys are bounded to KNOWN
         commands (peer-supplied garbage would otherwise grow the metrics
         dict without limit); unknown ones share one counter."""
+        if havoc_mod.enabled():
+            act = havoc_mod.decide(
+                "rpc.server.stall",
+                key=req.get("COMMAND", "") if isinstance(req, dict)
+                else None)
+            if act is not None:
+                # Injected worker stall: this worker sleeps (no lock
+                # held) — the wedged-pool shape deadline propagation
+                # and flow control must degrade under.
+                time.sleep(float(act.get("delay_s", 0.05)))
         # ONE snapshot per request: the membership check (metrics key
         # bounding) and the dispatch must read the SAME map, or a
         # concurrent update_handlers swap between them miscounts — or

@@ -55,6 +55,20 @@ fields, so PR-4 deadline propagation and the PR-8 traced
 rpc.client -> rpc.server -> gateway -> serve chain survive the
 transport swap unchanged.
 
+chordax-havoc (ISSUE 10): the client consults the active FaultPlan at
+two deterministic boundaries — once per `request()` for frame faults
+(drop / delay / corrupt / truncate / duplicate / mid-frame reset) and
+once per dial for a partial hello — and the pool carries a
+per-destination CIRCUIT BREAKER over dial/negotiate failures:
+BREAKER_THRESHOLD consecutive failures trip it open (jittered cooldown,
+doubling per re-open), open destinations fast-fail with
+BreakerOpenError instead of burning a connect timeout per caller, and
+one half-open probe at a time decides recovery (`rpc.wire.breaker.*`
+counters). A connection that dies with requests in flight fails every
+sibling waiter IMMEDIATELY (counted `rpc.wire.inflight_aborted`) — no
+pipelined request ever rides out its full caller timeout on a dead
+connection.
+
 LOCK ORDER (chordax-lint pass 3 audits this module): every lock here
 is a leaf, and NO lock is ever held across socket I/O. Frame writes
 are serialized by a per-connection WRITER thread draining a queue
@@ -71,6 +85,7 @@ from __future__ import annotations
 import json
 import os
 import queue
+import random
 import socket
 import struct
 import threading
@@ -79,6 +94,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from p2p_dhts_tpu import havoc as havoc_mod
 from p2p_dhts_tpu.metrics import METRICS
 
 #: Version-1 hello, sent by the client and echoed by the server. The
@@ -106,8 +122,21 @@ MAX_CONNS_PER_DEST = 4
 #: recv bound): a corrupt length prefix must not allocate the moon.
 MAX_FRAME_BYTES = 256 << 20
 
+#: Circuit breaker (ISSUE 10): consecutive dial/negotiate failures per
+#: destination before the breaker trips open...
+BREAKER_THRESHOLD = 3
+#: ...and the jittered cooldown before ONE half-open probe is allowed
+#: (doubles per consecutive re-open, capped).
+BREAKER_COOLDOWN_S = 2.0
+BREAKER_COOLDOWN_CAP_S = 30.0
+
 FRAME_REQUEST = 1
 FRAME_RESPONSE = 2
+
+#: Private RNG for breaker cooldown jitter: the client retry-backoff
+#: tests patch the MODULE-level random.uniform to observe their own
+#: draws, and the breaker's draws must not bleed into that surface.
+_JITTER = random.Random()
 
 _LEN = struct.Struct("<I")
 
@@ -119,6 +148,21 @@ _BIN_KEY = "__wire_bin__"
 
 class WireProtocolError(RuntimeError):
     """A framing/codec violation on an established binary connection."""
+
+
+class BreakerOpenError(RuntimeError):
+    """The destination's circuit breaker is open: repeated dial or
+    negotiation failures tripped it, and the cooldown (or an in-flight
+    half-open probe) says this request must fast-fail instead of
+    dialing — a dead peer costs one refusal, not a connect timeout per
+    caller."""
+
+
+#: Writer-queue sentinel chordax-havoc uses to kill a connection
+#: MID-FRAME: the writer sends whatever precedes it, then fails the
+#: connection (the injected-reset shape the sibling-abort path and the
+#: server's torn-frame handling are tested against).
+_HAVOC_RESET = object()
 
 
 class ConnDeadError(RuntimeError):
@@ -418,7 +462,8 @@ class _Conn:
         with self._lock:
             return len(self._pending)
 
-    def request(self, obj: dict, timeout: float) -> dict:
+    def request(self, obj: dict, timeout: float,
+                fault: Optional[dict] = None) -> dict:
         waiter = _Waiter()
         with self._lock:
             if self.dead:
@@ -431,7 +476,14 @@ class _Conn:
         # in sendall behind another request's write (and no lock is
         # held across socket I/O anywhere in this module). A send
         # failure surfaces through _fail_all -> waiter.error below.
-        self._sendq.put(frame)
+        if fault is not None:
+            # chordax-havoc (ISSUE 10): the decision was made ONCE at
+            # the wire.request boundary (deterministic per request —
+            # an internal dead-conn retry re-applies the SAME fault);
+            # here it mutates this frame's bytes / lifecycle.
+            self._apply_frame_fault(frame, fault)
+        else:
+            self._sendq.put(frame)
         METRICS.inc("rpc.wire.bytes_sent", len(frame))
         if not waiter.event.wait(timeout):
             self._forget(req_id)
@@ -449,6 +501,44 @@ class _Conn:
         with self._lock:
             self._pending.pop(req_id, None)
 
+    def _apply_frame_fault(self, frame: bytes, fault: dict) -> None:
+        """Mutate one outbound frame per an injected wire fault. Runs
+        on the CALLER thread with no lock held (the delay action
+        sleeps here)."""
+        action = fault.get("action", "drop")
+        if action == "drop":
+            return  # never enqueued; the caller rides out its timeout
+        if action == "delay":
+            time.sleep(float(fault.get("delay_s", 0.005)))
+            self._sendq.put(frame)
+            return
+        if action == "duplicate":
+            self._sendq.put(frame)
+            self._sendq.put(frame)
+            return
+        if action == "corrupt":
+            # Flip the frame-type byte: the length prefix stays valid,
+            # so the server reads a COMPLETE frame and then rejects it
+            # (-> marks the connection dead; siblings must abort fast).
+            bad = bytearray(frame)
+            bad[_LEN.size] ^= 0xFF
+            self._sendq.put(bytes(bad))
+            return
+        if action == "truncate":
+            # Half a frame with the full length prefix: the server's
+            # assembler waits for bytes that never come, and the NEXT
+            # frame's bytes complete it into garbage.
+            self._sendq.put(frame[:max(len(frame) // 2, _LEN.size + 1)])
+            return
+        if action == "reset":
+            # Half a frame, then the writer kills the connection:
+            # the mid-frame reset every pipelined sibling must survive
+            # with an immediate abort, not a ridden-out timeout.
+            self._sendq.put(frame[:max(len(frame) // 2, _LEN.size + 1)])
+            self._sendq.put(_HAVOC_RESET)
+            return
+        raise ValueError(f"unknown wire frame fault {action!r}")
+
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
             if self.dead:
@@ -456,6 +546,12 @@ class _Conn:
             self.dead = True
             pending = list(self._pending.values())
             self._pending.clear()
+        if pending:
+            # Sibling in-flight requests on a dying connection fail NOW
+            # with the transport error (-> RpcError at the client) —
+            # never by riding out their full caller timeout (ISSUE 10
+            # satellite; counted so a reset storm is visible).
+            METRICS.inc("rpc.wire.inflight_aborted", len(pending))
         for w in pending:
             w.error = RuntimeError(f"RPC transport failure: {exc}")
             w.event.set()
@@ -475,6 +571,10 @@ class _Conn:
         while True:
             frame = self._sendq.get()
             if frame is None:
+                return
+            if frame is _HAVOC_RESET:
+                self._fail_all(OSError(
+                    "havoc: injected connection reset mid-frame"))
                 return
             try:
                 self.sock.sendall(frame)
@@ -509,15 +609,91 @@ class NegotiationFallback(Exception):
     """The destination is a legacy (close-delimited JSON) server."""
 
 
+class _Breaker:
+    """Per-destination dial/negotiate circuit state (pool-lock
+    guarded; no lock of its own)."""
+
+    __slots__ = ("fails", "open_until", "probing", "opens")
+
+    def __init__(self) -> None:
+        self.fails = 0          # consecutive dial/negotiate failures
+        self.open_until = 0.0   # monotonic instant half-open unlocks
+        self.probing = False    # one half-open probe at a time
+        self.opens = 0          # times tripped (cooldown doubles)
+
+
 class WirePool:
     """Bounded per-destination pool of negotiated binary connections,
-    with a legacy-destination cache (the negotiation verdict)."""
+    with a legacy-destination cache (the negotiation verdict) and a
+    per-destination circuit breaker over dial/negotiate failures
+    (ISSUE 10): a destination that refuses BREAKER_THRESHOLD dials in a
+    row trips open, fast-fails every caller for a jittered cooldown,
+    then admits ONE half-open probe — success closes the breaker,
+    failure re-opens it with a doubled (capped) cooldown. Live pooled
+    connections keep serving regardless; the breaker only gates NEW
+    dials."""
 
     def __init__(self, max_per_dest: int = MAX_CONNS_PER_DEST):
         self._lock = threading.Lock()
         self._conns: Dict[Tuple[str, int], List[_Conn]] = {}
         self._legacy: Dict[Tuple[str, int], float] = {}
+        self._breakers: Dict[Tuple[str, int], _Breaker] = {}
         self.max_per_dest = max_per_dest
+
+    # -- circuit breaker -----------------------------------------------------
+    def _breaker_admit(self, dest: Tuple[str, int]) -> None:
+        """Gate one DIAL attempt: no-op while closed; raises
+        BreakerOpenError while open; past the cooldown, claims the one
+        half-open probe slot for this caller."""
+        with self._lock:
+            b = self._breakers.get(dest)
+            if b is None or b.fails < BREAKER_THRESHOLD:
+                return
+            now = time.monotonic()
+            if now < b.open_until or b.probing:
+                METRICS.inc("rpc.wire.breaker.fastfail")
+                raise BreakerOpenError(
+                    f"circuit open for {dest[0]}:{dest[1]} "
+                    f"({b.fails} consecutive dial failures; probe "
+                    f"{'in flight' if b.probing else 'pending'})")
+            b.probing = True
+        METRICS.inc("rpc.wire.breaker.half_open")
+
+    def _breaker_ok(self, dest: Tuple[str, int]) -> None:
+        with self._lock:
+            b = self._breakers.pop(dest, None)
+        if b is not None and b.fails >= BREAKER_THRESHOLD:
+            METRICS.inc("rpc.wire.breaker.closed")
+
+    def _breaker_fail(self, dest: Tuple[str, int]) -> None:
+        with self._lock:
+            b = self._breakers.setdefault(dest, _Breaker())
+            b.probing = False
+            b.fails += 1
+            if b.fails < BREAKER_THRESHOLD:
+                return
+            b.opens += 1
+            base = min(
+                BREAKER_COOLDOWN_S * (2 ** (b.opens - 1)),
+                BREAKER_COOLDOWN_CAP_S)
+            # Jittered half-open timing: N clients whose breakers all
+            # tripped on the same dead peer must not probe it back in
+            # lockstep (the retry-storm rule, net/rpc.py).
+            b.open_until = time.monotonic() + _JITTER.uniform(
+                base * 0.5, base)
+        METRICS.inc("rpc.wire.breaker.open")
+
+    def breaker_state(self, ip_addr: str, port: int) -> dict:
+        """Introspection for tests/health: the destination's breaker
+        row (zeros when never tripped)."""
+        with self._lock:
+            b = self._breakers.get((ip_addr, int(port)))
+            if b is None:
+                return {"fails": 0, "open": False, "opens": 0}
+            return {"fails": b.fails,
+                    "open": (b.fails >= BREAKER_THRESHOLD
+                             and time.monotonic() < b.open_until),
+                    "opens": b.opens}
 
     def known_legacy(self, dest: Tuple[str, int]) -> bool:
         with self._lock:
@@ -558,7 +734,21 @@ class WirePool:
         if conn is not None:
             METRICS.inc("rpc.wire.reuse")
             return conn
-        conn = self._dial(dest, timeout)
+        # Only a DIAL consults the breaker: live pooled connections
+        # above keep serving even while the breaker is open.
+        self._breaker_admit(dest)
+        try:
+            conn = self._dial(dest, timeout)
+        except NegotiationFallback:
+            # The peer answered TCP (it is a legacy server, not a dead
+            # one): responsive — the breaker closes, the legacy cache
+            # routes the caller.
+            self._breaker_ok(dest)
+            raise
+        except (OSError, socket.timeout):
+            self._breaker_fail(dest)
+            raise
+        self._breaker_ok(dest)
         with self._lock:
             conns = self._conns.setdefault(dest, [])
             if len(conns) < self.max_per_dest:
@@ -581,6 +771,16 @@ class WirePool:
 
     def _dial(self, dest: Tuple[str, int], timeout: float) -> _Conn:
         t0 = time.perf_counter()
+        hello = HELLO
+        if havoc_mod.enabled():
+            act = havoc_mod.decide("wire.client.hello",
+                                   key=f"{dest[0]}:{dest[1]}")
+            if act is not None:
+                # Partial hello: the server sees a 'C'-prefixed
+                # non-hello and must treat the connection as legacy
+                # (or time it out); this client's echo wait times out
+                # and falls back — the negotiation edge the tests pin.
+                hello = HELLO[:max(int(act.get("bytes", 2)), 1)]
         sock = socket.create_connection(dest, timeout=timeout)
         try:
             # The hello wait gets the FULL negotiation window even when
@@ -590,7 +790,7 @@ class WirePool:
             # budget (the caller's own deadline still bounds the
             # request at the layers above).
             sock.settimeout(NEGOTIATE_TIMEOUT_S)
-            sock.sendall(HELLO)
+            sock.sendall(hello)
             echo = b""
             while len(echo) < len(HELLO):
                 chunk = sock.recv(len(HELLO) - len(echo))
@@ -622,6 +822,7 @@ class WirePool:
             conns = [c for lst in self._conns.values() for c in lst]
             self._conns.clear()
             self._legacy.clear()
+            self._breakers.clear()
         for c in conns:
             c.close()
 
@@ -663,6 +864,14 @@ def request(ip_addr: str, port: int, obj: dict, timeout: float) -> dict:
     dest = (ip_addr, int(port))
     if _POOL.known_legacy(dest):
         raise NegotiationFallback(dest)
+    fault = None
+    if havoc_mod.enabled():
+        # The frame-fault decision is made ONCE per wire.request, at
+        # this stable boundary — not per internal dead-conn retry — so
+        # the consumed schedule is a pure function of the request
+        # stream (the byte-identical-replay contract).
+        fault = havoc_mod.decide("wire.client.frame",
+                                 key=f"{dest[0]}:{dest[1]}")
     deadline = time.perf_counter() + timeout
     attempt = 0
     while True:
@@ -672,7 +881,7 @@ def request(ip_addr: str, port: int, obj: dict, timeout: float) -> dict:
         t0 = time.perf_counter()
         try:
             resp = conn.request(obj, max(deadline - time.perf_counter(),
-                                         0.001))
+                                         0.001), fault=fault)
         except ConnDeadError:
             METRICS.inc("rpc.wire.errors")
             # Stale-pool artifact, nothing sent: always safe to retry

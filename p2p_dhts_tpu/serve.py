@@ -45,6 +45,12 @@ semantics — hop parity is non-negotiable):
     `close()` instead of vanishing in a worker thread. Obligation: the
     reference's RPC server never sheds load silently — a caller either
     gets its answer or sees the failure.
+  * POISON-BATCH QUARANTINE (ISSUE 10) — a failed MULTI-request batch
+    never shares its exception: every slot is requeued for ONE solo
+    retry (retried slots dispatch alone), so a poisoned payload fails
+    exactly its own caller while its former batch-mates succeed
+    (counted `serve.quarantined`). Obligation: coalescing is a
+    scheduling choice — it must not widen any request's blast radius.
 
 Request kinds:
 
@@ -110,6 +116,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from p2p_dhts_tpu import havoc as havoc_mod
 from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.metrics import METRICS, Metrics
@@ -148,7 +155,7 @@ class _Slot:
     dispatch instead of burning a batch lane on an abandoned answer."""
 
     __slots__ = ("kind", "payload", "t_submit", "result", "error", "ev",
-                 "deadline", "trace")
+                 "deadline", "trace", "retried")
 
     def __init__(self, kind: str, payload: tuple,
                  deadline: Optional[float] = None):
@@ -163,6 +170,11 @@ class _Slot:
         #: tracing is off or the caller carries no trace) — the engine
         #: parents this request's span under it at fan-out.
         self.trace = None
+        #: Poison-batch quarantine (ISSUE 10): True once this slot has
+        #: been requeued for its one SOLO retry after a failed batch —
+        #: a retried slot dispatches alone and a second failure fails
+        #: only it, never its former batch-mates.
+        self.retried = False
 
     def wait(self, timeout: Optional[float] = None):
         if not self.ev.wait(timeout):
@@ -964,7 +976,7 @@ class ServeEngine:
                         if btr is not None:
                             btr.t_launch1 = time.perf_counter()
                     except BaseException as exc:  # noqa: BLE001 — fanned
-                        self._deliver_error(batch, exc)
+                        self._quarantine_or_fail(batch, exc)
                         batch = []
                         continue
                 finally:
@@ -1031,9 +1043,15 @@ class ServeEngine:
                 return []
             kind = self._pending[0].kind
             batch = []
-            while (self._pending and len(batch) < self._bucket_max
-                   and self._pending[0].kind == kind):
+            if self._pending[0].retried:
+                # A quarantined slot dispatches ALONE: its one solo
+                # retry must not take fresh batch-mates down with it.
                 batch.append(self._pending.popleft())
+            else:
+                while (self._pending and len(batch) < self._bucket_max
+                       and self._pending[0].kind == kind
+                       and not self._pending[0].retried):
+                    batch.append(self._pending.popleft())
             # Popping may leave the queue empty while the batch is not
             # yet launched; block the fast path until the launch (and
             # for puts, the store swap) is done. No call that can raise
@@ -1070,6 +1088,24 @@ class ServeEngine:
         size = len(batch)
         bucket = self._bucket_for(size)
         pad = bucket - size
+
+        if havoc_mod.enabled():
+            # chordax-havoc (ISSUE 10): dispatch-failure injection,
+            # BEFORE any device work (a launch that never ran cannot
+            # retrace or poison the chained state/store). Two sites:
+            # a per-engine batch failure (the flapping-ring scenario)
+            # and a payload-matched poison (the quarantine scenario —
+            # the matched slot's solo retry keeps failing while its
+            # former batch-mates' retries succeed).
+            act = havoc_mod.decide("serve.launch", key=self._name)
+            if act is None:
+                act = havoc_mod.decide(
+                    "serve.poison",
+                    key=[s.payload[0] for s in batch if s.payload])
+            if act is not None:
+                raise RuntimeError(
+                    f"havoc: injected dispatch failure "
+                    f"({kind} batch of {size}, engine {self._name!r})")
 
         with self._lock:
             self.batch_log.append((kind, size, bucket))
@@ -1340,7 +1376,7 @@ class ServeEngine:
                             if sepoch == self._store_epoch:
                                 self._store = prev_store
                                 self._store_epoch += 1
-            self._deliver_error(batch, exc)
+            self._quarantine_or_fail(batch, exc)
             return
         now = time.perf_counter()
         if btr is not None:
@@ -1420,6 +1456,40 @@ class ServeEngine:
                     ("serve.deliver", btr.t_results, t_end)):
                 trace_mod.record_span(name, t0, t1, trace_id=tid,
                                       parent_id=batch_sid, cat="serve")
+
+    def _quarantine_or_fail(self, batch: List[_Slot],
+                            exc: BaseException) -> None:
+        """Poison-batch quarantine (ISSUE 10): a failed MULTI-request
+        batch does not share its exception — every not-yet-retried slot
+        is requeued for ONE solo retry (front of the queue, original
+        order, popped one per batch), so a single poisoned payload
+        fails alone while its batch-mates succeed on their retries. A
+        solo retry's failure (or any single-request batch's) delivers
+        the error to exactly its own caller."""
+        retry = [s for s in batch if not s.retried and not s.ev.is_set()]
+        if len(retry) < 2:
+            # Nothing to split: solo request, a quarantined retry, or
+            # a batch whose live slots already collapsed to <= 1.
+            self._deliver_error(batch, exc)
+            return
+        for slot in retry:
+            slot.retried = True
+        with self._lock:
+            if self._closing and not self._drain_on_close:
+                requeue = False
+            else:
+                self._pending.extendleft(reversed(retry))
+                self._not_empty.notify()
+                requeue = True
+        if not requeue:
+            self._deliver_error(batch, exc)
+            return
+        self._metrics.inc("serve.quarantined", len(retry))
+        from p2p_dhts_tpu.health import FLIGHT
+        FLIGHT.record("serve", "batch_quarantined", engine=self._name,
+                      kind=batch[0].kind if batch else "?",
+                      n=len(retry),
+                      error=f"{type(exc).__name__}: {exc}")
 
     def _drop_expired(self, slots: List[_Slot]) -> None:
         """Fail slots whose deadline passed before dispatch. Distinct

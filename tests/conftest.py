@@ -175,6 +175,20 @@ def pytest_runtest_makereport(item, call):
                     ("chordax flight recorder (tail)", tail))
         except Exception:  # noqa: BLE001 — reporting must not mask the failure
             pass
+        # chordax-havoc: a failure under a FaultPlan is only
+        # reproducible with the plan's seed + step cursors — attach
+        # them as their own report section. describe_for_incident()
+        # (not describe_active): a failure inside `with
+        # havoc.injected(...)` unwinds through the uninstall before
+        # this hook runs, and the last-uninstalled plan is the one
+        # that was live when the test broke.
+        try:
+            from p2p_dhts_tpu import havoc
+            line = havoc.describe_for_incident()
+            if line:
+                report.sections.append(("chordax-havoc plan", line))
+        except Exception:  # noqa: BLE001 — reporting must not mask the failure
+            pass
     if item.get_closest_marker("soak") is None:
         return
     # Record the call phase, and ALSO setup-phase skips — the session

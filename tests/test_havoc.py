@@ -1,0 +1,567 @@
+"""chordax-havoc: deterministic fault injection + graceful degradation
+(ISSUE 10).
+
+Pins the plane's contracts:
+
+  * determinism — a FaultPlan's schedule is a pure function of
+    (seed, site, n): same seed => byte-identical schedules, across
+    instances and against the consumed record; different seed differs.
+  * wire faults — dropped frames ride out only their own timeout; a
+    mid-frame injected reset aborts SIBLING in-flight requests
+    immediately (counted `rpc.wire.inflight_aborted`).
+  * circuit breaker — repeated dial failures trip the per-destination
+    breaker open (fast-fail without a connect timeout), one half-open
+    probe closes it when the peer returns.
+  * flow control — a connection past its in-flight bound gets BUSY
+    frames before the worker pool, and the server keeps serving.
+  * quarantine — a poisoned payload inside a coalesced batch fails
+    ALONE after one solo retry; its batch-mates succeed.
+  * membership — confirm-rounds + reachability-probe veto keep an
+    asymmetric partition from flapping a reachable peer dead/alive;
+    a heartbeat cancels a still-pending OP_FAIL; a post-heal rejoin
+    resurrects the dead row and schedules the maintain/repair nudge.
+  * reporting — dump_on_error carries the active plan's seed + step
+    cursors, so any chaos failure is reproducible from the log.
+"""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu import havoc
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.gateway import Gateway
+from p2p_dhts_tpu.health import dump_on_error
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client, DeferredResponse, RpcError, Server
+from p2p_dhts_tpu.serve import ServeEngine
+
+pytestmark = pytest.mark.havoc
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """No plan leaks across tests, and the pool starts/ends fresh
+    (breaker + negotiation state is per-destination)."""
+    havoc.uninstall()
+    wire.reset_pool()
+    yield
+    havoc.uninstall()
+    wire.reset_pool()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_same_seed_byte_identical():
+    spec = {"wire.client.frame": {
+        "rate": 0.4,
+        "actions": [{"action": "drop"},
+                    {"action": "delay", "delay_s": 0.001, "weight": 2},
+                    {"action": "corrupt"}]}}
+    a = havoc.FaultPlan(0x5EED, spec)
+    b = havoc.FaultPlan(0x5EED, spec)
+    sched = a.export_site_schedule("wire.client.frame", 256)
+    assert sched == b.export_site_schedule("wire.client.frame", 256)
+    assert any(s != "-" for s in sched) and any(s == "-" for s in sched)
+    # The consumed record equals the exported schedule for the same
+    # stream, and serializes byte-identically across instances.
+    for _ in range(64):
+        a.decide("wire.client.frame", key="x")
+        b.decide("wire.client.frame", key="x")
+    assert a.schedule_bytes() == b.schedule_bytes()
+    assert a.consumed_schedule()["wire.client.frame"] == sched[:64]
+    # A different seed draws a different schedule.
+    c = havoc.FaultPlan(0x5EEE, spec)
+    assert c.export_site_schedule("wire.client.frame", 256) != sched
+
+
+def test_fault_plan_decide_is_race_free():
+    """limit accounting and the consumed record hold under concurrent
+    decisions: the whole decision serializes under one lock, so N
+    racing threads fire at most `limit` faults and the record stays in
+    cursor order (the byte-identical-replay contract's concurrency
+    half)."""
+    plan = havoc.FaultPlan(11, {"serve.launch": {"limit": 1}})
+    fired = []
+    barrier = threading.Barrier(8)
+
+    def hit():
+        barrier.wait()
+        for _ in range(16):
+            if plan.decide("serve.launch", key="e") is not None:
+                fired.append(1)
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(fired) == 1, f"limit=1 fired {len(fired)} times"
+    rec = plan.consumed_schedule()["serve.launch"]
+    assert len(rec) == 128 and rec.count("fail") == 1
+    assert rec == plan.export_site_schedule("serve.launch", 128,
+                                            key="e")
+
+
+def test_fault_plan_match_after_limit_and_unknown_site():
+    with pytest.raises(ValueError):
+        havoc.FaultPlan(1, {"no.such.site": {}})
+    with pytest.raises(ValueError, match="unknown action"):
+        havoc.FaultPlan(1, {"wire.client.frame": {
+            "actions": [{"action": "truncat"}]}})  # typo'd action
+    plan = havoc.FaultPlan(2, {
+        "serve.poison": {"match": [111, 222]},
+        "serve.launch": {"after": 2, "limit": 1},
+    })
+    # match: fires only when the site key (or one of a key list) hits.
+    assert plan.decide("serve.poison", key=333) is None
+    assert plan.decide("serve.poison", key=[333, 111]) is not None
+    assert plan.decide("serve.poison", key=None) is None
+    # after/limit: skips the first 2 decisions, then fires exactly once.
+    fired = [plan.decide("serve.launch", key="e") is not None
+             for _ in range(6)]
+    assert fired == [False, False, True, False, False, False]
+    # Unconsulted sites never appear in the consumed schedule.
+    assert "wire.client.frame" not in plan.consumed_schedule()
+    assert plan.cursors()["serve.poison"] == 3
+
+
+def test_install_uninstall_and_dump_reports_seed_cursor():
+    plan = havoc.FaultPlan(0xABCD, {"serve.launch": {"rate": 1.0}})
+    assert havoc.describe_active() is None
+    with havoc.injected(plan):
+        assert havoc.enabled() and havoc.active() is plan
+        with pytest.raises(RuntimeError):
+            havoc.install(havoc.FaultPlan(1, {}))  # one plan at a time
+        havoc.decide("serve.launch", key="e")
+        out = io.StringIO()
+        with pytest.raises(ValueError):
+            with dump_on_error("havoc-test", stream=out):
+                raise ValueError("boom")
+        text = out.getvalue()
+        assert "seed=0xabcd" in text and "serve.launch=1(1 fired)" in text
+    assert not havoc.enabled() and havoc.describe_active() is None
+    # A failure that unwound through injected()'s finally still has a
+    # reproducibility line: the last-uninstalled plan, labeled so.
+    line = havoc.describe_for_incident()
+    assert line is not None and "seed=0xabcd" in line \
+        and "[uninstalled]" in line
+
+
+# ---------------------------------------------------------------------------
+# wire faults
+# ---------------------------------------------------------------------------
+
+def test_wire_drop_then_clean_retry():
+    srv = Server(0, {"PING": lambda req: {"PONG": True}}, num_threads=2)
+    srv.run_in_background()
+    try:
+        plan = havoc.FaultPlan(3, {
+            "wire.client.frame": {"limit": 1,
+                                  "actions": [{"action": "drop"}]}})
+        with havoc.injected(plan), wire.forced("binary"):
+            t0 = time.perf_counter()
+            with pytest.raises(RpcError, match="timed out"):
+                Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=0.5)
+            # The drop costs ITS caller its own timeout, nothing more.
+            assert time.perf_counter() - t0 < 2.0
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=10)
+            assert r["SUCCESS"]
+        assert plan.fired().get("wire.client.frame") == 1
+    finally:
+        srv.kill()
+
+
+def test_wire_reset_mid_frame_aborts_siblings_immediately():
+    """The ISSUE-10 satellite regression: a connection reset with
+    pipelined requests in flight fails the SIBLINGS with an immediate
+    RpcError — never by riding out their full caller timeout."""
+    ev = threading.Event()
+
+    def slow(req):
+        ev.wait(8.0)
+        return {"OK": True}
+
+    srv = Server(0, {"SLOW": slow, "PING": lambda req: {"P": 1}},
+                 num_threads=2)
+    srv.run_in_background()
+    wire.pool().max_per_dest = 1  # everything shares ONE connection
+    aborted0 = METRICS.counter("rpc.wire.inflight_aborted")
+    sibling = {}
+
+    def call_slow():
+        t0 = time.perf_counter()
+        try:
+            Client.make_request("127.0.0.1", srv.port,
+                                {"COMMAND": "SLOW"}, timeout=30)
+            sibling["outcome"] = "ok"
+        except RpcError as exc:
+            sibling["outcome"] = str(exc)
+        sibling["elapsed"] = time.perf_counter() - t0
+
+    try:
+        with wire.forced("binary"):
+            # Prime the one pooled connection, then put the sibling in
+            # flight on it.
+            Client.make_request("127.0.0.1", srv.port,
+                                {"COMMAND": "PING"}, timeout=10)
+            t = threading.Thread(target=call_slow)
+            t.start()
+            time.sleep(0.2)
+            plan = havoc.FaultPlan(4, {
+                "wire.client.frame": {"limit": 1,
+                                      "actions": [{"action": "reset"}]}})
+            with havoc.injected(plan):
+                with pytest.raises(RpcError):
+                    Client.make_request("127.0.0.1", srv.port,
+                                        {"COMMAND": "PING"}, timeout=10)
+            t.join(10)
+        assert "transport failure" in sibling["outcome"], sibling
+        # Immediate, not the 30 s ride-out.
+        assert sibling["elapsed"] < 5.0, sibling
+        assert METRICS.counter("rpc.wire.inflight_aborted") > aborted0
+    finally:
+        ev.set()
+        wire.pool().max_per_dest = wire.MAX_CONNS_PER_DEST
+        srv.kill()
+
+
+def test_circuit_breaker_trips_fastfails_and_recovers():
+    # A port with nothing listening: grab one, then close it.
+    import socket as _socket
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    dest = ("127.0.0.1", port)
+    open0 = METRICS.counter("rpc.wire.breaker.open")
+    fast0 = METRICS.counter("rpc.wire.breaker.fastfail")
+    with wire.forced("binary"):
+        for _ in range(wire.BREAKER_THRESHOLD):
+            with pytest.raises(RpcError):
+                Client.make_request(*dest, {"COMMAND": "PING"},
+                                    timeout=2)
+        assert METRICS.counter("rpc.wire.breaker.open") == open0 + 1
+        assert wire.pool().breaker_state(*dest)["open"]
+        # Open: the next caller fast-fails without dialing.
+        t0 = time.perf_counter()
+        with pytest.raises(RpcError, match="circuit open"):
+            Client.make_request(*dest, {"COMMAND": "PING"}, timeout=5)
+        assert time.perf_counter() - t0 < 0.25
+        assert METRICS.counter("rpc.wire.breaker.fastfail") == fast0 + 1
+
+        # The peer comes back; force the cooldown over and let the ONE
+        # half-open probe close the breaker.
+        srv = Server(port, {"PING": lambda req: {"PONG": True}},
+                     num_threads=2)
+        srv.run_in_background()
+        try:
+            closed0 = METRICS.counter("rpc.wire.breaker.closed")
+            with wire.pool()._lock:
+                wire.pool()._breakers[dest].open_until = 0.0
+            r = Client.make_request(*dest, {"COMMAND": "PING"},
+                                    timeout=10)
+            assert r["SUCCESS"]
+            assert METRICS.counter("rpc.wire.breaker.closed") == \
+                closed0 + 1
+            assert wire.pool().breaker_state(*dest) == {
+                "fails": 0, "open": False, "opens": 0}
+        finally:
+            srv.kill()
+
+
+# ---------------------------------------------------------------------------
+# server flow control (the PR-9 open item)
+# ---------------------------------------------------------------------------
+
+def test_flow_control_sheds_busy_before_worker_pool():
+    ev = threading.Event()
+
+    def slow(req):
+        ev.wait(5.0)
+        return {"N": req.get("I")}
+
+    srv = Server(0, {"SLOW": slow, "PING": lambda req: {"P": 1}},
+                 num_threads=2, max_inflight_per_conn=2)
+    srv.run_in_background()
+    wire.pool().max_per_dest = 1
+    busy0 = METRICS.counter("rpc.server.busy_rejected")
+    results = []
+
+    def fire(i):
+        try:
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "SLOW", "I": i},
+                                    timeout=15)
+            results.append(("ok", bool(r.get("SUCCESS"))))
+        except RpcError as exc:
+            results.append(("err", str(exc)))
+
+    try:
+        with wire.forced("binary"):
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            time.sleep(0.6)
+            busy = METRICS.counter("rpc.server.busy_rejected") - busy0
+            ev.set()
+            for t in threads:
+                t.join(20)
+            assert busy > 0, "no frame was shed"
+            assert any(r[0] == "err" and "busy" in r[1]
+                       for r in results), results
+            assert any(r == ("ok", True) for r in results), results
+            # The selector survived the flood: a FRESH connection is
+            # served normally afterwards.
+            wire.reset_pool()
+            assert Client.make_request("127.0.0.1", srv.port,
+                                       {"COMMAND": "PING"},
+                                       timeout=10)["SUCCESS"]
+    finally:
+        ev.set()
+        wire.pool().max_per_dest = wire.MAX_CONNS_PER_DEST
+        srv.kill()
+
+
+# ---------------------------------------------------------------------------
+# server-side injection: worker stall, deferred-continuation loss
+# ---------------------------------------------------------------------------
+
+def test_worker_stall_and_deferred_loss_bounded_by_deadline():
+    from concurrent.futures import ThreadPoolExecutor
+    pool = ThreadPoolExecutor(max_workers=2)
+
+    def outer(req):
+        return DeferredResponse(lambda r: {"V": 7}, pool)
+
+    srv = Server(0, {"OUTER": outer, "PING": lambda req: {"P": 1}},
+                 num_threads=2)
+    srv.run_in_background()
+    try:
+        plan = havoc.FaultPlan(6, {
+            "rpc.server.stall": {"limit": 1,
+                                 "actions": [{"action": "stall",
+                                              "delay_s": 0.4}]},
+            "rpc.server.deferred_loss": {"limit": 1},
+        })
+        with havoc.injected(plan), wire.forced("binary"):
+            t0 = time.perf_counter()
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=10)
+            assert r["SUCCESS"]
+            assert time.perf_counter() - t0 >= 0.35  # the stall ran
+            # Deferred loss: the reply NEVER comes; the caller's own
+            # timeout bounds the wait — never past its deadline.
+            t0 = time.perf_counter()
+            with pytest.raises(RpcError, match="timed out"):
+                Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "OUTER"}, timeout=0.8)
+            assert time.perf_counter() - t0 < 3.0
+            # The connection (and its flow-control slot) keep serving.
+            assert Client.make_request("127.0.0.1", srv.port,
+                                       {"COMMAND": "OUTER"},
+                                       timeout=10)["V"] == 7
+    finally:
+        srv.kill()
+        pool.shutdown(wait=False)
+
+
+def test_partition_blocks_outbound_only():
+    srv_a = Server(0, {"PING": lambda req: {"A": 1}}, num_threads=2)
+    srv_b = Server(0, {"PING": lambda req: {"B": 1}}, num_threads=2)
+    srv_a.run_in_background()
+    srv_b.run_in_background()
+    try:
+        plan = havoc.FaultPlan(7, {
+            "net.partition": {"match": [f"127.0.0.1:{srv_a.port}"]}})
+        with havoc.injected(plan), wire.forced("binary"):
+            t0 = time.perf_counter()
+            with pytest.raises(RpcError, match="partition"):
+                Client.make_request("127.0.0.1", srv_a.port,
+                                    {"COMMAND": "PING"}, timeout=10)
+            assert time.perf_counter() - t0 < 0.5  # block = fail fast
+            # The OTHER direction of the cut is untouched: traffic to
+            # the unmatched destination flows.
+            assert Client.make_request("127.0.0.1", srv_b.port,
+                                       {"COMMAND": "PING"},
+                                       timeout=10)["B"] == 1
+        # Healed: the blocked destination answers again.
+        assert Client.make_request("127.0.0.1", srv_a.port,
+                                   {"COMMAND": "PING"},
+                                   timeout=10)["A"] == 1
+    finally:
+        srv_a.kill()
+        srv_b.kill()
+
+
+# ---------------------------------------------------------------------------
+# poison-batch quarantine (serve engine)
+# ---------------------------------------------------------------------------
+
+def test_poison_batch_quarantine_fails_alone(rng):
+    ids = _rand_ids(rng, 32)
+    state = build_ring(ids, RingConfig(finger_mode="materialized"))
+    eng = ServeEngine(state, empty_store(640, 4), bucket_min=4,
+                      bucket_max=16, name="havoc-quarantine")
+    eng.start()
+    eng.warmup(["dhash_put", "dhash_get"])
+    keys = _rand_ids(rng, 6)
+    segs = [rng.randint(0, 200, size=(4, 10)).astype(np.int32)
+            for _ in keys]
+    poison = keys[2]
+    q0 = METRICS.counter("serve.quarantined")
+    plan = havoc.FaultPlan(8, {"serve.poison": {"match": [poison]}})
+    try:
+        with havoc.injected(plan):
+            slots = eng.submit_many(
+                "dhash_put",
+                [(k, s, 4, 0) for k, s in zip(keys, segs)])
+            outcomes = []
+            for s in slots:
+                try:
+                    outcomes.append(("ok", s.wait(60)))
+                except RuntimeError as exc:
+                    outcomes.append(("err", str(exc)))
+        # The poisoned slot failed ALONE (after its one solo retry);
+        # every batch-mate succeeded on its own retry.
+        assert outcomes[2][0] == "err" and "havoc" in outcomes[2][1]
+        assert all(o == ("ok", True)
+                   for i, o in enumerate(outcomes) if i != 2), outcomes
+        assert METRICS.counter("serve.quarantined") - q0 == len(keys)
+        # Store state is consistent: the good keys read back, the
+        # poisoned one is absent (its put never applied), and the
+        # post-fault re-put HEALS it to 100% readable.
+        for i, k in enumerate(keys):
+            _, ok = eng.dhash_get(k)
+            assert bool(ok) == (i != 2)
+        assert eng.dhash_put(poison, segs[2], 4, 0)
+        _, ok = eng.dhash_get(poison)
+        assert bool(ok)
+        eng.assert_no_retraces()
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# partition-aware membership
+# ---------------------------------------------------------------------------
+
+def test_membership_probe_veto_flap_suppression_and_rejoin(rng):
+    from p2p_dhts_tpu.membership import MembershipManager
+    from p2p_dhts_tpu.membership.kernels import padded_capacity
+
+    member_ids = _rand_ids(rng, 12)
+    mets = Metrics()
+    gw = Gateway(metrics=mets, name="havoc-member")
+    gw.add_ring("hm", build_ring(member_ids,
+                                 RingConfig(finger_mode="materialized"),
+                                 capacity=padded_capacity(16)),
+                default=True, bucket_min=4, bucket_max=8,
+                warmup=["churn_apply", "stabilize_sweep"])
+    reachable = {"value": True}
+    mgr = MembershipManager(
+        gw, "hm", heartbeat_interval_s=0.05, min_heartbeats=3,
+        confirm_rounds=2, probe=lambda mid: reachable["value"],
+        round_timeout_s=600.0, metrics=mets)
+    try:
+        member = _rand_ids(rng, 1)[0]
+        assert mgr.request_join(member)
+        mgr.step()  # apply the join
+        assert member in mgr.alive_ids()
+        for _ in range(4):
+            mgr.heartbeat(member)
+            time.sleep(0.02)
+
+        # The one-way cut: the member's heartbeats are DROPPED by the
+        # injection site (delivery visibly fails) while the probe
+        # direction still flows — the confirmed candidate is VETOED,
+        # not failed; across many detector rounds, no flapping.
+        drop_plan = havoc.FaultPlan(0xA51, {
+            "membership.heartbeat": {"match": [member],
+                                     "actions": [{"action": "drop"}]}})
+        with havoc.injected(drop_plan):
+            assert mgr.heartbeat(member) is False  # injected drop
+            time.sleep(0.5)
+            for _ in range(3):
+                assert mgr.heartbeat(member) is False
+                mgr.step()
+                time.sleep(0.05)
+        assert member in mgr.alive_ids(), "reachable peer was failed"
+        assert mets.counter("membership.fail_vetoed.hm") >= 1
+        assert mets.counter("membership.failures_detected.hm") == 0
+
+        # Flap suppression: an operator/detector OP_FAIL still pending
+        # is CANCELLED by a late-delivered heartbeat.
+        assert mgr.fail_member(member)
+        assert mgr.pending_ops == 1
+        assert mgr.heartbeat(member)
+        assert mgr.pending_ops == 0
+        assert mets.counter("membership.flap_suppressed.hm") == 1
+        assert member in mgr.alive_ids()
+
+        # The cut becomes REAL (probe fails too): the member is failed
+        # after confirm_rounds scans — and a post-heal rejoin
+        # resurrects the dead row and schedules the maintain/nudge.
+        # (The EWMA adapted to the earlier silence, so the wait must
+        # comfortably re-cross phi_threshold x the learned interval.)
+        reachable["value"] = False
+        deadline = time.time() + 20.0
+        while (member in mgr.alive_ids()
+               and mets.counter("membership.failures_detected.hm") == 0
+               and time.time() < deadline):
+            time.sleep(0.3)
+            mgr.step()
+        mgr.quiesce(max_rounds=16)
+        assert member not in mgr.alive_ids()
+        assert mets.counter("membership.failures_detected.hm") == 1
+        assert mgr.request_join(member)
+        mgr.step()
+        assert member in mgr.alive_ids()
+        assert mets.counter("membership.rejoins.hm") == 1
+
+        # Injected clock skew drives phi over threshold despite fresh
+        # heartbeats — and the probe veto still holds the line.
+        reachable["value"] = True
+        for _ in range(4):
+            mgr.heartbeat(member)
+            time.sleep(0.02)
+        plan = havoc.FaultPlan(9, {
+            "membership.clock": {"match": [member],
+                                 "actions": [{"action": "skew",
+                                              "skew_s": 60.0}]}})
+        with havoc.injected(plan):
+            for _ in range(3):
+                mgr.step()
+        assert member in mgr.alive_ids()
+        assert mets.counter("membership.fail_vetoed.hm") >= 2
+    finally:
+        mgr.close()
+        gw.close()
+
+
+def test_membership_heartbeat_delay_injection():
+    """The delay action shifts a heartbeat's recorded arrival back in
+    time (it was delivered LATE): the inter-arrival model sees the gap
+    a slow path would have produced — pure bookkeeping, no ring."""
+    plan = havoc.FaultPlan(10, {
+        "membership.heartbeat": {"match": [42],
+                                 "actions": [{"action": "delay",
+                                              "delay_s": 0.25}]}})
+    act = plan.decide("membership.heartbeat", key=42)
+    assert act == {"action": "delay", "delay_s": 0.25}
+    assert plan.decide("membership.heartbeat", key=43) is None

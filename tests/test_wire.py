@@ -39,7 +39,8 @@ from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 from p2p_dhts_tpu.net import wire
-from p2p_dhts_tpu.net.rpc import Client, DeferredResponse, Server
+from p2p_dhts_tpu.net.rpc import (Client, DeferredResponse, RpcError,
+                                  Server)
 
 pytestmark = pytest.mark.wire
 
@@ -567,6 +568,198 @@ def test_deferred_response_completes_on_persistent_connection():
     finally:
         srv.kill()
         pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# negotiation edge cases (ISSUE 10 satellite): a misbehaving server must
+# produce a fast fallback or a deadline-bounded failure — never a hang
+# ---------------------------------------------------------------------------
+
+def _scripted_server(behaviors):
+    """A fake TCP server running one scripted behavior per accepted
+    connection (the last behavior repeats). Returns (port, closer)."""
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    stop = threading.Event()
+
+    def loop():
+        i = 0
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            fn = behaviors[min(i, len(behaviors) - 1)]
+            i += 1
+            try:
+                fn(conn)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def closer():
+        stop.set()
+        try:
+            lsock.close()
+        except OSError:
+            pass
+
+    return port, closer
+
+
+def _behavior_partial_hello_close(conn):
+    conn.recv(64)
+    conn.sendall(wire.HELLO[:2])  # truncated hello, then die
+
+
+def _behavior_json_reply(conn):
+    conn.settimeout(5.0)
+    buf = b""
+    while b"}" not in buf:
+        chunk = conn.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    conn.sendall(b'{"SUCCESS":true,"VIA":"json"}')
+
+
+def test_partial_hello_then_close_falls_back_fast():
+    """A server that sends a TRUNCATED hello and dies: the client must
+    conclude legacy and fall back to the JSON transport — quickly,
+    not after some unbounded wait."""
+    port, closer = _scripted_server(
+        [_behavior_partial_hello_close, _behavior_json_reply])
+    try:
+        t0 = time.perf_counter()
+        with wire.forced("binary"):
+            r = Client.make_request("127.0.0.1", port,
+                                    {"COMMAND": "PING"}, timeout=5)
+        elapsed = time.perf_counter() - t0
+        assert r["SUCCESS"] and r["VIA"] == "json"
+        assert elapsed < wire.NEGOTIATE_TIMEOUT_S + 3.0
+        assert wire.pool().known_legacy(("127.0.0.1", port))
+    finally:
+        closer()
+
+
+def test_partial_hello_then_stall_never_hangs_past_deadline():
+    """A server that sends a partial hello and STALLS (no close): the
+    negotiation window bounds the probe, the JSON fallback's wait is
+    bounded by the caller timeout — the caller NEVER hangs past its
+    deadline."""
+    def stall(conn):
+        conn.recv(64)
+        conn.sendall(wire.HELLO[:2])
+        time.sleep(8.0)  # neither echo nor close
+
+    port, closer = _scripted_server([stall])
+    try:
+        t0 = time.perf_counter()
+        with wire.forced("binary"):
+            with pytest.raises(RpcError):
+                Client.make_request("127.0.0.1", port,
+                                    {"COMMAND": "PING"}, timeout=1.0)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < wire.NEGOTIATE_TIMEOUT_S + 1.0 + 1.5, elapsed
+    finally:
+        closer()
+
+
+def test_server_dies_between_hello_and_first_frame():
+    """A server that completes negotiation then dies: the request on
+    the fresh connection fails IMMEDIATELY (reader EOF -> every
+    pending waiter aborted), not at the caller timeout."""
+    def hello_then_die(conn):
+        conn.recv(len(wire.HELLO))
+        conn.sendall(wire.HELLO)
+        conn.recv(4096)  # wait for the first frame, then die
+        # close follows from the scripted-server finally
+
+    port, closer = _scripted_server([hello_then_die])
+    aborted0 = METRICS.counter("rpc.wire.inflight_aborted")
+    try:
+        t0 = time.perf_counter()
+        with wire.forced("binary"):
+            with pytest.raises(RpcError, match="transport failure"):
+                Client.make_request("127.0.0.1", port,
+                                    {"COMMAND": "PING"}, timeout=10)
+        assert time.perf_counter() - t0 < 3.0
+        assert METRICS.counter("rpc.wire.inflight_aborted") > aborted0
+    finally:
+        closer()
+
+
+def test_hello_then_silence_bounded_by_caller_timeout():
+    """Negotiation succeeds but the server never answers any frame:
+    the caller's own timeout (and nothing longer) bounds the wait."""
+    def hello_then_silence(conn):
+        conn.recv(len(wire.HELLO))
+        conn.sendall(wire.HELLO)
+        time.sleep(6.0)  # swallow frames, answer nothing
+
+    port, closer = _scripted_server([hello_then_silence])
+    try:
+        t0 = time.perf_counter()
+        with wire.forced("binary"):
+            with pytest.raises(RpcError, match="timed out"):
+                Client.make_request("127.0.0.1", port,
+                                    {"COMMAND": "PING"}, timeout=0.8)
+        elapsed = time.perf_counter() - t0
+        assert 0.7 <= elapsed < 2.5, elapsed
+    finally:
+        closer()
+
+
+def test_server_kill_aborts_in_flight_siblings():
+    """Server death with a pipelined request in flight: the sibling
+    fails with an immediate RpcError (counted), never by riding out
+    its full caller timeout (ISSUE 10 satellite)."""
+    ev = threading.Event()
+
+    def slow(req):
+        ev.wait(6.0)
+        return {"OK": True}
+
+    srv = Server(0, {"SLOW": slow, "PING": lambda req: {"P": 1}},
+                 num_threads=2)
+    srv.run_in_background()
+    outcome = {}
+
+    def call_slow():
+        t0 = time.perf_counter()
+        try:
+            Client.make_request("127.0.0.1", srv.port,
+                                {"COMMAND": "SLOW"}, timeout=30)
+            outcome["err"] = None
+        except RpcError as exc:
+            outcome["err"] = str(exc)
+        outcome["elapsed"] = time.perf_counter() - t0
+
+    try:
+        with wire.forced("binary"):
+            Client.make_request("127.0.0.1", srv.port,
+                                {"COMMAND": "PING"}, timeout=10)
+            t = threading.Thread(target=call_slow)
+            t.start()
+            time.sleep(0.3)
+            srv.kill()
+            t.join(10)
+        assert outcome["err"] is not None and \
+            "transport failure" in outcome["err"], outcome
+        assert outcome["elapsed"] < 5.0, outcome
+    finally:
+        ev.set()
+        srv.kill()
 
 
 def test_deadline_and_unencodable_response_surface_as_envelope():

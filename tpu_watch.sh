@@ -1,31 +1,33 @@
 #!/bin/bash
-# TPU recovery watcher, round 9: the ten configs still want on-chip
-# records (greens from r07/r08 carry over). Wait for the chip to be
-# free, probe the remote-compile service (dead since round 4:
+# TPU recovery watcher, round 10: the ten configs still want on-chip
+# records (greens from r07/r08/r09 carry over). Wait for the chip to
+# be free, probe the remote-compile service (dead since round 4:
 # connection-refused on its port while cached programs kept executing),
 # and when it answers, run the configs without a green record one at a
-# time into BENCH_ATTEMPT_r09.jsonl (bench's _record_lkg promotes each
+# time into BENCH_ATTEMPT_r10.jsonl (bench's _record_lkg promotes each
 # green on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# round-8 --trace device-timeline archiving (now into BENCH_TRACE_r09).
-# NEW in round 9 (chordax-wire): the pre-bench gateway smoke now
-# hard-gates the binary transport — wire-isolated batched path at
-# >= 3x the JSON keys/s and <= 1/2 its p50, binary-transport 1000-key
-# parity, the traced rpc.client->rpc.server->gateway->serve chain over
-# the persistent connections, zero steady-state retraces — so no chip
-# time is spent on a tree whose front door regressed. Never kills
-# anything mid-TPU-work; every probe and bench attempt runs to
-# completion (a blocked fresh-shape jit takes ~25 min to fail — that
-# is the probe's cost when the service is down, accepted).
+# --trace device-timeline archiving (now into BENCH_TRACE_r10). The
+# round-9 chordax-wire hard gates stay (wire-isolated binary >= 3x
+# JSON keys/s at <= 1/2 p50, traced chain, zero retraces). NEW in
+# round 10 (chordax-havoc): a HAVOC SMOKE pre-bench gate — the
+# scenario matrix (lossy wire / flapping ring / asymmetric partition /
+# poison batch) must hold >= 99% availability with byte-identical
+# same-seed fault schedules and 100% readable post-fault on CPU before
+# any bench touches the chip; a tree whose degradation machinery
+# regressed gets no hardware time. Never kills anything mid-TPU-work;
+# every probe and bench attempt runs to completion (a blocked
+# fresh-shape jit takes ~25 min to fail — that is the probe's cost
+# when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-9 watcher start (ten configs + chordax-wire smoke gate)"
+log "round-10 watcher start (ten configs + wire + havoc smoke gates)"
 
 needed() {  # configs without a green record yet (r07/r08 greens count)
   python - <<'EOF'
 import json
 ok = set()
 for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
-                "BENCH_ATTEMPT_r09.jsonl"):
+                "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -96,6 +98,17 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Havoc smoke (ISSUE 10): the fault-injection scenario matrix must
+  # hold — >=99% availability under lossy wire and a flapping ring,
+  # byte-identical same-seed fault schedules, the poison lane failing
+  # alone, 100% readable post-fault, zero retraces — on CPU before
+  # anything claims the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config havoc --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "havoc smoke FAILED - fix the degradation machinery before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -106,11 +119,11 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r09
+    mkdir -p BENCH_TRACE_r10
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r09/$c)"
-      python bench.py --config "$c" --trace "BENCH_TRACE_r09" \
-        >> BENCH_ATTEMPT_r09.jsonl 2>> BENCH_ATTEMPT_r09.err
+      log "running --config $c (device trace -> BENCH_TRACE_r10/$c)"
+      python bench.py --config "$c" --trace "BENCH_TRACE_r10" \
+        >> BENCH_ATTEMPT_r10.jsonl 2>> BENCH_ATTEMPT_r10.err
       log "config $c rc=$?"
     done
   else
