@@ -2378,6 +2378,320 @@ def _bench_havoc_phases(gw, srv, eng, mets, rng, havoc, wire, Client,
     })
 
 
+# ---------------------------------------------------------------------------
+# config 11: pulse — continuous telemetry + SLO tracking (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def bench_pulse(n_peers: int = 512, data_keys: int = 48,
+                closed_reqs: int = 200, fault_requests: int = 50,
+                tick_s: float = 0.1, smax: int = 4,
+                bucket_min: int = 8, bucket_max: int = 64) -> dict:
+    """chordax-pulse end to end (ISSUE 11). Hard assertions:
+
+      * sampler overhead <= 5%% p50 (plus timer slack) on the gateway
+        closed loop — continuous telemetry is affordable always-on;
+      * on a HEALTHY run every SLO verdict is OK;
+      * a seeded havoc lossy-wire scenario drives the availability
+        SLO to BREACH, the breach lands in the flight recorder as an
+        incident carrying the burn rate, and the verdict recovers to
+        OK after the fault window — all observed over the PULSE wire
+        verb (polled mid-bench, exactly as the watcher would);
+      * one repair round exports as a SINGLE linked
+        digest -> diff -> heal trace in the Chrome document;
+      * the Prometheus exposition parses; zero steady-state retraces.
+
+    CHORDAX_PULSE_SERIES=<path> additionally archives the sampled
+    series + final verdicts as a JSON artifact (tpu_watch stores it
+    next to the BENCH records)."""
+    from p2p_dhts_tpu import havoc, trace
+    from p2p_dhts_tpu.dhash.store import empty_store
+    from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+    from p2p_dhts_tpu.health import FLIGHT
+    from p2p_dhts_tpu.metrics import METRICS
+    from p2p_dhts_tpu.net import wire
+    from p2p_dhts_tpu.net.rpc import Client, RpcError, Server
+    from p2p_dhts_tpu.pulse import PulseSampler
+
+    rng = np.random.RandomState(0x9015E)
+    gw = Gateway(name="bench-pulse")
+    member_ids = [int.from_bytes(rng.bytes(16), "little")
+                  for _ in range(n_peers)]
+    gw.add_ring("pu", build_ring(member_ids,
+                                 RingConfig(finger_mode="materialized")),
+                empty_store((data_keys + 16) * 14, smax),
+                default=True, bucket_min=bucket_min,
+                bucket_max=bucket_max,
+                warmup=["find_successor", "dhash_get", "dhash_put",
+                        "sync_digest", "repair_reindex"])
+    gw.add_ring("pw", build_ring(member_ids,
+                                 RingConfig(finger_mode="materialized")),
+                empty_store((data_keys + 16) * 14, smax),
+                bucket_min=bucket_min, bucket_max=bucket_max,
+                warmup=["dhash_get", "dhash_put", "sync_digest",
+                        "repair_reindex"])
+    sampler = PulseSampler(
+        metrics=METRICS, interval_s=tick_s,
+        slos=[{"name": "availability", "kind": "availability",
+               "target_pct": 99.0,
+               "total": "rpc.client.requests",
+               "errors": "rpc.client.errors",
+               "window_s": 1.5, "long_window_s": 6.0},
+              {"name": "gw-p99", "kind": "latency",
+               "hist": "gateway.latency_ms.find_successor.pu",
+               "quantile": 0.99, "bound_ms": 2000.0,
+               "window_s": 5.0}])
+    gw.attach_pulse(sampler)
+    srv = Server(0, {}, num_threads=4)
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        return _bench_pulse_phases(
+            gw, srv, sampler, rng, havoc, trace, wire, Client,
+            RpcError, METRICS, FLIGHT, data_keys, closed_reqs,
+            fault_requests, smax)
+    finally:
+        sampler.close()
+        srv.kill()
+        wire.reset_pool()
+        havoc.uninstall()
+        gw.close()
+
+
+def _bench_pulse_phases(gw, srv, sampler, rng, havoc, trace, wire,
+                        Client, RpcError, METRICS, FLIGHT, data_keys,
+                        closed_reqs, fault_requests, smax) -> dict:
+    from p2p_dhts_tpu.metrics import nearest_rank
+    from p2p_dhts_tpu.pulse import parse_prometheus
+    from p2p_dhts_tpu.repair.scheduler import run_sync_round
+
+    def _key(r):
+        return int.from_bytes(r.bytes(16), "little")
+
+    def _poll_verdict(want, timeout_s):
+        """The watcher's view: the verdict over the PULSE verb, not
+        in-process state. The poll itself rides the (possibly
+        fault-injected) wire, so a faulted poll attempt is retried,
+        never fatal, and its timeout stays short — a dropped frame
+        must cost 1 s, not a 10 s stall that eats the poll budget."""
+        deadline = time.time() + timeout_s
+        last = None
+        while time.time() < deadline:
+            try:
+                resp = Client.make_request(
+                    "127.0.0.1", srv.port,
+                    {"COMMAND": "PULSE", "SLO": True}, timeout=1.0,
+                    retries=2)
+            except RpcError:
+                continue  # the fault plan ate the poll; ask again
+            last = resp["SLO"]["availability"]
+            if last["verdict"] == want:
+                return last
+            time.sleep(0.05)
+        raise AssertionError(
+            f"availability SLO never reached {want} "
+            f"(last: {last})")
+
+    # -- phase 0: seed data + closed-loop baseline (sampler OFF) --------
+    keys = [_key(rng) for _ in range(data_keys)]
+    segs = [rng.randint(0, 200, size=(smax, 10)).astype(np.int32)
+            for _ in keys]
+    for k, s in zip(keys, segs):
+        assert gw.dhash_put(k, s, smax, 0, ring_id="pu"), \
+            "pulse bench seed PUT failed"
+
+    def closed_loop(n):
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            owner, hops = gw.find_successor(_key(rng), 0,
+                                            ring_id="pu", timeout=120)
+            lats.append(time.perf_counter() - t0)
+            assert owner >= 0 and hops >= 0
+        s = sorted(lats)
+        return (nearest_rank(s, 0.5), nearest_rank(s, 0.99),
+                sum(lats))
+
+    def measured_p50():
+        """Best-of-3 closed-loop p50 after two discarded warm-in
+        runs: the run right after a pause/warmup is systematically
+        fastest and back-to-back p50s drift ~1.5x on the 1-core
+        smoke host, so single A-then-B runs blame pure scheduler
+        drift on condition B. Min-of-k under identical regimes is
+        what the 5% gate can honestly compare."""
+        closed_loop(closed_reqs)
+        closed_loop(closed_reqs)
+        runs = [closed_loop(closed_reqs) for _ in range(3)]
+        best = min(runs, key=lambda r: r[0])
+        return best[0], best[1]
+
+    p50_off, p99_off = measured_p50()
+
+    # -- phase 1: the same loop with the sampler RUNNING ----------------
+    sampler.start()
+    deadline = time.time() + 30.0
+    while sampler.rounds < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert sampler.rounds >= 2, "sampler loop never ticked"
+    p50_on, p99_on = measured_p50()
+    overhead_x = p50_on / p50_off if p50_off else 1.0
+    # <= 5% p50 overhead, with a small absolute allowance for timer/
+    # scheduler noise on the 1-core smoke host (the PR-8 rule).
+    assert p50_on <= p50_off * 1.05 + 3e-4, (
+        f"sampler overhead: p50 {p50_off * 1e3:.3f} -> "
+        f"{p50_on * 1e3:.3f} ms ({overhead_x:.3f}x)")
+
+    # -- phase 2: healthy verdicts over the PULSE verb ------------------
+    for _ in range(10):   # give the availability SLO rpc traffic
+        r = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "FIND_SUCCESSOR", "KEY": format(_key(rng), "x"),
+             "DEADLINE_MS": 8000.0}, timeout=10.0)
+        assert r.get("SUCCESS")
+    healthy = _poll_verdict("OK", 15.0)
+    resp = Client.make_request(
+        "127.0.0.1", srv.port,
+        {"COMMAND": "PULSE", "SLO": True, "SERIES": "rpc.",
+         "PROM": True}, timeout=10.0)
+    assert resp["ATTACHED"] and resp["STATUS"]["ticks"] >= 2
+    for name, row in resp["SLO"].items():
+        assert row["verdict"] == "OK", (name, row)
+    assert parse_prometheus(resp["PROM"]), "exposition did not parse"
+    n_series = resp["STATUS"]["series"]
+    assert n_series > 0, "sampler tracked no series"
+
+    # -- phase 3: havoc lossy wire -> availability BREACH ---------------
+    breach_evts0 = len([e for e in FLIGHT.recent()
+                        if e.get("event") == "slo_breach"])
+    lossy_spec = {"wire.client.frame": {
+        "rate": 0.6,
+        "actions": [{"action": "drop"}, {"action": "reset",
+                                         "weight": 2}]}}
+    wire.reset_pool()
+    t_fault = time.perf_counter()
+    fault_ok = fault_err = 0
+    with havoc.injected(havoc.FaultPlan(0x9B7EA, lossy_spec)), \
+            wire.forced("binary"):
+        for i in range(fault_requests):
+            try:
+                r = Client.make_request(
+                    "127.0.0.1", srv.port,
+                    {"COMMAND": "FIND_SUCCESSOR",
+                     "KEY": format(_key(rng), "x"),
+                     "DEADLINE_MS": 8000.0},
+                    timeout=0.3, retries=0)
+                fault_ok += bool(r.get("SUCCESS"))
+            except RpcError:
+                fault_err += 1
+        assert fault_err > fault_requests // 4, (
+            f"lossy wire produced only {fault_err} errors — the "
+            f"scenario never stressed the SLO")
+        breach = _poll_verdict("BREACH", 15.0)
+    fault_wall = time.perf_counter() - t_fault
+    wire.reset_pool()
+    assert breach["burn_short"] >= 1.0 and breach["burn_long"] >= 1.0
+    incidents = [e for e in FLIGHT.recent()
+                 if e.get("event") == "slo_breach"
+                 and e.get("slo") == "availability"]
+    assert len(incidents) > breach_evts0, \
+        "breach never landed in the flight recorder"
+    assert incidents[-1].get("burn_short", 0) >= 1.0, \
+        f"incident lacks the burn rate: {incidents[-1]}"
+
+    # -- phase 4: fault window over -> recovery back to OK --------------
+    t_rec = time.perf_counter()
+    deadline = time.time() + 30.0
+    recovered = None
+    while time.time() < deadline:
+        r = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "FIND_SUCCESSOR", "KEY": format(_key(rng), "x"),
+             "DEADLINE_MS": 8000.0}, timeout=10.0, retries=2)
+        assert r.get("SUCCESS")
+        resp = Client.make_request(
+            "127.0.0.1", srv.port, {"COMMAND": "PULSE", "SLO": True},
+            timeout=10.0, retries=2)
+        recovered = resp["SLO"]["availability"]
+        if recovered["verdict"] == "OK":
+            break
+        time.sleep(0.1)
+    assert recovered is not None and recovered["verdict"] == "OK", (
+        f"availability SLO never recovered post-fault: {recovered}")
+    recovery_wall = time.perf_counter() - t_rec
+    assert METRICS.counter("pulse.slo_recovered.availability") >= 1
+
+    # -- phase 5: one repair round = ONE linked trace -------------------
+    # Ring pw is missing everything pu holds; a traced round must read
+    # as a single digest -> diff -> heal tree in the Chrome export.
+    with trace.tracing() as tstore:
+        res = run_sync_round(gw, "pu", "pw",
+                             max_keys=max(data_keys * 2, 64))
+    assert sum(res.healed.values()) > 0, "repair round healed nothing"
+    spans = tstore.spans()
+    chain = trace.find_chain(spans, "repair.heal")
+    assert [s["name"] for s in chain] == ["repair.heal",
+                                          "repair.round"], (
+        f"repair chain broken: {[s['name'] for s in chain]}")
+    root = chain[-1]
+    round_names = {s["name"] for s in spans
+                   if s["trace_id"] == root["trace_id"]}
+    assert {"repair.round", "repair.digest", "repair.diff",
+            "repair.heal"} <= round_names, round_names
+    doc = json.loads(tstore.export_chrome(root["trace_id"]))
+    ev_names = {ev["name"] for ev in doc["traceEvents"]}
+    assert {"repair.round", "repair.digest", "repair.heal"} <= \
+        ev_names, ev_names
+
+    # -- phase 6: HEALTH mid-bench + retraces + the series artifact -----
+    hresp = Client.make_request("127.0.0.1", srv.port,
+                                {"COMMAND": "HEALTH"}, timeout=10.0)
+    net = hresp["HEALTH"]["NET"]
+    assert "wire_breakers" in net and any(
+        row["port"] == srv.port for row in net["flow_control"])
+    assert "pulse" in hresp["HEALTH"]["LOOPS"], "sampler not in HEALTH"
+    for rid in ("pu", "pw"):
+        gw.router.get(rid).engine.assert_no_retraces()
+    artifact = os.environ.get("CHORDAX_PULSE_SERIES")
+    if artifact:
+        with open(artifact, "w") as fh:
+            json.dump({"series": sampler.export_series(),
+                       "verdicts": sampler.verdicts(),
+                       "status": sampler.status()}, fh)
+
+    tick_p50, tick_p99 = METRICS.quantiles("pulse.tick_ms")
+    return _emit({
+        "config": "pulse",
+        "metric": f"sampler p50 overhead on the gateway closed loop "
+                  f"({closed_reqs} reqs; {n_series} live series at "
+                  f"{sampler.interval_s}s cadence)",
+        "value": round(overhead_x, 3),
+        "unit": "x untraced p50 (<= 1.05 gated)",
+        "vs_baseline": None,
+        "p50_off_ms": round(p50_off * 1e3, 3),
+        "p50_on_ms": round(p50_on * 1e3, 3),
+        "p99_on_ms": round(p99_on * 1e3, 3),
+        "tick_p50_ms": round(tick_p50, 3) if tick_p50 else None,
+        "tick_p99_ms": round(tick_p99, 3) if tick_p99 else None,
+        "series": n_series,
+        "slo": {
+            "healthy": "OK (all objectives)",
+            "breach_burn_short": breach["burn_short"],
+            "breach_burn_long": breach["burn_long"],
+            "fault_errors": f"{fault_err}/{fault_requests}",
+            "fault_wall_s": round(fault_wall, 2),
+            "recovery_wall_s": round(recovery_wall, 2),
+            "incidents": len(incidents),
+        },
+        "repair_trace": f"ok (one linked digest->diff->heal trace, "
+                        f"{len(doc['traceEvents'])} events, "
+                        f"{sum(res.healed.values())} keys healed)",
+        "steady_state_retraces": 0,
+        "parity": "ok (healthy OK -> seeded lossy-wire BREACH with "
+                  "flight-recorder incident + burn rate -> post-fault "
+                  "OK, all polled over the PULSE verb)",
+        "device": str(jax.devices()[0]),
+    })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -2385,7 +2699,7 @@ def main() -> None:
                     choices=["chord16", "ida", "dhash", "dhash_sharded",
                              "lookup_1m", "sweep_10m", "serve",
                              "gateway", "repair", "membership",
-                             "havoc"])
+                             "havoc", "pulse"])
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace per config "
                          "into DIR/<config> (VERDICT r3 #4: evidence-based "
@@ -2428,6 +2742,9 @@ def main() -> None:
                 n_peers=192, data_keys=24, replay_requests=24,
                 lossy_requests=60, flap_requests=40, poison_batch=6,
                 bucket_min=4, bucket_max=32),
+            "pulse": lambda: bench_pulse(
+                n_peers=192, data_keys=16, closed_reqs=80,
+                fault_requests=30, bucket_min=4, bucket_max=32),
         }
     else:
         runs = {
@@ -2442,6 +2759,7 @@ def main() -> None:
             "repair": bench_repair,
             "membership": bench_membership,
             "havoc": bench_havoc,
+            "pulse": bench_pulse,
         }
     if args.config:
         runs = {args.config: runs[args.config]}

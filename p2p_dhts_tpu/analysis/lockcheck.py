@@ -70,6 +70,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "trace.py"),
     os.path.join("p2p_dhts_tpu", "health.py"),
     os.path.join("p2p_dhts_tpu", "havoc.py"),
+    os.path.join("p2p_dhts_tpu", "pulse.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

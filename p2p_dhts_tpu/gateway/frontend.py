@@ -83,11 +83,13 @@ FINGER_RING_ID = "__finger__"
 #: METRICS / TRACE_STATUS / HEALTH are the chordax-scope introspection
 #: verbs (ISSUE 8): the whole metrics registry, the tracing plane's
 #: status/spans, and the unified loop-health snapshot — all queryable
-#: over the wire on every gateway server.
+#: over the wire on every gateway server. PULSE is the chordax-pulse
+#: continuous-telemetry verb (ISSUE 11): series tails, SLO verdicts +
+#: burn rates, and Prometheus-style exposition of the live registry.
 GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
                     "SYNC_RANGE", "REPAIR_STATUS", "JOIN_RING",
                     "HEARTBEAT", "MEMBER_STATUS", "METRICS",
-                    "TRACE_STATUS", "HEALTH")
+                    "TRACE_STATUS", "HEALTH", "PULSE")
 
 
 def _key_int(v) -> int:
@@ -131,6 +133,10 @@ class Gateway:
         # hot add/remove keeps in sync with the registered store rings.
         self._memberships: Dict[str, Any] = {}
         self._auto_repair: Optional[Any] = None
+        # chordax-pulse wiring (ISSUE 11): the attached PulseSampler
+        # the PULSE verb serves (lifecycle stays with whoever built
+        # it; the gateway only holds the read-side reference).
+        self._pulse: Optional[Any] = None
 
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
@@ -181,6 +187,18 @@ class Gateway:
             "schedulers": [s.status() for s in scheds],
             "counters": self.metrics.base.counters_with_prefix("repair."),
         }
+
+    # -- pulse telemetry plane (chordax-pulse, ISSUE 11) ---------------------
+    def attach_pulse(self, sampler) -> None:
+        """Register (or, with None, detach) the PulseSampler the PULSE
+        verb serves. The sampler's lifecycle — start/close — belongs
+        to its creator; the gateway never stops it."""
+        with self._rings_lock:
+            self._pulse = sampler
+
+    def pulse_sampler(self):
+        with self._rings_lock:
+            return self._pulse
 
     # -- membership control plane (chordax-membership, ISSUE 7) --------------
     def attach_membership(self, manager) -> None:
@@ -1125,19 +1143,62 @@ class Gateway:
     def handle_health(self, req: dict) -> dict:
         """The unified health plane in one verb: every registered
         background loop's run/backoff/stall snapshot (HealthRegistry),
-        this gateway's per-ring health machine states, and the flight
-        recorder's occupancy (TAIL > 0 inlines that many events)."""
-        from p2p_dhts_tpu.health import FLIGHT as _FLIGHT, HEALTH
+        this gateway's per-ring health machine states, the flight
+        recorder's occupancy (TAIL > 0 inlines that many events), and
+        — chordax-pulse (ISSUE 11), closing the PR-10 open thread —
+        the NET section: per-destination wire-breaker state, per-
+        server connection flow-control occupancy, BUSY shed counters,
+        and the engine quarantine count, all pollable by the
+        watcher."""
+        from p2p_dhts_tpu.health import (FLIGHT as _FLIGHT, HEALTH,
+                                         net_snapshot)
         out = {
             "LOOPS": HEALTH.snapshot(),
             "RINGS": self.router.health_snapshot(),
             "FLIGHT": {"events": len(_FLIGHT),
                        "recorded": _FLIGHT.recorded},
+            "NET": net_snapshot(),
         }
         tail = int(req.get("TAIL", 0) or 0)
         if tail > 0:
             out["FLIGHT"]["tail"] = _FLIGHT.recent(tail)
         return {"HEALTH": out}
+
+    def handle_pulse(self, req: dict) -> dict:
+        """The chordax-pulse verb (ISSUE 11). Payload sections, each
+        opt-in so a periodic poll stays cheap:
+
+          SERIES: series-id prefix (or true/"*" for all) -> the
+              matching rings' tails, TAIL points each (default 32),
+              as [[t, value], ...] rows.
+          SLO: true -> every objective's verdict row (OK/WARN/BREACH
+              + short/long-window burn rates).
+          PROM: true -> Prometheus-style text exposition of the live
+              metrics registry (works with no sampler attached).
+
+        ATTACHED=false means no sampler is wired to this gateway —
+        series/SLO sections are then absent, never an RPC error."""
+        from p2p_dhts_tpu import pulse as pulse_mod
+        sampler = self.pulse_sampler()
+        out: dict = {"ATTACHED": sampler is not None}
+        if sampler is not None:
+            out["STATUS"] = sampler.status()
+            sel = req.get("SERIES")
+            if sel is not None:
+                tail = req.get("TAIL")
+                # TAIL: 0 is a real request (ids only, no points) —
+                # only an ABSENT field takes the default.
+                tail = 32 if tail is None else int(tail)
+                prefix = None if sel in (True, "*", "") else str(sel)
+                out["SERIES"] = {
+                    sid: [[round(t, 3), v] for t, v in pts]
+                    for sid, pts in sampler.series_tail(prefix,
+                                                        tail).items()}
+            if req.get("SLO"):
+                out["SLO"] = sampler.verdicts()
+        if req.get("PROM"):
+            out["PROM"] = pulse_mod.expose_prometheus(self.metrics.base)
+        return out
 
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
@@ -1170,6 +1231,9 @@ class Gateway:
             self._memberships.clear()
             writer, self._repl_writer = self._repl_writer, None
             self._repl_policy = None
+            # Detach (never close) the pulse sampler: its lifecycle
+            # belongs to whoever built it.
+            self._pulse = None
         # Membership loops stop FIRST (they submit churn batches and
         # nudge schedulers); then repair, then the writer.
         scheds = managers + scheds
@@ -1231,5 +1295,6 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "METRICS": gw.handle_metrics,
         "TRACE_STATUS": gw.handle_trace_status,
         "HEALTH": gw.handle_health,
+        "PULSE": gw.handle_pulse,
     })
     return gw

@@ -83,10 +83,15 @@ class HealthRegistry:
                     self._loops.pop(key, None)
         return out
 
-    def snapshot(self) -> Dict[str, dict]:
+    def snapshot(self, include_net: bool = False) -> Dict[str, dict]:
         """{unique loop name: health dict}. Name collisions (two
         schedulers over the same pair in one process) disambiguate
-        with a #k suffix instead of silently shadowing."""
+        with a #k suffix instead of silently shadowing. With
+        `include_net`, one extra `"net"` row (kind "net") carries the
+        process's wire-breaker / connection-flow-control / quarantine
+        state (chordax-pulse, ISSUE 11 — the PR-10 "pollable by the
+        watcher" thread), so one snapshot() answers both "are the
+        loops healthy" and "is the transport degrading"."""
         out: Dict[str, dict] = {}
         for loop in self.loops():
             name = loop.name
@@ -95,12 +100,42 @@ class HealthRegistry:
                 name = f"{loop.name}#{k}"
                 k += 1
             out[name] = loop.health()
+        if include_net:
+            name = "net"
+            k = 2
+            while name in out:
+                name = f"net#{k}"
+                k += 1
+            out[name] = net_snapshot()
         return out
 
 
 #: The process-wide registry the HEALTH verb serves (loops register
 #: here by default; tests may construct their own).
 HEALTH = HealthRegistry()
+
+
+def net_snapshot(metrics: Optional[Metrics] = None) -> dict:
+    """The transport-degradation state in one row (chordax-pulse,
+    ISSUE 11 — closing the PR-10 open thread): every destination's
+    dial circuit-breaker state (`rpc.wire.breaker.*`'s live twin),
+    every live server's connection flow-control occupancy, the BUSY
+    shed counters, and the engine's poison-quarantine count. Lazy
+    imports: health must stay importable without the net stack."""
+    m = metrics if metrics is not None else METRICS
+    from p2p_dhts_tpu.net import rpc as rpc_mod
+    from p2p_dhts_tpu.net import wire as wire_mod
+    return {
+        "kind": "net",
+        "wire_breakers": wire_mod.breaker_snapshot(),
+        "flow_control": rpc_mod.flow_control_snapshot(),
+        "busy": {
+            "rejected": m.counter("rpc.server.busy_rejected"),
+            "dropped": m.counter("rpc.server.busy_dropped"),
+            "client_seen": m.counter("rpc.client.busy"),
+        },
+        "quarantined": m.counter("serve.quarantined"),
+    }
 
 
 class PacedLoop:

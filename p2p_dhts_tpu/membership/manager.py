@@ -78,6 +78,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from p2p_dhts_tpu import havoc as havoc_mod
+from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.health import PacedLoop
 from p2p_dhts_tpu.keyspace import KEYS_IN_RING
 from p2p_dhts_tpu.membership import OP_FAIL, OP_JOIN, OP_LEAVE
@@ -434,14 +435,28 @@ class MembershipManager(PacedLoop):
     def step(self) -> dict:
         """One foreground control round (the deterministic form tests,
         the bench, and the dryrun drive; the background loop calls the
-        same thing). Detect -> batch -> apply -> sweep."""
+        same thing). Detect -> batch -> apply -> sweep.
+
+        chordax-pulse (ISSUE 11): with tracing enabled the whole round
+        is ONE linked span tree — `membership.round` at the root, the
+        scan -> churn_apply -> stabilize -> maintain phases as
+        children, the gateway/engine spans of the device batches
+        nesting underneath — so a membership round reads as a single
+        trace in the Chrome export (the PR-8 open thread). span() is
+        one flag read when tracing is off."""
+        with trace_mod.span("membership.round", cat="membership",
+                            ring=self.ring_id):
+            return self._step_impl()
+
+    def _step_impl(self) -> dict:
         from p2p_dhts_tpu.gateway.admission import Deadline
 
         now = time.monotonic()
-        with self._lock:
-            candidates = self._detect_failures_locked(now)
-        if candidates:
-            self._confirm_failures(candidates)
+        with trace_mod.span("membership.scan", cat="membership"):
+            with self._lock:
+                candidates = self._detect_failures_locked(now)
+            if candidates:
+                self._confirm_failures(candidates)
         granted = self.bucket.take(self.max_batch)
         batch: List[Tuple[int, int]] = []
         with self._lock:
@@ -459,8 +474,11 @@ class MembershipManager(PacedLoop):
             dl = Deadline.from_timeout(self.round_timeout_s)
             self.backend.begin_handoff()
             try:
-                flags = self.gateway.churn_apply_many(
-                    batch, ring_id=self.ring_id, deadline=dl)
+                with trace_mod.span("membership.churn_apply",
+                                    cat="membership",
+                                    rows=len(batch)):
+                    flags = self.gateway.churn_apply_many(
+                        batch, ring_id=self.ring_id, deadline=dl)
                 with self._lock:
                     applied_n, lost_rows, resurrected = \
                         self._apply_to_mirror_locked(
@@ -495,14 +513,18 @@ class MembershipManager(PacedLoop):
         # Stabilize pacing: one sweep per round while unconverged,
         # bounded per step so a wedged ring cannot monopolize the loop.
         sweeps = 0
-        while not self.converged and sweeps < self.sweep_max_rounds:
-            dl = Deadline.from_timeout(self.round_timeout_s)
-            self.converged = bool(self.gateway.stabilize_ring(
-                self.ring_id, deadline=dl))
-            self.sweep_rounds += 1
-            sweeps += 1
-            if not batch and sweeps >= 1:
-                break  # idle rounds sweep at most once
+        if not self.converged:
+            with trace_mod.span("membership.stabilize",
+                                cat="membership"):
+                while not self.converged and \
+                        sweeps < self.sweep_max_rounds:
+                    dl = Deadline.from_timeout(self.round_timeout_s)
+                    self.converged = bool(self.gateway.stabilize_ring(
+                        self.ring_id, deadline=dl))
+                    self.sweep_rounds += 1
+                    sweeps += 1
+                    if not batch and sweeps >= 1:
+                        break  # idle rounds sweep at most once
 
         # Targeted heals for the transferred ranges, once the sweep has
         # re-tiled custody: one paced local-maintenance pass purges the
@@ -511,20 +533,24 @@ class MembershipManager(PacedLoop):
         # nudged repair pairs heal the rest from replicas.
         regenerated = 0
         if self._maintain_due and self.converged:
-            dl = Deadline.from_timeout(self.round_timeout_s)
-            if getattr(self.engine, "has_store", False):
-                regenerated = self.gateway.dhash_maintain(
-                    self.ring_id, deadline=dl)
-                self.rows_regenerated += regenerated
-                if regenerated:
+            with trace_mod.span("membership.maintain",
+                                cat="membership"):
+                dl = Deadline.from_timeout(self.round_timeout_s)
+                if getattr(self.engine, "has_store", False):
+                    regenerated = self.gateway.dhash_maintain(
+                        self.ring_id, deadline=dl)
+                    self.rows_regenerated += regenerated
+                    if regenerated:
+                        self.metrics.inc(
+                            f"membership.rows_regenerated."
+                            f"{self.ring_id}",
+                            regenerated)
+                self._maintain_due = False
+                nudged = self.gateway.nudge_repair(self.ring_id)
+                if nudged:
                     self.metrics.inc(
-                        f"membership.rows_regenerated.{self.ring_id}",
-                        regenerated)
-            self._maintain_due = False
-            nudged = self.gateway.nudge_repair(self.ring_id)
-            if nudged:
-                self.metrics.inc(
-                    f"membership.heal_enqueued.{self.ring_id}", nudged)
+                        f"membership.heal_enqueued.{self.ring_id}",
+                        nudged)
 
         # Stall detection (the PR-6 rule): work pends but two
         # consecutive rounds applied nothing — flip visible, idle-pace.

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import threading
 import time
 from typing import Dict, Iterator, Optional, Sequence, Tuple
@@ -63,10 +64,32 @@ class Metrics:
         self._timers: Dict[str, Dict[str, float]] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, collections.deque] = {}
+        # Monotonic per-hist appended-sample totals and value sums
+        # (never decremented by reservoir eviction): the totals are
+        # the snapshot-delta cursor chordax-pulse's windowed
+        # percentiles advance through, and totals+sums back the
+        # Prometheus summary `_count`/`_sum` samples (which must be
+        # cumulative, not reservoir-capped). Each hist AND counter
+        # also carries an INCARNATION stamp (one process-unique
+        # creation counter): a key deleted by remove_prefix and later
+        # re-created restarts under a NEW stamp, so a pulse cursor
+        # from the old incarnation can never alias a valid position
+        # in the new one (even when the new value/total has already
+        # grown past the old cursor).
+        self._hist_totals: Dict[str, int] = {}
+        self._hist_sums: Dict[str, float] = {}
+        self._hist_epochs: Dict[str, int] = {}
+        self._counter_epochs: Dict[str, int] = {}
+        self._creations = 0
 
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + value
+            prev = self._counters.get(name)
+            if prev is None:
+                prev = 0
+                self._creations += 1
+                self._counter_epochs[name] = self._creations
+            self._counters[name] = prev + value
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -114,27 +137,84 @@ class Metrics:
                 for k in [k for k in fam if _match(k)]:
                     del fam[k]
                     removed += 1
+            # Cursors/stamps/sums die with their key (uncounted: they
+            # are bookkeeping for keys already counted above); a later
+            # re-created key restarts under a FRESH incarnation stamp,
+            # which is what tells pulse's cursors to re-seed rather
+            # than read a cross-incarnation delta.
+            for fam in (self._hist_totals, self._hist_sums,
+                        self._hist_epochs, self._counter_epochs):
+                for k in [k for k in fam if _match(k)]:
+                    del fam[k]
         return removed
+
+    def _hist_locked(self, name: str) -> collections.deque:
+        """The named reservoir, created (with a fresh incarnation
+        stamp) on first use. Caller holds the lock."""
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = collections.deque(
+                maxlen=self.HIST_CAP)
+            self._creations += 1
+            self._hist_epochs[name] = self._creations
+        return h
 
     def observe_hist(self, name: str, value: float) -> None:
         """Append one sample to a bounded reservoir histogram."""
+        value = float(value)
         with self._lock:
-            h = self._hists.get(name)
-            if h is None:
-                h = self._hists[name] = collections.deque(
-                    maxlen=self.HIST_CAP)
-            h.append(float(value))
+            self._hist_locked(name).append(value)
+            self._hist_totals[name] = self._hist_totals.get(name, 0) + 1
+            self._hist_sums[name] = \
+                self._hist_sums.get(name, 0.0) + value
 
     def observe_hist_many(self, name: str, values: Sequence[float]) -> None:
         """Append a batch of samples under ONE lock acquisition — the
         serve engine's fan-out path records a whole batch's latencies
         at once instead of contending per request."""
+        vals = [float(v) for v in values]
         with self._lock:
+            self._hist_locked(name).extend(vals)
+            self._hist_totals[name] = \
+                self._hist_totals.get(name, 0) + len(vals)
+            self._hist_sums[name] = \
+                self._hist_sums.get(name, 0.0) + sum(vals)
+
+    def state(self) -> dict:
+        """The CHEAP whole-registry state: counters + gauges +
+        monotonic per-hist totals/sums + the per-key incarnation
+        stamps, copied under ONE lock acquisition with NO percentile
+        computation and NO reservoir copy — the per-tick read
+        chordax-pulse's sampler takes instead of snapshot() (whose
+        hists section sorts every reservoir)."""
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
+                    "hist_totals": dict(self._hist_totals),
+                    "hist_sums": dict(self._hist_sums),
+                    "hist_epochs": dict(self._hist_epochs),
+                    "counter_epochs": dict(self._counter_epochs)}
+
+    def hist_delta(self, name: str, since_total: int
+                   ) -> Tuple[list, int]:
+        """(new samples, new total): every sample appended to `name`
+        AFTER the reservoir had recorded `since_total` appends — the
+        snapshot-delta read behind windowed interval percentiles. Only
+        the TAIL is copied (an idle tick copies nothing); when more
+        samples arrived than the reservoir retains, the overflow is
+        gone and the newest HIST_CAP stand in (the same newest-win
+        rule the reservoir itself applies)."""
+        with self._lock:
+            total = self._hist_totals.get(name, 0)
             h = self._hists.get(name)
-            if h is None:
-                h = self._hists[name] = collections.deque(
-                    maxlen=self.HIST_CAP)
-            h.extend(float(v) for v in values)
+            n_new = total - int(since_total)
+            if h is None or n_new <= 0:
+                return [], total
+            n = len(h)
+            n_new = min(n_new, n)
+            # ONE traversal for the tail copy (per-index deque access
+            # would re-walk blocks from the nearer end per element).
+            return list(itertools.islice(h, n - n_new, n)), total
 
     def quantiles(self, name: str,
                   qs: Sequence[float] = (0.5, 0.99)
@@ -183,6 +263,10 @@ class Metrics:
             self._timers.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._hist_totals.clear()
+            self._hist_sums.clear()
+            self._hist_epochs.clear()
+            self._counter_epochs.clear()
 
 
 #: Process-wide default registry (the RPC layer and overlay peers record

@@ -49,6 +49,7 @@ import socket
 import struct
 import threading
 import time
+import weakref
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
@@ -85,6 +86,28 @@ SHED_QUEUE_SIZE = 256
 
 class RpcError(RuntimeError):
     """Transport- or protocol-level RPC failure."""
+
+
+#: Weak registry of every live Server in the process: the HEALTH
+#: verb's flow-control view (chordax-pulse, ISSUE 11) enumerates it —
+#: weak so a server that was never killed (test debris) leaves the
+#: snapshot with its last reference instead of pinning it forever.
+_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def flow_control_snapshot() -> List[dict]:
+    """Per-server connection flow-control occupancy (live servers
+    only, port-sorted): connections, dispatched-but-unanswered
+    in-flight total, and the per-connection bound — the PR-10
+    "breaker/flow-control state pollable by the watcher" thread's
+    server half. Counter context (`rpc.server.busy_*`) lives in the
+    metrics registry next to it."""
+    rows = []
+    for srv in list(_SERVERS):
+        if srv is None or not srv.is_alive():
+            continue
+        rows.append(srv.flow_control())
+    return sorted(rows, key=lambda r: r["port"])
 
 
 class DeferredResponse:
@@ -482,6 +505,19 @@ class Server:
         # connection drops) without touching the selector themselves.
         self._waker_r, self._waker_w = socket.socketpair()
         self._waker_r.setblocking(False)
+        _SERVERS.add(self)
+
+    def flow_control(self) -> dict:
+        """This server's connection flow-control occupancy (the HEALTH
+        verb's per-server row). In-flight counts are read without each
+        connection's fc_lock — a point-in-time observability read, not
+        an accounting one."""
+        with self._conns_lock:
+            states = list(self._conns.values())
+        return {"port": self.port,
+                "connections": len(states),
+                "inflight": sum(st.inflight for st in states),
+                "max_inflight_per_conn": self.max_inflight_per_conn}
 
     # -- lifecycle ---------------------------------------------------------
     def run_in_background(self) -> None:

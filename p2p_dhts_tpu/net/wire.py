@@ -683,17 +683,36 @@ class WirePool:
                 base * 0.5, base)
         METRICS.inc("rpc.wire.breaker.open")
 
+    @staticmethod
+    def _breaker_row(b: Optional["_Breaker"], now: float) -> dict:
+        """ONE definition of a breaker's externally-visible row —
+        breaker_state and breaker_snapshot must never disagree on
+        what "open" means."""
+        if b is None:
+            return {"fails": 0, "open": False, "opens": 0}
+        return {"fails": b.fails,
+                "open": (b.fails >= BREAKER_THRESHOLD
+                         and now < b.open_until),
+                "opens": b.opens}
+
     def breaker_state(self, ip_addr: str, port: int) -> dict:
         """Introspection for tests/health: the destination's breaker
         row (zeros when never tripped)."""
         with self._lock:
-            b = self._breakers.get((ip_addr, int(port)))
-            if b is None:
-                return {"fails": 0, "open": False, "opens": 0}
-            return {"fails": b.fails,
-                    "open": (b.fails >= BREAKER_THRESHOLD
-                             and time.monotonic() < b.open_until),
-                    "opens": b.opens}
+            return self._breaker_row(
+                self._breakers.get((ip_addr, int(port))),
+                time.monotonic())
+
+    def breaker_snapshot(self) -> Dict[str, dict]:
+        """EVERY destination's breaker row in one call — the HEALTH
+        verb's `rpc.wire.breaker.*` state view (chordax-pulse closes
+        the PR-10 "pollable by the watcher" thread with this). Keys
+        are "ip:port"; only destinations with at least one recorded
+        failure appear (a clean pool reads as {})."""
+        now = time.monotonic()
+        with self._lock:
+            return {f"{dest[0]}:{dest[1]}": self._breaker_row(b, now)
+                    for dest, b in self._breakers.items()}
 
     def known_legacy(self, dest: Tuple[str, int]) -> bool:
         with self._lock:
@@ -846,6 +865,12 @@ def reset_pool() -> None:
     """Close every pooled connection and forget negotiation verdicts
     (tests; a process fork)."""
     _POOL.close_all()
+
+
+def breaker_snapshot() -> Dict[str, dict]:
+    """The process pool's per-destination breaker rows (the HEALTH
+    verb's wire section)."""
+    return _POOL.breaker_snapshot()
 
 
 def request(ip_addr: str, port: int, obj: dict, timeout: float) -> dict:

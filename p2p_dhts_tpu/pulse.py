@@ -1,0 +1,645 @@
+"""chordax-pulse: continuous telemetry, SLO tracking, exposition.
+
+Everything chordax-scope (ISSUE 8) records is either a lifetime
+counter or a one-shot snapshot — nobody could answer "what was p99
+over the last 30 seconds" or "is availability burning its budget",
+which is exactly what a capacity policy loop (ROADMAP chordax-elastic)
+must consume and what the reference's DHash maintenance cadence
+implicitly assumes: decisions driven by RATES OVER WINDOWS, not
+totals. Three pieces:
+
+  * `PulseSampler` — a `health.PacedLoop` that snapshots the metrics
+    registry each tick (`Metrics.state()`: one lock, no reservoir
+    copy) into bounded per-key time-series rings:
+      - counters  -> `<key>|rate`   windowed delta / tick dt (per s)
+      - gauges    -> `<key>|value`  the raw instantaneous value
+      - hists     -> `<key>|p50` / `<key>|p99` / `<key>|n`  INTERVAL
+        percentiles over only the samples appended since the previous
+        tick (`Metrics.hist_delta`, the snapshot-delta API), so
+        `serve.*` / `gateway.*` / `rpc.*` all gain windowed latency
+        percentiles with zero per-request instrumentation.
+    Rings are bounded (evictions counted, never silent); a series
+    whose source key left the registry (ring retirement,
+    `remove_prefix`) is retired on the next tick — the PR-8
+    stale-telemetry rule applied to pulse itself.
+  * `SloEngine` — declarative objectives (`availability` %, `latency`
+    bound, `error_rate` bound, each over a window) evaluated every
+    tick into OK / WARN / BREACH verdicts with MULTI-WINDOW
+    error-budget burn rates (short window reacts, long window
+    confirms — the SRE multi-window multi-burn-rate rule, simplified).
+    Verdict transitions are counted, gauged, and — for breaches —
+    land in the flight recorder as incident events carrying the burn
+    rates, so `health.dump_on_error()` replays the SLO story next to
+    the fault that caused it.
+  * `expose_prometheus()` — Prometheus-style text exposition of the
+    live registry (counters / gauges / timer+hist summaries), the
+    lingua-franca form the PULSE wire verb serves next to series
+    tails and SLO verdicts.
+
+Sampling OFF costs nothing: an un-started sampler never touches the
+registry, and every instrumentation site this PR adds to the control
+planes is a `trace.span()` (one flag read when tracing is disabled —
+the chordax-scope discipline).
+
+LOCK ORDER: `PulseSampler._lock` and `SloEngine._lock` are LEAVES —
+never held across a registry call, a flight-recorder append, or a
+sleep. `sample()` is driven by ONE thread at a time (the loop thread,
+or a foreground driver while the loop is not started). This module
+never imports jax.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.health import FLIGHT, PacedLoop
+from p2p_dhts_tpu.metrics import METRICS, Metrics, nearest_rank
+
+#: Points retained per series ring (newest win).
+DEFAULT_RING_POINTS = 128
+
+#: Metric-key prefixes the sampler tracks by default: the serving
+#: families whose rates/percentiles the elastic loop and the watcher
+#: consume. Operator-extensible per sampler.
+DEFAULT_PREFIXES = ("serve.", "gateway.", "rpc.", "repair.",
+                    "membership.")
+
+#: Verdicts, in escalation order.
+OK, WARN, BREACH = "OK", "WARN", "BREACH"
+_STATE_CODE = {OK: 0, WARN: 1, BREACH: 2}
+
+
+class SeriesRing:
+    """One bounded time series: (t, value) points, newest win;
+    evictions counted (the SpanStore rule)."""
+
+    __slots__ = ("points", "evicted")
+
+    def __init__(self, capacity: int):
+        self.points: deque = deque(maxlen=int(capacity))
+        self.evicted = 0
+
+    def append(self, t: float, value: float) -> None:
+        if len(self.points) == self.points.maxlen:
+            self.evicted += 1
+        self.points.append((t, value))
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+class Slo:
+    """One parsed objective. Declarative spec (the README's "SLO spec
+    format"):
+
+      {"name": "gw-avail", "kind": "availability",
+       "target_pct": 99.0,                # error budget = 1%
+       "total": "rpc.client.requests",    # counter key, or prefix
+       "errors": "rpc.client.errors",     #   ending "." (summed)
+       "window_s": 2.0,                   # short (reacting) window
+       "long_window_s": 8.0,              # long (confirming) window
+       "warn_burn": 0.5, "breach_burn": 1.0}
+
+      {"name": "gw-p99", "kind": "latency",
+       "hist": "gateway.latency_ms.dhash_get.r1",  # key or prefix
+       "quantile": 0.99, "bound_ms": 50.0,
+       "window_s": 5.0, "warn_ratio": 0.8}
+
+      {"name": "gw-errs", "kind": "error_rate",
+       "max_ratio": 0.05,                 # error budget = 5%
+       "total": "gateway.requests.", "errors": "gateway.errors.",
+       "window_s": 2.0, "long_window_s": 8.0}
+
+    Counter kinds (`availability` / `error_rate`) share the machinery:
+    the windowed error fraction divided by the budget is the BURN RATE
+    (burn 1.0 = spending exactly the whole budget); a verdict goes
+    BREACH when BOTH windows burn at/above `breach_burn`, WARN when
+    the short window burns at/above `warn_burn`, OK otherwise — and a
+    window with no traffic is OK (no evidence is not an incident).
+    `latency` compares the WORST interval quantile point inside
+    `window_s` against `bound_ms` (burn = worst / bound)."""
+
+    KINDS = ("availability", "latency", "error_rate")
+
+    def __init__(self, spec: dict):
+        spec = dict(spec)
+        self.name = str(spec.pop("name"))
+        self.kind = str(spec.pop("kind"))
+        if self.kind not in self.KINDS:
+            raise ValueError(f"SLO {self.name!r}: unknown kind "
+                             f"{self.kind!r} (want one of {self.KINDS})")
+        self.window_s = float(spec.pop("window_s", 5.0))
+        self.long_window_s = float(
+            spec.pop("long_window_s", self.window_s * 4))
+        if self.long_window_s < self.window_s:
+            raise ValueError(f"SLO {self.name!r}: long_window_s < "
+                             f"window_s")
+        self.warn_burn = float(spec.pop("warn_burn", 0.5))
+        self.breach_burn = float(spec.pop("breach_burn", 1.0))
+        if self.kind == "latency":
+            self.hist = str(spec.pop("hist"))
+            self.quantile = float(spec.pop("quantile", 0.99))
+            self.bound_ms = float(spec.pop("bound_ms"))
+            self.warn_ratio = float(spec.pop("warn_ratio", 0.8))
+            self.total = self.errors = None
+            self.budget = None
+        else:
+            self.total = str(spec.pop("total"))
+            self.errors = str(spec.pop("errors"))
+            if self.kind == "availability":
+                target = float(spec.pop("target_pct"))
+                if not 0.0 < target < 100.0:
+                    raise ValueError(f"SLO {self.name!r}: target_pct "
+                                     f"must be in (0, 100)")
+                self.budget = 1.0 - target / 100.0
+            else:
+                self.budget = float(spec.pop("max_ratio"))
+                if not 0.0 < self.budget <= 1.0:
+                    raise ValueError(f"SLO {self.name!r}: max_ratio "
+                                     f"must be in (0, 1]")
+            self.hist = None
+        if spec:
+            raise ValueError(f"SLO {self.name!r}: unknown spec fields "
+                             f"{sorted(spec)}")
+
+
+def _counter_sum(counters: Dict[str, int], sel: str) -> int:
+    """Exact key, or — when `sel` ends with a dot — the family sum."""
+    if sel.endswith("."):
+        return sum(v for k, v in counters.items() if k.startswith(sel))
+    return counters.get(sel, 0)
+
+
+class SloEngine:
+    """Evaluates a set of Slo objectives each tick against cumulative
+    counter snapshots (windowed deltas) and the sampler's interval
+    percentile points. Owned/driven by PulseSampler; readable from any
+    thread via `verdicts()`."""
+
+    def __init__(self, slos: Sequence, *,
+                 metrics: Optional[Metrics] = None, flight=None):
+        self.slos: List[Slo] = [s if isinstance(s, Slo) else Slo(s)
+                                for s in slos]
+        names = [s.name for s in self.slos]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self.metrics = metrics if metrics is not None else METRICS
+        self.flight = flight if flight is not None else FLIGHT
+        self._lock = threading.Lock()
+        # Per counter-SLO: deque of (t, total, errors) cumulative
+        # snapshots, trimmed to the long window.
+        self._track: Dict[str, deque] = {s.name: deque()
+                                         for s in self.slos}
+        self._verdicts: Dict[str, dict] = {
+            s.name: {"verdict": OK, "kind": s.kind, "burn_short": 0.0,
+                     "burn_long": 0.0, "since": None}
+            for s in self.slos}
+
+    def _burn_counter(self, slo: Slo, track: deque, now: float,
+                      window_s: float) -> float:
+        """Windowed error fraction / budget over the trailing window.
+        The baseline is the OLDEST snapshot still inside the window
+        (or the newest one before it, so a window spanning one tick
+        still sees that tick's delta)."""
+        if not track:
+            return 0.0
+        t_now, tot_now, err_now = track[-1]
+        base = None
+        for (t, tot, err) in reversed(track):
+            if t_now - t <= window_s + 1e-9:
+                base = (t, tot, err)
+            else:
+                base = (t, tot, err)  # one snapshot beyond the edge
+                break
+        if base is None or base[0] >= t_now:
+            return 0.0
+        d_tot = tot_now - base[1]
+        d_err = err_now - base[2]
+        if d_tot <= 0:
+            return 0.0
+        return (d_err / d_tot) / slo.budget
+
+    def _burn_latency(self, slo: Slo, points: Sequence[Tuple[float,
+                                                             float]],
+                      now: float) -> float:
+        worst = None
+        for t, v in reversed(points):
+            if now - t > slo.window_s + 1e-9:
+                break
+            worst = v if worst is None else max(worst, v)
+        if worst is None:
+            return 0.0
+        return worst / slo.bound_ms
+
+    def evaluate(self, now: float, counters: Dict[str, int],
+                 latency_points) -> List[dict]:
+        """One tick: update tracks, compute burns, move verdicts.
+        `latency_points(hist_key, quantile) -> [(t, v), ...]` is the
+        sampler's interval-percentile lookup. Returns the transition
+        records (already counted/gauged/flight-fed)."""
+        transitions: List[dict] = []
+        # Latency points are fetched BEFORE our lock: latency_points
+        # takes the sampler's leaf, and two leaves must never stack.
+        lat_points = {slo.name: latency_points(slo.hist, slo.quantile)
+                      for slo in self.slos if slo.kind == "latency"}
+        with self._lock:
+            for slo in self.slos:
+                row = self._verdicts[slo.name]
+                if slo.kind == "latency":
+                    burn_short = self._burn_latency(
+                        slo, lat_points[slo.name], now)
+                    burn_long = burn_short
+                    warn_at, breach_at = slo.warn_ratio, 1.0
+                else:
+                    track = self._track[slo.name]
+                    track.append((now,
+                                  _counter_sum(counters, slo.total),
+                                  _counter_sum(counters, slo.errors)))
+                    while len(track) > 2 and \
+                            now - track[1][0] > slo.long_window_s:
+                        track.popleft()
+                    burn_short = self._burn_counter(
+                        slo, track, now, slo.window_s)
+                    burn_long = self._burn_counter(
+                        slo, track, now, slo.long_window_s)
+                    warn_at, breach_at = slo.warn_burn, slo.breach_burn
+                if burn_short >= breach_at and burn_long >= breach_at:
+                    verdict = BREACH
+                elif burn_short >= warn_at:
+                    verdict = WARN
+                else:
+                    verdict = OK
+                prev = row["verdict"]
+                row["burn_short"] = round(burn_short, 4)
+                row["burn_long"] = round(burn_long, 4)
+                if verdict != prev:
+                    row["verdict"] = verdict
+                    row["since"] = now
+                    transitions.append({
+                        "slo": slo.name, "kind": slo.kind,
+                        "from": prev, "to": verdict,
+                        "burn_short": round(burn_short, 4),
+                        "burn_long": round(burn_long, 4)})
+        # Recording happens OUTSIDE the leaf lock (flight/metrics own
+        # their own leaves; never stack them under ours).
+        for tr in transitions:
+            name = tr["slo"]
+            self.metrics.gauge(f"pulse.slo_state.{name}",
+                               _STATE_CODE[tr["to"]])
+            if tr["to"] == BREACH:
+                self.metrics.inc(f"pulse.slo_breach.{name}")
+                self.flight.record(
+                    "pulse", "slo_breach", slo=name, kind=tr["kind"],
+                    burn_short=tr["burn_short"],
+                    burn_long=tr["burn_long"])
+            elif tr["to"] == WARN:
+                self.metrics.inc(f"pulse.slo_warn.{name}")
+                self.flight.record(
+                    "pulse", "slo_warn", slo=name, kind=tr["kind"],
+                    burn_short=tr["burn_short"])
+            else:
+                self.metrics.inc(f"pulse.slo_recovered.{name}")
+                self.flight.record(
+                    "pulse", "slo_recovered", slo=name,
+                    kind=tr["kind"], burn_short=tr["burn_short"],
+                    burn_long=tr["burn_long"])
+        for slo in self.slos:
+            with self._lock:
+                burn = self._verdicts[slo.name]["burn_short"]
+                burn_l = self._verdicts[slo.name]["burn_long"]
+            self.metrics.gauge(f"pulse.burn_short.{slo.name}", burn)
+            self.metrics.gauge(f"pulse.burn_long.{slo.name}", burn_l)
+        return transitions
+
+    def verdicts(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: dict(row)
+                    for name, row in self._verdicts.items()}
+
+
+# ---------------------------------------------------------------------------
+# the sampler loop
+# ---------------------------------------------------------------------------
+
+class PulseSampler(PacedLoop):
+    """Fixed-cadence registry sampler + SLO evaluator (one per
+    process is typical; tests run private ones over private
+    registries). `start()` runs it as a background PacedLoop (it
+    self-registers in health.HEALTH like every paced loop); `sample()`
+    is the deterministic foreground tick tests and the dryrun drive.
+    Attach to a gateway (`gateway.attach_pulse(sampler)`) so the PULSE
+    wire verb can serve its series and verdicts."""
+
+    def __init__(self, *, metrics: Optional[Metrics] = None,
+                 interval_s: float = 1.0,
+                 ring_points: int = DEFAULT_RING_POINTS,
+                 prefixes: Sequence[str] = DEFAULT_PREFIXES,
+                 slos: Sequence = (),
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 10.0,
+                 registry=None):
+        mets = metrics if metrics is not None else METRICS
+        PacedLoop.__init__(
+            self, name="pulse", kind="pulse",
+            interval_s=interval_s, interval_idle_s=interval_s,
+            backoff_base_s=backoff_base_s, backoff_cap_s=backoff_cap_s,
+            metrics=mets, failure_metric="pulse.tick_failures",
+            thread_name="pulse-sampler", registry=registry)
+        self.ring_points = int(ring_points)
+        self.prefixes = tuple(str(p) for p in prefixes)
+        self.slo = SloEngine(slos, metrics=mets)
+        # A latency SLO reads the sampler's interval-percentile rings;
+        # a hist outside our prefixes never grows one, so the
+        # objective would sit at OK forever — a misconfiguration only
+        # a constructor check can surface (counter SLOs read the raw
+        # registry and are prefix-independent).
+        for slo in self.slo.slos:
+            if slo.kind == "latency" and not self._tracked(slo.hist):
+                raise ValueError(
+                    f"latency SLO {slo.name!r} watches hist "
+                    f"{slo.hist!r}, which is outside the sampler's "
+                    f"prefixes {self.prefixes} — no interval series "
+                    f"would ever exist and the verdict could never "
+                    f"leave OK")
+        self._lock = threading.Lock()   # LEAF: rings + cursors only
+        self._rings: Dict[str, SeriesRing] = {}
+        #: Per-counter (incarnation stamp, value) cursor — same
+        #: aliasing rule as the hist cursors below.
+        self._prev_counters: Dict[str, Tuple[int, int]] = {}
+        #: Per-hist (incarnation stamp, appended-sample total) cursor:
+        #: the stamp detects a hist deleted and re-created between
+        #: ticks, whose totals alone could alias a valid position.
+        self._prev_hist_totals: Dict[str, Tuple[int, int]] = {}
+        self._prev_t: Optional[float] = None
+
+    # -- the tick ------------------------------------------------------------
+    def _round(self) -> None:
+        self.sample()
+
+    def _tracked(self, key: str) -> bool:
+        return any(key.startswith(p) for p in self.prefixes)
+
+    def sample(self, now: Optional[float] = None) -> dict:
+        """One sampling tick. `now` (monotonic-like seconds) is
+        injectable so tests hand-compute rates/windows; production
+        ticks use time.monotonic(). Returns a tick summary."""
+        t_wall0 = time.perf_counter()
+        t = time.monotonic() if now is None else float(now)
+        st = self.metrics.state()
+        counters = st["counters"]
+        gauges = st["gauges"]
+        hist_totals = st["hist_totals"]
+        hist_epochs = st.get("hist_epochs", {})
+        counter_epochs = st.get("counter_epochs", {})
+        # Interval hist percentiles FIRST (hist_delta takes the
+        # registry lock per key; do it before taking our own leaf).
+        # hist_delta's RETURNED total is the cursor to advance to:
+        # samples appended between state() and hist_delta are in this
+        # tick's delta, and re-reading them next tick would
+        # double-count them in the interval series.
+        hist_points: Dict[str, Tuple[float, float, int]] = {}
+        live_totals: Dict[str, int] = {}
+        with self._lock:
+            prev_cursors = dict(self._prev_hist_totals)
+        for key, total in hist_totals.items():
+            if not self._tracked(key):
+                continue
+            epoch = hist_epochs.get(key, 0)
+            prev = prev_cursors.get(key)
+            if prev is None or prev[0] != epoch:
+                # First sighting, or a re-created hist (fresh
+                # incarnation stamp): the old cursor is meaningless
+                # regardless of how the totals compare — seed only.
+                continue
+            if total > prev[1]:
+                samples, live_total = self.metrics.hist_delta(
+                    key, prev[1])
+                live_totals[key] = live_total
+                if samples:
+                    srt = sorted(samples)
+                    hist_points[key] = (nearest_rank(srt, 0.5),
+                                        nearest_rank(srt, 0.99),
+                                        len(samples))
+        evicted = 0
+        retired = 0
+        n_series = 0
+        with self._lock:
+            dt = (t - self._prev_t) if self._prev_t is not None else None
+            live_ids = set()
+
+            def _append(series_id: str, value: float) -> None:
+                nonlocal evicted
+                ring = self._rings.get(series_id)
+                if ring is None:
+                    ring = self._rings[series_id] = SeriesRing(
+                        self.ring_points)
+                before = ring.evicted
+                ring.append(t, float(value))
+                evicted += ring.evicted - before
+                live_ids.add(series_id)
+
+            for key, val in counters.items():
+                if not self._tracked(key):
+                    continue
+                prev = self._prev_counters.get(key)
+                ep = counter_epochs.get(key, 0)
+                if prev is not None and prev[0] == ep \
+                        and dt is not None and dt > 0 \
+                        and val >= prev[1]:
+                    _append(f"{key}|rate", (val - prev[1]) / dt)
+                else:
+                    # First sighting, a re-created counter (fresh
+                    # incarnation stamp), or a reset: seed only.
+                    live_ids.add(f"{key}|rate")
+            for key, val in gauges.items():
+                if self._tracked(key):
+                    _append(f"{key}|value", val)
+            for key, (p50, p99, n) in hist_points.items():
+                _append(f"{key}|p50", p50)
+                _append(f"{key}|p99", p99)
+                _append(f"{key}|n", n)
+            # A hist that exists but saw no new samples keeps its ring.
+            for key in hist_totals:
+                if self._tracked(key):
+                    for suffix in ("|p50", "|p99", "|n"):
+                        if f"{key}{suffix}" in self._rings:
+                            live_ids.add(f"{key}{suffix}")
+            # Retire rings whose source key left the registry (ring
+            # retirement / remove_prefix): stale series must not haunt
+            # the PULSE verb, the PR-8 rule.
+            for dead in [sid for sid in self._rings
+                         if sid not in live_ids]:
+                del self._rings[dead]
+                retired += 1
+            self._prev_counters = {
+                k: (counter_epochs.get(k, 0), v)
+                for k, v in counters.items() if self._tracked(k)}
+            self._prev_hist_totals = {
+                k: (hist_epochs.get(k, 0), live_totals.get(k, v))
+                for k, v in hist_totals.items() if self._tracked(k)}
+            self._prev_t = t
+            n_series = len(self._rings)
+        transitions = self.slo.evaluate(
+            t, counters, self._latency_points)
+        self.rounds += 1
+        self.mark_round()
+        self.metrics.inc("pulse.ticks")
+        if evicted:
+            self.metrics.inc("pulse.series_evicted", evicted)
+        if retired:
+            self.metrics.inc("pulse.series_retired", retired)
+        tick_ms = (time.perf_counter() - t_wall0) * 1e3
+        self.metrics.observe_hist("pulse.tick_ms", tick_ms)
+        return {"t": t, "series": n_series, "evicted": evicted,
+                "retired": retired, "transitions": transitions,
+                "tick_ms": round(tick_ms, 3)}
+
+    def _latency_points(self, hist_key: str, quantile: float
+                        ) -> List[Tuple[float, float]]:
+        """The SLO engine's interval-percentile lookup: the `|p50` or
+        `|p99` series of `hist_key` (nearest supported quantile; a
+        prefix selector takes the worst across matching series)."""
+        suffix = "|p50" if quantile <= 0.75 else "|p99"
+        with self._lock:
+            if hist_key.endswith("."):
+                # Dot-bounded family match, the _counter_sum rule:
+                # "gateway.read." must not absorb "gateway.readiness".
+                merged: List[Tuple[float, float]] = []
+                for sid, ring in self._rings.items():
+                    if sid.startswith(hist_key) and \
+                            sid.endswith(suffix):
+                        merged.extend(ring.points)
+                merged.sort(key=lambda p: p[0])
+                return merged
+            ring = self._rings.get(f"{hist_key}{suffix}")
+            return list(ring.points) if ring is not None else []
+
+    # -- read side (PULSE verb / tests / artifact) ---------------------------
+    def series_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def series_tail(self, selector: Optional[str] = None,
+                    n: int = 32) -> Dict[str, List[Tuple[float,
+                                                         float]]]:
+        """{series id: the newest `n` (t, value) points, oldest
+        first} for every series whose id starts with `selector`
+        (None = all). `n` <= 0 enumerates the matching ids with
+        empty point lists — the cheap what-exists poll."""
+        n = int(n)
+        with self._lock:
+            return {sid: (list(ring.points)[-n:] if n > 0 else [])
+                    for sid, ring in sorted(self._rings.items())
+                    if selector is None or sid.startswith(selector)}
+
+    def evictions(self) -> int:
+        with self._lock:
+            return sum(r.evicted for r in self._rings.values())
+
+    def verdicts(self) -> Dict[str, dict]:
+        return self.slo.verdicts()
+
+    def status(self) -> dict:
+        """The PULSE verb's status payload."""
+        with self._lock:
+            n_series = len(self._rings)
+            n_points = sum(len(r.points) for r in self._rings.values())
+        return {
+            "ticks": self.rounds,
+            "interval_s": self.interval_s,
+            "series": n_series,
+            "points": n_points,
+            "ring_points": self.ring_points,
+            "prefixes": list(self.prefixes),
+            "slos": [s.name for s in self.slo.slos],
+            "running": self.thread.is_alive(),
+        }
+
+    def export_series(self) -> dict:
+        """The whole series store as one JSON-able dict (the watcher's
+        archived artifact: series next to the BENCH records)."""
+        with self._lock:
+            return {sid: [[round(tt, 3), vv] for tt, vv in ring.points]
+                    for sid, ring in sorted(self._rings.items())}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus-style exposition
+# ---------------------------------------------------------------------------
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(key: str) -> str:
+    return "chordax_" + _NAME_SANITIZE.sub("_", key)
+
+
+def expose_prometheus(metrics: Optional[Metrics] = None) -> str:
+    """Prometheus text exposition of the live registry: counters and
+    gauges verbatim, timers and reservoir hists as summaries (count /
+    sum, p50/p99 quantile samples). Dotted keys sanitize to
+    `chordax_<key_with_underscores>`; dynamic key segments stay in the
+    metric name (label-less exposition — the bounded key families make
+    that safe). On-demand only: this walks snapshot(), never the
+    sampler."""
+    m = metrics if metrics is not None else METRICS
+    snap = m.snapshot()
+    st = m.state()
+    lines: List[str] = []
+    for key, val in sorted(snap.get("counters", {}).items()):
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {val}")
+    for key, val in sorted(snap.get("gauges", {}).items()):
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {val}")
+    for key, row in sorted(snap.get("timers", {}).items()):
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} summary")
+        lines.append(f"{name}_count {row['count']}")
+        lines.append(f"{name}_sum {row['total_s']}")
+    for key, row in sorted(snap.get("hists", {}).items()):
+        name = _prom_name(key)
+        lines.append(f"# TYPE {name} summary")
+        if row.get("p50") is not None:
+            lines.append(f'{name}{{quantile="0.5"}} {row["p50"]}')
+        if row.get("p99") is not None:
+            lines.append(f'{name}{{quantile="0.99"}} {row["p99"]}')
+        # Summary _count/_sum must be CUMULATIVE (Prometheus rate()
+        # over them is the whole point): the monotonic appended
+        # totals, not the reservoir occupancy (which caps at HIST_CAP
+        # and would read as rate 0 under sustained load). Quantiles
+        # above remain reservoir-windowed — an operational summary.
+        lines.append(
+            f"{name}_count {st['hist_totals'].get(key, row['count'])}")
+        lines.append(
+            f"{name}_sum {st['hist_sums'].get(key, 0.0)}")
+    return "\n".join(lines) + "\n"
+
+
+#: One exposition line: `name value` or `name{labels} value` (the
+#: value is validated by float(), not the pattern — nan/inf/exponent
+#: forms all pass through).
+PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Minimal exposition parser (the round-trip half the tests and
+    the PULSE verb's consumers rely on): {sample name [+labels]:
+    float value}; comment/TYPE lines skipped; malformed lines raise."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = PROM_LINE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        out[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return out

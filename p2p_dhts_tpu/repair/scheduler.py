@@ -53,6 +53,7 @@ import threading
 import time
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.health import PacedLoop
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
@@ -133,7 +134,28 @@ def run_sync_round(gateway, ring_a: str, ring_b: str, *,
                    metrics: Optional[Metrics] = None) -> RoundResult:
     """One anti-entropy round between two registered store rings.
     Standalone (the SYNC_RANGE RPC verb calls this directly); the
-    scheduler adds pacing/backoff around it."""
+    scheduler adds pacing/backoff around it.
+
+    chordax-pulse (ISSUE 11): with tracing enabled the whole round is
+    ONE linked span tree — `repair.round` at the root, the
+    digest -> diff -> reindex -> scan -> heal phases as children, and
+    the gateway/engine spans each phase's device ops open nesting
+    underneath — so a repair round reads as a single trace in the
+    Chrome export instead of an unparented span soup (the PR-8 open
+    thread). span() is a no-op after one flag read when tracing is
+    off (the serve hot-path discipline)."""
+    with trace_mod.span("repair.round", cat="repair",
+                        pair=f"{ring_a}-{ring_b}"):
+        return _sync_round_impl(
+            gateway, ring_a, ring_b, max_keys=max_keys,
+            max_heal=max_heal, deadline=deadline, reindex=reindex,
+            metrics=metrics)
+
+
+def _sync_round_impl(gateway, ring_a: str, ring_b: str, *,
+                     max_keys: int, max_heal: Optional[int],
+                     deadline, reindex: bool,
+                     metrics: Optional[Metrics]) -> RoundResult:
     import numpy as np
 
     import jax.numpy as jnp
@@ -156,15 +178,18 @@ def run_sync_round(gateway, ring_a: str, ring_b: str, *,
     depth, fanout_bits = depths[pair[0]]
 
     # 1. digests, engine-ordered with in-flight puts.
-    dig = {rid: gateway.sync_digest(rid, deadline=dl) for rid in pair}
-    ia = MerkleIndex(
-        levels=tuple(jnp.asarray(l) for l in dig[pair[0]].levels),
-        counts=jnp.asarray(dig[pair[0]].counts))
-    ib = MerkleIndex(
-        levels=tuple(jnp.asarray(l) for l in dig[pair[1]].levels),
-        counts=jnp.asarray(dig[pair[1]].counts))
-    leaf_diff, nodes = kernels.merkle_diff(ia, ib)
-    leaf_diffs = int(jnp.sum(leaf_diff))
+    with trace_mod.span("repair.digest", cat="repair"):
+        dig = {rid: gateway.sync_digest(rid, deadline=dl)
+               for rid in pair}
+    with trace_mod.span("repair.diff", cat="repair"):
+        ia = MerkleIndex(
+            levels=tuple(jnp.asarray(l) for l in dig[pair[0]].levels),
+            counts=jnp.asarray(dig[pair[0]].counts))
+        ib = MerkleIndex(
+            levels=tuple(jnp.asarray(l) for l in dig[pair[1]].levels),
+            counts=jnp.asarray(dig[pair[1]].counts))
+        leaf_diff, nodes = kernels.merkle_diff(ia, ib)
+        leaf_diffs = int(jnp.sum(leaf_diff))
     mets.inc("repair.rounds")
     if leaf_diffs == 0:
         return RoundResult(pair, True, 0, int(nodes), 0, 0,
@@ -175,23 +200,25 @@ def run_sync_round(gateway, ring_a: str, ring_b: str, *,
     # 2. the duplicate-index re-pair pass (engine-ordered store rewrite).
     rw = {rid: 0 for rid in pair}
     if reindex:
-        for rid in pair:
-            rw[rid] = int(gateway.repair_reindex(rid, deadline=dl))
-            if rw[rid]:
-                mets.inc(f"repair.reindexed.{rid}", rw[rid])
+        with trace_mod.span("repair.reindex", cat="repair"):
+            for rid in pair:
+                rw[rid] = int(gateway.repair_reindex(rid, deadline=dl))
+                if rw[rid]:
+                    mets.inc(f"repair.reindexed.{rid}", rw[rid])
 
     # 3. delta key extraction from each ring's store snapshot.
     cand_ints: List[int] = []
     seen = set()
-    for rid in pair:
-        snap = backends[rid].engine.store_snapshot()
-        cand, ok = kernels.delta_scan(snap, leaf_diff, depth,
-                                      fanout_bits, max_keys)
-        ok_np = np.asarray(ok)
-        for j, k in enumerate(lanes_to_ints(np.asarray(cand))):
-            if ok_np[j] and k not in seen:
-                seen.add(k)
-                cand_ints.append(k)
+    with trace_mod.span("repair.scan", cat="repair"):
+        for rid in pair:
+            snap = backends[rid].engine.store_snapshot()
+            cand, ok = kernels.delta_scan(snap, leaf_diff, depth,
+                                          fanout_bits, max_keys)
+            ok_np = np.asarray(ok)
+            for j, k in enumerate(lanes_to_ints(np.asarray(cand))):
+                if ok_np[j] and k not in seen:
+                    seen.add(k)
+                    cand_ints.append(k)
     candidates = len(cand_ints)
     heal_n = candidates if max_heal is None else min(candidates,
                                                     int(max_heal))
@@ -201,55 +228,61 @@ def run_sync_round(gateway, ring_a: str, ring_b: str, *,
     canonicalized = 0
     unhealable = 0
     if heal_keys:
-        # 4. batched reads from BOTH sides, one engine batch each.
-        reads = {rid: gateway.dhash_get_many(heal_keys, ring_id=rid,
-                                             deadline=dl)
-                 for rid in pair}
-        # Entries are (payload, is_canon): canonicalize re-puts of
-        # already-readable keys are layout repair, NOT heals — keeping
-        # them out of `healed` is what lets the scheduler's stall
-        # detector see a round that changed nothing.
-        puts: Dict[str, List[tuple]] = {rid: [] for rid in pair}
-        bytes_moved = 0
-        for j, k in enumerate(heal_keys):
-            res = {rid: reads[rid][j] for rid in pair}
-            ok_by = {rid: bool(res[rid][1]) for rid in pair}
-            if not any(ok_by.values()):
-                unhealable += 1
-                continue
-            if all(ok_by.values()):
-                # Both readable yet the pair still differs somewhere in
-                # this bucket: re-put each side from ITS OWN read —
-                # canonical (key, 1..n) layout, per-ring values
-                # preserved (value divergence is invisible to a
-                # keys-only tree, exactly as in the reference).
-                canonicalized += 1
-                for rid in pair:
-                    seg = np.asarray(res[rid][0])
-                    puts[rid].append(
-                        ((k, seg, _derived_length(seg), 0), True))
-                continue
-            src = pair[0] if ok_by[pair[0]] else pair[1]
-            dst = pair[1] if src == pair[0] else pair[0]
-            seg = np.asarray(res[src][0])
-            puts[dst].append(((k, seg, _derived_length(seg), 0), False))
-            bytes_moved += int(seg.size) * 4
-        for rid, entries in puts.items():
-            if not entries:
-                continue
-            oks = gateway.dhash_put_many([e for e, _ in entries],
-                                         ring_id=rid, deadline=dl)
-            n_ok = sum(1 for (_, canon), v in zip(entries, oks)
-                       if v and not canon)
-            healed[rid] += n_ok
-            if n_ok:
-                mets.inc(f"repair.keys_healed.{rid}", n_ok)
-        if bytes_moved:
-            mets.inc("repair.bytes_moved", bytes_moved)
-        if canonicalized:
-            mets.inc("repair.canonicalized", canonicalized)
-        if unhealable:
-            mets.inc("repair.unhealable", unhealable)
+        with trace_mod.span("repair.heal", cat="repair",
+                            candidates=len(heal_keys)):
+            # 4. batched reads from BOTH sides, one engine batch each.
+            reads = {rid: gateway.dhash_get_many(heal_keys,
+                                                 ring_id=rid,
+                                                 deadline=dl)
+                     for rid in pair}
+            # Entries are (payload, is_canon): canonicalize re-puts of
+            # already-readable keys are layout repair, NOT heals —
+            # keeping them out of `healed` is what lets the
+            # scheduler's stall detector see a round that changed
+            # nothing.
+            puts: Dict[str, List[tuple]] = {rid: [] for rid in pair}
+            bytes_moved = 0
+            for j, k in enumerate(heal_keys):
+                res = {rid: reads[rid][j] for rid in pair}
+                ok_by = {rid: bool(res[rid][1]) for rid in pair}
+                if not any(ok_by.values()):
+                    unhealable += 1
+                    continue
+                if all(ok_by.values()):
+                    # Both readable yet the pair still differs
+                    # somewhere in this bucket: re-put each side from
+                    # ITS OWN read — canonical (key, 1..n) layout,
+                    # per-ring values preserved (value divergence is
+                    # invisible to a keys-only tree, exactly as in
+                    # the reference).
+                    canonicalized += 1
+                    for rid in pair:
+                        seg = np.asarray(res[rid][0])
+                        puts[rid].append(
+                            ((k, seg, _derived_length(seg), 0), True))
+                    continue
+                src = pair[0] if ok_by[pair[0]] else pair[1]
+                dst = pair[1] if src == pair[0] else pair[0]
+                seg = np.asarray(res[src][0])
+                puts[dst].append(
+                    ((k, seg, _derived_length(seg), 0), False))
+                bytes_moved += int(seg.size) * 4
+            for rid, entries in puts.items():
+                if not entries:
+                    continue
+                oks = gateway.dhash_put_many([e for e, _ in entries],
+                                             ring_id=rid, deadline=dl)
+                n_ok = sum(1 for (_, canon), v in zip(entries, oks)
+                           if v and not canon)
+                healed[rid] += n_ok
+                if n_ok:
+                    mets.inc(f"repair.keys_healed.{rid}", n_ok)
+            if bytes_moved:
+                mets.inc("repair.bytes_moved", bytes_moved)
+            if canonicalized:
+                mets.inc("repair.canonicalized", canonicalized)
+            if unhealable:
+                mets.inc("repair.unhealable", unhealable)
     # Converged means NOTHING healable remained this round: no
     # candidates beyond data loss, nothing deferred, nothing rewritten.
     converged = (deferred == 0 and canonicalized == 0
@@ -287,7 +320,20 @@ def run_drift_round(gateway, ring_id: str, baseline_store, *,
     path — and, under RepairScheduler.add_drift, the same token-bucket
     cadence — as cross-ring repair. One-directional on purpose: keys
     created since the checkpoint differ too but need no restore, so
-    convergence means "nothing left to heal", not "digests equal"."""
+    convergence means "nothing left to heal", not "digests equal".
+    Traced as one `repair.drift_round` root span (ISSUE 11) so a
+    drift heal reads as a single trace like a pair round."""
+    with trace_mod.span("repair.drift_round", cat="repair",
+                        ring=str(ring_id)):
+        return _drift_round_impl(
+            gateway, ring_id, baseline_store, max_keys=max_keys,
+            max_heal=max_heal, deadline=deadline, metrics=metrics)
+
+
+def _drift_round_impl(gateway, ring_id: str, baseline_store, *,
+                      max_keys: int, max_heal: Optional[int],
+                      deadline, metrics: Optional[Metrics]
+                      ) -> DriftRoundResult:
     import numpy as np
 
     import jax.numpy as jnp

@@ -26,6 +26,19 @@ the serving layers. This module adds the missing spine:
     uses to assemble spans from timestamps after the fact (request
     sub-spans + batch spans with fan-in links).
 
+Span families (chordax-pulse, ISSUE 11, adds the control-plane
+roots): request-path spans (`rpc.client.<CMD>` -> `rpc.server.<CMD>`
+-> `gateway.<kind>` -> `serve.request.<kind>` / `serve.batch.<kind>`)
+chain per request; a RepairScheduler round is ONE `repair.round` tree
+(children `repair.digest` / `repair.diff` / `repair.reindex` /
+`repair.scan` / `repair.heal`; drift rounds root at
+`repair.drift_round`) and a MembershipManager round ONE
+`membership.round` tree (children `membership.scan` /
+`membership.churn_apply` / `membership.stabilize` /
+`membership.maintain`), each with the gateway/engine spans of its
+device ops nested underneath — so a control-plane round reads as a
+single trace in the Chrome export.
+
 Everything is stdlib; recording a span is one dict append under one
 leaf lock (never held across any call out of this module). Tracing is
 OFF by default: `enable()` flips one module-global flag, and every

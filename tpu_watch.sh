@@ -1,33 +1,37 @@
 #!/bin/bash
-# TPU recovery watcher, round 10: the ten configs still want on-chip
-# records (greens from r07/r08/r09 carry over). Wait for the chip to
-# be free, probe the remote-compile service (dead since round 4:
-# connection-refused on its port while cached programs kept executing),
-# and when it answers, run the configs without a green record one at a
-# time into BENCH_ATTEMPT_r10.jsonl (bench's _record_lkg promotes each
-# green on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# --trace device-timeline archiving (now into BENCH_TRACE_r10). The
-# round-9 chordax-wire hard gates stay (wire-isolated binary >= 3x
-# JSON keys/s at <= 1/2 p50, traced chain, zero retraces). NEW in
-# round 10 (chordax-havoc): a HAVOC SMOKE pre-bench gate — the
-# scenario matrix (lossy wire / flapping ring / asymmetric partition /
-# poison batch) must hold >= 99% availability with byte-identical
-# same-seed fault schedules and 100% readable post-fault on CPU before
-# any bench touches the chip; a tree whose degradation machinery
-# regressed gets no hardware time. Never kills anything mid-TPU-work;
-# every probe and bench attempt runs to completion (a blocked
-# fresh-shape jit takes ~25 min to fail — that is the probe's cost
-# when the service is down, accepted).
+# TPU recovery watcher, round 11: eleven configs want on-chip records
+# (greens from r07-r10 carry over; chordax-pulse joins the want list).
+# Wait for the chip to be free, probe the remote-compile service (dead
+# since round 4: connection-refused on its port while cached programs
+# kept executing), and when it answers, run the configs without a
+# green record one at a time into BENCH_ATTEMPT_r11.jsonl (bench's
+# _record_lkg promotes each green on-chip record into BENCH_LKG.json).
+# On-chip attempts keep the --trace device-timeline archiving (now
+# into BENCH_TRACE_r11). All prior gates stay (wire-isolated binary
+# >= 3x JSON keys/s at <= 1/2 p50, traced chain, havoc scenario
+# matrix >= 99% availability, zero retraces). NEW in round 11
+# (chordax-pulse): a PULSE SMOKE pre-bench gate — sampler overhead
+# <= 5% p50 on the gateway closed loop, SLO verdicts OK on a healthy
+# run and BREACH->recovery under the seeded lossy-wire scenario, one
+# linked digest->diff->heal repair trace — must pass on CPU before
+# anything claims the chip; the pulse config polls its own PULSE +
+# HEALTH verbs MID-BENCH (the watcher's remote view) and archives the
+# sampled series artifact (CHORDAX_PULSE_SERIES) next to the BENCH
+# records. Never kills anything mid-TPU-work; every probe and bench
+# attempt runs to completion (a blocked fresh-shape jit takes ~25 min
+# to fail — that is the probe's cost when the service is down,
+# accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-10 watcher start (ten configs + wire + havoc smoke gates)"
+log "round-11 watcher start (eleven configs + wire/havoc/pulse smoke gates)"
 
-needed() {  # configs without a green record yet (r07/r08 greens count)
+needed() {  # configs without a green record yet (r07-r10 greens count)
   python - <<'EOF'
 import json
 ok = set()
 for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
-                "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl"):
+                "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl",
+                "BENCH_ATTEMPT_r11.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -39,7 +43,8 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
     except FileNotFoundError:
         pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
-        "sweep_10m", "serve", "gateway", "repair", "membership"]
+        "sweep_10m", "serve", "gateway", "repair", "membership",
+        "pulse"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -109,6 +114,22 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Pulse smoke (ISSUE 11): continuous telemetry must hold — sampler
+  # overhead <= 5% p50 on the gateway closed loop, SLO verdicts OK on
+  # the healthy run and BREACH -> flight incident -> recovery under
+  # the seeded lossy-wire scenario (polled over the PULSE verb
+  # mid-bench), one linked digest->diff->heal repair trace, zero
+  # retraces — on CPU before anything claims the chip. The sampled
+  # series artifact lands next to this round's records.
+  mkdir -p BENCH_TRACE_r11
+  if ! JAX_PLATFORMS=cpu \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r11/pulse_series_smoke.json \
+      python bench.py --config pulse --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "pulse smoke FAILED - fix the telemetry plane before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -119,11 +140,15 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r10
+    mkdir -p BENCH_TRACE_r11
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r10/$c)"
-      python bench.py --config "$c" --trace "BENCH_TRACE_r10" \
-        >> BENCH_ATTEMPT_r10.jsonl 2>> BENCH_ATTEMPT_r10.err
+      log "running --config $c (device trace -> BENCH_TRACE_r11/$c)"
+      # The pulse config archives its sampled series + verdicts next
+      # to this round's records (the mid-bench PULSE/HEALTH polls are
+      # inside the config itself).
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r11/pulse_series_$c.json" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r11" \
+        >> BENCH_ATTEMPT_r11.jsonl 2>> BENCH_ATTEMPT_r11.err
       log "config $c rc=$?"
     done
   else
