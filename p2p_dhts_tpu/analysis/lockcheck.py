@@ -62,6 +62,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "overlay", "jax_bridge.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "router.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "admission.py"),
+    os.path.join("p2p_dhts_tpu", "gateway", "cache.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "frontend.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "metrics_ext.py"),
     os.path.join("p2p_dhts_tpu", "repair", "scheduler.py"),

@@ -29,6 +29,7 @@ from p2p_dhts_tpu.gateway.admission import (  # noqa: F401
     RingBusyError,
     SingleFlight,
 )
+from p2p_dhts_tpu.gateway.cache import HotKeyCache  # noqa: F401
 from p2p_dhts_tpu.gateway.frontend import (  # noqa: F401
     FINGER_RING_ID,
     GATEWAY_COMMANDS,

@@ -1,0 +1,128 @@
+"""chordax-fastlane hot-key result cache (ISSUE 12).
+
+The gateway has identified hot-key storms since PR 4 — single-flight
+collapses concurrent duplicates to one engine submission — but every
+RESOLVED storm re-executed on its next wave. This is the memo-cache
+step the reference never needed (it had no batched front door): a
+bounded LRU of read-side results (FIND_SUCCESSOR, replica-aware GET)
+keyed by (ring-epoch, op, ring, key), sitting BEHIND single-flight so
+a storm populates exactly one entry and every later wave is a host
+dict hit instead of an engine round trip.
+
+CORRECTNESS RULE — epoch invalidation, never per-key patching: any
+write or topology change that could move a key's answer (a PUT on any
+ring, a churn_apply batch, a stabilize sweep, a store-mutating
+maintenance/reindex pass, RingRouter.set_key_range, ring add/remove)
+bumps the cache epoch, which invalidates the WHOLE cache in O(1).
+Entries fill with the epoch captured BEFORE their engine flight, and a
+stale-epoch fill is dropped — so a result computed against a pre-write
+store/ring can never land after the write invalidated it, and a cached
+answer can never survive a membership change (the PR-7 handoff
+discipline applied to memoization). Wholesale invalidation trades hit
+rate under write-heavy load for an unbeatable staleness argument;
+read-heavy hot-key traffic (the Zipf storm this exists for) keeps its
+>80% hit rate because epochs only move when writes do.
+
+LOCK ORDER: one leaf lock around the OrderedDict; never held across
+an engine call, a fill computation, or any other lock (the admission
+module's discipline). This module never imports jax.
+
+Metrics (`gateway.cache.*`): hits / misses / evictions (capacity) /
+invalidations (epoch bumps), plus a size gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+
+class HotKeyCache:
+    """Bounded LRU of read results, invalidated wholesale by epoch."""
+
+    def __init__(self, capacity: int = 4096,
+                 metrics: Optional[Metrics] = None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self._metrics = metrics if metrics is not None else METRICS
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Any, Any]" = OrderedDict()
+        self._epoch = 0
+
+    @property
+    def epoch(self) -> int:
+        """The current invalidation epoch. Callers capture this BEFORE
+        computing a fill; put() drops fills from older epochs."""
+        with self._lock:
+            return self._epoch
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key: Any) -> Tuple[bool, Any]:
+        """(hit, value). A hit refreshes LRU order; metrics count both
+        outcomes so the hit rate is one counter division away."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                value = self._entries[key]
+                hit = True
+            else:
+                value, hit = None, False
+        if hit:
+            self._metrics.inc("gateway.cache.hits")
+        else:
+            self._metrics.inc("gateway.cache.misses")
+        return hit, value
+
+    def put(self, epoch: int, key: Any, value: Any) -> bool:
+        """Install one result computed under `epoch`. A fill whose
+        epoch is no longer current is DROPPED (the write/topology
+        change that bumped the epoch may have changed this very
+        answer); returns whether the entry landed."""
+        evicted = 0
+        with self._lock:
+            if epoch != self._epoch:
+                return False
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                evicted += 1
+            size = len(self._entries)
+        if evicted:
+            self._metrics.inc("gateway.cache.evictions", evicted)
+        self._metrics.gauge("gateway.cache.size", size)
+        return True
+
+    def invalidate(self, reason: str = "") -> int:
+        """Bump the epoch and drop every entry (wholesale — the
+        correctness rule). Returns the number of entries dropped.
+        Cheap when already empty, so redundant bumps (a PUT that also
+        fired the router's topology listener) cost a lock hop."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._epoch += 1
+        self._metrics.inc("gateway.cache.invalidations")
+        self._metrics.gauge("gateway.cache.size", 0)
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            size, epoch = len(self._entries), self._epoch
+        return {
+            "size": size,
+            "capacity": self.capacity,
+            "epoch": epoch,
+            "hits": self._metrics.counter("gateway.cache.hits"),
+            "misses": self._metrics.counter("gateway.cache.misses"),
+            "evictions": self._metrics.counter("gateway.cache.evictions"),
+            "invalidations": self._metrics.counter(
+                "gateway.cache.invalidations"),
+        }

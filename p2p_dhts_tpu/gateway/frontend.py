@@ -53,18 +53,23 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from p2p_dhts_tpu.gateway.admission import (Deadline, NO_DEADLINE,
                                             RingAdmission, RingBusyError,
                                             SingleFlight)
+from p2p_dhts_tpu.gateway.cache import HotKeyCache
 from p2p_dhts_tpu.gateway.metrics_ext import GatewayMetrics
 from p2p_dhts_tpu.gateway.router import (RingBackend, RingRouter,
                                          RingUnavailableError,
                                          UnknownRingError)
 from p2p_dhts_tpu.health import FLIGHT
-from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, LANES
 from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.net import wire
 from p2p_dhts_tpu import trace as trace_mod
-from p2p_dhts_tpu.serve import DeadlineExpiredError, ServeEngine
+from p2p_dhts_tpu.serve import (DeadlineExpiredError, ServeEngine,
+                                gather_vector)
 
 #: Ops that may serve through the fallback path while a ring is
 #: degraded. Lookups are idempotent and have a semantics-identical
@@ -98,6 +103,26 @@ def _key_int(v) -> int:
     return (int(v, 16) if isinstance(v, str) else int(v)) % KEYS_IN_RING
 
 
+class _VectorRun:
+    """Array-native payload for the serving core (chordax-fastlane,
+    ISSUE 12): a full-length [N, LANES] uint32 key array (plus the
+    kind's start array) standing where a per-request payload list
+    would — len() is the admission/metrics/deadline unit, and
+    _engine_serve routes it through ServeEngine.submit_vector instead
+    of per-key slots. The zero-copy decode of a binary KEYS section
+    flows through one of these untouched from wire to device."""
+
+    __slots__ = ("keys", "starts")
+
+    def __init__(self, keys: np.ndarray,
+                 starts: Optional[np.ndarray] = None):
+        self.keys = keys
+        self.starts = starts
+
+    def __len__(self) -> int:
+        return self.keys.shape[0]
+
+
 class Gateway:
     """Multi-ring serving front door over ServeEngine backends."""
 
@@ -108,6 +133,7 @@ class Gateway:
     def __init__(self, router: Optional[RingRouter] = None,
                  metrics: Optional[Metrics] = None,
                  single_flight_capacity: int = 4096,
+                 cache_capacity: int = 4096,
                  name: str = "gateway"):
         self.name = name
         self.router = router if router is not None else RingRouter()
@@ -115,6 +141,21 @@ class Gateway:
         self._rings_lock = threading.Lock()
         self._admission: Dict[str, RingAdmission] = {}
         self._single_flight = SingleFlight(single_flight_capacity)
+        # chordax-fastlane (ISSUE 12): bounded read-side hot-key result
+        # cache BEHIND single-flight (a storm populates one entry),
+        # epoch-invalidated wholesale by every PUT-side write and
+        # every ownership-moving change — churn_apply, stabilize,
+        # maintenance, set_key_range, ring add/remove — so a cached
+        # answer never survives a write or a membership change.
+        # cache_capacity=0 disables it (every read goes to the engine).
+        self._cache: Optional[HotKeyCache] = (
+            HotKeyCache(cache_capacity, metrics=self.metrics.base)
+            if cache_capacity else None)
+        self._topology_cb = None
+        if self._cache is not None:
+            cache = self._cache
+            self._topology_cb = lambda change: cache.invalidate(change)
+            self.router.add_topology_listener(self._topology_cb)
         self._finger_backend: Optional[RingBackend] = None
         # DHash replication params rings default to; DHashPeer wiring
         # sets these so device rings added afterwards match the
@@ -141,6 +182,20 @@ class Gateway:
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
         self._default_ida = (int(n), int(m), int(p))
+
+    # -- hot-key read cache (chordax-fastlane, ISSUE 12) ---------------------
+    @property
+    def cache(self) -> Optional[HotKeyCache]:
+        return self._cache
+
+    def _invalidate_reads(self, reason: str) -> None:
+        """Epoch-bump the read cache after anything that can change a
+        read's answer. Runs in a finally on every write path: a write
+        that FAILED may still have partially applied (a quorum write
+        with some acked replicas, a churn batch that rolled back after
+        installing), so the bump must not depend on success."""
+        if self._cache is not None:
+            self._cache.invalidate(reason)
 
     # -- replication policy (chordax-repair) ---------------------------------
     def set_replication(self, policy) -> None:
@@ -238,7 +293,10 @@ class Gateway:
             else Deadline.from_timeout(timeout)
         backend = self.router.get(ring_id)
         payloads = [(int(op), _key_int(member)) for op, member in entries]
-        return self._serve_many(backend, "churn_apply", payloads, dl)
+        try:
+            return self._serve_many(backend, "churn_apply", payloads, dl)
+        finally:
+            self._invalidate_reads("churn_apply")
 
     def stabilize_ring(self, ring_id: str, *,
                        timeout: Optional[float] = None,
@@ -249,8 +307,11 @@ class Gateway:
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
         backend = self.router.get(ring_id)
-        return bool(self._serve_many(backend, "stabilize_sweep", [()],
-                                     dl)[0])
+        try:
+            return bool(self._serve_many(backend, "stabilize_sweep", [()],
+                                         dl)[0])
+        finally:
+            self._invalidate_reads("stabilize_sweep")
 
     def dhash_maintain(self, ring_id: str, *,
                        timeout: Optional[float] = None,
@@ -264,8 +325,11 @@ class Gateway:
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
         backend = self.router.get(ring_id)
-        return int(self._serve_many(backend, "dhash_maintain", [()],
-                                    dl)[0])
+        try:
+            return int(self._serve_many(backend, "dhash_maintain", [()],
+                                        dl)[0])
+        finally:
+            self._invalidate_reads("dhash_maintain")
 
     def nudge_repair(self, ring_id: str) -> int:
         """Wake the repair pairs covering `ring_id` (their loops drop
@@ -464,6 +528,18 @@ class Gateway:
                           deadline: Deadline = NO_DEADLINE) -> List[Any]:
         rid = backend.ring_id
         n = len(payloads)
+        # Admission weight: a payload list charges one slot per
+        # request (each becomes an engine slot); a _VectorRun charges
+        # one slot per ENGINE CHUNK — that is the queue pressure the
+        # ring actually faces, and it is what lets a 1M-key vector
+        # (123 chunks at bucket 8192) fit a 4096-slot budget instead
+        # of being structurally rejected. Latency samples follow the
+        # same unit (one per chunk, not one per key).
+        if isinstance(payloads, _VectorRun):
+            rows = getattr(backend.engine, "bucket_max", 8192)
+            adm_n = max(1, -(-n // int(rows)))
+        else:
+            adm_n = n
         t0 = time.perf_counter()
         if deadline.expired():
             self.metrics.count_deadline_dropped(rid, n)
@@ -481,9 +557,9 @@ class Gateway:
             if trace_mod.enabled():
                 with trace_mod.span("gateway.admission", cat="gateway",
                                     ring=rid):
-                    adm.acquire(n, deadline)
+                    adm.acquire(adm_n, deadline)
             else:
-                adm.acquire(n, deadline)
+                adm.acquire(adm_n, deadline)
         except RingBusyError:
             # (admission.py records the budget-full flight event at
             # the source, with occupancy attached.)
@@ -532,7 +608,7 @@ class Gateway:
                     backend.record_success(probing=probing)
                     self.metrics.observe_latency(
                         kind, rid,
-                        [time.perf_counter() - t0] * n)
+                        [time.perf_counter() - t0] * adm_n)
                     return results
             # Fallback path: the ring is degraded (or the attempt above
             # just failed) and the op has a semantics-identical direct
@@ -558,19 +634,29 @@ class Gateway:
                     f"({type(exc).__name__}: {exc})") from exc
             self.metrics.count_fallback(kind, rid, n)
             self.metrics.observe_latency(
-                kind, rid, [time.perf_counter() - t0] * n)
+                kind, rid, [time.perf_counter() - t0] * adm_n)
             return results
         finally:
-            adm.release(n)
+            adm.release(adm_n)
             self.metrics.gauge_inflight(rid, adm.inflight)
 
     def _engine_serve(self, backend: RingBackend, kind: str,
                       payloads: Sequence[tuple],
                       deadline: Deadline) -> List[Any]:
-        slots = backend.engine.submit_many(kind, list(payloads),
-                                           deadline=deadline.at)
+        if isinstance(payloads, _VectorRun):
+            # chordax-fastlane: the key array rides to the engine
+            # whole — no per-key slots, no per-key waits; the result
+            # is the concatenated chunk arrays.
+            slots = backend.engine.submit_vector(
+                kind, payloads.keys, payloads.starts,
+                deadline=deadline.at)
+        else:
+            slots = backend.engine.submit_many(kind, list(payloads),
+                                               deadline=deadline.at)
         wait_s = deadline.clamp(self.DEFAULT_WAIT_S)
         try:
+            if isinstance(payloads, _VectorRun):
+                return gather_vector(slots, wait_s)
             return [slot.wait(wait_s) for slot in slots]
         except TimeoutError:
             # A wait bounded by the CALLER's deadline says nothing
@@ -589,6 +675,8 @@ class Gateway:
         (dependency-free, always available) and find_successor's direct
         kernel dispatch (the per-table-bridge shape — one jit call on
         the calling thread, no engine)."""
+        if isinstance(payloads, _VectorRun):
+            return self._fallback_serve_vector(backend, kind, payloads)
         if kind == "finger_index":
             out = []
             for key_int, start_int in payloads:
@@ -627,6 +715,45 @@ class Gateway:
         return [(int(owner[j]), int(hops[j]))
                 for j in range(len(payloads))]
 
+    def _fallback_serve_vector(self, backend: RingBackend, kind: str,
+                               run: _VectorRun):
+        """Vector twin of _fallback_serve, returning the engine-shaped
+        result ARRAYS. The direct find_successor dispatch stays fully
+        vectorized (the kernel takes lanes); the handoff-mirror and
+        finger closed forms convert once through lanes_to_ints — the
+        DEGRADED path trades the zero-copy guarantee for availability,
+        by design."""
+        from p2p_dhts_tpu import keyspace
+        if kind == "finger_index":
+            key_ints = keyspace.lanes_to_ints(run.keys)
+            start_ints = keyspace.lanes_to_ints(run.starts)
+            out = np.empty(len(key_ints), np.int32)
+            for j, (ki, si) in enumerate(zip(key_ints, start_ints)):
+                dist = (ki - si) % KEYS_IN_RING
+                out[j] = dist.bit_length() - 1 if dist else -1
+            return out
+        mgr = backend.membership
+        if mgr is not None and backend.in_handoff:
+            self.metrics.base.inc(
+                f"membership.handoff_failover.{backend.ring_id}",
+                len(run))
+            owners = np.asarray(
+                [mgr.owner_row(k)
+                 for k in keyspace.lanes_to_ints(run.keys)], np.int64)
+            return owners, np.zeros(len(run), np.int32)
+        if backend.ring_state is None:
+            raise RingUnavailableError(
+                f"ring {backend.ring_id!r} has no RingState for a "
+                f"direct fallback dispatch")
+        import jax.numpy as jnp
+
+        from p2p_dhts_tpu.core.ring import find_successor
+        owner, hops = find_successor(
+            backend.ring_state,
+            jnp.asarray(np.ascontiguousarray(run.keys)),
+            jnp.asarray(run.starts))
+        return np.asarray(owner), np.asarray(hops)
+
     # -- public ops ----------------------------------------------------------
     def find_successor(self, key, start_row: int = 0, *,
                        ring_id: Optional[str] = None,
@@ -645,13 +772,39 @@ class Gateway:
     def _find_successor_routed(self, backend: RingBackend, k: int,
                                start_row: int, dl: Deadline
                                ) -> Tuple[int, int]:
+        # chordax-fastlane: cache first (a hot key's steady state is a
+        # host dict hit), single-flight behind it (a cold storm still
+        # collapses to ONE engine flight, whose leader fills the
+        # entry), the engine last. HEALTHY rings only, both directions:
+        # a degraded ring's requests must keep reaching the serving
+        # core or its re-probe (and recovery) would starve behind
+        # cache hits — and a fallback-path answer, computed off a
+        # possibly-stale snapshot, must never be memoized.
+        from p2p_dhts_tpu.gateway.router import HEALTHY
+        cache = (self._cache if self._cache is not None
+                 and backend.state == HEALTHY else None)
+        ckey = ("fs", backend.ring_id, k, start_row)
+        if cache is not None:
+            hit, val = cache.get(ckey)
+            if hit:
+                return val
+
+        def _flight() -> Tuple[int, int]:
+            ep = cache.epoch if cache is not None else 0
+            res = self._serve_many(
+                backend, "find_successor", [(k, start_row)], dl)[0]
+            # Re-check at fill time: the ring may have DEGRADED inside
+            # this very flight (engine failure -> fallback answer) and
+            # a fallback result must not be memoized.
+            if cache is not None and backend.state == HEALTHY:
+                cache.put(ep, ckey, res)
+            return res
+
         sf_key = ("find_successor", backend.ring_id, k, start_row)
         try:
             return self._single_flight.run(
-                sf_key,
-                lambda: self._serve_many(
-                    backend, "find_successor", [(k, start_row)], dl)[0],
-                dl, on_hit=self.metrics.count_single_flight_hit)
+                sf_key, _flight, dl,
+                on_hit=self.metrics.count_single_flight_hit)
         except (DeadlineExpiredError, RingBusyError):
             # A shared flight fails with the LEADER's budget/admission
             # luck. If THIS caller's own deadline still has room, its
@@ -659,8 +812,7 @@ class Gateway:
             # stranger's failure.
             if dl.expired():
                 raise
-            return self._serve_many(
-                backend, "find_successor", [(k, start_row)], dl)[0]
+            return _flight()
 
     def find_successor_many(self, payloads: Sequence[tuple], *,
                             ring_id: Optional[str] = None,
@@ -740,12 +892,36 @@ class Gateway:
                              "are contradictory; drop one")
         use_fo = (failover if failover is not None
                   else (writer is not None and ring_id is None))
+        cache = self._cache
         if not use_fo:
             backend = self.router.route(key_int=k, ring_id=ring_id)
-            return self._serve_many(backend, "dhash_get", [(k,)], dl)[0]
+            # HEALTHY rings only (the _find_successor_routed rule): a
+            # sick ring's reads keep reaching the probe machinery.
+            from p2p_dhts_tpu.gateway.router import HEALTHY
+            if cache is not None and backend.state != HEALTHY:
+                cache = None
+            ckey = ("get", backend.ring_id, k)
+            if cache is not None:
+                hit, val = cache.get(ckey)
+                if hit:
+                    return val
+            ep = cache.epoch if cache is not None else 0
+            res = self._serve_many(backend, "dhash_get", [(k,)], dl)[0]
+            if cache is not None:
+                cache.put(ep, ckey, res)
+            return res
         if writer is None:
             raise ValueError("failover=True but no replication policy "
                              "is set (Gateway.set_replication)")
+        # Replica-aware reads cache under their own key family ("any
+        # healthy replica's answer"), distinct from explicit-ring
+        # reads; misses cache too — the next PUT invalidates them.
+        ckey = ("get*", k)
+        if cache is not None:
+            hit, val = cache.get(ckey)
+            if hit:
+                return val
+        ep = cache.epoch if cache is not None else 0
         # Health-ordered replica set: healthy rings keep their
         # primary-first target order; degraded/ejected rings move to
         # the back (they would only cost a failed attempt first).
@@ -768,12 +944,18 @@ class Gateway:
                     f"repair.read_failover.{backend.ring_id}")
                 continue
             if ok:
+                if cache is not None:
+                    cache.put(ep, ckey, (seg, ok))
                 return seg, ok
             miss = (seg, ok)
             if j < len(targets) - 1:
                 self.metrics.base.inc(
                     f"repair.read_failover.{backend.ring_id}")
         if miss is not None:
+            if cache is not None and last_exc is None:
+                # A clean readable-nowhere verdict is cacheable; one
+                # that only holds because a replica was down is not.
+                cache.put(ep, ckey, miss)
             return miss  # readable nowhere: a plain miss, not an error
         assert last_exc is not None
         raise RingUnavailableError(
@@ -801,15 +983,20 @@ class Gateway:
                              "are contradictory; drop one")
         use_repl = (replicate if replicate is not None
                     else (writer is not None and ring_id is None))
-        if use_repl:
-            if writer is None:
-                raise ValueError("replicate=True but no replication "
-                                 "policy is set (Gateway.set_replication)")
-            return writer.put(k, segments, int(length), int(start_row), dl)
-        backend = self.router.route(key_int=k, ring_id=ring_id)
-        return self._serve_many(
-            backend, "dhash_put",
-            [(k, segments, int(length), int(start_row))], dl)[0]
+        try:
+            if use_repl:
+                if writer is None:
+                    raise ValueError("replicate=True but no replication "
+                                     "policy is set "
+                                     "(Gateway.set_replication)")
+                return writer.put(k, segments, int(length),
+                                  int(start_row), dl)
+            backend = self.router.route(key_int=k, ring_id=ring_id)
+            return self._serve_many(
+                backend, "dhash_put",
+                [(k, segments, int(length), int(start_row))], dl)[0]
+        finally:
+            self._invalidate_reads("dhash_put")
 
     # -- batched store ops on ONE explicit ring (the repair heal path) -------
     def dhash_get_many(self, keys: Sequence, *, ring_id: str,
@@ -834,7 +1021,10 @@ class Gateway:
         backend = self.router.get(ring_id)
         payloads = [(_key_int(k), seg, int(length), int(start))
                     for k, seg, length, start in entries]
-        return self._serve_many(backend, "dhash_put", payloads, dl)
+        try:
+            return self._serve_many(backend, "dhash_put", payloads, dl)
+        finally:
+            self._invalidate_reads("dhash_put_many")
 
     # -- repair control ops (chordax-repair, ISSUE 6) ------------------------
     def sync_digest(self, ring_id: str, *,
@@ -855,7 +1045,11 @@ class Gateway:
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
         backend = self.router.get(ring_id)
-        return self._serve_many(backend, "repair_reindex", [()], dl)[0]
+        try:
+            return self._serve_many(backend, "repair_reindex", [()],
+                                    dl)[0]
+        finally:
+            self._invalidate_reads("repair_reindex")
 
     # -- stats ---------------------------------------------------------------
     def stats(self) -> dict:
@@ -863,6 +1057,8 @@ class Gateway:
         out = self.metrics.snapshot(ring_ids)
         out["health"] = self.router.health_snapshot()
         out["default_ring"] = self.router.default_ring_id
+        if self._cache is not None:
+            out["cache"] = self._cache.stats()
         with self._rings_lock:
             managers = list(self._memberships.values())
         if managers:
@@ -881,6 +1077,13 @@ class Gateway:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
         if "KEYS" in req:
+            lanes = self._vector_lanes(req["KEYS"])
+            if lanes is not None:
+                # chordax-fastlane: the binary transport's packed u128
+                # run flows to the device as ONE lane-array view —
+                # zero per-key python on this path (guarded by test).
+                return self._handle_find_successor_fast(req, lanes,
+                                                        ring_id, dl)
             keys = [_key_int(k) for k in req["KEYS"]]
             # No `or`-fallback: a numpy STARTS vector has no truth
             # value (the binary transport delivers one).
@@ -891,7 +1094,6 @@ class Gateway:
                 raise ValueError("STARTS length must match KEYS")
             res = self.find_successor_many(
                 list(zip(keys, starts)), ring_id=ring_id, deadline=dl)
-            import numpy as np
             return {"OWNERS": np.asarray([r[0] for r in res],
                                          dtype=np.int64),
                     "HOPS": np.asarray([r[1] for r in res],
@@ -902,6 +1104,119 @@ class Gateway:
         owner, hops = self._find_successor_routed(
             backend, key, int(req.get("START", 0)), dl)
         return {"OWNER": owner, "HOPS": hops, "RING": backend.ring_id}
+
+    def _handle_find_successor_fast(self, req: dict, lanes: np.ndarray,
+                                    ring_id: Optional[str],
+                                    dl: Deadline) -> dict:
+        """The zero-copy vector FIND_SUCCESSOR lane: numpy end-to-end
+        (lanes in, OWNERS/HOPS arrays out), vectorized routing, whole-
+        array engine submission. Per-ring failure semantics match
+        find_successor_many: a failing ring's lanes come back
+        (-1, -1, ring) without voiding the rest."""
+        n = lanes.shape[0]
+        if n == 0:
+            return {"OWNERS": np.zeros(0, np.int64),
+                    "HOPS": np.zeros(0, np.int32), "RINGS": []}
+        starts = req.get("STARTS")
+        if starts is None or len(starts) == 0:
+            starts_arr = None
+        else:
+            starts_arr = np.asarray(starts, dtype=np.int32)
+            if starts_arr.shape != (n,):
+                raise ValueError("STARTS length must match KEYS")
+        owners = np.full(n, -1, np.int64)
+        hops = np.full(n, -1, np.int32)
+        rings = np.empty(n, dtype=object)
+        for backend, idxs in self._group_by_ring_vec(lanes, ring_id):
+            sub_keys = lanes if idxs is None else lanes[idxs]
+            if starts_arr is None:
+                sub_starts = np.zeros(sub_keys.shape[0], np.int32)
+            else:
+                sub_starts = (starts_arr if idxs is None
+                              else starts_arr[idxs])
+            run = _VectorRun(sub_keys, sub_starts)
+            if idxs is None:
+                rings[:] = backend.ring_id
+            else:
+                rings[idxs] = backend.ring_id
+            try:
+                o, h = self._serve_many(backend, "find_successor", run,
+                                        dl)
+            except (RingUnavailableError, RingBusyError,
+                    DeadlineExpiredError):
+                continue  # this ring's lanes stay (-1, -1, ring)
+            if idxs is None:
+                owners[:] = o
+                hops[:] = h
+            else:
+                owners[idxs] = o
+                hops[idxs] = h
+        return {"OWNERS": owners, "HOPS": hops,
+                "RINGS": rings.tolist()}
+
+    @staticmethod
+    def _vector_lanes(keys) -> Optional[np.ndarray]:
+        """A KEYS field in LANE-NATIVE form -> [N, LANES] uint32 array
+        for the zero-copy fast lane (wire.U128Keys: one frombuffer
+        view; an already-lane-shaped ndarray: as-is). None for the
+        legacy list forms (hex strings / ints), which keep the
+        _key_int adapter path."""
+        if isinstance(keys, wire.U128Keys):
+            return keys.lanes()
+        if isinstance(keys, np.ndarray) and keys.ndim == 2 \
+                and keys.shape[1] == LANES:
+            return (keys if keys.dtype == np.uint32
+                    else keys.astype(np.uint32))
+        return None
+
+    def _group_by_ring_vec(self, lanes: np.ndarray,
+                           ring_id: Optional[str]
+                           ) -> List[Tuple[RingBackend,
+                                           Optional[np.ndarray]]]:
+        """Vectorized _group_by_ring: [(backend, row_index_array)]
+        with None standing for ALL rows (the single-ring common case —
+        no index materialization, no copy). Same semantics — explicit
+        ring_id wins, else first-owner-wins in registration order
+        against ONE router snapshot, else the default ring — with
+        ownership resolved as whole-array range masks instead of a
+        python test per key."""
+        if ring_id is not None:
+            return [(self.router.get(ring_id), None)]
+        ring_list, default = self.router.snapshot()
+        n = lanes.shape[0]
+        ranged = [b for b in ring_list if b.key_range is not None]
+        if not ranged:
+            if default is None:
+                raise UnknownRingError(
+                    "no ring routes this request (empty router, or no "
+                    "key-range owner and no default ring)")
+            return [(default, None)]
+        assigned = np.full(n, -1, np.int32)
+        backends: List[RingBackend] = []
+        for b in ranged:
+            mask = b.owns_keys_mask(lanes) & (assigned < 0)
+            if mask.any():
+                backends.append(b)
+                assigned[mask] = len(backends) - 1
+        rest = assigned < 0
+        if rest.any():
+            if default is None:
+                j = int(np.nonzero(rest)[0][0])
+                from p2p_dhts_tpu.keyspace import lanes_to_int
+                raise UnknownRingError(
+                    f"no ring owns key {lanes_to_int(lanes[j]):#x} and "
+                    f"no default ring is registered")
+            try:
+                di = next(i for i, b in enumerate(backends)
+                          if b is default)
+            except StopIteration:
+                backends.append(default)
+                di = len(backends) - 1
+            assigned[rest] = di
+        if len(backends) == 1:
+            return [(backends[0], None)]
+        return [(b, np.nonzero(assigned == i)[0])
+                for i, b in enumerate(backends)]
 
     def _group_by_ring(self, key_ints: Sequence[int],
                        ring_id: Optional[str]
@@ -935,6 +1250,9 @@ class Gateway:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
         if "KEYS" in req:
+            lanes = self._vector_lanes(req["KEYS"])
+            if lanes is not None:
+                return self._handle_get_fast(lanes, ring_id, dl)
             keys = [_key_int(k) for k in req["KEYS"]]
             if not keys:
                 return {"SEGMENTS": [], "OK": [], "RINGS": []}
@@ -971,6 +1289,75 @@ class Gateway:
         segs, ok = self.dhash_get(req["KEY"], ring_id=ring_id, deadline=dl)
         return {"SEGMENTS": segs, "OK": bool(ok)}
 
+    def _handle_get_fast(self, lanes: np.ndarray,
+                         ring_id: Optional[str], dl: Deadline) -> dict:
+        """The zero-copy vector GET lane: SEGMENTS returns as ONE
+        stacked [N, S, m] int32 array (the binary transport ships it
+        as a single raw — and, negotiated, compressed — section; the
+        JSON encoder lowers it to the same per-key nested lists the
+        legacy envelope carried, so resp["SEGMENTS"][i] indexes
+        identically on both wires). Same per-ring failure semantics as
+        the legacy vector path: a down ring zeroes only ITS lanes and
+        reports under RING_ERRORS. Heterogeneous multi-ring segment
+        shapes (differing store max_segments) fall back to the per-key
+        list form — correctness over layout there."""
+        n = lanes.shape[0]
+        if n == 0:
+            return {"SEGMENTS": [], "OK": [], "RINGS": []}
+        groups = self._group_by_ring_vec(lanes, ring_id)
+        rings = np.empty(n, dtype=object)
+        ring_errors: Dict[str, str] = {}
+        results: List[Tuple[RingBackend, Optional[np.ndarray],
+                            np.ndarray, np.ndarray]] = []
+        for backend, idxs in groups:
+            sub_keys = lanes if idxs is None else lanes[idxs]
+            if idxs is None:
+                rings[:] = backend.ring_id
+            else:
+                rings[idxs] = backend.ring_id
+            try:
+                segs, ok = self._serve_many(backend, "dhash_get",
+                                            _VectorRun(sub_keys), dl)
+            except (RingUnavailableError, RingBusyError,
+                    DeadlineExpiredError) as exc:
+                ring_errors[backend.ring_id] = str(exc)
+                continue
+            results.append((backend, idxs, segs, ok))
+        out: dict
+        shapes = {r[2].shape[1:] for r in results}
+        ok_out = np.zeros(n, dtype=bool)
+        if len(shapes) == 1 and not ring_errors:
+            # The hot path: every lane answered with one segment
+            # geometry — SEGMENTS ships as ONE stacked section.
+            shape = results[0][2].shape[1:]
+            segs_out = np.zeros((n,) + shape, np.int32)
+            for _, idxs, segs, ok in results:
+                if idxs is None:
+                    segs_out[:] = segs
+                    ok_out[:] = ok
+                else:
+                    segs_out[idxs] = segs
+                    ok_out[idxs] = ok
+            out = {"SEGMENTS": segs_out, "OK": ok_out,
+                   "RINGS": rings.tolist()}
+        else:
+            # Partial failure or mixed per-ring segment geometry:
+            # per-key list assembly, the LEGACY shape — a failed
+            # ring's lanes stay [] exactly as the adapter path
+            # returns them (a zero-filled matrix would read as a
+            # plausible engine answer, not a down ring).
+            segs_list: List[Any] = [[] for _ in range(n)]
+            for _, idxs, segs, ok in results:
+                rows = range(n) if idxs is None else idxs
+                for local_j, i in enumerate(rows):
+                    segs_list[int(i)] = segs[local_j]
+                    ok_out[int(i)] = bool(ok[local_j])
+            out = {"SEGMENTS": segs_list, "OK": ok_out,
+                   "RINGS": rings.tolist()}
+        if ring_errors:
+            out["RING_ERRORS"] = ring_errors
+        return out
+
     def handle_put(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
@@ -978,72 +1365,85 @@ class Gateway:
             entries = req["ENTRIES"]
             if not entries:
                 return {"OK": [], "RINGS": []}
-            payloads = [(_key_int(e["KEY"]), e["SEGMENTS"],
-                         int(e.get("LENGTH", len(e["SEGMENTS"]))),
-                         int(e.get("START", 0))) for e in entries]
-            writer = self._writer()
-            if writer is not None and ring_id is None:
-                # Replicated vector PUT. Entries are grouped by OWNING
-                # ring first (same per-key routing as the non-replicated
-                # path — a key-range owner must stay each entry's
-                # primary replica) and each group fans to its owner +
-                # the next registered rings; per-entry OK is the
-                # w-quorum verdict at return time (stragglers finish
-                # asynchronously).
-                groups, _ = self._group_by_ring(
-                    [p[0] for p in payloads], None)
-                ok_out = [False] * len(payloads)
-                rings_out = [""] * len(payloads)
-                target_union: List[str] = []
-                group_reports = []
-                for rid, idxs in groups.items():
-                    outcome = writer.put_many([payloads[i] for i in idxs],
-                                              dl)
-                    for i, ok in zip(idxs, outcome.per_entry_ok):
-                        ok_out[i] = bool(ok)
-                        rings_out[i] = outcome.targets[0]
-                    for t in outcome.targets:
-                        if t not in target_union:
-                            target_union.append(t)
-                    group_reports.append({
-                        "PRIMARY": outcome.targets[0],
-                        "TARGETS": outcome.targets,
-                        "ACKED": outcome.acked_rings,
-                        "FAILED": outcome.failed_rings,
-                        "ENTRIES": len(idxs)})
-                return {"OK": ok_out, "RINGS": rings_out,
-                        "REPLICATION": {
-                            "TARGETS": target_union,
-                            "GROUPS": group_reports,
-                            "W": writer.policy.w}}
-            groups, backends = self._group_by_ring(
-                [p[0] for p in payloads], ring_id)
-            ok_out = [False] * len(payloads)
-            rings_out = [""] * len(payloads)
-            ring_errors: Dict[str, str] = {}
-            for rid, idxs in groups.items():
-                for i in idxs:
-                    rings_out[i] = rid
-                try:
-                    res = self._serve_many(backends[rid], "dhash_put",
-                                           [payloads[i] for i in idxs],
-                                           dl)
-                except (RingUnavailableError, RingBusyError,
-                        DeadlineExpiredError) as exc:
-                    ring_errors[rid] = str(exc)
-                    continue
-                for i, ok in zip(idxs, res):
-                    ok_out[i] = bool(ok)
-            out = {"OK": ok_out, "RINGS": rings_out}
-            if ring_errors:
-                out["RING_ERRORS"] = ring_errors
-            return out
+            try:
+                return self._handle_put_entries(entries, ring_id, dl)
+            finally:
+                # Vector PUT (both the replicated and the grouped
+                # direct form) invalidates the read cache exactly like
+                # the single-key paths.
+                self._invalidate_reads("put_entries")
         segments = req["SEGMENTS"]
         ok = self.dhash_put(req["KEY"], segments,
                             int(req.get("LENGTH", len(segments))),
                             int(req.get("START", 0)),
                             ring_id=ring_id, deadline=dl)
         return {"OK": bool(ok)}
+
+    def _handle_put_entries(self, entries, ring_id,
+                            dl: Deadline) -> dict:
+        """The ENTRIES vector-PUT body of handle_put (replicated
+        fan-out or per-key-routed direct writes), split out so the
+        caller's finally owns the cache invalidation."""
+        payloads = [(_key_int(e["KEY"]), e["SEGMENTS"],
+                     int(e.get("LENGTH", len(e["SEGMENTS"]))),
+                     int(e.get("START", 0))) for e in entries]
+        writer = self._writer()
+        if writer is not None and ring_id is None:
+            # Replicated vector PUT. Entries are grouped by OWNING
+            # ring first (same per-key routing as the non-replicated
+            # path — a key-range owner must stay each entry's
+            # primary replica) and each group fans to its owner +
+            # the next registered rings; per-entry OK is the
+            # w-quorum verdict at return time (stragglers finish
+            # asynchronously).
+            groups, _ = self._group_by_ring(
+                [p[0] for p in payloads], None)
+            ok_out = [False] * len(payloads)
+            rings_out = [""] * len(payloads)
+            target_union: List[str] = []
+            group_reports = []
+            for rid, idxs in groups.items():
+                outcome = writer.put_many([payloads[i] for i in idxs],
+                                          dl)
+                for i, ok in zip(idxs, outcome.per_entry_ok):
+                    ok_out[i] = bool(ok)
+                    rings_out[i] = outcome.targets[0]
+                for t in outcome.targets:
+                    if t not in target_union:
+                        target_union.append(t)
+                group_reports.append({
+                    "PRIMARY": outcome.targets[0],
+                    "TARGETS": outcome.targets,
+                    "ACKED": outcome.acked_rings,
+                    "FAILED": outcome.failed_rings,
+                    "ENTRIES": len(idxs)})
+            return {"OK": ok_out, "RINGS": rings_out,
+                    "REPLICATION": {
+                        "TARGETS": target_union,
+                        "GROUPS": group_reports,
+                        "W": writer.policy.w}}
+        groups, backends = self._group_by_ring(
+            [p[0] for p in payloads], ring_id)
+        ok_out = [False] * len(payloads)
+        rings_out = [""] * len(payloads)
+        ring_errors: Dict[str, str] = {}
+        for rid, idxs in groups.items():
+            for i in idxs:
+                rings_out[i] = rid
+            try:
+                res = self._serve_many(backends[rid], "dhash_put",
+                                       [payloads[i] for i in idxs],
+                                       dl)
+            except (RingUnavailableError, RingBusyError,
+                    DeadlineExpiredError) as exc:
+                ring_errors[rid] = str(exc)
+                continue
+            for i, ok in zip(idxs, res):
+                ok_out[i] = bool(ok)
+        out = {"OK": ok_out, "RINGS": rings_out}
+        if ring_errors:
+            out["RING_ERRORS"] = ring_errors
+        return out
 
     def handle_sync_range(self, req: dict) -> dict:
         """One on-demand anti-entropy round between two named rings —
@@ -1207,13 +1607,33 @@ class Gateway:
             # Explicit None/empty check: numpy TABLE_STARTS (binary
             # transport) has no truth value.
             starts = req.get("TABLE_STARTS")
+            lanes = self._vector_lanes(keys)
+            if lanes is not None:
+                # Zero-copy fast lane: both 128-bit vectors ride as
+                # lane arrays (absent TABLE_STARTS = all-zero starts).
+                if starts is None or len(starts) == 0:
+                    slanes = np.zeros_like(lanes)
+                else:
+                    slanes = self._vector_lanes(starts)
+                if slanes is not None:
+                    if slanes.shape[0] != lanes.shape[0]:
+                        raise ValueError(
+                            "TABLE_STARTS length must match KEYS")
+                    if lanes.shape[0] == 0:
+                        return {"INDICES": np.zeros(0, np.int32)}
+                    backend = self._get_finger_backend()
+                    idx = self._serve_many(
+                        backend, "finger_index",
+                        _VectorRun(lanes, slanes), dl)
+                    return {"INDICES": np.asarray(idx, np.int32)}
+                # Mixed forms (lane keys, list starts): the adapter
+                # path below serves it.
             if starts is None or len(starts) == 0:
                 starts = [0] * len(keys)
             if len(starts) != len(keys):
                 raise ValueError("TABLE_STARTS length must match KEYS")
             idx = self.finger_index_many(list(zip(keys, starts)),
                                          deadline=dl)
-            import numpy as np
             return {"INDICES": np.asarray(idx, dtype=np.int32)}
         return {"INDEX": self.finger_index(
             req["KEY"], req.get("TABLE_START", 0), deadline=dl)}
@@ -1253,6 +1673,12 @@ class Gateway:
                 self.remove_ring(ring_id, drain=drain)
             except UnknownRingError:
                 pass  # concurrently removed
+        # Detach the cache's topology listener LAST (the remove_ring
+        # loop above still wants its invalidations): on a SHARED
+        # router, a closed gateway must not stay subscribed forever.
+        if self._topology_cb is not None:
+            self.router.remove_topology_listener(self._topology_cb)
+            self._topology_cb = None
         if first_exc is not None:
             raise first_exc
 

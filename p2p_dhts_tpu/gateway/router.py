@@ -81,6 +81,14 @@ def key_in_range(key_int: int, lo: int, hi: int) -> bool:
     return key_int >= lo or key_int <= hi
 
 
+def keys_in_range_mask(lanes, lo: int, hi: int):
+    """Vectorized key_in_range over a whole [N, LANES] uint32 key
+    array (chordax-fastlane): one boolean mask, zero per-key python —
+    the rule above, computed on the wire's zero-copy lane view."""
+    from p2p_dhts_tpu.keyspace import lanes_in_range_mask
+    return lanes_in_range_mask(lanes, lo, hi)
+
+
 class RingBackend:
     """One named serving backend: engine + key range + health machine.
 
@@ -141,6 +149,19 @@ class RingBackend:
         if self.key_range is None:
             return False
         return key_in_range(key_int, *self.key_range)
+
+    def owns_keys_mask(self, lanes):
+        """Vectorized ownership over an [N, LANES] uint32 key array:
+        one boolean mask (all-False for range-less backends), zero
+        per-key python — the fast lane's routing primitive. The
+        key_range read is one reference; set_key_range swaps it
+        atomically, so a concurrent re-split yields either the old
+        complete range or the new one, never a torn pair."""
+        rng = self.key_range
+        if rng is None:
+            import numpy as np
+            return np.zeros(lanes.shape[0], dtype=bool)
+        return keys_in_range_mask(lanes, *rng)
 
     # -- elasticity (chordax-membership) --------------------------------------
     def set_ring_state(self, state) -> None:
@@ -260,6 +281,36 @@ class RingRouter:
         self._lock = threading.Lock()
         self._rings: Dict[str, RingBackend] = {}
         self._default: Optional[str] = None
+        # Topology listeners (chordax-fastlane): fired AFTER any change
+        # that can move a key's owner — add/remove/set_key_range — so
+        # the gateway's hot-key cache can epoch-invalidate (a cached
+        # answer must never survive a membership change). Fired
+        # OUTSIDE the router lock; callbacks must be cheap and never
+        # call back into the router.
+        self._topology_listeners: List[Callable[[str], None]] = []
+
+    def add_topology_listener(self, cb: Callable[[str], None]) -> None:
+        """Register cb(change_kind) to fire after every ownership-
+        moving registry change ("add_ring" / "remove_ring" /
+        "set_key_range")."""
+        with self._lock:
+            self._topology_listeners.append(cb)
+
+    def remove_topology_listener(self, cb: Callable[[str], None]) -> None:
+        """Unregister a listener (idempotent). A Gateway closing on a
+        SHARED router must detach its cache listener here, or every
+        closed gateway's cache stays pinned and fires forever."""
+        with self._lock:
+            try:
+                self._topology_listeners.remove(cb)
+            except ValueError:
+                pass
+
+    def _fire_topology(self, change: str) -> None:
+        with self._lock:
+            listeners = list(self._topology_listeners)
+        for cb in listeners:
+            cb(change)
 
     # -- registry ------------------------------------------------------------
     def add_ring(self, backend: RingBackend, default: bool = False) -> None:
@@ -270,6 +321,7 @@ class RingRouter:
             self._rings[backend.ring_id] = backend
             if default or self._default is None:
                 self._default = backend.ring_id
+        self._fire_topology("add_ring")
 
     def remove_ring(self, ring_id: str) -> RingBackend:
         """Unregister and RETURN the backend; the caller closes its
@@ -280,6 +332,7 @@ class RingRouter:
                 raise UnknownRingError(f"no ring {ring_id!r}")
             if self._default == ring_id:
                 self._default = next(iter(self._rings), None)
+        self._fire_topology("remove_ring")
         return backend
 
     def get(self, ring_id: str) -> RingBackend:
@@ -304,6 +357,7 @@ class RingRouter:
                 (int(key_range[0]) % KEYS_IN_RING,
                  int(key_range[1]) % KEYS_IN_RING)
                 if key_range is not None else None)
+        self._fire_topology("set_key_range")
 
     def route(self, key_int: Optional[int] = None,
               ring_id: Optional[str] = None) -> RingBackend:

@@ -155,6 +155,10 @@ class Key:
 # host <-> device lane conversion (numpy only; jittable math is in ops.u128)
 # ---------------------------------------------------------------------------
 
+_U64_MASK = (1 << 64) - 1
+_U128_MASK = KEYS_IN_RING - 1
+
+
 def int_to_lanes(value: int) -> np.ndarray:
     """One 128-bit int -> [LANES] uint32, little-endian lanes."""
     value = int(value) % KEYS_IN_RING
@@ -164,9 +168,20 @@ def int_to_lanes(value: int) -> np.ndarray:
 
 
 def ints_to_lanes(values: Iterable[int]) -> np.ndarray:
-    """Batch of ints -> [N, LANES] uint32 (vectorized for multi-million-id rings)."""
-    buf = b"".join((int(v) % KEYS_IN_RING).to_bytes(16, "little") for v in values)
-    return np.frombuffer(buf, dtype="<u4").reshape(-1, LANES).astype(np.uint32)
+    """Batch of ints -> [N, LANES] uint32. Python ints cannot enter
+    numpy without per-element conversion, so the measured-fastest
+    bridge is one C to_bytes per value appended into a bytearray and
+    ONE writable frombuffer view over it — no intermediate bytes join,
+    no astype copy (both measurably slower at 100K+ keys; fromiter and
+    object-dtype u64 splits slower still). The fast lane skips even
+    this via lanes_from_u128_bytes."""
+    buf = bytearray()
+    ext = buf.extend
+    for v in values:
+        # `v & mask` == `v % 2^128` for every python int, negatives
+        # included — and & is cheaper than % on the CPython fast path.
+        ext((int(v) & _U128_MASK).to_bytes(16, "little"))
+    return np.frombuffer(buf, dtype="<u4").reshape(-1, LANES)
 
 
 def lanes_to_int(lanes: np.ndarray) -> int:
@@ -176,11 +191,79 @@ def lanes_to_int(lanes: np.ndarray) -> int:
 
 
 def lanes_to_ints(lanes: np.ndarray) -> list:
-    """[N, LANES] uint32 -> list of python ints (vectorized inverse of
-    ints_to_lanes — one bulk byte conversion, no per-row numpy calls)."""
-    lanes = np.ascontiguousarray(np.asarray(lanes), dtype="<u4")
-    buf = lanes.tobytes()
-    return [
-        int.from_bytes(buf[16 * i : 16 * i + 16], "little")
-        for i in range(lanes.shape[0])
-    ]
+    """[N, LANES] uint32 -> list of python ints. The u64 halves come
+    out in one C-level view + tolist (no per-row slicing or
+    int.from_bytes); the remaining per-row work is the single `|`/`<<`
+    that python-int assembly inherently costs."""
+    pairs = lanes_view_u64(lanes)
+    los = pairs[:, 0].tolist()
+    his = pairs[:, 1].tolist()
+    return [lo | (hi << 64) for lo, hi in zip(los, his)]
+
+
+# ---------------------------------------------------------------------------
+# lane-array-native forms (chordax-fastlane, ISSUE 12): the wire's packed
+# 16-byte little-endian u128 runs ARE the engine's [N, LANES] u32 layout —
+# one frombuffer view bridges them with zero per-key work in either
+# direction.
+# ---------------------------------------------------------------------------
+
+def lanes_from_u128_bytes(buf) -> np.ndarray:
+    """Packed little-endian 16-byte u128 runs -> [N, LANES] uint32,
+    as ONE zero-copy np.frombuffer view (read-only when `buf` is an
+    immutable bytes/memoryview — exactly what the wire decoder hands
+    over). The binary fast lane's wire->device decode."""
+    arr = np.frombuffer(buf, dtype="<u4")
+    if arr.size % LANES:
+        raise ValueError(
+            f"u128 run of {arr.size * 4} bytes is not 16-aligned")
+    return arr.reshape(-1, LANES)
+
+
+def lanes_to_u128_bytes(lanes: np.ndarray) -> bytes:
+    """[N, LANES] uint32 -> packed little-endian u128 runs (one
+    tobytes; the inverse of lanes_from_u128_bytes)."""
+    arr = np.ascontiguousarray(np.asarray(lanes), dtype="<u4")
+    if arr.ndim != 2 or arr.shape[1] != LANES:
+        raise ValueError(f"expected [N, {LANES}] lanes, got {arr.shape}")
+    return arr.tobytes()
+
+
+def lanes_view_u64(lanes: np.ndarray) -> np.ndarray:
+    """[N, LANES] uint32 lanes -> [N, 2] uint64 (lo, hi) view — the
+    comparable form vectorized 128-bit range tests run on. Zero-copy
+    when the input is already contiguous little-endian u32."""
+    arr = np.asarray(lanes)
+    if arr.dtype != np.dtype("<u4") or not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr, dtype="<u4")
+    return arr.reshape(-1, LANES).view("<u8")
+
+
+def lanes_ge_scalar(pairs: np.ndarray, bound: int) -> np.ndarray:
+    """[N, 2] u64 (lo, hi) pairs >= bound, vectorized (bound a python
+    int on the 2^128 circle)."""
+    blo = np.uint64(int(bound) & _U64_MASK)
+    bhi = np.uint64((int(bound) >> 64) & _U64_MASK)
+    return (pairs[:, 1] > bhi) | ((pairs[:, 1] == bhi)
+                                  & (pairs[:, 0] >= blo))
+
+
+def lanes_le_scalar(pairs: np.ndarray, bound: int) -> np.ndarray:
+    """[N, 2] u64 (lo, hi) pairs <= bound, vectorized."""
+    blo = np.uint64(int(bound) & _U64_MASK)
+    bhi = np.uint64((int(bound) >> 64) & _U64_MASK)
+    return (pairs[:, 1] < bhi) | ((pairs[:, 1] == bhi)
+                                  & (pairs[:, 0] <= blo))
+
+
+def lanes_in_range_mask(lanes: np.ndarray, lo: int, hi: int) -> np.ndarray:
+    """Vectorized clockwise-inclusive [lo, hi] membership on the 2^128
+    circle for a whole [N, LANES] key array — the router's
+    key_in_range rule with zero per-key python (lo == hi matches
+    exactly that one key, wrapped ranges take the complement union)."""
+    pairs = lanes_view_u64(lanes)
+    lo %= KEYS_IN_RING
+    hi %= KEYS_IN_RING
+    if lo <= hi:
+        return lanes_ge_scalar(pairs, lo) & lanes_le_scalar(pairs, hi)
+    return lanes_ge_scalar(pairs, lo) | lanes_le_scalar(pairs, hi)

@@ -443,7 +443,8 @@ class _ConnState:
     I/O — the selector thread increments, workers decrement)."""
 
     __slots__ = ("sock", "mode", "buf", "asm", "send_lock",
-                 "last_activity", "dead", "fc_lock", "inflight")
+                 "last_activity", "dead", "fc_lock", "inflight",
+                 "compress")
 
     def __init__(self, sock: socket.socket, now: float):
         self.sock = sock
@@ -455,6 +456,9 @@ class _ConnState:
         self.dead = False
         self.fc_lock = threading.Lock()
         self.inflight = 0
+        #: Negotiated at the hello (v2 = both ends zlib large nd
+        #: sections on their outbound frames).
+        self.compress = False
 
 
 class Server:
@@ -768,14 +772,20 @@ class Server:
             if st.buf[0:1] == wire.HELLO[:1]:
                 if len(st.buf) < len(wire.HELLO):
                     return  # await the rest of a possible hello
-                if bytes(st.buf[:len(wire.HELLO)]) == wire.HELLO:
+                got = bytes(st.buf[:len(wire.HELLO)])
+                if got in (wire.HELLO, wire.HELLO_V2):
                     st.mode = "binary"
+                    # Echo the client's own version: a v2 hello
+                    # negotiates per-connection compression of large
+                    # nd sections (chordax-fastlane); a v1 client gets
+                    # v1 back and an uncompressed session.
+                    st.compress = got == wire.HELLO_V2
                     st.asm = wire.FrameAssembler()
                     leftover = bytes(st.buf[len(wire.HELLO):])
                     st.buf = bytearray()
                     try:
                         with st.send_lock:
-                            st.sock.sendall(wire.HELLO)
+                            st.sock.sendall(got)
                     except OSError:
                         self._drop(sel, st)
                         return
@@ -1033,7 +1043,8 @@ class Server:
     def _send_frame(self, st: _ConnState, req_id: int,
                     resp: JsonObj) -> None:
         try:
-            frame = wire.encode_frame(wire.FRAME_RESPONSE, req_id, resp)
+            frame = wire.encode_frame(wire.FRAME_RESPONSE, req_id, resp,
+                                      compress=st.compress)
         # chordax-lint: disable=bare-except -- an unencodable handler result must become the error envelope, not a silently dropped reply
         except Exception as exc:
             frame = wire.encode_frame(

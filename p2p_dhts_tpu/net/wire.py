@@ -90,17 +90,34 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from p2p_dhts_tpu import havoc as havoc_mod
+from p2p_dhts_tpu import keyspace
 from p2p_dhts_tpu.metrics import METRICS
 
 #: Version-1 hello, sent by the client and echoed by the server. The
 #: first byte must never be ``{`` — that byte is the legacy-JSON
 #: discriminator on the server side.
 HELLO = b"CWX\x01"
+
+#: Version-2 hello (chordax-fastlane, ISSUE 12): same framing, plus
+#: per-connection zlib compression of LARGE ``nd`` sections. A v2
+#: client sends this; a v2 server echoes it (compression negotiated);
+#: a v1 server echoes ``CWX\x01`` (the client runs the session
+#: uncompressed — one rule, still zero flag-days). Anything else
+#: 'C'-prefixed stays a legacy close-delimited request, as before.
+HELLO_V2 = b"CWX\x02"
+
+#: Sections below this size skip compression outright: small frames
+#: are latency-bound and zlib would cost more than the bytes saved.
+COMPRESS_MIN_BYTES = 16 << 10
+#: zlib level 1: the wire is a LAN/localhost serving path — cheap
+#: passes that halve SEGMENTS payloads win; ratio-chasing levels lose.
+COMPRESS_LEVEL = 1
 
 #: How long a client waits for the hello echo before concluding the
 #: destination is a legacy (close-delimited JSON) server. Legacy
@@ -220,6 +237,20 @@ class U128Keys:
         return [lo | (hi << 64)
                 for lo, hi in struct.iter_unpack("<QQ", self._buf)]
 
+    def lanes(self) -> np.ndarray:
+        """The packed run as the engine's [N, LANES] uint32 lane
+        layout — ONE zero-copy np.frombuffer view (chordax-fastlane):
+        the wire's 16-byte little-endian runs ARE the device layout,
+        so the binary vector path never round-trips through per-key
+        python ints."""
+        return keyspace.lanes_from_u128_bytes(self._buf)
+
+    @classmethod
+    def from_lanes(cls, lanes: np.ndarray) -> "U128Keys":
+        """[N, LANES] uint32 lanes -> packed wire run (one tobytes;
+        the symmetric return direction of the fast lane)."""
+        return cls(keyspace.lanes_to_u128_bytes(lanes))
+
     def __eq__(self, other) -> bool:
         if isinstance(other, U128Keys):
             return self._buf == other._buf
@@ -264,18 +295,36 @@ def _decode_value(value: Any, sections: List[Any]) -> Any:
     return value
 
 
-def encode_payload(obj: dict) -> bytes:
+def encode_payload(obj: dict, compress: bool = False) -> bytes:
     """One request/response dict -> header JSON + concatenated binary
-    sections (the bytes AFTER frame_type/request_id)."""
+    sections (the bytes AFTER frame_type/request_id). With `compress`
+    (a NEGOTIATED per-connection verdict, never assumed), ``nd``
+    sections of COMPRESS_MIN_BYTES or more ride zlib-deflated — the
+    SEGMENTS-heavy GET/PUT reply payloads — while small sections (and
+    u128 key runs, which are cryptographic-hash output and do not
+    deflate) stay raw; a section that fails to shrink ships raw too,
+    so the wire never pays for incompressible data twice."""
     sections: List[Tuple[dict, bytes]] = []
     skeleton = _encode_value(obj, sections)
     if sections:
         descs = []
+        out_bufs: List[bytes] = []
         for desc, buf in sections:
             d = dict(desc)
+            if (compress and d.get("k") == "nd"
+                    and len(buf) >= COMPRESS_MIN_BYTES):
+                z = zlib.compress(buf, COMPRESS_LEVEL)
+                if len(z) < len(buf):
+                    METRICS.inc("rpc.wire.compress.sections")
+                    METRICS.inc("rpc.wire.compress.raw_bytes", len(buf))
+                    METRICS.inc("rpc.wire.compress.wire_bytes", len(z))
+                    d["c"] = "z"
+                    buf = z
             d["n"] = len(buf)
             descs.append(d)
+            out_bufs.append(buf)
         skeleton[SECTIONS_KEY] = descs
+        sections = list(zip((d for d in descs), out_bufs))
     header = json.dumps(skeleton, separators=(",", ":")).encode()
     parts = [_LEN.pack(len(header)), header]
     parts.extend(buf for _, buf in sections)
@@ -316,6 +365,41 @@ def decode_payload(body: memoryview) -> dict:
                     "truncated frame: section overruns")
             raw = body[off:off + n]
             off += n
+            codec = desc.get("c")
+            if codec is not None:
+                if codec != "z":
+                    raise WireProtocolError(
+                        f"unknown section codec {codec!r}")
+                if desc.get("k") != "nd":
+                    raise WireProtocolError(
+                        "compressed section is not an nd array")
+                # Decompression trades the zero-copy view for the
+                # byte savings — only ever on sections the encoder
+                # judged large enough for that trade. The inflated
+                # size is fully determined by the descriptor's
+                # dtype×shape, so inflate EXACTLY that many bytes and
+                # reject any stream that over- or under-runs it — a
+                # peer-crafted deflate bomb costs one bounded buffer,
+                # never an OOM.
+                shape = [int(v) for v in desc["sh"]]
+                expected = int(np.dtype(desc["dt"]).itemsize)
+                for dim in shape:
+                    if dim < 0:
+                        raise WireProtocolError(
+                            f"negative dimension {dim}")
+                    expected *= dim
+                if expected > MAX_FRAME_BYTES:
+                    raise WireProtocolError(
+                        f"compressed section inflates to {expected} "
+                        f"bytes (bound {MAX_FRAME_BYTES})")
+                dec = zlib.decompressobj()
+                raw = dec.decompress(bytes(raw), expected)
+                if len(raw) != expected or not dec.eof or \
+                        dec.unconsumed_tail:
+                    raise WireProtocolError(
+                        f"compressed section inflated to {len(raw)} "
+                        f"bytes, descriptor says {expected}")
+                METRICS.inc("rpc.wire.decompress.sections")
             kind = desc.get("k")
             if kind == "nd":
                 arr = np.frombuffer(raw, dtype=np.dtype(desc["dt"]))
@@ -329,12 +413,13 @@ def decode_payload(body: memoryview) -> dict:
     except WireProtocolError:
         raise
     except (KeyError, IndexError, ValueError, TypeError,
-            AttributeError) as exc:
+            AttributeError, zlib.error) as exc:
         raise WireProtocolError(f"malformed frame: {exc!r}") from exc
 
 
-def encode_frame(frame_type: int, request_id: int, obj: dict) -> bytes:
-    payload = encode_payload(obj)
+def encode_frame(frame_type: int, request_id: int, obj: dict,
+                 compress: bool = False) -> bytes:
+    payload = encode_payload(obj, compress=compress)
     body = struct.pack("<BQ", frame_type, request_id) + payload
     return _LEN.pack(len(body)) + body
 
@@ -440,9 +525,13 @@ class _Conn:
     frame writes off a queue, a reader thread demultiplexing responses
     by request id."""
 
-    def __init__(self, sock: socket.socket, dest: Tuple[str, int]):
+    def __init__(self, sock: socket.socket, dest: Tuple[str, int],
+                 compress: bool = False):
         self.sock = sock
         self.dest = dest
+        #: Negotiated at the hello (v2 echo): large nd sections on
+        #: THIS connection's outbound frames ride zlib-deflated.
+        self.compress = compress
         self._lock = threading.Lock()
         self._pending: Dict[int, _Waiter] = {}
         self._next_id = 1
@@ -471,7 +560,8 @@ class _Conn:
             req_id = self._next_id
             self._next_id += 1
             self._pending[req_id] = waiter
-        frame = encode_frame(FRAME_REQUEST, req_id, obj)
+        frame = encode_frame(FRAME_REQUEST, req_id, obj,
+                             compress=self.compress)
         # Hand the frame to the writer thread: the caller never blocks
         # in sendall behind another request's write (and no lock is
         # held across socket I/O anywhere in this module). A send
@@ -790,7 +880,15 @@ class WirePool:
 
     def _dial(self, dest: Tuple[str, int], timeout: float) -> _Conn:
         t0 = time.perf_counter()
-        hello = HELLO
+        # v2-first hello ladder: try CWX\x02 (binary + compression); a
+        # server that answers neither hello within the window gets ONE
+        # plain CWX\x01 retry on a fresh connection before the legacy
+        # verdict — a strict-v1 binary server (which treats an unknown
+        # 'C'-prefixed hello as legacy and stays silent) must DOWNGRADE
+        # to an uncompressed binary session, never all the way to the
+        # one-shot JSON transport. A genuinely legacy destination costs
+        # two bounded probes once per LEGACY_TTL_S.
+        hellos: List[bytes] = [HELLO_V2, HELLO]
         if havoc_mod.enabled():
             act = havoc_mod.decide("wire.client.hello",
                                    key=f"{dest[0]}:{dest[1]}")
@@ -799,42 +897,54 @@ class WirePool:
                 # non-hello and must treat the connection as legacy
                 # (or time it out); this client's echo wait times out
                 # and falls back — the negotiation edge the tests pin.
-                hello = HELLO[:max(int(act.get("bytes", 2)), 1)]
-        sock = socket.create_connection(dest, timeout=timeout)
-        try:
-            # The hello wait gets the FULL negotiation window even when
-            # the caller's remaining deadline is shorter: a legacy
-            # verdict is cached for LEGACY_TTL_S and must reflect the
-            # peer's protocol, never one nearly-expired request's
-            # budget (the caller's own deadline still bounds the
-            # request at the layers above).
-            sock.settimeout(NEGOTIATE_TIMEOUT_S)
-            sock.sendall(hello)
-            echo = b""
-            while len(echo) < len(HELLO):
-                chunk = sock.recv(len(HELLO) - len(echo))
-                if not chunk:
-                    break
-                echo += chunk
-        except socket.timeout:
+                # The injected fault IS this dial's negotiation
+                # attempt, so no clean-hello retry follows it.
+                hellos = [HELLO[:max(int(act.get("bytes", 2)), 1)]]
+        echo = b""
+        sock: Optional[socket.socket] = None
+        for hello in hellos:
+            sock = socket.create_connection(dest, timeout=timeout)
+            try:
+                # The hello wait gets the FULL negotiation window even
+                # when the caller's remaining deadline is shorter: a
+                # legacy verdict is cached for LEGACY_TTL_S and must
+                # reflect the peer's protocol, never one nearly-expired
+                # request's budget (the caller's own deadline still
+                # bounds the request at the layers above).
+                sock.settimeout(NEGOTIATE_TIMEOUT_S)
+                sock.sendall(hello)
+                echo = b""
+                while len(echo) < len(HELLO):
+                    chunk = sock.recv(len(HELLO) - len(echo))
+                    if not chunk:
+                        break
+                    echo += chunk
+            except socket.timeout:
+                sock.close()
+                sock = None
+                echo = b""
+                continue  # next hello (or the legacy verdict below)
+            except OSError:
+                sock.close()
+                raise
+            if echo in (HELLO, HELLO_V2):
+                break
             sock.close()
+            sock = None
+        if sock is None or echo not in (HELLO, HELLO_V2):
+            if sock is not None:
+                sock.close()
             self.mark_legacy(dest)
             METRICS.inc("rpc.wire.negotiation_fallback")
             raise NegotiationFallback(dest) from None
-        except OSError:
-            sock.close()
-            raise
-        if echo != HELLO:
-            sock.close()
-            self.mark_legacy(dest)
-            METRICS.inc("rpc.wire.negotiation_fallback")
-            raise NegotiationFallback(dest)
         sock.settimeout(None)  # the reader thread blocks in recv
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         METRICS.inc("rpc.wire.connects")
         METRICS.observe_hist("rpc.client.connect",
                              time.perf_counter() - t0)
-        return _Conn(sock, dest)
+        # A v2 echo == both ends compress large nd sections; a v1 echo
+        # (an older server) == an ordinary uncompressed binary session.
+        return _Conn(sock, dest, compress=(echo == HELLO_V2))
 
     def close_all(self) -> None:
         with self._lock:

@@ -283,6 +283,14 @@ class ReplicatedWriter:
             else:
                 self.metrics.inc(
                     f"repair.replication.replica_failed.{rid}")
+            # chordax-fastlane: a STRAGGLER completing after the
+            # quorum return must epoch-bump the read cache itself —
+            # the caller's bump happened at quorum, and a read that
+            # cached this replica's pre-write value in the window
+            # would otherwise serve it forever (the cache invariant
+            # is "no cached answer survives a write", not "…survives
+            # the quorum ack").
+            self.gateway._invalidate_reads("replica_straggler")
         state.record(rid, oks)
         with state.lock:
             t_q = state.t_quorum

@@ -118,12 +118,19 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from p2p_dhts_tpu import havoc as havoc_mod
 from p2p_dhts_tpu import trace as trace_mod
-from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, LANES
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
 KINDS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
          "sync_digest", "repair_reindex", "churn_apply",
          "stabilize_sweep", "dhash_maintain")
+
+#: Kinds with an ARRAY-NATIVE vector submission (chordax-fastlane,
+#: ISSUE 12): submit_vector carries whole [N, LANES] u32 key arrays to
+#: the device with zero per-key python — the read-side lookup kinds
+#: whose wire form is a packed u128 run. Mutators keep the per-payload
+#: path (their validation/normalization is inherently per entry).
+VECTOR_KINDS = ("find_successor", "dhash_get", "finger_index")
 
 #: Kinds that mutate the engine's store or ring state: they stay off
 #: the caller-inline fast path (their read-modify-write must never
@@ -155,7 +162,7 @@ class _Slot:
     dispatch instead of burning a batch lane on an abandoned answer."""
 
     __slots__ = ("kind", "payload", "t_submit", "result", "error", "ev",
-                 "deadline", "trace", "retried")
+                 "deadline", "trace", "retried", "vec")
 
     def __init__(self, kind: str, payload: tuple,
                  deadline: Optional[float] = None):
@@ -175,6 +182,11 @@ class _Slot:
         #: a retried slot dispatches alone and a second failure fails
         #: only it, never its former batch-mates.
         self.retried = False
+        #: chordax-fastlane (ISSUE 12): >0 marks a VECTOR chunk slot —
+        #: payload holds whole numpy arrays of `vec` rows, the slot
+        #: dispatches as its own batch, and result is the chunk's
+        #: result arrays (gather_vector concatenates across chunks).
+        self.vec = 0
 
     def wait(self, timeout: Optional[float] = None):
         if not self.ev.wait(timeout):
@@ -452,6 +464,82 @@ class ServeEngine:
         if not self._started:
             self.start()
         slots = [_Slot(kind, p, deadline) for p in payloads]
+        return self._submit_slots(slots, kind, deadline)
+
+    def submit_vector(self, kind: str, keys, starts=None,
+                      deadline: Optional[float] = None) -> List[_Slot]:
+        """Array-native vector submission (chordax-fastlane, ISSUE 12):
+        one [N, LANES] uint32 key array (the zero-copy wire->device
+        layout, keyspace.lanes_from_u128_bytes) rides to the device in
+        <= bucket_max row chunks with ZERO per-key python — no int
+        round-trip, no per-key slot. Kinds (VECTOR_KINDS):
+
+          * "find_successor" — `starts` is an [N] int32 start-row array
+            (None = all zeros); each chunk slot resolves to
+            (owner [c] i64-ish, hops [c]) host arrays.
+          * "dhash_get" — keys only; chunk result (segments
+            [c, S, m] i32, ok [c] bool).
+          * "finger_index" — `starts` is an [N, LANES] uint32
+            table-start key array; chunk result indices [c] i32.
+
+        Chunks ride the SAME FIFO queue, pre-traced buckets, deadline
+        shedding, and quarantine as every other submission (a vector
+        chunk is its own batch, so batching semantics and zero-retrace
+        guarantees carry over unchanged); gather_vector() waits and
+        concatenates the chunk results back to full length."""
+        import numpy as np
+        if kind not in VECTOR_KINDS:
+            raise ValueError(f"kind {kind!r} has no vector form "
+                             f"(VECTOR_KINDS: {VECTOR_KINDS})")
+        if kind in ("find_successor", "dhash_get") and self._state is None:
+            raise ValueError(f"engine has no RingState; {kind} "
+                             "requests need one")
+        if kind == "dhash_get" and self._store is None:
+            raise ValueError("engine has no RingState+FragmentStore; "
+                             "dhash_get requests need both")
+        keys = np.asarray(keys)
+        if keys.ndim != 2 or keys.shape[1] != LANES:
+            raise ValueError(f"expected [N, {LANES}] uint32 key lanes, "
+                             f"got {keys.shape}")
+        if keys.dtype != np.uint32:
+            keys = keys.astype(np.uint32)
+        n = keys.shape[0]
+        if kind == "find_successor":
+            starts = (np.zeros(n, np.int32) if starts is None
+                      else np.asarray(starts, dtype=np.int32))
+            if starts.shape != (n,):
+                raise ValueError(f"starts must be [{n}] int32, got "
+                                 f"{starts.shape}")
+        elif kind == "finger_index":
+            if starts is None:
+                raise ValueError("finger_index vectors need [N, LANES] "
+                                 "table-start lanes")
+            starts = np.asarray(starts)
+            if starts.shape != (n, LANES):
+                raise ValueError(f"table starts must be [{n}, {LANES}], "
+                                 f"got {starts.shape}")
+            if starts.dtype != np.uint32:
+                starts = starts.astype(np.uint32)
+        elif starts is not None:
+            raise ValueError("dhash_get vectors take keys only")
+        if not self._started:
+            self.start()
+        slots: List[_Slot] = []
+        step = self._bucket_max
+        for off in range(0, n, step):
+            ck = keys[off:off + step]
+            payload = ((ck,) if starts is None
+                       else (ck, starts[off:off + step]))
+            slot = _Slot(kind, payload, deadline)
+            slot.vec = ck.shape[0]
+            slots.append(slot)
+        return self._submit_slots(slots, kind, deadline)
+
+    def _submit_slots(self, slots: List[_Slot], kind: str,
+                      deadline: Optional[float]) -> List[_Slot]:
+        """Shared submission tail (trace attach, expired drop, the
+        caller-inline fast path, bounded enqueue) for scalar and
+        vector slots alike."""
         if trace_mod.enabled():
             tctx = trace_mod.current()
             if tctx is not None:
@@ -755,6 +843,12 @@ class ServeEngine:
     # -- stats --------------------------------------------------------------
 
     @property
+    def bucket_max(self) -> int:
+        """Largest dispatch bucket — also the row width submit_vector
+        chunks at (the gateway charges vector admission per chunk)."""
+        return self._bucket_max
+
+    @property
     def window_s(self) -> float:
         return self._window_s
 
@@ -1043,14 +1137,19 @@ class ServeEngine:
                 return []
             kind = self._pending[0].kind
             batch = []
-            if self._pending[0].retried:
+            if self._pending[0].retried or self._pending[0].vec:
                 # A quarantined slot dispatches ALONE: its one solo
                 # retry must not take fresh batch-mates down with it.
+                # A VECTOR chunk is likewise its own (already full-
+                # width) batch — coalescing scalar slots into it would
+                # mean per-key python re-assembly, the exact cost the
+                # fast lane exists to remove.
                 batch.append(self._pending.popleft())
             else:
                 while (self._pending and len(batch) < self._bucket_max
                        and self._pending[0].kind == kind
-                       and not self._pending[0].retried):
+                       and not self._pending[0].retried
+                       and not self._pending[0].vec):
                     batch.append(self._pending.popleft())
             # Popping may leave the queue empty while the batch is not
             # yet launched; block the fast path until the launch (and
@@ -1084,6 +1183,8 @@ class ServeEngine:
         from p2p_dhts_tpu import keyspace
         kern = self._get_kernels()
         jnp, np = kern["jnp"], kern["np"]
+        if batch[0].vec:
+            return self._launch_vector(batch[0], kern, jnp, np)
         kind = batch[0].kind
         size = len(batch)
         bucket = self._bucket_for(size)
@@ -1265,6 +1366,61 @@ class ServeEngine:
                 self._store = new_store
         return ("dhash_put", ok, prev_store, epoch)
 
+    def _launch_vector(self, slot: _Slot, kern, jnp, np):
+        """Dispatch one VECTOR chunk (chordax-fastlane): the payload's
+        numpy arrays pad to the chunk's power-of-two bucket by
+        replicating row 0 (a repeat, never a new action — the scalar
+        path's pad rule) and launch through the SAME pre-traced
+        kernels, so a vector dispatch can never retrace. Zero per-key
+        python: padding is one concatenate, inputs go to the device as
+        whole arrays."""
+        kind = slot.kind
+        c = slot.vec
+        bucket = self._bucket_for(c)
+        pad = bucket - c
+
+        if havoc_mod.enabled():
+            # The engine-level dispatch-failure site applies to vector
+            # chunks too; the payload-matched poison site stays
+            # scalar-only (its key matching is per-payload ints).
+            act = havoc_mod.decide("serve.launch", key=self._name)
+            if act is not None:
+                raise RuntimeError(
+                    f"havoc: injected dispatch failure "
+                    f"({kind} vector chunk of {c}, engine "
+                    f"{self._name!r})")
+
+        with self._lock:
+            self.batch_log.append((kind, c, bucket))
+            self.batches_served += 1
+            self.requests_served += c
+            self._fill_sum += c / bucket
+        self._metrics.inc(f"serve.requests.{kind}", c)
+        self._metrics.inc("serve.batches")
+        self._metrics.inc("serve.vector_chunks")
+        self._metrics.gauge("serve.batch_fill", c / bucket)
+        self._metrics.observe_hist(f"serve.batch_occupancy.{kind}",
+                                   c / bucket)
+
+        def pad_rows(arr):
+            if not pad:
+                return arr
+            return np.concatenate(
+                [arr, np.broadcast_to(arr[:1], (pad,) + arr.shape[1:])])
+
+        keys = jnp.asarray(pad_rows(slot.payload[0]))
+        if kind == "find_successor":
+            starts = jnp.asarray(pad_rows(slot.payload[1]))
+            owner, hops = kern["find_successor"](self._state, keys,
+                                                 starts)
+            return ("vec", kind, c, owner, hops)
+        if kind == "dhash_get":
+            segs, ok = kern["dhash_get"](self._state, self._store, keys)
+            return ("vec", kind, c, segs, ok)
+        # finger_index
+        starts = jnp.asarray(pad_rows(slot.payload[1]))
+        return ("vec", kind, c, kern["finger_index"](keys, starts))
+
     # -- completion loop ----------------------------------------------------
 
     def _complete_loop(self) -> None:
@@ -1289,7 +1445,21 @@ class ServeEngine:
             btr.t_sync0 = time.perf_counter()
         try:
             kind = handle[0]
-            if kind == "finger_index":
+            if kind == "vec":
+                # Vector chunk (chordax-fastlane): one slot, whole
+                # result arrays, zero per-key python — the host sync is
+                # one np.asarray per output and the pad rows slice off.
+                _, vkind, c = handle[0], handle[1], handle[2]
+                slot = batch[0]
+                if vkind == "find_successor":
+                    slot.result = (np.asarray(handle[3])[:c],
+                                   np.asarray(handle[4])[:c])
+                elif vkind == "dhash_get":
+                    slot.result = (np.asarray(handle[3])[:c],
+                                   np.asarray(handle[4])[:c])
+                else:  # finger_index
+                    slot.result = np.asarray(handle[3])[:c]
+            elif kind == "finger_index":
                 idx = np.asarray(handle[1])
                 for j, slot in enumerate(batch):
                     slot.result = int(idx[j])
@@ -1525,6 +1695,25 @@ class ServeEngine:
                       error=f"{type(exc).__name__}: {exc}")
         if delivered == 0:
             self._late_errors.append(exc)
+
+
+def gather_vector(slots: Sequence[_Slot],
+                  timeout: Optional[float] = None):
+    """Wait every vector chunk slot (submit_vector's return) and
+    concatenate the chunk result arrays back to full [N] length —
+    single-chunk vectors return their arrays untouched (no copy).
+    `timeout` bounds each chunk wait, the submit_many convention."""
+    import numpy as np
+    results = [s.wait(timeout) for s in slots]
+    if not results:
+        return None
+    first = results[0]
+    if isinstance(first, tuple):
+        if len(results) == 1:
+            return first
+        return tuple(np.concatenate([r[i] for r in results])
+                     for i in range(len(first)))
+    return first if len(results) == 1 else np.concatenate(results)
 
 
 # ---------------------------------------------------------------------------

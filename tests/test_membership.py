@@ -68,11 +68,12 @@ def _seg(rng):
 
 
 def _mk_gateway(rng, n_peers=24, joiners=16, second_ring=True,
-                metrics=None, auto_repair=False):
+                metrics=None, auto_repair=False, cache_capacity=4096):
     """Gateway with an elastic capacity-padded ring "ma" (+ replica
     "mb"), every churn kind pre-traced."""
     mets = metrics if metrics is not None else Metrics()
-    gw = Gateway(metrics=mets, name="test-membership")
+    gw = Gateway(metrics=mets, name="test-membership",
+                 cache_capacity=cache_capacity)
     sched = None
     if auto_repair:
         sched = gw.enable_auto_repair(rate_keys_s=1e6, burst_keys=1e6,
@@ -602,7 +603,12 @@ def test_mass_join_regression_over_3_simultaneous(transport):
 
 def test_replica_aware_get_failover_and_parity():
     rng = np.random.RandomState(17)
-    gw, mets, ids, _ = _mk_gateway(rng)
+    # cache_capacity=0: this test wipes a key DIRECTLY from the engine
+    # store (no gateway-visible write, so no epoch bump) to force the
+    # failover path — the fastlane hot-key cache would legitimately
+    # serve the pre-wipe read otherwise. The cache's own semantics are
+    # covered by tests/test_fastlane.py's invalidation matrix.
+    gw, mets, ids, _ = _mk_gateway(rng, cache_capacity=0)
     try:
         gw.set_replication(ReplicationPolicy(n_replicas=2, w=2))
         key = _rand_ids(rng, 1)[0]

@@ -1,37 +1,40 @@
 #!/bin/bash
-# TPU recovery watcher, round 11: eleven configs want on-chip records
-# (greens from r07-r10 carry over; chordax-pulse joins the want list).
-# Wait for the chip to be free, probe the remote-compile service (dead
-# since round 4: connection-refused on its port while cached programs
-# kept executing), and when it answers, run the configs without a
-# green record one at a time into BENCH_ATTEMPT_r11.jsonl (bench's
-# _record_lkg promotes each green on-chip record into BENCH_LKG.json).
-# On-chip attempts keep the --trace device-timeline archiving (now
-# into BENCH_TRACE_r11). All prior gates stay (wire-isolated binary
-# >= 3x JSON keys/s at <= 1/2 p50, traced chain, havoc scenario
-# matrix >= 99% availability, zero retraces). NEW in round 11
-# (chordax-pulse): a PULSE SMOKE pre-bench gate — sampler overhead
-# <= 5% p50 on the gateway closed loop, SLO verdicts OK on a healthy
-# run and BREACH->recovery under the seeded lossy-wire scenario, one
-# linked digest->diff->heal repair trace — must pass on CPU before
-# anything claims the chip; the pulse config polls its own PULSE +
-# HEALTH verbs MID-BENCH (the watcher's remote view) and archives the
-# sampled series artifact (CHORDAX_PULSE_SERIES) next to the BENCH
-# records. Never kills anything mid-TPU-work; every probe and bench
-# attempt runs to completion (a blocked fresh-shape jit takes ~25 min
-# to fail — that is the probe's cost when the service is down,
-# accepted).
+# TPU recovery watcher, round 12: twelve configs want on-chip records
+# (greens from r07-r11 carry over; chordax-fastlane joins the want
+# list). Wait for the chip to be free, probe the remote-compile
+# service (dead since round 4: connection-refused on its port while
+# cached programs kept executing), and when it answers, run the
+# configs without a green record one at a time into
+# BENCH_ATTEMPT_r12.jsonl (bench's _record_lkg promotes each green
+# on-chip record into BENCH_LKG.json). On-chip attempts keep the
+# --trace device-timeline archiving (now into BENCH_TRACE_r12). All
+# prior gates stay (wire-isolated binary >= 3x JSON keys/s at <= 1/2
+# p50, traced chain, havoc scenario matrix >= 99% availability, pulse
+# smoke, zero retraces). NEW in round 12 (chordax-fastlane): a
+# FASTLANE SMOKE pre-bench gate — the wire-isolated 1M-KEY vector
+# holds the >= 3x keys/s / <= 1/2 p50 binary edge with the zero-copy
+# codec, a real 1M-key vector RPC through gateway+engine performs
+# ZERO per-key python (counted) with direct-engine parity, and the
+# Zipf(1.1) hot-key closed loop shows cache hit rate > 80% with
+# cache-hit p50 under the engine round trip — must pass on CPU before
+# anything claims the chip. ALSO NEW: the round-5 IDA-decode verdict
+# (BENCH_NOTES_r12.md) says the LKG 93.3 MB/s decode row is the
+# PRE-FIX dot-path cliff — when the ida config re-records on chip,
+# expect the platform-split default (VPU MAC) to replace it. Never
+# kills anything mid-TPU-work; every probe and bench attempt runs to
+# completion (a blocked fresh-shape jit takes ~25 min to fail — that
+# is the probe's cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-11 watcher start (eleven configs + wire/havoc/pulse smoke gates)"
+log "round-12 watcher start (twelve configs + wire/havoc/pulse/fastlane smoke gates)"
 
-needed() {  # configs without a green record yet (r07-r10 greens count)
+needed() {  # configs without a green record yet (r07-r11 greens count)
   python - <<'EOF'
 import json
 ok = set()
 for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
                 "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl",
-                "BENCH_ATTEMPT_r11.jsonl"):
+                "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -44,7 +47,7 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
         pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership",
-        "pulse"]
+        "pulse", "fastlane"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -56,11 +59,11 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all ten configs recorded green — done"
+    log "all twelve configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
-  # chordax-lint gate (ISSUE 3; now four passes incl. the metric-key
+  # chordax-lint gate (ISSUE 3; four passes incl. the metric-key
   # doc-drift gate): a finding means this tree is not the code we want
   # hardware evidence for — fail the cycle before any bench touches
   # the chip. CPU-pinned so the gate never claims the TPU (same
@@ -121,12 +124,24 @@ for i in $(seq 1 80); do
   # mid-bench), one linked digest->diff->heal repair trace, zero
   # retraces — on CPU before anything claims the chip. The sampled
   # series artifact lands next to this round's records.
-  mkdir -p BENCH_TRACE_r11
+  mkdir -p BENCH_TRACE_r12
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_PULSE_SERIES=BENCH_TRACE_r11/pulse_series_smoke.json \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r12/pulse_series_smoke.json \
       python bench.py --config pulse --smoke \
       >> tpu_watch.log 2>&1; then
     log "pulse smoke FAILED - fix the telemetry plane before benching"
+    sleep 300
+    continue
+  fi
+  # Fastlane smoke (ISSUE 12): the zero-copy serving path must hold —
+  # wire-isolated 1M-key vector >= 3x JSON keys/s at <= 1/2 p50, a
+  # real 1M-key binary vector RPC with ZERO per-key python and
+  # direct-engine parity, Zipf hot-key cache hit rate > 80% with
+  # cache-hit p50 under the uncached round trip, the PUT-invalidation
+  # check, and zero retraces — on CPU before anything claims the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config fastlane --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "fastlane smoke FAILED - fix the zero-copy path before benching"
     sleep 300
     continue
   fi
@@ -140,15 +155,15 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r11
+    mkdir -p BENCH_TRACE_r12
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r11/$c)"
+      log "running --config $c (device trace -> BENCH_TRACE_r12/$c)"
       # The pulse config archives its sampled series + verdicts next
       # to this round's records (the mid-bench PULSE/HEALTH polls are
       # inside the config itself).
-      CHORDAX_PULSE_SERIES="BENCH_TRACE_r11/pulse_series_$c.json" \
-        python bench.py --config "$c" --trace "BENCH_TRACE_r11" \
-        >> BENCH_ATTEMPT_r11.jsonl 2>> BENCH_ATTEMPT_r11.err
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r12/pulse_series_$c.json" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r12" \
+        >> BENCH_ATTEMPT_r12.jsonl 2>> BENCH_ATTEMPT_r12.err
       log "config $c rc=$?"
     done
   else
