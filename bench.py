@@ -2736,11 +2736,16 @@ def bench_fastlane(n_peers: int = 4096, vector_keys: int = 1_000_000,
     # for the GET/PUT phases); "bulk": the explicit-RING vector target
     # with ONE pre-traced 8192-row bucket so the 1M-key vector runs
     # bucket-aligned chunks.
+    # The hot ring warms its FUSED program too (chordax-fuse): the
+    # Zipf/GET phases run mixed read kinds concurrently, so the cache
+    # and invalidation gates below re-prove themselves with fusion
+    # genuinely armed, not just fuse-capable.
     gw.add_ring("hot", hot_state,
                 empty_store(capacity=8192, max_segments=32),
                 default=True, bucket_min=hot_bucket_min,
                 bucket_max=hot_bucket_max, reprobe_s=300.0,
-                warmup=["find_successor", "dhash_get", "dhash_put"])
+                warmup=["find_successor", "dhash_get", "dhash_put",
+                        "fused"])
     gw.add_ring("bulk", bulk_state, bucket_min=bulk_bucket,
                 bucket_max=bulk_bucket, reprobe_s=300.0,
                 warmup=["find_successor"])
@@ -2820,6 +2825,16 @@ def _bench_fastlane_phases(gw, srv, rng, vector_keys, wire_reqs,
         assert (int(owners[j]), int(hops[j])) == (o, h), \
             f"zero-copy parity FAIL at key index {j}"
     bulk_eng.assert_no_retraces()
+    # chordax-fuse (ISSUE 13) regression guard: the 1M-key vector just
+    # rode the SAME FIFO queue a fused dispatch drains — single-kind
+    # vectors never form a fused group (by design), but the queue must
+    # stay the fuse-CAPABLE engine's queue, never a side channel
+    # (someone flipping the capability default off, or the vector path
+    # growing a bypass lane, fails here visibly). The fusion-ARMED
+    # re-proof runs on the hot ring below (fused_warmed asserted).
+    assert bulk_eng.fuse_enabled, \
+        "fastlane: bulk engine is not fuse-capable — the vector path " \
+        "left the fused engine's queue"
     e2e_keys_s = vector_keys / e2e_wall
 
     # -- phase 3: Zipf(1.1) hot-key closed loop -------------------------
@@ -2906,6 +2921,11 @@ def _bench_fastlane_phases(gw, srv, rng, vector_keys, wire_reqs,
 
     hot_eng = gw.router.get("hot").engine
     hot_eng.assert_no_retraces()
+    # The hot ring's gates above (Zipf closed loop, PUT invalidation,
+    # compression GETs) ran with fusion ARMED — mixed read bursts on
+    # this engine dispatch fused, and zero retraces still held.
+    assert hot_eng.fuse_enabled and hot_eng.fused_warmed, \
+        "fastlane: hot engine is not serving with fusion armed"
     return {
         "value": round(e2e_keys_s, 1),
         "zero_copy": {
@@ -2932,6 +2952,301 @@ def _bench_fastlane_phases(gw, srv, rng, vector_keys, wire_reqs,
     }
 
 
+def bench_fuse(n_peers: int = 2048, data_keys: int = 192,
+               workers: int = 6, reqs_each: int = 100,
+               bucket_min: int = 8, bucket_max: int = 64,
+               smax: int = 8, ida_blocks: int = 2048,
+               ida_segs: int = 64) -> dict:
+    """chordax-fuse (ISSUE 13), the hard CPU-smoke win gate:
+
+      1. MIXED-KIND CLOSED LOOP — workers interleaving
+         find_successor / dhash_get / finger_index against ONE engine.
+         The fused engine (multi-kind super-batch dispatch) must hold
+         >= 1.25x the throughput of the identical engine with
+         fuse=False (the kind-by-kind drain) at equal-or-better p50.
+      2. FUSED PARITY — a held mixed burst dispatches as ONE fused
+         batch whose per-kind answers are byte-exact vs the direct
+         kernels (the unfused dispatch's own parity anchor).
+      3. FIFO STRADDLE — a put between two fused read groups splits
+         them: the earlier get reads the old value, the later get
+         reads the write, and the batch log shows the put strictly
+         between the read groups.
+      4. ZERO steady-state retraces on both engines over the storm.
+      5. IDA BACKEND MICROBENCH — dot vs MAC vs pallas decode
+         side-by-side through ops.ida_backend with byte parity
+         asserted; pallas skips TIMING on CPU with the visible
+         interpret-mode reason (it still parity-checks at a tiny
+         shape)."""
+    import threading
+
+    from p2p_dhts_tpu.metrics import METRICS, nearest_rank
+    from p2p_dhts_tpu.ops import ida_backend
+    from p2p_dhts_tpu.serve import ServeEngine
+
+    rng = np.random.RandomState(0xF5E)
+    state = build_ring(_rand_lanes(rng, n_peers),
+                       RingConfig(finger_mode="materialized"))
+    n_ida, m_ida, p_ida = 14, 10, 257
+
+    # Seed ONE store value shared by both engines (stores are immutable
+    # pytrees; each engine chains its own line from the same snapshot,
+    # and the closed loops are read-only, so the comparison stays
+    # apples-to-apples).
+    put_keys = _rand_ids(rng, data_keys)
+    seed_segs = rng.randint(
+        0, p_ida, size=(data_keys, smax, m_ida)).astype(np.int32)
+    store0, seed_ok = create_batch(
+        state, empty_store(capacity=data_keys * (n_ida + 4) * 2,
+                           max_segments=smax),
+        keys_from_ints(put_keys), jnp.asarray(seed_segs),
+        jnp.full((data_keys,), smax, jnp.int32),
+        jnp.zeros((data_keys,), jnp.int32), n_ida, m_ida, p_ida)
+    assert bool(jnp.all(seed_ok)), "fuse bench: seeding puts failed"
+
+    warm = ["find_successor", "dhash_get", "finger_index", "dhash_put"]
+    eng_f = ServeEngine(state, store0, n=n_ida, m=m_ida, p=p_ida,
+                        bucket_min=bucket_min, bucket_max=bucket_max,
+                        fuse=True, name="fuse-on").start()
+    eng_u = ServeEngine(state, store0, n=n_ida, m=m_ida, p=p_ida,
+                        bucket_min=bucket_min, bucket_max=bucket_max,
+                        fuse=False, name="fuse-off").start()
+    try:
+        eng_f.warmup(warm + ["fused"])
+        eng_u.warmup(warm)
+        out = _bench_fuse_phases(
+            eng_f, eng_u, state, store0, rng, put_keys, seed_segs,
+            workers, reqs_each, smax, n_ida, m_ida, p_ida, METRICS,
+            nearest_rank, threading)
+    finally:
+        eng_f.close()
+        eng_u.close()
+    out.update(_bench_fuse_ida_backends(rng, ida_backend, ida_blocks,
+                                        ida_segs, m_ida, p_ida))
+    out.update({
+        "config": "fuse",
+        "metric": f"mixed-kind closed-loop req/s through the FUSED "
+                  f"engine ({workers} workers x {reqs_each} reqs, "
+                  f"fs/get/fi interleaved, {n_peers}-peer ring, "
+                  f"buckets {bucket_min}..{bucket_max})",
+        "unit": "req/sec",
+        "vs_baseline": None,
+        "device": str(jax.devices()[0]),
+    })
+    return _emit(out)
+
+
+def _bench_fuse_phases(eng_f, eng_u, state, store0, rng, put_keys,
+                       seed_segs, workers, reqs_each, smax, n_ida,
+                       m_ida, p_ida, METRICS, nearest_rank,
+                       threading) -> dict:
+    """Phases 1-4 of bench_fuse (closed loops, parity, straddle,
+    retraces); split out so the caller's try/finally owns teardown."""
+    from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+
+    # -- phase 2 first (parity before the storm muddies the logs): one
+    # held mixed burst -> ONE fused batch, byte-exact per kind --------
+    pkeys = _rand_ids(rng, 8)
+    fstart = _rand_ids(rng, 1)[0]
+    eng_f._test_hold.set()
+    try:
+        burst = []
+        for j, k in enumerate(pkeys):
+            burst.append(eng_f.submit("find_successor", (k, 0)))
+            burst.append(eng_f.submit("dhash_get",
+                                      (put_keys[j % len(put_keys)],)))
+            burst.append(eng_f.submit("finger_index", (k, fstart)))
+    finally:
+        eng_f._test_hold.clear()
+    got = [s.wait(600) for s in burst]
+    assert any(e[0] == "fused" for e in list(eng_f.batch_log)[-4:]), \
+        "fuse bench: mixed burst did not dispatch fused"
+    owner, hops = find_successor(state, keys_from_ints(pkeys),
+                                 jnp.zeros(len(pkeys), jnp.int32))
+    owner, hops = np.asarray(owner), np.asarray(hops)
+    want_segs, want_ok = read_batch(
+        state, store0,
+        keys_from_ints([put_keys[j % len(put_keys)]
+                        for j in range(len(pkeys))]),
+        n_ida, m_ida, p_ida)
+    want_segs, want_ok = np.asarray(want_segs), np.asarray(want_ok)
+    for j, k in enumerate(pkeys):
+        assert got[3 * j] == (int(owner[j]), int(hops[j])), \
+            f"fused find_successor parity FAIL at lane {j}"
+        segs_j, ok_j = got[3 * j + 1]
+        assert bool(ok_j) == bool(want_ok[j]) and \
+            (np.asarray(segs_j) == want_segs[j]).all(), \
+            f"fused dhash_get parity FAIL at lane {j}"
+        dist = (k - fstart) % KEYS_IN_RING
+        assert got[3 * j + 2] == (dist.bit_length() - 1 if dist
+                                  else -1), \
+            f"fused finger_index parity FAIL at lane {j}"
+
+    # -- phase 1: the closed-loop win gate ------------------------------
+    loop_keys = _rand_ids(rng, workers * reqs_each)
+
+    def run_loop(eng):
+        lat: list = []
+        lock = threading.Lock()
+        errors: list = []
+
+        def worker(w):
+            wrng = np.random.RandomState(4000 + w)
+            mine = []
+            try:
+                for i in range(reqs_each):
+                    kind = (w + i) % 3
+                    k = loop_keys[w * reqs_each + i]
+                    t0 = time.perf_counter()
+                    if kind == 0:
+                        eng.find_successor(k, 0, timeout=600)
+                    elif kind == 1:
+                        eng.dhash_get(
+                            put_keys[wrng.randint(len(put_keys))],
+                            timeout=600)
+                    else:
+                        eng.finger_index(k, fstart, timeout=600)
+                    mine.append(time.perf_counter() - t0)
+            # chordax-lint: disable=bare-except -- closed-loop worker: a failed request must fail the GATE, not die silently in a thread
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(f"worker {w}: {type(exc).__name__}: {exc}")
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors
+        return (workers * reqs_each) / wall, \
+            nearest_rank(sorted(lat), 0.5), wall
+
+    # Unfused baseline first, fused second (both warmed; order keeps
+    # the fused storm's metrics adjacent to the assertions below).
+    unfused_rps, unfused_p50, unfused_wall = run_loop(eng_u)
+    fused0 = METRICS.counter("serve.fused_batches")
+    fused_rps, fused_p50, fused_wall = run_loop(eng_f)
+    fused_batches = METRICS.counter("serve.fused_batches") - fused0
+    assert fused_batches > 0, \
+        "fuse bench: the mixed storm never dispatched a fused batch"
+    assert not any(e[0] == "fused" for e in eng_u.batch_log), \
+        "fuse bench: the fuse=False baseline dispatched fused batches"
+    speedup = fused_rps / unfused_rps
+    assert speedup >= 1.25, (
+        f"fuse gate FAILED: fused {fused_rps:.1f} req/s is only "
+        f"{speedup:.2f}x the unfused {unfused_rps:.1f} req/s "
+        f"(need >= 1.25x)")
+    assert fused_p50 <= unfused_p50, (
+        f"fuse gate FAILED: fused p50 {fused_p50 * 1e3:.2f}ms is worse "
+        f"than unfused {unfused_p50 * 1e3:.2f}ms")
+
+    # -- phase 3: FIFO straddle ----------------------------------------
+    sk = put_keys[0]
+    new_segs = rng.randint(0, p_ida,
+                           size=(smax, m_ida)).astype(np.int32)
+    log0 = len(eng_f.batch_log)
+    eng_f._test_hold.set()
+    try:
+        g1 = eng_f.submit("dhash_get", (sk,))
+        f1 = eng_f.submit("find_successor", (sk, 0))
+        pslot = eng_f.submit("dhash_put", (sk, new_segs, smax, 0))
+        g2 = eng_f.submit("dhash_get", (sk,))
+        f2 = eng_f.submit("find_successor", (sk, 0))
+    finally:
+        eng_f._test_hold.clear()
+    old_segs, ok1 = g1.wait(600)
+    assert bool(ok1) and (np.asarray(old_segs) == seed_segs[0]).all(), \
+        "straddle FAIL: the pre-put get did not read the old value"
+    assert pslot.wait(600) is True
+    got2, ok2 = g2.wait(600)
+    assert bool(ok2) and \
+        (np.asarray(got2)[:smax] == new_segs).all(), \
+        "straddle FAIL: the post-put get did not read its write"
+    assert f1.wait(600) == f2.wait(600)
+    tail = [e[0] for e in list(eng_f.batch_log)[log0:]]
+    pi = tail.index("dhash_put")
+    assert 0 < pi < len(tail) - 1, (
+        f"straddle FAIL: the put was not strictly between the fused "
+        f"read groups ({tail})")
+
+    # -- phase 4: zero retraces + occupancy telemetry -------------------
+    eng_f.assert_no_retraces()
+    eng_u.assert_no_retraces()
+    hist_totals = METRICS.state()["hist_totals"]
+    assert hist_totals.get("serve.fused_occupancy", 0) > 0, \
+        "fuse bench: serve.fused_occupancy never recorded"
+    assert any(k.startswith("serve.fused_lane_share.")
+               for k in hist_totals), \
+        "fuse bench: per-kind fused lane-share hists never recorded"
+
+    return {
+        "value": round(fused_rps, 1),
+        "fused": {
+            "req_s": round(fused_rps, 1),
+            "p50_ms": round(fused_p50 * 1e3, 3),
+            "wall_s": round(fused_wall, 2),
+            "fused_batches": int(fused_batches),
+        },
+        "unfused_baseline": {
+            "req_s": round(unfused_rps, 1),
+            "p50_ms": round(unfused_p50 * 1e3, 3),
+            "wall_s": round(unfused_wall, 2),
+        },
+        "speedup_x": round(speedup, 2),
+        "parity": "ok (byte-exact all three kinds in one fused batch)",
+        "fifo_straddle": "ok (put splits the fused read groups; "
+                         "read-your-writes holds)",
+        "steady_state_retraces": 0,
+    }
+
+
+def _bench_fuse_ida_backends(rng, ida_backend, blocks, segs, m,
+                             p) -> dict:
+    """Phase 5 of bench_fuse: the parity-gated IDA backend microbench —
+    dot vs MAC vs pallas side-by-side so tpu_watch's on-chip A/B is one
+    re-record away (the r12 verdict's missing measurement). Pallas on
+    CPU parity-checks at a tiny shape through the interpreter and skips
+    TIMING with the availability reason recorded."""
+    n = 14
+    segments = jnp.asarray(
+        rng.randint(0, 256, size=(blocks, segs, m)), jnp.int32)
+    payload_mb = blocks * segs * m / 1e6
+    frags = encode_kernel(segments, n, m, p)
+    sel = np.stack([rng.choice(n, size=m, replace=False)
+                    for _ in range(blocks)])
+    rows = jnp.take_along_axis(
+        frags, jnp.asarray(sel)[:, :, None], axis=1)
+    idx = jnp.asarray(sel + 1, jnp.int32)
+    want = np.asarray(segments)
+
+    recs = {}
+    for name in ida_backend.IDA_BACKENDS:
+        _usable, reason = ida_backend.availability(name)
+        if name == "pallas" and jax.default_backend() == "cpu":
+            tiny = ida_backend.decode(rows[:8, :, :16], idx[:8], p,
+                                      backend=name)
+            assert (np.asarray(tiny) == want[:8, :16, :]).all(), \
+                "pallas (interpret) decode parity FAIL"
+            recs[name] = {"mb_s": None,
+                          "skipped": reason,
+                          "parity": "ok (tiny shape, interpret mode)"}
+            continue
+        got = ida_backend.decode(rows, idx, p, backend=name)
+        assert (np.asarray(got) == want).all(), \
+            f"IDA backend {name!r} decode parity FAIL"
+        t = _time(lambda: (ida_backend.decode(rows, idx, p,
+                                              backend=name),))
+        recs[name] = {"mb_s": round(payload_mb / t, 1), "parity": "ok"}
+    return {"ida_backends": {
+        "default": ida_backend.resolve(),
+        "shape": f"{blocks} blocks x {segs} segs (m={m} p={p})",
+        **recs,
+    }}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -2939,7 +3254,7 @@ def main() -> None:
                     choices=["chord16", "ida", "dhash", "dhash_sharded",
                              "lookup_1m", "sweep_10m", "serve",
                              "gateway", "repair", "membership",
-                             "havoc", "pulse", "fastlane"])
+                             "havoc", "pulse", "fastlane", "fuse"])
     ap.add_argument("--trace", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace per config "
                          "into DIR/<config> (VERDICT r3 #4: evidence-based "
@@ -2992,6 +3307,10 @@ def main() -> None:
                 n_peers=1024, vector_keys=1_000_000, wire_reqs=2,
                 zipf_keys=256, zipf_reqs=400, zipf_workers=2,
                 data_keys=32, bulk_bucket=8192),
+            "fuse": lambda: bench_fuse(
+                n_peers=512, data_keys=64, workers=4, reqs_each=60,
+                bucket_min=8, bucket_max=32, smax=4, ida_blocks=256,
+                ida_segs=32),
         }
     else:
         runs = {
@@ -3008,6 +3327,7 @@ def main() -> None:
             "havoc": bench_havoc,
             "pulse": bench_pulse,
             "fastlane": bench_fastlane,
+            "fuse": bench_fuse,
         }
     if args.config:
         runs = {args.config: runs[args.config]}

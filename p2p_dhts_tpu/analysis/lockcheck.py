@@ -72,6 +72,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "health.py"),
     os.path.join("p2p_dhts_tpu", "havoc.py"),
     os.path.join("p2p_dhts_tpu", "pulse.py"),
+    os.path.join("p2p_dhts_tpu", "ops", "ida_backend.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

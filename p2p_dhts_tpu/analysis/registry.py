@@ -103,10 +103,10 @@ def default_kernels() -> List[KernelSpec]:
                    (state_m.ids, keys, state_m.n_valid)),
         # The serve/gateway finger kernel (serve.ServeEngine's
         # "finger_index" kind — the RPC FINGER_INDEX command's device
-        # path): entry index = bit_length((key - start) mod 2^128) - 1.
+        # path): entry index = bit_length((key - start) mod 2^128) - 1,
+        # the ONE closed-form copy the per-kind and fused paths share.
         KernelSpec("serve.finger_index",
-                   lambda k, s: u128.bit_length(u128.sub(k, s)) - 1,
-                   (keys, keys)),
+                   ring.finger_index_batch, (keys, keys)),
     ]
 
     # The chordax-repair kernels (ISSUE 6): the Merkle-diff comparison
@@ -151,6 +151,38 @@ def default_kernels() -> List[KernelSpec]:
                    (state_cap, churn_ops, churn_lanes)),
         KernelSpec("membership.stabilize_sweep", mk.stabilize_round,
                    (state_cap,)),
+    ]
+
+    # The chordax-fuse kernels (ISSUE 13): the multi-kind super-batch
+    # programs (the ServeEngine's fused dispatch path — one program
+    # answering a mixed FIND_SUCCESSOR/GET/FINGER_INDEX burst) and the
+    # selectable IDA decode backends — the new hot-path entry points a
+    # GSPMD miscompile would silently corrupt. The fused specs ALSO
+    # cover the cross-module edge the fused queue introduced
+    # (serve -> ring + store under one jit); the lock-order half of
+    # that audit rides lockcheck.DEFAULT_LOCK_MODULES (serve.py /
+    # gateway/* / ops/ida_backend.py).
+    from p2p_dhts_tpu.ops import ida_backend
+    dec_rows = jnp.zeros((batch, 10, 8), jnp.int32)
+    dec_idx = jnp.broadcast_to(
+        jnp.arange(1, 11, dtype=jnp.int32), (batch, 10))
+
+    specs += [
+        KernelSpec("core.ring.fused_lookup",
+                   ring.fused_lookup_batch,
+                   (state_m, keys, starts, keys, keys)),
+        KernelSpec("serve.fused_read",
+                   lambda s, st, k, r: dstore.fused_read_batch(
+                       s, st, k, r, k, k, k),
+                   (state_m, store, keys, starts)),
+        KernelSpec("ops.ida_backend.decode[dot]",
+                   lambda r, i: ida_backend.decode_body(r, i, 257,
+                                                        "dot"),
+                   (dec_rows, dec_idx)),
+        KernelSpec("ops.ida_backend.decode[mac]",
+                   lambda r, i: ida_backend.decode_body(r, i, 257,
+                                                        "mac"),
+                   (dec_rows, dec_idx)),
     ]
 
     if mesh is not None:

@@ -873,6 +873,47 @@ def find_successor_unroll2(state: RingState, keys: jax.Array,
                         structured_pred=True, unroll=2)
 
 
+@jax.jit
+def finger_index_batch(keys: jax.Array, starts: jax.Array) -> jax.Array:
+    """Batched finger-table entry index: for each (key, table_start)
+    lane pair, bit_length((key - start) mod 2^128) - 1 — the closed form
+    of FingerTable::Lookup's 128-entry containing-range scan
+    (finger_table.h:115-130), -1 for the zero-distance LookupError case.
+
+    keys / starts: [B, 4] u32 lane vectors. THE single device-side copy
+    of the overlay bridge op: serve.ServeEngine's "finger_index" kind
+    and the fused multi-kind read kernels (chordax-fuse) both resolve
+    through it, so the closed form can never fork.
+    """
+    return u128.bit_length(u128.sub(keys, starts)) - 1
+
+
+@functools.partial(jax.jit, static_argnames=("max_hops",))
+def fused_lookup_batch(state: RingState, fs_keys: jax.Array,
+                       fs_starts: jax.Array, fi_keys: jax.Array,
+                       fi_starts: jax.Array,
+                       max_hops: Optional[int] = None
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """chordax-fuse: the store-less multi-kind super-batch program —
+    successor search and the finger closed form under ONE jit, so a
+    mixed FIND_SUCCESSOR + FINGER_INDEX burst costs one XLA dispatch
+    instead of one per kind.
+
+    Per-kind input blocks (fs_keys/fs_starts for the lookup lanes,
+    fi_keys/fi_starts for the finger lanes) are padded by the caller to
+    one shared bucket; the per-lane kind selector lives host-side in
+    the ServeEngine's fused batch plan (it decides block membership and
+    result fan-out — the device program stays selector-free so each
+    sub-computation only touches its own block's lanes, keeping the
+    fused program's arithmetic equal to the per-kind dispatches it
+    replaces). Returns (owner [B], hops [B], finger_idx [B]) —
+    byte-identical to find_successor + finger_index_batch run apart.
+    The store-carrying triple lives in dhash.store.fused_read_batch.
+    """
+    owner, hops = find_successor(state, fs_keys, fs_starts, max_hops)
+    return owner, hops, finger_index_batch(fi_keys, fi_starts)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def owner_of(state: RingState, keys: jax.Array) -> jax.Array:
     """Omniscient 0-hop ownership: row of the ring successor of each key.

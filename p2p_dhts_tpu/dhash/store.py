@@ -28,6 +28,8 @@ import jax.numpy as jnp
 
 from p2p_dhts_tpu.core.ring import (
     RingState,
+    find_successor,
+    finger_index_batch,
     get_n_successors,
     n_successors_converged,
     placement_converged,
@@ -360,3 +362,49 @@ def read_batch(ring: RingState, store: FragmentStore, keys: jax.Array,
         segments = decode_kernel(rows, idx, p)                     # [B, S, m]
     segments = jnp.where(ok[:, None, None], segments, 0)
     return segments, ok
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "m", "p", "adaptive_decode"))
+def fused_read_batch(ring: RingState, store: FragmentStore,
+                     fs_keys: jax.Array, fs_starts: jax.Array,
+                     get_keys: jax.Array, fi_keys: jax.Array,
+                     fi_starts: jax.Array, n: int = 14, m: int = 10,
+                     p: int = 257, adaptive_decode: Optional[bool] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                jax.Array, jax.Array]:
+    """chordax-fuse: the multi-kind super-batch read program — successor
+    search, store read, and the finger closed form under ONE jit, so a
+    mixed FIND_SUCCESSOR + GET + FINGER_INDEX burst costs one XLA
+    dispatch (and one device round trip) instead of one per kind.
+
+    Per-kind input blocks, each padded by the caller to one shared
+    bucket:
+
+      fs_keys [B, 4] u32 + fs_starts [B] i32   — lookup lanes
+      get_keys [B, 4] u32                      — store-read lanes
+      fi_keys / fi_starts [B, 4] u32           — finger lanes
+
+    The per-lane kind selector lives HOST-side, in the ServeEngine's
+    fused batch plan: it decides which block a queued request's lanes
+    land in and how the per-kind output blocks fan back out. Keeping
+    the selector off the device means each sub-computation reads only
+    its own block — the fused program's arithmetic equals the sum of
+    the per-kind dispatches it replaces (a device-side selector over
+    one shared lane array would run every kind's math on every lane,
+    tripling the work to save nothing). An absent kind's block is a
+    replicated dummy row, exactly the bucket-pad rule: a repeat, never
+    a new action — all three sub-kernels are read-only, so a dummy
+    lane can't perturb the ring or the store.
+
+    Returns (owner [B], hops [B], segments [B, S, m], ok [B],
+    finger_idx [B]) — byte-identical to find_successor + read_batch +
+    finger_index_batch dispatched apart (the parity the fuse bench and
+    tests pin). The store-less pair program is
+    core.ring.fused_lookup_batch.
+    """
+    owner, hops = find_successor(ring, fs_keys, fs_starts)
+    segments, ok = read_batch(ring, store, get_keys, n, m, p,
+                              adaptive_decode)
+    return owner, hops, segments, ok, finger_index_batch(fi_keys,
+                                                         fi_starts)

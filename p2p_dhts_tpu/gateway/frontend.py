@@ -845,23 +845,38 @@ class Gateway:
                 out[i] = (owner, hops, rid)
         return out  # type: ignore[return-value]
 
+    def _finger_backend_for(self, ring_id: Optional[str]) -> RingBackend:
+        """chordax-fuse (ISSUE 13): finger_index is stateless, so a
+        caller naming a RING serves it through that ring's engine —
+        landing finger lookups in the SAME fused queue as the ring's
+        FIND_SUCCESSOR/GET traffic, where a mixed burst coalesces into
+        one multi-kind program. Identical answers either way (one
+        closed form, core.ring.finger_index_batch); callers opting in
+        should warm "finger_index" on that ring. Default (no ring):
+        the process-shared finger engine, unchanged."""
+        if ring_id is not None:
+            return self.router.get(ring_id)
+        return self._get_finger_backend()
+
     def finger_index(self, key, table_start, *,
+                     ring_id: Optional[str] = None,
                      timeout: Optional[float] = None,
                      deadline: Optional[Deadline] = None) -> int:
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
-        backend = self._get_finger_backend()
+        backend = self._finger_backend_for(ring_id)
         return self._serve_many(
             backend, "finger_index",
             [(_key_int(key), _key_int(table_start))], dl)[0]
 
     def finger_index_many(self, payloads: Sequence[tuple], *,
+                          ring_id: Optional[str] = None,
                           timeout: Optional[float] = None,
                           deadline: Optional[Deadline] = None
                           ) -> List[int]:
         dl = deadline if deadline is not None \
             else Deadline.from_timeout(timeout)
-        backend = self._get_finger_backend()
+        backend = self._finger_backend_for(ring_id)
         return self._serve_many(
             backend, "finger_index",
             [(_key_int(k), _key_int(s)) for k, s in payloads], dl)
@@ -1602,6 +1617,10 @@ class Gateway:
 
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
+        # chordax-fuse: RING opts the lookup into that ring's engine
+        # (and its fused multi-kind queue); absent RING keeps the
+        # shared finger engine — the reference wire shape unchanged.
+        ring_id = req.get("RING")
         if "KEYS" in req:
             keys = req["KEYS"]
             # Explicit None/empty check: numpy TABLE_STARTS (binary
@@ -1621,7 +1640,7 @@ class Gateway:
                             "TABLE_STARTS length must match KEYS")
                     if lanes.shape[0] == 0:
                         return {"INDICES": np.zeros(0, np.int32)}
-                    backend = self._get_finger_backend()
+                    backend = self._finger_backend_for(ring_id)
                     idx = self._serve_many(
                         backend, "finger_index",
                         _VectorRun(lanes, slanes), dl)
@@ -1633,10 +1652,11 @@ class Gateway:
             if len(starts) != len(keys):
                 raise ValueError("TABLE_STARTS length must match KEYS")
             idx = self.finger_index_many(list(zip(keys, starts)),
-                                         deadline=dl)
+                                         ring_id=ring_id, deadline=dl)
             return {"INDICES": np.asarray(idx, dtype=np.int32)}
         return {"INDEX": self.finger_index(
-            req["KEY"], req.get("TABLE_START", 0), deadline=dl)}
+            req["KEY"], req.get("TABLE_START", 0), ring_id=ring_id,
+            deadline=dl)}
 
     def close(self, drain: bool = True) -> None:
         """Close every registered ring's engine (the shared finger

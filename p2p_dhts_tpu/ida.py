@@ -107,38 +107,41 @@ def decode_kernel(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
     slice). The inverse Vandermonde is computed in-graph so decodes with
     heterogeneous index sets batch together.
 
-    DEFAULT PATH is platform-split at trace time (round 5, per
-    measurement on both platforms — the orderings are INVERTED):
-      * TPU: the VPU multiply-accumulate. Lowering the per-block tiny
-        [m, m] @ [m, S] through dot_general pads every batch element to
-        full MXU systolic tiles — measured 93.3 MB/s on v5e against
-        22 GB/s encode (BENCH_ATTEMPT_r04.jsonl).
-      * CPU: dot_general. XLA:CPU has no tile-padding cliff and runs
-        the batched tiny dot at full speed, while the unrolled MAC
-        measured ~250x slower there (BENCH_NOTES_r05: 100.7 vs 0.4
-        MB/s at the bench shape).
-    The dot path stays callable as ``decode_kernel_dot`` and bench.py
-    measures both on whatever platform it runs.
+    DEFAULT PATH resolves through the ops.ida_backend registry
+    (chordax-fuse, ISSUE 13) AT TRACE TIME — the same moment the old
+    hardcoded platform split fired, so unconfigured behavior is
+    byte-identical to rounds 5-12:
+      * TPU -> "mac", the VPU multiply-accumulate. Lowering the
+        per-block tiny [m, m] @ [m, S] through dot_general pads every
+        batch element to full MXU systolic tiles — measured 93.3 MB/s
+        on v5e against 22 GB/s encode (BENCH_ATTEMPT_r04.jsonl).
+      * CPU -> "dot", dot_general. XLA:CPU has no tile-padding cliff
+        and runs the batched tiny dot at full speed, while the
+        unrolled MAC measured ~250x slower there (BENCH_NOTES_r05:
+        100.7 vs 0.4 MB/s at the bench shape).
+    Override with ida_backend.set_backend(...) or
+    CHORDAX_IDA_BACKEND=dot|mac|pallas|auto BEFORE the first decode
+    traces (this jit's cache does not key on the knob; for a per-call
+    choice use ida_backend.decode). The dot path stays callable as
+    ``decode_kernel_dot`` and bench.py measures every backend
+    side-by-side on whatever platform it runs.
     """
-    inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
-    if jax.default_backend() == "cpu":  # trace-time platform choice
-        out = modp.mod_matmul(inv, rows, p)              # [..., m, S]
-    else:
-        out = modp.mod_matmul_batched_tiny(inv, rows, p)
-    return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
+    from p2p_dhts_tpu.ops import ida_backend
+    return ida_backend.decode_body(rows, indices, p,
+                                   ida_backend.resolve())
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
 def decode_kernel_dot(rows: jax.Array, indices: jax.Array,
                       p: int) -> jax.Array:
-    """decode_kernel through dot_general — the pre-round-5 default, kept
-    as the measured fallback (bench.py reports it as decode_dot_mb_s).
-    On batched tiny shapes the MXU pads ~99% of each tile (the 93 MB/s
-    cliff); XLA:CPU shows the same ordering, so the VPU path is the
-    default on every platform."""
-    inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
-    out = modp.mod_matmul(inv, rows, p)                  # [..., m, S]
-    return jnp.swapaxes(out, -1, -2)                     # [..., S, m]
+    """decode_kernel pinned to the "dot" registry backend — the
+    pre-round-5 default, kept as the measured fallback (bench.py
+    reports it as decode_dot_mb_s). On batched tiny shapes the MXU
+    pads ~99% of each tile (the 93 MB/s cliff). ONE body: the registry
+    owns every decode implementation (chordax-fuse), so the paths can
+    never fork."""
+    from p2p_dhts_tpu.ops import ida_backend
+    return ida_backend.decode_body(rows, indices, p, "dot")
 
 
 @functools.partial(jax.jit, static_argnames=("p",))

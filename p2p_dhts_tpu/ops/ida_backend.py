@@ -1,0 +1,171 @@
+"""Selectable IDA decode backends (chordax-fuse, ISSUE 13).
+
+The per-block IDA decode has THREE implementations with wildly
+different hardware profiles, and since round 5 the choice has been a
+trace-time platform split buried inside ``ida.decode_kernel``:
+
+  * ``dot``    — inverse-Vandermonde then ``modp.mod_matmul``
+                 (dot_general). Fastest on XLA:CPU; on TPU the batched
+                 tiny [m, m] @ [m, S] pads every batch element to full
+                 MXU systolic tiles (the measured 93.3 MB/s cliff,
+                 BENCH_ATTEMPT_r04 / BENCH_NOTES_r12.md).
+  * ``mac``    — ``modp.mod_matmul_batched_tiny``, the unrolled VPU
+                 multiply-accumulate. Dodges the MXU cliff on TPU;
+                 ~250x slower than dot on CPU (BENCH_NOTES_r05).
+  * ``pallas`` — ``ops.modp_pallas.decode_kernel_pallas``, the whole
+                 per-block pipeline (Lagrange synthetic division,
+                 Fermat inverse, scale, matmul) fused in VMEM. Written
+                 in round 5 but never first-class selectable; compiled
+                 Mosaic needs a TPU — on CPU it runs interpret-mode
+                 (parity yes, speed no).
+
+This registry makes the choice FIRST-CLASS: resolution order is an
+explicit per-call ``backend=`` argument, then the process-wide
+``set_backend()`` override, then the ``CHORDAX_IDA_BACKEND`` env var,
+then the measured platform default (``dot`` on CPU, ``mac``
+otherwise — exactly the round-5 split, so an unconfigured process
+behaves byte-for-byte as before). ``"auto"`` names the platform
+default explicitly. ``ida.decode_kernel`` resolves through here AT
+TRACE TIME (the same moment the old platform split fired), so set the
+backend before the first decode traces; ``decode()`` below keys its
+jit cache on the backend name and honors a flip at any time — the
+parity-gated microbench (``bench.py --config fuse``) measures all
+three side by side through it.
+
+All three backends are exact under the same bound the kernels enforce
+(m * (p-1)^2 < 2^24 for the f32 paths); byte-identical fragments are
+pinned by tests/test_fuse.py and the fuse bench's parity gate.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.ops import modp
+
+#: Environment knob: CHORDAX_IDA_BACKEND=dot|mac|pallas|auto.
+ENV_VAR = "CHORDAX_IDA_BACKEND"
+
+#: The selectable concrete backends ("auto" resolves to one of these).
+IDA_BACKENDS = ("dot", "mac", "pallas")
+
+_lock = threading.Lock()
+_configured: Optional[str] = None
+
+
+def set_backend(name: Optional[str]) -> None:
+    """Install a process-wide backend override (None clears it back to
+    env-var/platform resolution). Validates eagerly — a typo must fail
+    here, not as a KeyError inside a trace."""
+    global _configured
+    if name is not None and name != "auto" and name not in IDA_BACKENDS:
+        raise ValueError(
+            f"unknown IDA backend {name!r}; choose one of "
+            f"{IDA_BACKENDS + ('auto',)}")
+    with _lock:
+        _configured = name
+
+
+def configured() -> Optional[str]:
+    with _lock:
+        return _configured
+
+
+def platform_default() -> str:
+    """The measured round-5 platform split: dot rides XLA:CPU's fast
+    batched tiny dot; everything else dodges the MXU padding cliff on
+    the VPU MAC path (ida.decode_kernel's historical behavior)."""
+    return "dot" if jax.default_backend() == "cpu" else "mac"
+
+
+def resolve(name: Optional[str] = None) -> str:
+    """Concrete backend name for this call: explicit arg > set_backend
+    > CHORDAX_IDA_BACKEND > platform default. "auto" (at any level)
+    short-circuits to the platform default."""
+    for cand in (name, configured(), os.environ.get(ENV_VAR)):
+        if cand:
+            if cand == "auto":
+                return platform_default()
+            if cand not in IDA_BACKENDS:
+                raise ValueError(
+                    f"unknown IDA backend {cand!r}; choose one of "
+                    f"{IDA_BACKENDS + ('auto',)}")
+            return cand
+    return platform_default()
+
+
+def availability(name: str) -> Tuple[bool, str]:
+    """(usable, reason). Every backend is *callable* everywhere; the
+    reason string says at what cost — the fuse bench surfaces it when
+    it skips timing a backend (pallas on CPU runs interpret-mode:
+    parity holds but the numbers would measure the interpreter, not
+    the kernel)."""
+    if name in ("dot", "mac"):
+        return True, "pure XLA (portable)"
+    if name == "pallas":
+        if jax.default_backend() == "cpu":
+            return True, ("interpret-mode only on CPU (compiled Mosaic "
+                          "needs a TPU): parity holds, timing would "
+                          "measure the interpreter")
+        return True, "compiled Mosaic kernel (VMEM-fused)"
+    raise ValueError(f"unknown IDA backend {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# the three decode bodies — plain traceable functions, shared by the
+# jitted public entry point below AND by ida.decode_kernel's trace
+# ---------------------------------------------------------------------------
+
+def _decode_dot(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
+    inv = modp.vandermonde_inverse(indices, p)           # [..., m, m]
+    return jnp.swapaxes(modp.mod_matmul(inv, rows, p), -1, -2)
+
+
+def _decode_mac(rows: jax.Array, indices: jax.Array, p: int) -> jax.Array:
+    inv = modp.vandermonde_inverse(indices, p)
+    return jnp.swapaxes(modp.mod_matmul_batched_tiny(inv, rows, p),
+                        -1, -2)
+
+
+def _decode_pallas(rows: jax.Array, indices: jax.Array,
+                   p: int) -> jax.Array:
+    # Deferred import: pallas pulls jax.experimental machinery no
+    # dot/mac caller should pay for. Interpret mode on CPU — the
+    # kernel body runs as composed jax ops, so it nests fine inside
+    # an outer jit (tests/test_ida.py's existing parity discipline).
+    from p2p_dhts_tpu.ops.modp_pallas import decode_kernel_pallas
+    return decode_kernel_pallas(
+        rows, indices, p, interpret=jax.default_backend() == "cpu")
+
+
+_IMPLS = {"dot": _decode_dot, "mac": _decode_mac,
+          "pallas": _decode_pallas}
+
+
+def decode_body(rows: jax.Array, indices: jax.Array, p: int,
+                backend: str) -> jax.Array:
+    """The traceable dispatch (backend already concrete): [B, m, S]
+    int32 fragment rows + [B, m] 1-based indices -> [B, S, m] decoded
+    segments. dot/mac accept arbitrary leading batch dims; pallas is
+    3-D (its tile grid is rank-fixed)."""
+    return _IMPLS[backend](rows, indices, p)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "backend"))
+def _decode_jit(rows, indices, p, backend):
+    return decode_body(rows, indices, p, backend)
+
+
+def decode(rows, indices, p: int, backend: Optional[str] = None):
+    """Public selectable decode: resolve the backend (per-call arg >
+    set_backend > env > platform default), then dispatch through a
+    jit keyed on the concrete name — flipping the backend mid-process
+    re-routes the NEXT call (unlike ida.decode_kernel, whose choice is
+    baked at trace time)."""
+    return _decode_jit(rows, indices, p, backend=resolve(backend))

@@ -51,6 +51,24 @@ semantics — hop parity is non-negotiable):
     exactly its own caller while its former batch-mates succeed
     (counted `serve.quarantined`). Obligation: coalescing is a
     scheduling choice — it must not widen any request's blast radius.
+  * MULTI-KIND SUPER-BATCH FUSION (ISSUE 13, chordax-fuse) — a head
+    run of the queue spanning >= 2 read-only kinds (FUSE_KINDS:
+    find_successor / dhash_get / finger_index, scalar slots and vector
+    chunks alike) dispatches as ONE pre-traced fused program — per-kind
+    key-lane blocks at a shared power-of-two bucket, the kind selector
+    resolved host-side, per-kind output blocks fanned back per slot —
+    instead of one XLA call per kind (what a mixed gateway RPC burst
+    otherwise costs). Obligation: fusion is read-side ONLY — mutators
+    end the fused run, so FIFO across the fused group and any
+    straddling put/churn batch is exactly the unfused engine's (the
+    straddle regression test pins it), and every kind's answer is
+    byte-identical to its per-kind dispatch (same kernels, same pad
+    rule). The fused program pre-traces when warmup names "fused" (or
+    via the warm-everything default); an engine warmed WITHOUT it
+    keeps the kind-by-kind drain — the zero-retrace contract outranks
+    fusion — while a never-warmed engine fuses on demand. Counted
+    `serve.fused_batches`, occupancy under `serve.fused_occupancy` +
+    per-kind `serve.fused_lane_share.<kind>`.
 
 Request kinds:
 
@@ -131,6 +149,18 @@ KINDS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
 #: whose wire form is a packed u128 run. Mutators keep the per-payload
 #: path (their validation/normalization is inherently per entry).
 VECTOR_KINDS = ("find_successor", "dhash_get", "finger_index")
+
+#: chordax-fuse (ISSUE 13): the read-only kinds the dispatcher may
+#: coalesce ACROSS into one pre-traced multi-kind super-batch program —
+#: the same set as VECTOR_KINDS (read-only, shape-compatible key
+#: lanes). A head run of the queue spanning >= 2 of these dispatches
+#: as ONE fused XLA program (per-kind input blocks at a shared bucket,
+#: host-side kind selector, per-kind output blocks) instead of one
+#: dispatch per kind. Mutators never fuse: they chain state/store and
+#: their FIFO position is load-bearing — a mutator in the queue ends
+#: the fused run, so a read submitted after a put still observes the
+#: put (the straddle rule, regression-tested).
+FUSE_KINDS = VECTOR_KINDS
 
 #: Kinds that mutate the engine's store or ring state: they stay off
 #: the caller-inline fast path (their read-modify-write must never
@@ -254,9 +284,23 @@ class ServeEngine:
                  max_queue: int = 65536,
                  merkle_depth: int = 4, merkle_fanout_bits: int = 3,
                  metrics: Optional[Metrics] = None,
+                 fuse: bool = True,
                  name: str = "serve"):
         self._state = state
         self._store = store
+        # chordax-fuse (ISSUE 13): multi-kind super-batch dispatch. ON
+        # by default wherever the engine can serve >= 2 of FUSE_KINDS
+        # (a RingState unlocks find_successor alongside the stateless
+        # finger_index; a store adds dhash_get). fuse=False keeps the
+        # kind-by-kind drain — the bench's unfused baseline.
+        self._fuse = bool(fuse) and state is not None
+        # The fused program pre-traces only when warmup asks for it
+        # ("fused" in the kinds list, or the warm-everything default).
+        # An engine warmed WITHOUT it keeps the kind-by-kind drain —
+        # the zero-retrace contract outranks fusion — while an engine
+        # that never warmed fuses on demand (it has no contract to
+        # break, and the first mixed burst simply compiles).
+        self._fused_warmed = False
         self._ida = (int(n), int(m), int(p))
         self._merkle = (int(merkle_depth), int(merkle_fanout_bits))
         self._window_cap_s = float(window_cap_s)
@@ -328,7 +372,11 @@ class ServeEngine:
         # jit plumbing, built lazily (importing this module must not
         # touch jax — overlay etiquette, jax_bridge docstring).
         self._kernels: Dict[str, Any] = {}
-        self._trace_counts: Dict[str, int] = {k: 0 for k in KINDS}
+        # "fused" is the multi-kind super-batch program's recompile
+        # counter — a pseudo-kind for trace accounting only (never
+        # submittable).
+        self._trace_counts: Dict[str, int] = {
+            k: 0 for k in KINDS + ("fused",)}
         self._warmup_trace_counts: Optional[Dict[str, int]] = None
         self._late_errors: List[BaseException] = []
 
@@ -727,7 +775,26 @@ class ServeEngine:
         import numpy as np
 
         if kinds is None:
+            # Warm-everything default: every submittable kind, plus the
+            # fused super-batch program when the engine can fuse.
             kinds = [k for k in KINDS if self._kind_available(k)]
+            want_fused = self._fuse and \
+                len([k for k in kinds if k in FUSE_KINDS]) >= 2
+        else:
+            # Explicit lists warm exactly what they name: the fused
+            # program costs a per-bucket compile of ALL the read
+            # kernels combined, so only callers expecting mixed head
+            # runs pay for it (pseudo-kind "fused"). An engine warmed
+            # without it keeps the kind-by-kind drain — zero retraces
+            # stay guaranteed either way.
+            kinds = list(kinds)
+            want_fused = "fused" in kinds
+            if want_fused:
+                kinds = [k for k in kinds if k != "fused"]
+                if not self._fuse:
+                    raise ValueError(
+                        "cannot warm 'fused': the engine cannot fuse "
+                        "(fuse=False, or no RingState)")
         for kind in kinds:
             if not self._kind_available(kind):
                 raise ValueError(f"cannot warm {kind!r}: engine lacks "
@@ -735,6 +802,15 @@ class ServeEngine:
         for kind in kinds:
             for b in self._buckets:
                 self._warm_one(kind, b, np)
+        if want_fused:
+            for b in self._buckets:
+                self._warm_fused(b, np)
+            # Armed only once EVERY bucket is traced: the engine may
+            # already be serving, and flipping mid-loop would let a
+            # mixed burst dispatch fused at a not-yet-warmed bucket —
+            # compiling on the dispatch path, exactly what the
+            # _pop_batch gate exists to prevent.
+            self._fused_warmed = True
         with self._lock:
             self._warmup_trace_counts = dict(self._trace_counts)
         return dict(self._trace_counts)
@@ -818,6 +894,23 @@ class ServeEngine:
             _, repaired = kern["dhash_maintain"](self._state, shadow)
             np.asarray(repaired)
 
+    def _warm_fused(self, b: int, np) -> None:
+        """Pre-trace the fused multi-kind program at bucket b: all-zero
+        blocks (every sub-kernel is read-only, so a dummy lane is a
+        harmless repeated lookup/read/finger — the pad rule)."""
+        kern = self._get_kernels()
+        if "fused" not in kern:
+            return
+        jnp = kern["jnp"]
+        keys = jnp.asarray(np.zeros((b, 4), np.uint32))
+        rows = jnp.asarray(np.zeros((b,), np.int32))
+        if self._store is not None:
+            out = kern["fused"](self._state, self._store, keys, rows,
+                                keys, keys, keys)
+        else:
+            out = kern["fused"](self._state, keys, rows, keys, keys)
+        np.asarray(out[0])
+
     @property
     def trace_counts(self) -> Dict[str, int]:
         with self._lock:
@@ -847,6 +940,25 @@ class ServeEngine:
         """Largest dispatch bucket — also the row width submit_vector
         chunks at (the gateway charges vector admission per chunk)."""
         return self._bucket_max
+
+    @property
+    def fuse_enabled(self) -> bool:
+        """True while the dispatcher MAY coalesce mixed read-kind head
+        runs into one fused program (chordax-fuse) — the capability
+        knob. On an engine that warmed per-kind programs only, fusion
+        additionally waits for the fused program to be pre-traced
+        (warmup with "fused"; see `fused_warmed`) so it can never
+        violate the zero-retrace contract. The fastlane bench asserts
+        this so the vector path can never silently bypass the fused
+        queue."""
+        return self._fuse
+
+    @property
+    def fused_warmed(self) -> bool:
+        """True once the fused super-batch program is pre-traced for
+        every bucket (warmup with "fused" in the kinds, or the
+        warm-everything default on a fuse-capable engine)."""
+        return self._fused_warmed
 
     @property
     def window_s(self) -> float:
@@ -911,8 +1023,6 @@ class ServeEngine:
             import jax
             import jax.numpy as jnp
 
-            from p2p_dhts_tpu.ops import u128
-
             # Buffer donation frees the per-bucket key/start inputs for
             # XLA reuse; CPU ignores donation with a warning per
             # program, so only donate on real-device backends.
@@ -925,12 +1035,13 @@ class ServeEngine:
                 # contract needs.
                 self._trace_counts[kind] += 1
 
+            from p2p_dhts_tpu.core import ring as ring_mod
+
             def finger_index(keys, starts):
                 count("finger_index")
-                dist = u128.sub(keys, starts)
-                return u128.bit_length(dist) - 1
-
-            from p2p_dhts_tpu.core import ring as ring_mod
+                # THE single closed-form copy (ring.finger_index_batch)
+                # — the per-kind and fused paths can never fork.
+                return ring_mod.finger_index_batch(keys, starts)
 
             def find_succ(state, keys, starts):
                 count("find_successor")
@@ -984,6 +1095,28 @@ class ServeEngine:
                 return maint_mod.local_maintenance(state, store, starts,
                                                    n, m, p)
 
+            # chordax-fuse (ISSUE 13): the multi-kind super-batch
+            # program. One variant per engine shape — the store triple
+            # (find_successor + dhash_get + finger_index) or the
+            # store-less pair (find_successor + finger_index) — so
+            # every fused dispatch hits ONE pre-traced program per
+            # bucket regardless of which kinds a given head run mixes
+            # (an absent kind's block is dummy lanes, never a new
+            # program signature).
+            def fused_read(state, store, fs_keys, fs_starts, get_keys,
+                           fi_keys, fi_starts):
+                count("fused")
+                return store_mod.fused_read_batch(
+                    state, store, fs_keys, fs_starts, get_keys, fi_keys,
+                    fi_starts, n, m, p)
+
+            def fused_lookup(state, fs_keys, fs_starts, fi_keys,
+                             fi_starts):
+                count("fused")
+                return ring_mod.fused_lookup_batch(state, fs_keys,
+                                                   fs_starts, fi_keys,
+                                                   fi_starts)
+
             self._kernels = {
                 "jnp": jnp,
                 "np": np,
@@ -1010,6 +1143,12 @@ class ServeEngine:
                 "stabilize_sweep": jax.jit(stabilize_sweep),
                 "dhash_maintain": jax.jit(dhash_maintain),
             }
+            if self._state is not None:
+                # The fused program reads (never chains) state + store,
+                # so nothing is donated — same rule as dhash_get.
+                self._kernels["fused"] = jax.jit(
+                    fused_read if self._store is not None
+                    else fused_lookup)
         return self._kernels
 
     # -- dispatch loop ------------------------------------------------------
@@ -1131,19 +1270,57 @@ class ServeEngine:
     def _pop_batch(self) -> List[_Slot]:
         """Head run of same-kind requests, up to bucket_max — FIFO
         across kinds, so a get submitted after a put completes against
-        the post-put store."""
+        the post-put store. chordax-fuse (ISSUE 13): a head run
+        SPANNING >= 2 read-only kinds (FUSE_KINDS, scalar slots and
+        vector chunks alike) pops as one FUSED group instead — a
+        single multi-kind program replaces the per-kind dispatches. A
+        mutator (or a quarantined retry) in the queue still ends the
+        run, so fusion can never reorder a read across a write."""
         with self._lock:
             if not self._pending:
                 return []
             kind = self._pending[0].kind
             batch = []
-            if self._pending[0].retried or self._pending[0].vec:
+            # Fuse only when it cannot retrace a WARMED steady state:
+            # either the fused program was pre-traced, or the engine
+            # never warmed (no contract — the first mixed burst just
+            # compiles).
+            if (self._fuse and kind in FUSE_KINDS
+                    and (self._fused_warmed
+                         or self._warmup_trace_counts is None)
+                    and not self._pending[0].retried):
+                # Scan (without popping) the head run of fusable slots,
+                # bounding each kind's lane total at bucket_max; only a
+                # genuinely MIXED run (>= 2 kinds) pops fused — a
+                # single-kind run keeps the existing scalar/vector
+                # paths (fusing it would buy nothing and cost dummy
+                # blocks).
+                lanes = {k: 0 for k in FUSE_KINDS}
+                kinds_seen = set()
+                take = 0
+                for slot in self._pending:
+                    if slot.retried or slot.kind not in FUSE_KINDS:
+                        break
+                    nl = slot.vec or 1
+                    if lanes[slot.kind] + nl > self._bucket_max:
+                        break
+                    lanes[slot.kind] += nl
+                    kinds_seen.add(slot.kind)
+                    take += 1
+                if len(kinds_seen) >= 2:
+                    batch = [self._pending.popleft()
+                             for _ in range(take)]
+            if batch:
+                pass
+            elif self._pending[0].retried or self._pending[0].vec:
                 # A quarantined slot dispatches ALONE: its one solo
                 # retry must not take fresh batch-mates down with it.
                 # A VECTOR chunk is likewise its own (already full-
                 # width) batch — coalescing scalar slots into it would
                 # mean per-key python re-assembly, the exact cost the
-                # fast lane exists to remove.
+                # fast lane exists to remove. (A vec chunk CAN ride a
+                # fused group above: there it joins as a whole array —
+                # one concatenate, still zero per-key python.)
                 batch.append(self._pending.popleft())
             else:
                 while (self._pending and len(batch) < self._bucket_max
@@ -1183,6 +1360,14 @@ class ServeEngine:
         from p2p_dhts_tpu import keyspace
         kern = self._get_kernels()
         jnp, np = kern["jnp"], kern["np"]
+        # chordax-fuse: a multi-kind group (or a degenerate one-kind
+        # remnant that still mixes vector chunks with scalar slots —
+        # deadline shedding can leave that) dispatches as ONE fused
+        # program. The one-program-per-engine-shape rule means even the
+        # degenerate shapes hit the pre-traced fused program.
+        if len({s.kind for s in batch}) >= 2 or (
+                len(batch) > 1 and any(s.vec for s in batch)):
+            return self._launch_fused(batch, kern, jnp, np)
         if batch[0].vec:
             return self._launch_vector(batch[0], kern, jnp, np)
         kind = batch[0].kind
@@ -1421,6 +1606,128 @@ class ServeEngine:
         starts = jnp.asarray(pad_rows(slot.payload[1]))
         return ("vec", kind, c, kern["finger_index"](keys, starts))
 
+    @staticmethod
+    def _fused_block(slots: List[_Slot], b: int, np, pos: int,
+                     convert, empty):
+        """One kind's padded input block for a fused dispatch: scalar
+        payloads convert in contiguous runs (ONE `convert(values)` call
+        per run), vector chunks pass through as whole arrays (zero
+        per-key python — the fastlane contract survives fusion), pad
+        rows replicate row 0. An EMPTY kind's block is `empty` (dummy
+        lanes/rows). `pos` picks the payload field (0 = keys, 1 =
+        finger table-start lanes / find_successor start rows)."""
+        if not slots:
+            return empty
+        arrs, vals = [], []
+        for slot in slots:
+            if slot.vec:
+                if vals:
+                    arrs.append(convert(vals))
+                    vals = []
+                arrs.append(np.asarray(slot.payload[pos]))
+            else:
+                vals.append(slot.payload[pos])
+        if vals:
+            arrs.append(convert(vals))
+        block = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+        pad = b - block.shape[0]
+        if pad:
+            block = np.concatenate(
+                [block,
+                 np.broadcast_to(block[:1], (pad,) + block.shape[1:])])
+        return block
+
+    def _fused_key_block(self, slots: List[_Slot], b: int, np,
+                         keyspace, pos: int):
+        """[b, 4] u32 key-lane block (keys, or finger start lanes)."""
+        return self._fused_block(slots, b, np, pos,
+                                 keyspace.ints_to_lanes,
+                                 np.zeros((b, 4), np.uint32))
+
+    def _fused_start_rows(self, slots: List[_Slot], b: int, np):
+        """[b] i32 start-row block for the find_successor lanes."""
+        block = self._fused_block(
+            slots, b, np, 1, lambda v: np.asarray(v, np.int32),
+            np.zeros((b,), np.int32))
+        return block.astype(np.int32, copy=False)
+
+    def _launch_fused(self, batch: List[_Slot], kern, jnp, np):
+        """Dispatch one multi-kind FUSED group (chordax-fuse): the
+        host-side kind selector (each slot's kind) partitions the
+        group's lanes into per-kind blocks, every block pads to ONE
+        shared bucket, and a single pre-traced program answers all of
+        them — one XLA dispatch and one device round trip where the
+        kind-by-kind drain paid one per kind. Results fan back out per
+        slot in FIFO order within each kind; byte-exact parity with
+        per-kind dispatch is the non-negotiable (same kernels, same
+        dtypes, same pad rule)."""
+        from p2p_dhts_tpu import keyspace
+        groups: Dict[str, List[_Slot]] = {k: [] for k in FUSE_KINDS}
+        for slot in batch:
+            groups[slot.kind].append(slot)
+        counts = {k: sum(s.vec or 1 for s in groups[k])
+                  for k in FUSE_KINDS}
+        bucket = self._bucket_for(max(1, max(counts.values())))
+        total = sum(counts.values())
+        present = [k for k in FUSE_KINDS if counts[k]]
+
+        if havoc_mod.enabled():
+            # Same two sites as the scalar path: the per-engine
+            # dispatch failure and the payload-matched poison (scalar
+            # lanes only — vec chunks carry arrays, not matchable ints).
+            act = havoc_mod.decide("serve.launch", key=self._name)
+            if act is None:
+                scalar_keys = [s.payload[0] for s in batch
+                               if not s.vec and s.payload]
+                if scalar_keys:
+                    act = havoc_mod.decide("serve.poison",
+                                           key=scalar_keys)
+            if act is not None:
+                raise RuntimeError(
+                    f"havoc: injected dispatch failure "
+                    f"(fused batch of {total}, engine {self._name!r})")
+
+        # Occupancy accounting (ISSUE 13 satellite): per-kind
+        # batch_occupancy would under-report a fused batch (each kind
+        # sees only its own lanes), so the fused batch ALSO records its
+        # whole-program fill (real lanes over all padded block lanes)
+        # and each kind's share of the real lanes.
+        n_blocks = 3 if self._store is not None else 2
+        fill = total / (bucket * n_blocks)
+        with self._lock:
+            self.batch_log.append(("fused", total, bucket))
+            self.batches_served += 1
+            self.requests_served += total
+            self._fill_sum += fill
+        self._metrics.inc("serve.batches")
+        self._metrics.inc("serve.fused_batches")
+        self._metrics.gauge("serve.batch_fill", fill)
+        self._metrics.observe_hist("serve.fused_occupancy", fill)
+        for kind in present:
+            self._metrics.inc(f"serve.requests.{kind}", counts[kind])
+            self._metrics.observe_hist(f"serve.batch_occupancy.{kind}",
+                                       counts[kind] / bucket)
+            self._metrics.observe_hist(f"serve.fused_lane_share.{kind}",
+                                       counts[kind] / total)
+
+        fs_keys = jnp.asarray(self._fused_key_block(
+            groups["find_successor"], bucket, np, keyspace, 0))
+        fs_starts = jnp.asarray(self._fused_start_rows(
+            groups["find_successor"], bucket, np))
+        fi_keys = jnp.asarray(self._fused_key_block(
+            groups["finger_index"], bucket, np, keyspace, 0))
+        fi_starts = jnp.asarray(self._fused_key_block(
+            groups["finger_index"], bucket, np, keyspace, 1))
+        if self._store is not None:
+            get_keys = jnp.asarray(self._fused_key_block(
+                groups["dhash_get"], bucket, np, keyspace, 0))
+            out = kern["fused"](self._state, self._store, fs_keys,
+                                fs_starts, get_keys, fi_keys, fi_starts)
+        else:
+            out = kern["fused"](self._state, fs_keys, fs_starts,
+                                fi_keys, fi_starts)
+        return ("fused", groups, out)
+
     # -- completion loop ----------------------------------------------------
 
     def _complete_loop(self) -> None:
@@ -1445,7 +1752,9 @@ class ServeEngine:
             btr.t_sync0 = time.perf_counter()
         try:
             kind = handle[0]
-            if kind == "vec":
+            if kind == "fused":
+                self._fan_out_fused(handle, np)
+            elif kind == "vec":
                 # Vector chunk (chordax-fastlane): one slot, whole
                 # result arrays, zero per-key python — the host sync is
                 # one np.asarray per output and the pad rows slice off.
@@ -1551,20 +1860,75 @@ class ServeEngine:
         now = time.perf_counter()
         if btr is not None:
             btr.t_results = now
-        kind = batch[0].kind
-        lats = [now - slot.t_submit for slot in batch]
+        # Latencies record per SLOT kind (a fused batch spans kinds;
+        # single-kind batches collapse to the old one-key behavior).
+        by_kind: Dict[str, List[float]] = {}
+        for slot in batch:
+            by_kind.setdefault(slot.kind, []).append(
+                now - slot.t_submit)
         with self._lock:
-            self._lat[kind].extend(lats)
-        self._metrics.observe_hist_many(
-            f"serve.latency_ms.{kind}", [v * 1e3 for v in lats])
+            for kind, lats in by_kind.items():
+                self._lat[kind].extend(lats)
+        for kind, lats in by_kind.items():
+            self._metrics.observe_hist_many(
+                f"serve.latency_ms.{kind}", [v * 1e3 for v in lats])
         # Spans land BEFORE the waiters wake: a caller that returns
         # from wait() and immediately reads the span store must find
         # its request's spans (the dryrun and the TRACE_STATUS verb
         # both do exactly that).
         if btr is not None and trace_mod.enabled():
-            self._record_batch_spans(batch, btr, kind)
+            self._record_batch_spans(
+                batch, btr,
+                "fused" if handle[0] == "fused" else batch[0].kind)
         for slot in batch:
             slot.ev.set()
+
+    def _fan_out_fused(self, handle, np) -> None:
+        """Device->host sync + per-slot fan-out for one fused batch:
+        slice each kind's output block and hand rows to that kind's
+        slots in FIFO order (scalar slots take one row in the exact
+        shapes the per-kind paths deliver; vector chunks take their
+        row slice as whole arrays). Only blocks that carry real lanes
+        are transferred — an absent kind's dummy block never crosses
+        to the host."""
+        _, groups, out = handle
+        if self._store is not None:
+            owner_d, hops_d, segs_d, ok_d, fidx_d = out
+        else:
+            owner_d, hops_d, fidx_d = out
+            segs_d = ok_d = None
+        if groups["find_successor"]:
+            owner, hops = np.asarray(owner_d), np.asarray(hops_d)
+            off = 0
+            for slot in groups["find_successor"]:
+                if slot.vec:
+                    slot.result = (owner[off:off + slot.vec],
+                                   hops[off:off + slot.vec])
+                    off += slot.vec
+                else:
+                    slot.result = (int(owner[off]), int(hops[off]))
+                    off += 1
+        if groups["dhash_get"]:
+            segs, ok = np.asarray(segs_d), np.asarray(ok_d)
+            off = 0
+            for slot in groups["dhash_get"]:
+                if slot.vec:
+                    slot.result = (segs[off:off + slot.vec],
+                                   ok[off:off + slot.vec])
+                    off += slot.vec
+                else:
+                    slot.result = (segs[off], bool(ok[off]))
+                    off += 1
+        if groups["finger_index"]:
+            fidx = np.asarray(fidx_d)
+            off = 0
+            for slot in groups["finger_index"]:
+                if slot.vec:
+                    slot.result = fidx[off:off + slot.vec]
+                    off += slot.vec
+                else:
+                    slot.result = int(fidx[off])
+                    off += 1
 
     def _record_batch_spans(self, batch: List[_Slot], btr: _BatchTrace,
                             kind: str) -> None:
@@ -1604,8 +1968,10 @@ class ServeEngine:
             req_ids = []
             for slot in slots:
                 ctx = slot.trace
+                # The request span carries the SLOT's kind (a fused
+                # batch spans kinds; the batch span carries "fused").
                 sid = trace_mod.record_span(
-                    f"serve.request.{kind}", slot.t_submit, t_end,
+                    f"serve.request.{slot.kind}", slot.t_submit, t_end,
                     trace_id=tid, parent_id=ctx.span_id,
                     cat="serve", links=(batch_sid,), engine=self._name)
                 req_ids.append(sid)

@@ -1,0 +1,638 @@
+"""chordax-fuse (ISSUE 13): multi-kind super-batch dispatch + the
+selectable IDA decode backends.
+
+Pins the tentpole's obligations:
+  * a head run spanning >= 2 read-only kinds dispatches as ONE fused
+    program whose per-kind answers are BYTE-EXACT vs per-kind dispatch
+    (same kernels, same pad rule — fusion is scheduling, never
+    semantics);
+  * FIFO across the fused group and any straddling mutator batch is
+    exactly the unfused engine's (a put splits the fused read groups;
+    read-your-writes holds);
+  * zero steady-state retraces over a mixed storm (the fused program
+    pre-traces at warmup like every kind);
+  * the quarantine discipline survives fusion (a poisoned fused batch
+    requeues solo retries; batch-mates succeed);
+  * ops.ida_backend: dot / MAC / pallas decode byte-identical
+    fragments on CPU, with explicit-arg > set_backend > env > platform
+    resolution.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import (build_ring, find_successor,
+                                    finger_index_batch, keys_from_ints)
+from p2p_dhts_tpu.dhash.store import (create_batch, empty_store,
+                                      fused_read_batch, read_batch)
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING, lanes_to_ints
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.serve import FUSE_KINDS, ServeEngine, gather_vector
+
+pytestmark = pytest.mark.fuse
+
+N_PEERS = 64
+IDA_N, IDA_M, IDA_P = 14, 10, 257
+SMAX = 4
+FSTART = 0xF1A6
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+def _closed_finger(key, start):
+    dist = (key - start) % KEYS_IN_RING
+    return dist.bit_length() - 1 if dist else -1
+
+
+@pytest.fixture(scope="module")
+def ring_state():
+    rng = np.random.RandomState(20260805)
+    return build_ring(_rand_ids(rng, N_PEERS),
+                      RingConfig(finger_mode="materialized"))
+
+
+@pytest.fixture(scope="module")
+def seeded():
+    """(keys, segments dict) pre-put into every module engine."""
+    rng = np.random.RandomState(88)
+    keys = _rand_ids(rng, 10)
+    segs = {k: rng.randint(0, 256, size=(SMAX, IDA_M)).astype(np.int32)
+            for k in keys}
+    return keys, segs
+
+
+@pytest.fixture(scope="module")
+def engine(ring_state, seeded):
+    """One warmed FUSED engine shared by the read-only tests."""
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=4096, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P,
+                      window_cap_s=0.001, bucket_min=4, bucket_max=16,
+                      max_queue=4096, name="fuse-t")
+    eng.start()
+    eng.warmup(["find_successor", "dhash_get", "dhash_put",
+                "finger_index", "fused"])
+    assert eng.fused_warmed
+    keys, segs = seeded
+    for k in keys:
+        assert eng.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+    yield eng
+    eng.close()
+
+
+def _held_mixed_burst(eng, keys, data_keys):
+    """Interleave fs/get/fi submissions under the dispatcher hold so
+    they form ONE head run; returns the slots in submission order."""
+    eng._test_hold.set()
+    try:
+        slots = []
+        for j, k in enumerate(keys):
+            slots.append(eng.submit("find_successor", (k, 0)))
+            slots.append(eng.submit(
+                "dhash_get", (data_keys[j % len(data_keys)],)))
+            slots.append(eng.submit("finger_index", (k, FSTART)))
+    finally:
+        eng._test_hold.clear()
+    return slots
+
+
+# ---------------------------------------------------------------------------
+# fused dispatch + parity (the non-negotiable)
+# ---------------------------------------------------------------------------
+
+def test_mixed_burst_dispatches_fused(engine, seeded):
+    rng = np.random.RandomState(1)
+    keys = _rand_ids(rng, 4)
+    data_keys = seeded[0]
+    n0 = engine.batches_served
+    slots = _held_mixed_burst(engine, keys, data_keys)
+    for s in slots:
+        s.wait(120)
+    log = list(engine.batch_log)
+    fused = [e for e in log if e[0] == "fused"]
+    assert fused, f"no fused batch in {log[-6:]}"
+    # The whole 12-request burst rode ONE dispatch.
+    assert engine.batches_served == n0 + 1
+    assert fused[-1][1] == 12
+
+
+def test_fused_parity_all_three_kinds(engine, ring_state, seeded):
+    """Byte-exact answers for every kind inside one fused batch vs the
+    direct kernels (the per-kind dispatch's own parity anchor)."""
+    rng = np.random.RandomState(2)
+    keys = _rand_ids(rng, 8)
+    data_keys, segs = seeded
+    slots = _held_mixed_burst(engine, keys, data_keys)
+    got = [s.wait(120) for s in slots]
+
+    owner, hops = find_successor(ring_state, keys_from_ints(keys),
+                                 jnp.zeros(len(keys), jnp.int32))
+    owner, hops = np.asarray(owner), np.asarray(hops)
+    for j, k in enumerate(keys):
+        assert got[3 * j] == (int(owner[j]), int(hops[j]))
+        sg, ok = got[3 * j + 1]
+        dk = data_keys[j % len(data_keys)]
+        assert bool(ok) and (np.asarray(sg) == segs[dk]).all()
+        assert got[3 * j + 2] == _closed_finger(k, FSTART)
+    engine.assert_no_retraces()
+
+
+def test_fused_vs_unfused_engine_identical(ring_state, seeded):
+    """The same mixed burst answers byte-identically on a fuse=False
+    engine (fusion is a scheduling choice, pinned end to end)."""
+    data_keys, segs = seeded
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=2048, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P, bucket_min=4,
+                      bucket_max=16, fuse=False, name="fuse-off-t")
+    eng.start()
+    try:
+        assert not eng.fuse_enabled
+        for k in data_keys[:4]:
+            assert eng.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+        rng = np.random.RandomState(3)
+        keys = _rand_ids(rng, 4)
+        slots = _held_mixed_burst(eng, keys, data_keys[:4])
+        got = [s.wait(120) for s in slots]
+        assert not any(e[0] == "fused" for e in eng.batch_log)
+        owner, hops = find_successor(ring_state, keys_from_ints(keys),
+                                     jnp.zeros(len(keys), jnp.int32))
+        owner, hops = np.asarray(owner), np.asarray(hops)
+        for j, k in enumerate(keys):
+            assert got[3 * j] == (int(owner[j]), int(hops[j]))
+            sg, ok = got[3 * j + 1]
+            assert bool(ok) and (np.asarray(sg) == segs[data_keys[j % 4]]).all()
+            assert got[3 * j + 2] == _closed_finger(k, FSTART)
+    finally:
+        eng.close()
+
+
+def test_single_kind_run_stays_unfused(engine):
+    """A single-kind head run keeps the existing scalar path — fusing
+    it would buy nothing and cost dummy blocks."""
+    engine._test_hold.set()
+    try:
+        slots = engine.submit_many("find_successor",
+                                   [(j + 1, 0) for j in range(6)])
+    finally:
+        engine._test_hold.clear()
+    for s in slots:
+        s.wait(120)
+    assert engine.batch_log[-1][0] == "find_successor"
+
+
+def test_vector_chunk_fuses_with_scalars(engine, ring_state, seeded):
+    """A submit_vector chunk joins the fused group as a whole array
+    (zero per-key python) next to scalar slots of other kinds."""
+    rng = np.random.RandomState(4)
+    vkeys = np.frombuffer(rng.bytes(16 * 5),
+                          dtype="<u4").reshape(-1, 4).copy()
+    data_keys, segs = seeded
+    engine._test_hold.set()
+    try:
+        vslots = engine.submit_vector("find_successor", vkeys)
+        gslot = engine.submit("dhash_get", (data_keys[0],))
+    finally:
+        engine._test_hold.clear()
+    vo, vh = gather_vector(vslots, 120)
+    do, dh = find_successor(ring_state, jnp.asarray(vkeys),
+                            jnp.zeros(5, jnp.int32))
+    assert (vo == np.asarray(do)).all() and (vh == np.asarray(dh)).all()
+    sg, ok = gslot.wait(120)
+    assert bool(ok) and (np.asarray(sg) == segs[data_keys[0]]).all()
+    assert engine.batch_log[-1][0] == "fused"
+    engine.assert_no_retraces()
+
+
+# ---------------------------------------------------------------------------
+# FIFO straddle (fusion is read-side only)
+# ---------------------------------------------------------------------------
+
+def test_fifo_straddle_put_splits_fused_groups(engine, seeded):
+    data_keys, segs = seeded
+    k = data_keys[1]
+    rng = np.random.RandomState(5)
+    new = rng.randint(0, 256, size=(SMAX, IDA_M)).astype(np.int32)
+    log0 = len(engine.batch_log)
+    engine._test_hold.set()
+    try:
+        g1 = engine.submit("dhash_get", (k,))
+        f1 = engine.submit("find_successor", (k, 0))
+        p = engine.submit("dhash_put", (k, new, SMAX, 0))
+        g2 = engine.submit("dhash_get", (k,))
+        f2 = engine.submit("find_successor", (k, 0))
+    finally:
+        engine._test_hold.clear()
+    old, ok1 = g1.wait(120)
+    assert bool(ok1) and (np.asarray(old) == segs[k]).all(), \
+        "pre-put get must read the OLD value"
+    assert p.wait(120) is True
+    got, ok2 = g2.wait(120)
+    assert bool(ok2) and (np.asarray(got) == new).all(), \
+        "post-put get must read its write"
+    assert f1.wait(120) == f2.wait(120)
+    kinds = [e[0] for e in list(engine.batch_log)[log0:]]
+    pi = kinds.index("dhash_put")
+    assert 0 < pi < len(kinds) - 1, \
+        f"the put must dispatch strictly between the read groups: {kinds}"
+    # restore the module fixture's value for later tests
+    assert engine.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+
+
+def test_churn_straddle_ends_fused_run(ring_state):
+    """A membership mutator in the queue ends the fused run exactly
+    like a put: the reads after it observe the post-churn ring."""
+    from p2p_dhts_tpu.membership import OP_FAIL
+    from p2p_dhts_tpu.membership.kernels import padded_capacity
+    rng = np.random.RandomState(6)
+    ids = sorted(_rand_ids(rng, 16))
+    state = build_ring(ids, RingConfig(finger_mode="materialized"),
+                       capacity=padded_capacity(16))
+    eng = ServeEngine(state, empty_store(1024, SMAX), n=IDA_N, m=IDA_M,
+                      p=IDA_P, bucket_min=4, bucket_max=8,
+                      name="fuse-churn")
+    eng.start()
+    try:
+        # A key owned by ids[3]: failing ids[3] moves it to ids[4].
+        key = ids[3] - 1
+        eng._test_hold.set()
+        try:
+            l1 = eng.submit("find_successor", (key, 0))
+            fi1 = eng.submit("finger_index", (key, 1))
+            c = eng.submit("churn_apply", (OP_FAIL, ids[3]))
+            l2 = eng.submit("find_successor", (key, 0))
+            fi2 = eng.submit("finger_index", (key, 1))
+        finally:
+            eng._test_hold.clear()
+        o1, h1 = l1.wait(120)
+        assert c.wait(120) is True
+        o2, h2 = l2.wait(120)
+        assert fi1.wait(120) == fi2.wait(120)
+        state_ids = lanes_to_ints(np.asarray(state.ids))
+        assert int(state_ids[o1]) == ids[3], "pre-churn lookup moved"
+        # The post-churn read observes the APPLIED fail: byte parity
+        # with a direct dispatch against the engine's chained state
+        # (which no longer answers ids[3] — convergence to the ideal
+        # successor is stabilize's job, not fail's).
+        post_state = eng.ring_snapshot()
+        do, dh = find_successor(post_state, keys_from_ints([key]),
+                                jnp.zeros(1, jnp.int32))
+        assert (o2, h2) == (int(np.asarray(do)[0]),
+                            int(np.asarray(dh)[0])), \
+            "post-churn lookup diverges from direct post-churn dispatch"
+        post_ids = lanes_to_ints(np.asarray(post_state.ids))
+        assert int(post_ids[o2]) != ids[3], \
+            "post-churn lookup still answered the failed node"
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# zero retraces + telemetry
+# ---------------------------------------------------------------------------
+
+def test_zero_retraces_over_mixed_storm(ring_state, seeded):
+    data_keys, segs = seeded
+    met = Metrics()
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=2048, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P, window_cap_s=0.001,
+                      bucket_min=4, bucket_max=16, metrics=met,
+                      name="fuse-storm")
+    eng.start()
+    try:
+        eng.warmup(["find_successor", "dhash_get", "dhash_put",
+                    "finger_index", "fused"])
+        for k in data_keys[:6]:
+            assert eng.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+        stop = threading.Event()
+        errors = []
+
+        def worker(w):
+            rng = np.random.RandomState(900 + w)
+            try:
+                i = 0
+                while not stop.is_set():
+                    kind = (w + i) % 3
+                    i += 1
+                    if kind == 0:
+                        eng.find_successor(
+                            int.from_bytes(rng.bytes(16), "little"), 0,
+                            timeout=120)
+                    elif kind == 1:
+                        eng.dhash_get(data_keys[rng.randint(6)],
+                                      timeout=120)
+                    else:
+                        eng.finger_index(
+                            int.from_bytes(rng.bytes(16), "little"),
+                            FSTART, timeout=120)
+            except BaseException as exc:  # noqa: BLE001 — recorded
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(3.0)
+        stop.set()
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:3]
+        assert met.counter("serve.fused_batches") > 0, \
+            "the storm never fused a batch"
+        eng.assert_no_retraces()
+        # Occupancy satellite: whole-batch fill + per-kind lane share.
+        totals = met.state()["hist_totals"]
+        assert totals.get("serve.fused_occupancy", 0) > 0
+        assert any(k.startswith("serve.fused_lane_share.")
+                   for k in totals)
+    finally:
+        eng.close()
+
+
+def test_fused_series_reach_pulse(ring_state, seeded):
+    """The fused occupancy hists surface as pulse interval-percentile
+    series (the satellite's 'wired through pulse' half)."""
+    from p2p_dhts_tpu.pulse import PulseSampler
+    data_keys, segs = seeded
+    met = Metrics()
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=1024, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P, bucket_min=4,
+                      bucket_max=16, metrics=met, name="fuse-pulse")
+    eng.start()
+    sampler = PulseSampler(metrics=met, registry=None)
+    try:
+        for k in data_keys[:2]:
+            assert eng.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+        sampler.sample(now=100.0)
+        rng = np.random.RandomState(8)
+        slots = _held_mixed_burst(eng, _rand_ids(rng, 3),
+                                  data_keys[:2])
+        for s in slots:
+            s.wait(120)
+        # A hist key first seen at a tick only SEEDS its delta cursor
+        # (pulse's snapshot-delta rule); points come from samples
+        # recorded after that — so: burst, seed tick, burst, tick.
+        sampler.sample(now=101.0)
+        slots = _held_mixed_burst(eng, _rand_ids(rng, 3),
+                                  data_keys[:2])
+        for s in slots:
+            s.wait(120)
+        sampler.sample(now=102.0)
+        sids = sampler.series_ids()
+        assert any(s.startswith("serve.fused_occupancy|") for s in sids), \
+            f"no fused-occupancy series in {sorted(sids)[:20]}"
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# failure paths
+# ---------------------------------------------------------------------------
+
+def test_fused_batch_quarantines_like_any_batch(ring_state, seeded):
+    """A fused batch that fails at dispatch splits into solo retries
+    (ISSUE 10 discipline): the batch-mates succeed on their retries
+    through the per-kind paths."""
+    data_keys, segs = seeded
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=1024, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P, bucket_min=4,
+                      bucket_max=16, name="fuse-q")
+    eng.start()
+    try:
+        for k in data_keys[:2]:
+            assert eng.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+        real = eng._get_kernels()["fused"]
+        boom = {"n": 0}
+
+        def bad(*a, **kw):
+            boom["n"] += 1
+            raise RuntimeError("injected fused dispatch failure")
+
+        eng._kernels["fused"] = bad
+        try:
+            slots = _held_mixed_burst(
+                eng, _rand_ids(np.random.RandomState(9), 2),
+                data_keys[:2])
+            got = [s.wait(120) for s in slots]
+        finally:
+            eng._kernels["fused"] = real
+        assert boom["n"] >= 1, "fused kernel never dispatched"
+        # Every slot succeeded on its solo retry (retries dispatch
+        # through the per-kind scalar paths, which are intact).
+        assert len(got) == 6
+        for j in (1, 4):
+            sg, ok = got[j]
+            assert bool(ok)
+    finally:
+        eng.close()
+
+
+def test_deadline_shed_degenerate_group(ring_state, seeded):
+    """Deadline shedding can collapse a mixed group to one kind — the
+    remnant still dispatches through the (always-warm) fused program;
+    live slots answer, expired slots raise DeadlineExpiredError."""
+    from p2p_dhts_tpu.serve import DeadlineExpiredError
+    data_keys, segs = seeded
+    eng = ServeEngine(ring_state,
+                      empty_store(capacity=1024, max_segments=SMAX),
+                      n=IDA_N, m=IDA_M, p=IDA_P, bucket_min=4,
+                      bucket_max=16, name="fuse-dl")
+    eng.start()
+    try:
+        for k in data_keys[:2]:
+            assert eng.dhash_put(k, segs[k], SMAX, 0, timeout=120)
+        eng._test_hold.set()
+        try:
+            live = [eng.submit("find_successor", (j + 1, 0))
+                    for j in range(2)]
+            dead = [eng.submit("dhash_get", (data_keys[0],),
+                               deadline=time.perf_counter() + 0.05)
+                    for _ in range(2)]
+            time.sleep(0.2)  # the get deadlines lapse while held
+        finally:
+            eng._test_hold.clear()
+        for s in live:
+            owner, hops = s.wait(120)
+            assert owner >= 0 and hops >= 0
+        for s in dead:
+            with pytest.raises(DeadlineExpiredError):
+                s.wait(120)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# the fused kernels directly (device parity, no engine)
+# ---------------------------------------------------------------------------
+
+def test_fused_read_batch_kernel_parity(ring_state):
+    rng = np.random.RandomState(10)
+    keys = _rand_ids(rng, 8)
+    lanes = keys_from_ints(keys)
+    starts = jnp.zeros(8, jnp.int32)
+    store = empty_store(1024, SMAX)
+    segs = rng.randint(0, 256, size=(8, SMAX, IDA_M)).astype(np.int32)
+    store, ok = create_batch(ring_state, store, lanes,
+                             jnp.asarray(segs),
+                             jnp.full((8,), SMAX, jnp.int32), starts,
+                             IDA_N, IDA_M, IDA_P)
+    assert bool(jnp.all(ok))
+    fstarts = keys_from_ints([FSTART] * 8)
+    o_f, h_f, sg_f, ok_f, fi_f = fused_read_batch(
+        ring_state, store, lanes, starts, lanes, lanes, fstarts,
+        IDA_N, IDA_M, IDA_P)
+    o_d, h_d = find_successor(ring_state, lanes, starts)
+    sg_d, ok_d = read_batch(ring_state, store, lanes, IDA_N, IDA_M,
+                            IDA_P)
+    fi_d = finger_index_batch(lanes, fstarts)
+    assert (np.asarray(o_f) == np.asarray(o_d)).all()
+    assert (np.asarray(h_f) == np.asarray(h_d)).all()
+    assert (np.asarray(sg_f) == np.asarray(sg_d)).all()
+    assert (np.asarray(ok_f) == np.asarray(ok_d)).all()
+    assert (np.asarray(fi_f) == np.asarray(fi_d)).all()
+
+
+# ---------------------------------------------------------------------------
+# gateway: finger verbs opt into a ring's fused queue
+# ---------------------------------------------------------------------------
+
+def test_gateway_finger_ring_routing(ring_state, seeded):
+    from p2p_dhts_tpu.gateway import Gateway
+    # Engines built by add_ring record serve.* into the process-global
+    # registry (only gateway.* keys ride the private one).
+    from p2p_dhts_tpu.metrics import METRICS
+    data_keys, segs = seeded
+    met = Metrics()
+    gw = Gateway(metrics=met, name="fuse-gw")
+    try:
+        gw.add_ring("fz", ring_state,
+                    empty_store(capacity=1024, max_segments=SMAX),
+                    default=True, bucket_min=4, bucket_max=16,
+                    warmup=["find_successor", "dhash_get", "dhash_put",
+                            "finger_index", "fused"])
+        for k in data_keys[:3]:
+            assert gw.dhash_put(k, segs[k], SMAX, 0, ring_id="fz",
+                                timeout=120)
+        eng = gw.router.get("fz").engine
+        assert eng.fuse_enabled
+        rng = np.random.RandomState(11)
+        keys = _rand_ids(rng, 4)
+        # Ring-routed finger answers == the shared-engine answers ==
+        # the closed form (one closed form everywhere).
+        for k in keys:
+            assert gw.finger_index(k, FSTART, ring_id="fz",
+                                   timeout=120) == \
+                _closed_finger(k, FSTART)
+        # A held mixed burst through gateway verbs on ONE ring fuses.
+        n0 = METRICS.counter("serve.fused_batches")
+        eng._test_hold.set()
+        results = {}
+
+        def call(name, fn):
+            results[name] = fn()
+
+        threads = [
+            threading.Thread(target=call, args=(
+                "fs", lambda: gw.find_successor(keys[0], 0,
+                                                ring_id="fz",
+                                                timeout=120))),
+            threading.Thread(target=call, args=(
+                "get", lambda: gw.dhash_get(data_keys[0], ring_id="fz",
+                                            timeout=120))),
+            threading.Thread(target=call, args=(
+                "fi", lambda: gw.finger_index(keys[1], FSTART,
+                                              ring_id="fz",
+                                              timeout=120))),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # all three land in the held queue
+        eng._test_hold.clear()
+        for t in threads:
+            t.join(120)
+        assert METRICS.counter("serve.fused_batches") > n0, \
+            "mixed gateway verbs on one ring did not fuse"
+        o, h = results["fs"]
+        do, dh = find_successor(ring_state, keys_from_ints([keys[0]]),
+                                jnp.zeros(1, jnp.int32))
+        assert (o, h) == (int(np.asarray(do)[0]), int(np.asarray(dh)[0]))
+        sg, ok = results["get"]
+        assert bool(ok) and (np.asarray(sg) == segs[data_keys[0]]).all()
+        assert results["fi"] == _closed_finger(keys[1], FSTART)
+        eng.assert_no_retraces()
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# the IDA backend registry
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ida_rows():
+    from p2p_dhts_tpu.ida import encode_kernel
+    rng = np.random.RandomState(12)
+    segments = jnp.asarray(rng.randint(0, 256, size=(16, 8, IDA_M)),
+                           jnp.int32)
+    frags = encode_kernel(segments, IDA_N, IDA_M, IDA_P)
+    sel = np.stack([rng.choice(IDA_N, size=IDA_M, replace=False)
+                    for _ in range(16)])
+    rows = jnp.take_along_axis(frags, jnp.asarray(sel)[:, :, None],
+                               axis=1)
+    idx = jnp.asarray(sel + 1, jnp.int32)
+    return rows, idx, np.asarray(segments)
+
+
+def test_ida_backends_decode_byte_identical(ida_rows):
+    from p2p_dhts_tpu.ops import ida_backend
+    rows, idx, want = ida_rows
+    for name in ida_backend.IDA_BACKENDS:
+        usable, reason = ida_backend.availability(name)
+        assert usable, (name, reason)
+        got = np.asarray(ida_backend.decode(rows, idx, IDA_P,
+                                            backend=name))
+        assert (got == want).all(), f"{name} decode diverges"
+
+
+def test_ida_backend_resolution_precedence(monkeypatch):
+    from p2p_dhts_tpu.ops import ida_backend
+    monkeypatch.delenv(ida_backend.ENV_VAR, raising=False)
+    try:
+        # Platform default on CPU is dot (the round-5 split).
+        assert ida_backend.resolve() == "dot"
+        monkeypatch.setenv(ida_backend.ENV_VAR, "mac")
+        assert ida_backend.resolve() == "mac"
+        ida_backend.set_backend("pallas")
+        assert ida_backend.resolve() == "pallas"      # set > env
+        assert ida_backend.resolve("dot") == "dot"    # arg > set
+        ida_backend.set_backend("auto")
+        assert ida_backend.resolve() == "dot"         # auto -> platform
+        monkeypatch.setenv(ida_backend.ENV_VAR, "bogus")
+        ida_backend.set_backend(None)
+        with pytest.raises(ValueError, match="unknown IDA backend"):
+            ida_backend.resolve()
+        with pytest.raises(ValueError, match="unknown IDA backend"):
+            ida_backend.set_backend("bogus")
+    finally:
+        ida_backend.set_backend(None)
+
+
+def test_decode_kernel_default_unchanged(ida_rows):
+    """The unconfigured ida.decode_kernel still round-trips (registry
+    default == the historical platform split)."""
+    from p2p_dhts_tpu.ida import decode_kernel
+    from p2p_dhts_tpu.ops import ida_backend
+    assert ida_backend.configured() is None
+    rows, idx, want = ida_rows
+    assert (np.asarray(decode_kernel(rows, idx, IDA_P)) == want).all()

@@ -1,40 +1,43 @@
 #!/bin/bash
-# TPU recovery watcher, round 12: twelve configs want on-chip records
-# (greens from r07-r11 carry over; chordax-fastlane joins the want
-# list). Wait for the chip to be free, probe the remote-compile
+# TPU recovery watcher, round 13: thirteen configs want on-chip
+# records (greens from r07-r12 carry over; chordax-fuse joins the
+# want list). Wait for the chip to be free, probe the remote-compile
 # service (dead since round 4: connection-refused on its port while
 # cached programs kept executing), and when it answers, run the
 # configs without a green record one at a time into
-# BENCH_ATTEMPT_r12.jsonl (bench's _record_lkg promotes each green
+# BENCH_ATTEMPT_r13.jsonl (bench's _record_lkg promotes each green
 # on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# --trace device-timeline archiving (now into BENCH_TRACE_r12). All
+# --trace device-timeline archiving (now into BENCH_TRACE_r13). All
 # prior gates stay (wire-isolated binary >= 3x JSON keys/s at <= 1/2
 # p50, traced chain, havoc scenario matrix >= 99% availability, pulse
-# smoke, zero retraces). NEW in round 12 (chordax-fastlane): a
-# FASTLANE SMOKE pre-bench gate — the wire-isolated 1M-KEY vector
-# holds the >= 3x keys/s / <= 1/2 p50 binary edge with the zero-copy
-# codec, a real 1M-key vector RPC through gateway+engine performs
-# ZERO per-key python (counted) with direct-engine parity, and the
-# Zipf(1.1) hot-key closed loop shows cache hit rate > 80% with
-# cache-hit p50 under the engine round trip — must pass on CPU before
-# anything claims the chip. ALSO NEW: the round-5 IDA-decode verdict
-# (BENCH_NOTES_r12.md) says the LKG 93.3 MB/s decode row is the
-# PRE-FIX dot-path cliff — when the ida config re-records on chip,
-# expect the platform-split default (VPU MAC) to replace it. Never
+# + fastlane smokes, zero retraces). NEW in round 13 (chordax-fuse):
+# a FUSE SMOKE pre-bench gate — mixed-kind closed-loop throughput
+# >= 1.25x the unfused kind-by-kind drain at equal-or-better p50,
+# byte-exact three-kind parity inside one fused batch, the FIFO
+# straddle assert, zero retraces, and the IDA backend registry
+# (dot/MAC/pallas) decoding byte-identical fragments — must pass on
+# CPU before anything claims the chip. THE WANT-LIST HEADLINE for
+# this round's chip window: (a) the fuse config's on-chip record —
+# the multi-kind super-batch win the whole round is named for — and
+# (b) the IDA BACKEND A/B the r12 verdict left open: the fuse
+# config's microbench (and the ida config's re-record) measure dot
+# vs VPU-MAC vs the compiled pallas kernel side by side, replacing
+# the stale 93.3 MB/s pre-fix dot-cliff row in BENCH_LKG. Never
 # kills anything mid-TPU-work; every probe and bench attempt runs to
 # completion (a blocked fresh-shape jit takes ~25 min to fail — that
 # is the probe's cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-12 watcher start (twelve configs + wire/havoc/pulse/fastlane smoke gates)"
+log "round-13 watcher start (thirteen configs + wire/havoc/pulse/fastlane/fuse smoke gates)"
 
-needed() {  # configs without a green record yet (r07-r11 greens count)
+needed() {  # configs without a green record yet (r07-r12 greens count)
   python - <<'EOF'
 import json
 ok = set()
 for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
                 "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl",
-                "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl"):
+                "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl",
+                "BENCH_ATTEMPT_r13.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -47,7 +50,7 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
         pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership",
-        "pulse", "fastlane"]
+        "pulse", "fastlane", "fuse"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -59,7 +62,7 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all twelve configs recorded green — done"
+    log "all thirteen configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
@@ -124,9 +127,9 @@ for i in $(seq 1 80); do
   # mid-bench), one linked digest->diff->heal repair trace, zero
   # retraces — on CPU before anything claims the chip. The sampled
   # series artifact lands next to this round's records.
-  mkdir -p BENCH_TRACE_r12
+  mkdir -p BENCH_TRACE_r13
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_PULSE_SERIES=BENCH_TRACE_r12/pulse_series_smoke.json \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r13/pulse_series_smoke.json \
       python bench.py --config pulse --smoke \
       >> tpu_watch.log 2>&1; then
     log "pulse smoke FAILED - fix the telemetry plane before benching"
@@ -145,6 +148,19 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Fuse smoke (ISSUE 13): the multi-kind super-batch path must hold —
+  # mixed fs/get/fi closed loop >= 1.25x the unfused kind-by-kind
+  # drain at equal-or-better p50, byte-exact three-kind parity inside
+  # one fused batch, the FIFO straddle assert (a put splits the fused
+  # read groups), zero retraces, and the IDA backend registry decoding
+  # byte-identical fragments (pallas timing skipped on CPU with its
+  # interpret-mode reason) — on CPU before anything claims the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config fuse --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "fuse smoke FAILED - fix the fused dispatch before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -155,15 +171,15 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r12
+    mkdir -p BENCH_TRACE_r13
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r12/$c)"
+      log "running --config $c (device trace -> BENCH_TRACE_r13/$c)"
       # The pulse config archives its sampled series + verdicts next
       # to this round's records (the mid-bench PULSE/HEALTH polls are
       # inside the config itself).
-      CHORDAX_PULSE_SERIES="BENCH_TRACE_r12/pulse_series_$c.json" \
-        python bench.py --config "$c" --trace "BENCH_TRACE_r12" \
-        >> BENCH_ATTEMPT_r12.jsonl 2>> BENCH_ATTEMPT_r12.err
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r13/pulse_series_$c.json" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r13" \
+        >> BENCH_ATTEMPT_r13.jsonl 2>> BENCH_ATTEMPT_r13.err
       log "config $c rc=$?"
     done
   else
