@@ -73,6 +73,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "havoc.py"),
     os.path.join("p2p_dhts_tpu", "pulse.py"),
     os.path.join("p2p_dhts_tpu", "ops", "ida_backend.py"),
+    os.path.join("p2p_dhts_tpu", "lens", "__init__.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

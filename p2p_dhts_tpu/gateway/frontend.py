@@ -91,10 +91,14 @@ FINGER_RING_ID = "__finger__"
 #: over the wire on every gateway server. PULSE is the chordax-pulse
 #: continuous-telemetry verb (ISSUE 11): series tails, SLO verdicts +
 #: burn rates, and Prometheus-style exposition of the live registry.
+#: CAPACITY is the chordax-lens verb (ISSUE 14): every ring's derived
+#: busy-fraction / capacity / headroom row plus (COSTS) the engines'
+#: per-(kind, bucket) cost tables and compile-cause ledgers — the
+#: subscription surface the elastic policy loop consumes.
 GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
                     "SYNC_RANGE", "REPAIR_STATUS", "JOIN_RING",
                     "HEARTBEAT", "MEMBER_STATUS", "METRICS",
-                    "TRACE_STATUS", "HEALTH", "PULSE")
+                    "TRACE_STATUS", "HEALTH", "PULSE", "CAPACITY")
 
 
 def _key_int(v) -> int:
@@ -178,6 +182,9 @@ class Gateway:
         # the PULSE verb serves (lifecycle stays with whoever built
         # it; the gateway only holds the read-side reference).
         self._pulse: Optional[Any] = None
+        # chordax-lens wiring (ISSUE 14): the attached LensLoop the
+        # CAPACITY verb serves (same read-side-reference rule).
+        self._lens: Optional[Any] = None
 
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
@@ -254,6 +261,18 @@ class Gateway:
     def pulse_sampler(self):
         with self._rings_lock:
             return self._pulse
+
+    # -- capacity / lens plane (chordax-lens, ISSUE 14) ----------------------
+    def attach_lens(self, lens) -> None:
+        """Register (or, with None, detach) the LensLoop the CAPACITY
+        verb serves. Lifecycle stays with whoever built it — the
+        gateway never starts or stops the loop."""
+        with self._rings_lock:
+            self._lens = lens
+
+    def lens_model(self):
+        with self._rings_lock:
+            return self._lens
 
     # -- membership control plane (chordax-membership, ISSUE 7) --------------
     def attach_membership(self, manager) -> None:
@@ -1615,6 +1634,48 @@ class Gateway:
             out["PROM"] = pulse_mod.expose_prometheus(self.metrics.base)
         return out
 
+    def handle_capacity(self, req: dict) -> dict:
+        """The chordax-lens verb (ISSUE 14): every ring's derived
+        capacity row (busy fraction, capacity/headroom keys/s, queue
+        delay, saturation verdict, kind mix) from the attached
+        LensLoop — the elastic policy loop's one-poll decision input.
+        With RING, only that ring's row. With COSTS, the raw engine
+        view rides along even without a lens attached: each ring's
+        per-(kind, bucket) cost table (bucket keys stringified — one
+        JSON shape on both transports) and its compile-cause ledger.
+        ATTACHED=false means no lens is wired to this gateway —
+        never an RPC error."""
+        lens = self.lens_model()
+        out: dict = {"ATTACHED": lens is not None}
+        if lens is not None:
+            report = lens.capacity_report()
+            ring = req.get("RING")
+            if ring is not None:
+                rings = report.get("rings", {})
+                report = dict(report)
+                report["rings"] = (
+                    {str(ring): rings[str(ring)]}
+                    if str(ring) in rings else {})
+            out["CAPACITY"] = report
+        if req.get("COSTS"):
+            costs: Dict[str, dict] = {}
+            for backend in self.router.snapshot()[0]:
+                table_fn = getattr(backend.engine, "cost_table", None)
+                ledger_fn = getattr(backend.engine, "compile_ledger",
+                                    None)
+                if table_fn is None and ledger_fn is None:
+                    continue
+                table = table_fn() if table_fn is not None else {}
+                costs[backend.ring_id] = {
+                    "cost_table": {
+                        kind: {str(b): row for b, row in rows.items()}
+                        for kind, rows in table.items()},
+                    "compiles": (ledger_fn()
+                                 if ledger_fn is not None else []),
+                }
+            out["COSTS"] = costs
+        return out
+
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         # chordax-fuse: RING opts the lookup into that ring's engine
@@ -1671,9 +1732,10 @@ class Gateway:
             self._memberships.clear()
             writer, self._repl_writer = self._repl_writer, None
             self._repl_policy = None
-            # Detach (never close) the pulse sampler: its lifecycle
-            # belongs to whoever built it.
+            # Detach (never close) the pulse sampler and the lens
+            # loop: their lifecycles belong to whoever built them.
             self._pulse = None
+            self._lens = None
         # Membership loops stop FIRST (they submit churn batches and
         # nudge schedulers); then repair, then the writer.
         scheds = managers + scheds
@@ -1742,5 +1804,6 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "TRACE_STATUS": gw.handle_trace_status,
         "HEALTH": gw.handle_health,
         "PULSE": gw.handle_pulse,
+        "CAPACITY": gw.handle_capacity,
     })
     return gw

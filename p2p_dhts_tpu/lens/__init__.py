@@ -1,0 +1,397 @@
+"""chordax-lens: device cost accounting read side — capacity/headroom
+model + continuous profiling (ISSUE 14).
+
+chordax-scope (ISSUE 8) can trace a request and chordax-pulse
+(ISSUE 11) can rate a counter, but neither answers the two questions
+the elastic arc turns on: "how much device time does each kind/bucket
+actually cost?" and "how much headroom does a ring have left?" — the
+Dapper/Monarch-style gap between event tracing and continuous RESOURCE
+accounting. The write side lives in `serve.py` (always-on per-(kind,
+bucket) dispatch-cost EWMAs, padding-waste lane accounting, the
+compile-cause ledger, the queue-delay signal); this package is the
+read side:
+
+  * `CapacityModel` — the pure window math: two engine
+    `cost_snapshot()`s plus the wall dt in between yield the ring's
+    BUSY FRACTION (device time consumed / wall time), its observed
+    SERVICE RATE (keys per device-second at the window's actual kind
+    mix — the "keys/s this ring can absorb at 100% duty" estimate,
+    EWMA-smoothed across windows, cost-table fallback when the window
+    was idle), the derived HEADROOM (absorbable minus currently
+    absorbed, floored at zero), the window's mean QUEUE DELAY (the
+    saturation signal: a ring whose device is keeping up has ~zero
+    queue delay no matter how busy), and a 0/1 SATURATED verdict.
+    Every input and output is a plain number, so tests hand-compute
+    the whole closed loop.
+  * `LensLoop` — a `health.PacedLoop` driving the model over every
+    ring a gateway serves: each tick deltas the engines' monotonic
+    accumulators and publishes `lens.busy.<ring>`,
+    `lens.capacity_keys_s.<ring>`, `lens.headroom.<ring>`,
+    `lens.saturated.<ring>` gauges and the `lens.queue_delay_ms.<ring>`
+    histogram — pulse series for free (the sampler tracks the `lens.`
+    prefix) and SLO-selectable (a latency SLO can bound the queue
+    delay). Rings that leave the router retire their lens keys on the
+    next tick (the PR-8 stale-telemetry rule). `update()` is the
+    deterministic foreground tick; `capacity_report()` is the CAPACITY
+    wire verb's payload — the exact subscription surface the
+    chordax-elastic policy loop will consume.
+  * `ProfilerLoop` — OPT-IN continuous profiling: a PacedLoop that
+    periodically captures a bounded `metrics.device_trace` window into
+    a rotated on-disk directory (`window-NNNNNN`, newest `max_windows`
+    kept), so a long soak always holds a recent device timeline
+    without unbounded disk growth. Off by default — nothing profiles
+    unless a loop is constructed and started. The digestion half is
+    `python -m p2p_dhts_tpu.lens.report` (per-kind cost breakdown from
+    a Chrome export) and `python -m p2p_dhts_tpu.lens.bench_report`
+    (the bench/soak trajectory table).
+
+LOCK ORDER: `LensLoop._lock` is a LEAF — never held across an engine
+call, a metrics call, or a router call (snapshots are collected first,
+the model computed, then results stored under the leaf and published
+outside it). This module never imports jax (device_trace degrades on
+its own).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+from p2p_dhts_tpu.health import HealthRegistry, PacedLoop
+from p2p_dhts_tpu.metrics import METRICS, Metrics, device_trace
+
+#: EWMA smoothing for the cross-window service-rate estimate: the
+#: loaded windows dominate, one idle tick cannot wipe the capacity
+#: estimate (an idle window contributes no observation at all).
+RATE_EWMA_ALPHA = 0.5
+
+#: Saturation verdict thresholds: busy fraction at/above SAT_BUSY or
+#: window mean queue delay at/above `saturation_delay_ms` flips
+#: `lens.saturated.<ring>` to 1.
+SAT_BUSY = 0.85
+DEFAULT_SATURATION_DELAY_MS = 50.0
+
+#: The lens gauge/hist families one ring owns (retired together when
+#: the ring leaves the router).
+_RING_KEY_FAMILIES = ("lens.busy", "lens.capacity_keys_s",
+                      "lens.headroom", "lens.saturated",
+                      "lens.queue_delay_ms")
+
+
+class CapacityModel:
+    """Window math for ONE ring: feed consecutive `cost_snapshot()`s
+    (monotonic accumulators) with their wall timestamps; read the
+    derived row. Stateless between rings — the LensLoop owns one per
+    ring id."""
+
+    def __init__(self, *, alpha: float = RATE_EWMA_ALPHA,
+                 sat_busy: float = SAT_BUSY,
+                 saturation_delay_ms: float =
+                 DEFAULT_SATURATION_DELAY_MS):
+        self.alpha = float(alpha)
+        self.sat_busy = float(sat_busy)
+        self.saturation_delay_ms = float(saturation_delay_ms)
+        self._prev: Optional[dict] = None
+        self._prev_t: Optional[float] = None
+        self.service_rate: Optional[float] = None
+        self.row: Optional[dict] = None
+
+    @staticmethod
+    def table_rate(cost_table: Dict[str, Dict[int, dict]]
+                   ) -> Optional[float]:
+        """Cold-start fallback: the best observed per-lane service
+        rate the engine's cost table implies (bucket lanes over the
+        bucket's EWMA dispatch time, best across kinds/buckets) —
+        what the model reports before any loaded window exists."""
+        best = None
+        for kind, buckets in cost_table.items():
+            for bucket, row in buckets.items():
+                if not bucket or row.get("ewma_ms", 0) <= 0:
+                    continue
+                rate = bucket / (row["ewma_ms"] / 1e3)
+                if best is None or rate > best:
+                    best = rate
+        return best
+
+    def observe(self, snap: dict, t: float,
+                cost_table: Optional[Dict[str, Dict[int, dict]]] = None
+                ) -> Optional[dict]:
+        """One window: returns the derived row (None on the seeding
+        observation). All math is arithmetic on the snapshot deltas —
+        hand-computable, the test contract."""
+        prev, prev_t = self._prev, self._prev_t
+        self._prev, self._prev_t = snap, t
+        if prev is None or prev_t is None or t <= prev_t:
+            return None
+        dt = t - prev_t
+        d_dev = max(snap["device_time_s"] - prev["device_time_s"], 0.0)
+        d_live = max(snap["lanes_live"] - prev["lanes_live"], 0)
+        d_pad = max(snap["lanes_padded"] - prev["lanes_padded"], 0)
+        busy = min(d_dev / dt, 1.0)
+        current_rate = d_live / dt
+        if d_dev > 1e-9 and d_live > 0:
+            observed = d_live / d_dev
+            self.service_rate = (
+                observed if self.service_rate is None
+                else self.service_rate
+                + self.alpha * (observed - self.service_rate))
+        elif self.service_rate is None and cost_table:
+            self.service_rate = self.table_rate(cost_table)
+        capacity = self.service_rate
+        headroom = (max(capacity - current_rate, 0.0)
+                    if capacity is not None else None)
+        d_qd_n = snap["queue_delay_n"] - prev["queue_delay_n"]
+        queue_delay_ms = (
+            (snap["queue_delay_sum_ms"] - prev["queue_delay_sum_ms"])
+            / d_qd_n if d_qd_n > 0 else 0.0)
+        saturated = int(busy >= self.sat_busy
+                        or queue_delay_ms >= self.saturation_delay_ms)
+        # The window's kind mix, by device-time share — the "at the
+        # current kind mix" qualifier on the headroom estimate.
+        # Normalized by the per-kind SUM (per-kind totals count full
+        # dispatch intervals; the busy union de-overlaps, so the two
+        # denominators differ under pipelining).
+        mix: Dict[str, float] = {}
+        kind_deltas = {
+            kind: tot - prev["device_time_by_kind"].get(kind, 0.0)
+            for kind, tot in snap["device_time_by_kind"].items()}
+        kind_total = sum(v for v in kind_deltas.values() if v > 0)
+        if kind_total > 1e-9:
+            for kind, d in kind_deltas.items():
+                if d / kind_total > 1e-6:
+                    mix[kind] = round(d / kind_total, 4)
+        self.row = {
+            "t": t,
+            "window_s": round(dt, 6),
+            "busy": round(busy, 6),
+            "current_keys_s": round(current_rate, 3),
+            "capacity_keys_s": (round(capacity, 3)
+                                if capacity is not None else None),
+            "headroom_keys_s": (round(headroom, 3)
+                                if headroom is not None else None),
+            "queue_delay_ms": round(queue_delay_ms, 4),
+            "saturated": saturated,
+            "mix": mix,
+            "lanes_live": d_live,
+            "lanes_padded": d_pad,
+            "queue_depth": snap.get("queue_depth", 0),
+        }
+        return self.row
+
+
+class LensLoop(PacedLoop):
+    """The per-gateway capacity/headroom loop: one CapacityModel per
+    registered ring, ticked over the engines' cost snapshots.
+    `start()` runs it as a background PacedLoop (self-registered in
+    health.HEALTH like every paced loop — the HEALTH verb reports it
+    for free); `update()` is the deterministic foreground tick tests,
+    the dryrun and the bench drive. Attach to a gateway
+    (`gateway.attach_lens(loop)`) so the CAPACITY wire verb serves
+    `capacity_report()`."""
+
+    def __init__(self, gateway, *, metrics: Optional[Metrics] = None,
+                 interval_s: float = 1.0,
+                 saturation_delay_ms: float =
+                 DEFAULT_SATURATION_DELAY_MS,
+                 rate_alpha: float = RATE_EWMA_ALPHA,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 10.0,
+                 registry: Optional[HealthRegistry] = None):
+        mets = metrics if metrics is not None else METRICS
+        PacedLoop.__init__(
+            self, name="lens", kind="lens",
+            interval_s=interval_s, interval_idle_s=interval_s,
+            backoff_base_s=backoff_base_s, backoff_cap_s=backoff_cap_s,
+            metrics=mets, failure_metric="lens.update_failures",
+            thread_name="lens-capacity", registry=registry)
+        self.gateway = gateway
+        self.saturation_delay_ms = float(saturation_delay_ms)
+        self.rate_alpha = float(rate_alpha)
+        self._lock = threading.Lock()  # LEAF: models + rows only
+        self._models: Dict[str, CapacityModel] = {}
+        self._rows: Dict[str, dict] = {}
+        self._updated_t: Optional[float] = None
+
+    def _round(self) -> None:
+        self.update()
+
+    def update(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """One capacity tick over every registered ring. `now`
+        (monotonic-like seconds) is injectable so tests hand-compute
+        windows; production ticks use time.monotonic(). Driven by ONE
+        thread at a time (the loop thread, or a foreground driver
+        while the loop is not started) — the PulseSampler rule.
+        Returns {ring id: derived row} for rings past their seeding
+        window."""
+        t = time.monotonic() if now is None else float(now)
+        # Engine snapshots are collected OUTSIDE our leaf lock.
+        snaps: Dict[str, tuple] = {}
+        backends, _ = self.gateway.router.snapshot()
+        for backend in backends:
+            snap_fn = getattr(backend.engine, "cost_snapshot", None)
+            if snap_fn is None:
+                continue  # stub/foreign engines have no cost plane
+            table_fn = getattr(backend.engine, "cost_table", None)
+            snaps[backend.ring_id] = (
+                snap_fn(), table_fn() if table_fn is not None else None)
+        rows: Dict[str, dict] = {}
+        retired: List[str] = []
+        with self._lock:
+            for rid in [r for r in self._models if r not in snaps]:
+                del self._models[rid]
+                self._rows.pop(rid, None)
+                retired.append(rid)
+            for rid, (snap, table) in snaps.items():
+                model = self._models.get(rid)
+                if model is None:
+                    model = self._models[rid] = CapacityModel(
+                        alpha=self.rate_alpha,
+                        saturation_delay_ms=self.saturation_delay_ms)
+                row = model.observe(snap, t, table)
+                if row is not None:
+                    self._rows[rid] = row
+                    rows[rid] = row
+            self._updated_t = t
+        # Publishing happens OUTSIDE the leaf (metrics owns its own).
+        for rid in retired:
+            for family in _RING_KEY_FAMILIES:
+                self.metrics.remove_prefix(f"{family}.{rid}")
+            self.metrics.inc("lens.rings_retired")
+        for rid, row in rows.items():
+            self.metrics.gauge(f"lens.busy.{rid}", row["busy"])
+            if row["capacity_keys_s"] is not None:
+                self.metrics.gauge(f"lens.capacity_keys_s.{rid}",
+                                   row["capacity_keys_s"])
+            if row["headroom_keys_s"] is not None:
+                self.metrics.gauge(f"lens.headroom.{rid}",
+                                   row["headroom_keys_s"])
+            self.metrics.gauge(f"lens.saturated.{rid}",
+                               row["saturated"])
+            self.metrics.observe_hist(f"lens.queue_delay_ms.{rid}",
+                                      row["queue_delay_ms"])
+        self.rounds += 1
+        self.mark_round()
+        self.metrics.inc("lens.updates")
+        return rows
+
+    # -- read side (CAPACITY verb / elastic loop / tests) --------------------
+    def headroom(self, ring_id: str) -> Optional[float]:
+        """The latest `lens.headroom.<ring>` estimate — keys/s this
+        ring can still absorb at the current kind mix (None before
+        the first loaded window)."""
+        with self._lock:
+            row = self._rows.get(ring_id)
+        return row["headroom_keys_s"] if row is not None else None
+
+    def rows(self) -> Dict[str, dict]:
+        with self._lock:
+            return {rid: dict(row) for rid, row in self._rows.items()}
+
+    def capacity_report(self) -> dict:
+        """The CAPACITY verb payload: every ring's derived capacity
+        row — the elastic policy loop's one-call decision input."""
+        with self._lock:
+            return {
+                "updated_t": self._updated_t,
+                "interval_s": self.interval_s,
+                "rings": {rid: dict(row)
+                          for rid, row in self._rows.items()},
+            }
+
+
+class ProfilerLoop(PacedLoop):
+    """Opt-in continuous profiling: every `interval_s`, capture one
+    bounded `metrics.device_trace` window (`capture_s` long) into
+    `directory/window-NNNNNN`, keeping only the newest `max_windows`
+    on disk (rotation — a week-long soak holds a recent timeline, not
+    a full week of profiles). `tracer` is injectable for tests (any
+    `tracer(path)` context manager); the default degrades to a no-op
+    wherever jax.profiler is unsupported, exactly like the bench's
+    `--trace`. OFF by default: nothing profiles unless a loop is
+    constructed AND started; `capture()` is the deterministic
+    foreground form."""
+
+    def __init__(self, directory: str, *, capture_s: float = 1.0,
+                 max_windows: int = 4, interval_s: float = 30.0,
+                 tracer=None, metrics: Optional[Metrics] = None,
+                 registry: Optional[HealthRegistry] = None):
+        mets = metrics if metrics is not None else METRICS
+        PacedLoop.__init__(
+            self, name="lens-profiler", kind="lens",
+            interval_s=interval_s, interval_idle_s=interval_s,
+            backoff_base_s=1.0, backoff_cap_s=60.0,
+            metrics=mets, failure_metric="lens.profile_failures",
+            thread_name="lens-profiler", registry=registry)
+        self.directory = str(directory)
+        self.capture_s = float(capture_s)
+        self.max_windows = int(max_windows)
+        self._tracer = tracer if tracer is not None else device_trace
+        # Numbering resumes past any windows a PREVIOUS process left
+        # in the directory: restarting at 0 would make _rotate (which
+        # keeps the lexically-newest names) delete every fresh capture
+        # while preserving the stale high-numbered ones.
+        self._window_n = 0
+        self._captured = 0
+        for path in self.windows():
+            tail = os.path.basename(path).rsplit("-", 1)[-1]
+            try:
+                self._window_n = max(self._window_n, int(tail) + 1)
+            except ValueError:
+                self._window_n = max(self._window_n, 1)
+
+    def _round(self) -> None:
+        self.capture()
+
+    def capture(self) -> str:
+        """One profiling window; returns the window path (which may
+        not exist when the platform's profiler degraded to a no-op).
+        The capture sleep is interruptible by close() — a stopping
+        loop never pins its thread for a full window."""
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory,
+                            f"window-{self._window_n:06d}")
+        self._window_n += 1
+        self._captured += 1
+        with self._tracer(path):
+            self._stop_ev.wait(self.capture_s)
+        self._rotate()
+        self.rounds += 1
+        self.mark_round()
+        self.metrics.inc("lens.profile_windows")
+        self.metrics.gauge("lens.profile_window_count",
+                           len(self.windows()))
+        return path
+
+    def windows(self) -> List[str]:
+        """On-disk window paths, oldest first."""
+        try:
+            names = sorted(n for n in os.listdir(self.directory)
+                           if n.startswith("window-"))
+        except OSError:
+            return []
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _rotate(self) -> None:
+        ws = self.windows()
+        while len(ws) > self.max_windows:
+            victim = ws.pop(0)
+            if os.path.isdir(victim):
+                shutil.rmtree(victim, ignore_errors=True)
+            else:
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass
+
+    def status(self) -> dict:
+        return {
+            "directory": self.directory,
+            "capture_s": self.capture_s,
+            "max_windows": self.max_windows,
+            "captured": self._captured,
+            "on_disk": len(self.windows()),
+            "running": self.thread.is_alive(),
+        }

@@ -1,0 +1,180 @@
+"""chordax-lens bench-trajectory report (ISSUE 14 satellite): render
+the repo's scattered performance evidence — `BENCH_r*.json` round
+records, `BENCH_LKG.json` last-known-good rows, `SOAK_RESULTS.jsonl`
+— into ONE markdown trajectory table with stale rows flagged VISIBLY.
+
+The standing "stale CPU smoke" caveat (ROADMAP: no TPU has answered
+since round 2; BENCH_LKG's serving-stack rows are stale-marked CPU
+placeholders) keeps hiding inside JSON `"stale": true` fields that
+nobody reads; this report makes it impossible to miss: every stale or
+value-less row renders with a `** STALE **` marker and the summary
+line counts them.
+
+CLI:  python -m p2p_dhts_tpu.lens.bench_report [--root DIR] [--out F.md]
+      (also reachable as `python bench.py --report`)
+API:  render_trajectory(root) -> str
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+STALE_MARK = "** STALE **"
+
+
+def _fmt_value(rec: dict) -> str:
+    v = rec.get("value")
+    if v is None:
+        return "—"
+    unit = rec.get("unit") or ""
+    return f"{v:g} {unit}".strip()
+
+
+def _is_stale(rec: dict) -> bool:
+    """A row is stale when it says so, when it carries no live value,
+    or when its only numbers are a replayed last-known-good."""
+    return bool(rec.get("stale")) or rec.get("value") is None \
+        or "last_known_good" in rec
+
+
+def load_rounds(root: str) -> Dict[str, dict]:
+    """{round label: {config: record}} from every BENCH_r*.json. Each
+    round file holds a driver envelope whose `parsed` field is the
+    bench's summary record (configs inlined when present)."""
+    out: Dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        label = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        configs = parsed.get("configs")
+        if isinstance(configs, list):
+            out[label] = {r.get("config", "?"): r for r in configs
+                          if isinstance(r, dict)}
+        else:
+            out[label] = {parsed.get("config", "headline"): parsed}
+    return out
+
+
+def load_lkg(root: str) -> Dict[str, dict]:
+    try:
+        with open(os.path.join(root, "BENCH_LKG.json"), "r",
+                  encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def load_soak(root: str) -> List[dict]:
+    rows: List[dict] = []
+    try:
+        with open(os.path.join(root, "SOAK_RESULTS.jsonl"), "r",
+                  encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
+
+def render_trajectory(root: str = ".") -> str:
+    rounds = load_rounds(root)
+    lkg = load_lkg(root)
+    soak = load_soak(root)
+    out: List[str] = ["# chordax bench trajectory", ""]
+
+    n_stale = 0
+    out += ["## Last known good (BENCH_LKG.json)", ""]
+    if lkg:
+        out += ["| config | value | device | when | status |",
+                "|---|---|---|---|---|"]
+        for config in sorted(lkg):
+            rec = lkg[config]
+            if not isinstance(rec, dict):
+                continue
+            stale = _is_stale(rec)
+            n_stale += stale
+            out.append(
+                f"| `{config}` | {_fmt_value(rec)} | "
+                f"{rec.get('device', '?')} | {rec.get('utc', '?')} | "
+                + (STALE_MARK if stale else "green") + " |")
+    else:
+        out.append("_no BENCH_LKG.json_")
+
+    out += ["", "## Round records (BENCH_r*.json)", ""]
+    if rounds:
+        out += ["| round | config | value | device | status |",
+                "|---|---|---|---|---|"]
+        for label in sorted(rounds):
+            for config in sorted(rounds[label]):
+                rec = rounds[label][config]
+                stale = _is_stale(rec)
+                n_stale += stale
+                out.append(
+                    f"| {label} | `{config}` | {_fmt_value(rec)} | "
+                    f"{rec.get('device', '?')} | "
+                    + (STALE_MARK if stale else "green") + " |")
+    else:
+        out.append("_no BENCH_r*.json round records_")
+
+    out += ["", "## Soak results (SOAK_RESULTS.jsonl)", ""]
+    if soak:
+        n_pass = sum(1 for r in soak if r.get("outcome") == "passed")
+        n_fail = len(soak) - n_pass
+        last = max((r.get("utc", "") for r in soak), default="?")
+        out.append(f"{len(soak)} soak rows: {n_pass} passed, "
+                   f"{n_fail} not-passed; newest {last}.")
+        if n_fail:
+            out += ["", "| test | outcome | when |", "|---|---|---|"]
+            for r in soak:
+                if r.get("outcome") != "passed":
+                    out.append(f"| `{r.get('test', '?')}` | "
+                               f"{r.get('outcome', '?')} | "
+                               f"{r.get('utc', '?')} |")
+    else:
+        out.append("_no SOAK_RESULTS.jsonl_")
+
+    out += ["",
+            f"**{n_stale} stale/value-less row(s)** — every one marked "
+            f"`{STALE_MARK.strip('* ')}` above is a replayed "
+            f"placeholder or CPU smoke awaiting fresh on-chip "
+            f"evidence, not a live hardware record.", ""]
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m p2p_dhts_tpu.lens.bench_report",
+        description="bench/soak trajectory table with stale rows "
+                    "flagged")
+    ap.add_argument("--root", default=".",
+                    help="repo root holding the BENCH_* artifacts")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown here (default: stdout)")
+    args = ap.parse_args(argv)
+    text = render_trajectory(args.root)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

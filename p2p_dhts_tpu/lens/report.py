@@ -1,0 +1,190 @@
+"""chordax-lens profile report: digest a Chrome trace export (the
+chordax-scope `SpanStore.export_chrome()` document — the same file the
+watcher archives next to each bench record) into a per-kind
+cost-breakdown table, so an archived timeline is ANALYZED, not just a
+raw artifact (ROADMAP item 4: "profile the traced device timeline and
+attack what it shows").
+
+Three views, one markdown document:
+
+  * PER-KIND BATCH COST — every `serve.batch.<kind>` span grouped by
+    kind: dispatch count, total/mean duration, share of all batch
+    time, mean fill. The "what does each kind actually cost" table.
+  * DISPATCH-STAGE DECOMPOSITION — the batch sub-spans
+    (`serve.coalesce` / `serve.bucket_pad` / `serve.device_dispatch` /
+    `serve.deliver`) summed: where a batch's wall time goes (a
+    matmul-bound profile shows device_dispatch dominating; a
+    host-bound one shows the pads/delivery).
+  * REQUEST-PATH SHARE — `serve.request.<kind>` spans per kind:
+    count + mean end-to-end latency (submit -> fan-out, queue wait
+    included) — the caller's view next to the device's.
+
+Fused batches (`serve.batch.fused`) additionally split their time by
+the `lane_share` annotation each fused span carries (ISSUE 14
+satellite), so fused device time attributes back to the kinds that
+rode it.
+
+CLI:  python -m p2p_dhts_tpu.lens.report --chrome TRACE.json [--out R.md]
+API:  report_from_chrome(doc) / report_from_store(span_store) -> str
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+_BATCH_PREFIX = "serve.batch."
+_REQUEST_PREFIX = "serve.request."
+_STAGES = ("serve.coalesce", "serve.bucket_pad",
+           "serve.device_dispatch", "serve.deliver")
+
+
+def _rows(doc: dict) -> List[dict]:
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("not a Chrome trace document: no traceEvents")
+    return [ev for ev in events if isinstance(ev, dict)]
+
+
+def cost_breakdown(doc: dict) -> dict:
+    """The numeric digest of one Chrome export (durations in ms)."""
+    batches: Dict[str, dict] = {}
+    stages: Dict[str, dict] = {}
+    requests: Dict[str, dict] = {}
+    fused_attrib: Dict[str, float] = {}
+    for ev in _rows(doc):
+        name = ev.get("name", "")
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        args = ev.get("args") or {}
+        if name.startswith(_BATCH_PREFIX):
+            kind = name[len(_BATCH_PREFIX):]
+            row = batches.setdefault(
+                kind, {"n": 0, "total_ms": 0.0, "fill_sum": 0.0,
+                       "fill_n": 0})
+            row["n"] += 1
+            row["total_ms"] += dur_ms
+            if isinstance(args.get("fill"), (int, float)):
+                row["fill_sum"] += float(args["fill"])
+                row["fill_n"] += 1
+            share = args.get("lane_share")
+            if kind == "fused" and isinstance(share, dict):
+                for k, s in share.items():
+                    try:
+                        fused_attrib[k] = fused_attrib.get(k, 0.0) + \
+                            dur_ms * float(s)
+                    except (TypeError, ValueError):
+                        continue
+        elif name in _STAGES:
+            row = stages.setdefault(name, {"n": 0, "total_ms": 0.0})
+            row["n"] += 1
+            row["total_ms"] += dur_ms
+        elif name.startswith(_REQUEST_PREFIX):
+            kind = name[len(_REQUEST_PREFIX):]
+            row = requests.setdefault(kind, {"n": 0, "total_ms": 0.0})
+            row["n"] += 1
+            row["total_ms"] += dur_ms
+    return {"batches": batches, "stages": stages,
+            "requests": requests, "fused_attribution": fused_attrib}
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.3f}"
+
+
+def render_markdown(breakdown: dict, title: str = "chordax-lens "
+                    "profile report") -> str:
+    """The human half: one markdown document per digest."""
+    out: List[str] = [f"# {title}", ""]
+    batches = breakdown["batches"]
+    total_batch_ms = sum(r["total_ms"] for r in batches.values())
+    out.append("## Per-kind batch cost")
+    out.append("")
+    if batches:
+        out.append("| kind | batches | total ms | mean ms | share | "
+                   "mean fill |")
+        out.append("|---|---|---|---|---|---|")
+        for kind in sorted(batches,
+                           key=lambda k: -batches[k]["total_ms"]):
+            r = batches[kind]
+            share = (r["total_ms"] / total_batch_ms * 100
+                     if total_batch_ms else 0.0)
+            fill = (r["fill_sum"] / r["fill_n"]
+                    if r["fill_n"] else None)
+            out.append(
+                f"| `{kind}` | {r['n']} | {_fmt(r['total_ms'])} | "
+                f"{_fmt(r['total_ms'] / r['n'])} | {share:.1f}% | "
+                + (f"{fill:.3f} |" if fill is not None else "n/a |"))
+    else:
+        out.append("_no serve.batch spans in this export_")
+    fused = breakdown["fused_attribution"]
+    if fused:
+        out += ["", "## Fused batch time, attributed by lane share",
+                "", "| kind | attributed ms |", "|---|---|"]
+        for kind in sorted(fused, key=lambda k: -fused[k]):
+            out.append(f"| `{kind}` | {_fmt(fused[kind])} |")
+    stages = breakdown["stages"]
+    if stages:
+        stage_total = sum(r["total_ms"] for r in stages.values())
+        out += ["", "## Dispatch-stage decomposition", "",
+                "| stage | spans | total ms | share |", "|---|---|---|---|"]
+        for name in _STAGES:
+            r = stages.get(name)
+            if r is None:
+                continue
+            share = (r["total_ms"] / stage_total * 100
+                     if stage_total else 0.0)
+            out.append(f"| `{name}` | {r['n']} | "
+                       f"{_fmt(r['total_ms'])} | {share:.1f}% |")
+    requests = breakdown["requests"]
+    if requests:
+        out += ["", "## Request-path latency (submit -> fan-out)", "",
+                "| kind | requests | mean ms |", "|---|---|---|"]
+        for kind in sorted(requests,
+                           key=lambda k: -requests[k]["total_ms"]):
+            r = requests[kind]
+            out.append(f"| `{kind}` | {r['n']} | "
+                       f"{_fmt(r['total_ms'] / r['n'])} |")
+    out.append("")
+    return "\n".join(out)
+
+
+def report_from_chrome(doc: dict, title: str = "chordax-lens profile "
+                       "report") -> str:
+    return render_markdown(cost_breakdown(doc), title)
+
+
+def report_from_store(store, title: str = "chordax-lens profile "
+                      "report (live SpanStore)") -> str:
+    """Digest a live chordax-scope SpanStore (no file round trip)."""
+    return report_from_chrome(json.loads(store.export_chrome()), title)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m p2p_dhts_tpu.lens.report",
+        description="per-kind cost breakdown of a Chrome trace export")
+    ap.add_argument("--chrome", required=True,
+                    help="Chrome trace-event JSON "
+                         "(SpanStore.export_chrome output)")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown here (default: stdout)")
+    ap.add_argument("--title", default=None)
+    args = ap.parse_args(argv)
+    with open(args.chrome, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    text = report_from_chrome(
+        doc, args.title if args.title is not None
+        else f"chordax-lens profile report — {args.chrome}")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

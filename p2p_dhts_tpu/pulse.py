@@ -64,9 +64,12 @@ DEFAULT_RING_POINTS = 128
 
 #: Metric-key prefixes the sampler tracks by default: the serving
 #: families whose rates/percentiles the elastic loop and the watcher
-#: consume. Operator-extensible per sampler.
+#: consume. Operator-extensible per sampler. "lens." makes the
+#: chordax-lens capacity plane (ISSUE 14) — busy fraction, headroom,
+#: saturation, queue delay — pulse series (and SLO-selectable) for
+#: free.
 DEFAULT_PREFIXES = ("serve.", "gateway.", "rpc.", "repair.",
-                    "membership.")
+                    "membership.", "lens.")
 
 #: Verdicts, in escalation order.
 OK, WARN, BREACH = "OK", "WARN", "BREACH"

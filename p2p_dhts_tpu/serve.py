@@ -69,6 +69,22 @@ semantics — hop parity is non-negotiable):
     fusion — while a never-warmed engine fuses on demand. Counted
     `serve.fused_batches`, occupancy under `serve.fused_occupancy` +
     per-kind `serve.fused_lane_share.<kind>`.
+  * DEVICE-COST ACCOUNTING (ISSUE 14, chordax-lens) — every dispatch
+    records its wall cost (launch start -> host sync end) into a
+    per-(kind, bucket) EWMA + histogram (`serve.cost_ms.<kind>.b<n>`),
+    its live-vs-padded lane split (`serve.lanes_live` /
+    `serve.lanes_padded`, `serve.pad_waste.<kind>`), the accumulated
+    device-time proxy (`serve.device_time_us` — the busy-fraction
+    numerator), and the FIFO head's queue delay
+    (`serve.queue_delay_ms` — the saturation signal) — ALWAYS ON,
+    independent of `trace.enabled()` (cheap counters;
+    `cost_accounting=False` is the bench's disabled baseline). Every
+    `_trace_counts` increment additionally lands in a compile-cause
+    LEDGER stamped with its measured duration and cause (warmup /
+    on-demand / fused / degenerate-group), so the zero-retrace
+    contract has a paper trail. Read side: `cost_table()`,
+    `cost_snapshot()`, `compile_ledger()` — the decision inputs the
+    `p2p_dhts_tpu.lens` capacity/headroom model consumes.
 
 Request kinds:
 
@@ -169,6 +185,13 @@ FUSE_KINDS = VECTOR_KINDS
 _MUTATOR_KINDS = ("dhash_put", "repair_reindex", "churn_apply",
                   "stabilize_sweep", "dhash_maintain")
 
+#: Kinds with NO per-lane input (one kernel call serves the whole
+#: batch): their dispatches carry no key lanes, so the chordax-lens
+#: padding-waste accounting records them lane-less (bucket 0, zero pad)
+#: instead of charging them phantom padded lanes.
+_NO_LANE_KINDS = frozenset({"sync_digest", "repair_reindex",
+                            "stabilize_sweep", "dhash_maintain"})
+
 _SENTINEL = object()
 
 
@@ -241,6 +264,37 @@ class _BatchTrace:
         self.t_sync0 = self.t_results = 0.0
 
 
+class _Cost:
+    """chordax-lens (ISSUE 14): one dispatch's ALWAYS-ON device-cost
+    record — built for every batch regardless of `trace.enabled()`
+    (unlike _BatchTrace), so the capacity/headroom model has
+    dispatch-time and padding data even with tracing off. A handful of
+    scalar fields filled as the dispatch proceeds; the accounting lands
+    at completion (`_account_cost`). cost_accounting=False on the
+    engine skips construction entirely (the bench's disabled
+    baseline — one attribute read per dispatch, nothing else)."""
+
+    __slots__ = ("kind", "bucket", "live", "padded", "kinds", "t0",
+                 "queue_delay_s", "warm_gen")
+
+    def __init__(self) -> None:
+        self.kind = ""
+        self.bucket = 0
+        self.live = 0
+        self.padded = 0
+        #: Distinct kinds in the dispatched group (>= 2 for a genuine
+        #: fused group; 1 marks the degenerate post-shed remnant that
+        #: still rides the fused program).
+        self.kinds = 1
+        self.t0 = 0.0
+        self.queue_delay_s = 0.0
+        #: The engine's warmup generation at launch start: any
+        #: warmup() activity DURING the launch window (even one that
+        #: started and finished entirely inside it) changes the
+        #: generation, telling the stamping to stand down.
+        self.warm_gen = 0
+
+
 def _buckets_between(lo: int, hi: int) -> List[int]:
     if lo <= 0 or (lo & (lo - 1)) or hi <= 0 or (hi & (hi - 1)):
         raise ValueError(f"bucket bounds must be powers of two, got "
@@ -276,6 +330,10 @@ class ServeEngine:
     # Collection sleep granularity: a full bucket dispatches at most
     # this late, and early-arriving full batches don't wait the window.
     _POLL_S = 200e-6
+    # chordax-lens: per-(kind, bucket) dispatch-time EWMA smoothing —
+    # recent dispatches dominate, one slow outlier cannot wipe the
+    # estimate.
+    _COST_EWMA_ALPHA = 0.25
 
     def __init__(self, state=None, store=None, *,
                  n: int = 14, m: int = 10, p: int = 257,
@@ -285,6 +343,7 @@ class ServeEngine:
                  merkle_depth: int = 4, merkle_fanout_bits: int = 3,
                  metrics: Optional[Metrics] = None,
                  fuse: bool = True,
+                 cost_accounting: bool = True,
                  name: str = "serve"):
         self._state = state
         self._store = store
@@ -368,6 +427,51 @@ class ServeEngine:
         self._fill_sum = 0.0
         self._lat: Dict[str, collections.deque] = {
             k: collections.deque(maxlen=8192) for k in KINDS}
+
+        # chordax-lens (ISSUE 14): always-on device-cost accounting.
+        # cost_accounting=False is the bench's disabled baseline (the
+        # <= 5% overhead gate measures against it); everything below is
+        # then zero-touch — no _Cost objects, no metric keys, no
+        # ledger rows. All fields _lock-protected like the telemetry
+        # above.
+        self._cost_on = bool(cost_accounting)
+        #: Per-(kind, bucket) dispatch-time EWMA (ms, launch start ->
+        #: host sync end) + lane accounting — the cost table the
+        #: capacity model and the CAPACITY verb read.
+        self._cost: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._device_time_s = 0.0
+        # Busy-union watermark: the pipelined dispatcher launches
+        # batch k+1 while batch k syncs, so summing per-batch
+        # [launch, sync] intervals would double-count the overlap and
+        # read busy > 1. device_time_s accumulates the UNION instead
+        # (each interval clipped to start past the previous high-water
+        # mark) — the honest busy-fraction numerator.
+        self._busy_until = 0.0
+        self._device_time_by_kind: Dict[str, float] = {}
+        self._lanes_live = 0
+        self._lanes_padded = 0
+        self._queue_delay_sum_ms = 0.0
+        self._queue_delay_n = 0
+        #: Compile-cause ledger: every _trace_counts increment stamped
+        #: with its measured duration and cause (warmup / on-demand /
+        #: fused / degenerate-group), newest win. A warmed engine's
+        #: steady state appends NOTHING here — the zero-retrace
+        #: contract, now with a paper trail.
+        self.compile_log: collections.deque = collections.deque(
+            maxlen=256)
+        # >0 while warmup() is tracing (the engine may already be
+        # serving — the mid-loop fused-arming case): the dispatch
+        # path's stamping stands down so a warmup-owned trace is never
+        # mis-stamped "on-demand" by a concurrent dispatcher snapshot
+        # diff (it lands once, as "warmup", from _stamp_warm). The
+        # GENERATION counter closes the start-and-finish-inside-one-
+        # launch-window race: _cost_begin captures it, and a changed
+        # generation at stamp time means a warmup ran somewhere inside
+        # the window (a genuine dispatch-path trace in that same
+        # window is then skipped too — a bounded misattribution in the
+        # rare arming-while-serving case, never a wrong-cause row).
+        self._warming = 0
+        self._warm_gen = 0
 
         # jit plumbing, built lazily (importing this module must not
         # touch jax — overlay etiquette, jax_bridge docstring).
@@ -622,13 +726,18 @@ class ServeEngine:
                     # stage is empty by construction.
                     btr = _BatchTrace()
                     btr.t_w0 = btr.t_w1 = slots[0].t_submit
+                cost = self._cost_begin(slots)
+                tc0 = dict(self._trace_counts) if cost is not None \
+                    else None
                 try:
                     if btr is not None:
                         btr.t_launch0 = time.perf_counter()
-                    handle = self._launch(slots)
+                    handle = self._launch(slots, cost)
                     if btr is not None:
                         btr.t_launch1 = time.perf_counter()
-                    self._complete_one(slots, handle, btr)
+                    if cost is not None:
+                        self._stamp_compiles(tc0, cost)
+                    self._complete_one(slots, handle, btr, cost)
                 except BaseException as exc:  # noqa: BLE001 — fanned out
                     self._deliver_error(slots, exc)
                 finally:
@@ -799,12 +908,30 @@ class ServeEngine:
             if not self._kind_available(kind):
                 raise ValueError(f"cannot warm {kind!r}: engine lacks "
                                  "the state/store it needs")
-        for kind in kinds:
-            for b in self._buckets:
-                self._warm_one(kind, b, np)
+        self._warming += 1
+        self._warm_gen += 1
+        try:
+            for kind in kinds:
+                for b in self._buckets:
+                    t0 = time.perf_counter()
+                    tc0 = dict(self._trace_counts)
+                    self._warm_one(kind, b, np)
+                    self._stamp_warm(b, tc0, t0)
+            if want_fused:
+                for b in self._buckets:
+                    t0 = time.perf_counter()
+                    tc0 = dict(self._trace_counts)
+                    self._warm_fused(b, np)
+                    self._stamp_warm(b, tc0, t0)
+        finally:
+            self._warming -= 1
+            # Bumped at EXIT as well: a warmup already in flight when
+            # a concurrent dispatch captured the generation, ending
+            # before that dispatch stamps, must still change the
+            # generation — otherwise its traces would pass both
+            # guards and double-stamp with a wrong cause.
+            self._warm_gen += 1
         if want_fused:
-            for b in self._buckets:
-                self._warm_fused(b, np)
             # Armed only once EVERY bucket is traced: the engine may
             # already be serving, and flipping mid-loop would let a
             # mixed burst dispatch fused at a not-yet-warmed bucket —
@@ -932,6 +1059,157 @@ class ServeEngine:
             raise AssertionError(
                 f"serve loop retraced {n} time(s) after warmup — a "
                 f"dispatch missed the pre-traced buckets")
+
+    # -- device-cost accounting (chordax-lens, ISSUE 14) --------------------
+
+    @property
+    def cost_accounting(self) -> bool:
+        return self._cost_on
+
+    def _cost_begin(self, batch: List[_Slot]) -> Optional[_Cost]:
+        """The per-dispatch cost record (None when accounting is off —
+        one attribute read, the trace.enabled() discipline). batch[0]
+        is the FIFO head, so its submit instant anchors the
+        queue-delay saturation signal."""
+        if not self._cost_on:
+            return None
+        c = _Cost()
+        c.t0 = time.perf_counter()
+        c.queue_delay_s = max(c.t0 - batch[0].t_submit, 0.0)
+        c.warm_gen = self._warm_gen
+        return c
+
+    def _stamp_compiles(self, tc0: Dict[str, int], cost: _Cost,
+                        cause: Optional[str] = None) -> None:
+        """Compile-cause stamping: any _trace_counts growth across the
+        launch lands in the ledger with the measured duration (the
+        launch wall time — jax traces AND compiles inside the call)
+        and its cause. Steady state on a warmed engine appends
+        nothing (the snapshot diff is empty). While a concurrent
+        warmup() is tracing — or if one ran ANYWHERE inside this
+        launch window (the generation check) — the dispatch path
+        stands down: the warmup owns those increments and stamps them
+        itself."""
+        if cause is None and (self._warming
+                              or cost.warm_gen != self._warm_gen):
+            return
+        now = time.perf_counter()
+        for kindkey, n in self._trace_counts.items():
+            d = n - tc0.get(kindkey, 0)
+            if d <= 0:
+                continue
+            if cause is not None:
+                why = cause
+            elif kindkey == "fused":
+                why = "degenerate-group" if cost.kinds < 2 else "fused"
+            else:
+                why = "on-demand"
+            ms = (now - cost.t0) * 1e3
+            rec = {"kind": kindkey, "bucket": cost.bucket, "cause": why,
+                   "n": d, "ms": round(ms, 3), "t": now}
+            with self._lock:
+                self.compile_log.append(rec)
+            self._metrics.observe_hist(f"serve.compile_ms.{kindkey}", ms)
+            self._metrics.inc(f"serve.compiles.{why}", d)
+
+    def _stamp_warm(self, bucket: int, tc0: Dict[str, int],
+                    t0: float) -> None:
+        """Warmup-path compile stamping (off the dispatch path). `tc0`
+        is the FULL pre-warm trace-count snapshot — only this warm
+        call's own traces land, never a re-count of earlier kinds'."""
+        if not self._cost_on:
+            return
+        c = _Cost()
+        c.t0 = t0
+        c.bucket = bucket
+        c.kinds = 2  # never "degenerate-group": warmup names the cause
+        self._stamp_compiles(tc0, c, cause="warmup")
+
+    def _account_cost(self, cost: _Cost, now: float) -> None:
+        """Completion-side accounting for one dispatched batch:
+        per-(kind, bucket) EWMA + histogram of the dispatch wall
+        (launch start -> host sync end — the device-time proxy the
+        busy-fraction model consumes), lane/padding totals, and the
+        queue-delay accumulators. Failed batches never account (their
+        timings measure the failure, not the kernel)."""
+        dt = now - cost.t0
+        ms = dt * 1e3
+        key = (cost.kind, cost.bucket)
+        qd_ms = cost.queue_delay_s * 1e3
+        with self._lock:
+            row = self._cost.get(key)
+            if row is None:
+                row = self._cost[key] = {
+                    "ewma_ms": ms, "n": 0, "last_ms": ms,
+                    "lanes_live": 0, "lanes_padded": 0}
+            else:
+                row["ewma_ms"] += self._COST_EWMA_ALPHA * \
+                    (ms - row["ewma_ms"])
+                row["last_ms"] = ms
+            row["n"] += 1
+            row["lanes_live"] += cost.live
+            row["lanes_padded"] += cost.padded
+            # The union contribution: only the part of [t0, now] past
+            # the previous dispatch's high-water mark counts toward
+            # busy time (pipeline overlap otherwise double-counts).
+            clipped = now - max(cost.t0, self._busy_until)
+            if clipped > 0:
+                self._device_time_s += clipped
+            else:
+                clipped = 0.0
+            self._busy_until = max(self._busy_until, now)
+            self._device_time_by_kind[cost.kind] = \
+                self._device_time_by_kind.get(cost.kind, 0.0) + dt
+            self._lanes_live += cost.live
+            self._lanes_padded += cost.padded
+            self._queue_delay_sum_ms += qd_ms
+            self._queue_delay_n += 1
+        self._metrics.observe_hist(
+            f"serve.cost_ms.{cost.kind}.b{cost.bucket}", ms)
+        if clipped:
+            self._metrics.inc("serve.device_time_us",
+                              int(clipped * 1e6))
+        self._metrics.inc("serve.lanes_live", cost.live)
+        if cost.padded:
+            self._metrics.inc("serve.lanes_padded", cost.padded)
+        total = cost.live + cost.padded
+        if total and cost.bucket:
+            self._metrics.observe_hist(f"serve.pad_waste.{cost.kind}",
+                                       cost.padded / total)
+        self._metrics.observe_hist("serve.queue_delay_ms", qd_ms)
+
+    def cost_table(self) -> Dict[str, Dict[int, dict]]:
+        """{kind: {bucket: {ewma_ms, last_ms, n, lanes_live,
+        lanes_padded}}} — the per-(kind, bucket) dispatch-cost view
+        bucket-sizing decisions and the CAPACITY verb read."""
+        with self._lock:
+            out: Dict[str, Dict[int, dict]] = {}
+            for (kind, bucket), row in self._cost.items():
+                out.setdefault(kind, {})[bucket] = dict(row)
+        return out
+
+    def cost_snapshot(self) -> dict:
+        """The cheap monotonic-accumulator view the lens capacity loop
+        deltas per tick (one lock, no copies beyond small dicts)."""
+        with self._lock:
+            return {
+                "device_time_s": self._device_time_s,
+                "device_time_by_kind": dict(self._device_time_by_kind),
+                "lanes_live": self._lanes_live,
+                "lanes_padded": self._lanes_padded,
+                "queue_delay_sum_ms": self._queue_delay_sum_ms,
+                "queue_delay_n": self._queue_delay_n,
+                "requests_served": self.requests_served,
+                "queue_depth": len(self._pending),
+            }
+
+    def compile_ledger(self) -> List[dict]:
+        """The compile-cause ledger, oldest first (bounded; newest
+        win): every jit trace this engine ever paid, stamped with kind,
+        bucket, cause (warmup / on-demand / fused / degenerate-group)
+        and measured duration."""
+        with self._lock:
+            return [dict(r) for r in self.compile_log]
 
     # -- stats --------------------------------------------------------------
 
@@ -1202,12 +1480,17 @@ class ServeEngine:
                     continue
                 try:
                     self._adapt_window(batch)
+                    cost = self._cost_begin(batch)
+                    tc0 = dict(self._trace_counts) if cost is not None \
+                        else None
                     try:
                         if btr is not None:
                             btr.t_launch0 = time.perf_counter()
-                        handle = self._launch(batch)
+                        handle = self._launch(batch, cost)
                         if btr is not None:
                             btr.t_launch1 = time.perf_counter()
+                        if cost is not None:
+                            self._stamp_compiles(tc0, cost)
                     except BaseException as exc:  # noqa: BLE001 — fanned
                         self._quarantine_or_fail(batch, exc)
                         batch = []
@@ -1226,9 +1509,9 @@ class ServeEngine:
                     # out right here instead of paying a thread handoff
                     # (the uncontended-latency path). Under load the
                     # handoff buys pipelining, so it stays.
-                    self._complete_one(batch, handle, btr)
+                    self._complete_one(batch, handle, btr, cost)
                 else:
-                    self._inflight.put((batch, handle, btr))
+                    self._inflight.put((batch, handle, btr, cost))
                 batch = []  # handed off; not ours to fail anymore
         except BaseException as exc:  # noqa: BLE001 — engine is wedged
             self._late_errors.append(exc)
@@ -1253,15 +1536,40 @@ class ServeEngine:
 
     def _collect_window(self) -> None:
         """Coalescing wait: sleep the adaptive window in fine slices,
-        bailing as soon as a full bucket is pending (or shutdown)."""
+        bailing as soon as a full bucket is pending (or shutdown). A
+        head-of-queue VECTOR chunk shortens the wait: it is already
+        full-width, so the only thing waiting can buy is a FUSION
+        partner of another kind — one poll slice covers a genuinely
+        concurrent mixed burst, while the full adaptive window (up to
+        window_cap_s) was pure dead time between chunk dispatches
+        under vector load (the lens cost accounting, ISSUE 14, exposed
+        it: ~3-6x vector-drive throughput on the CPU smoke host). A
+        quarantined retry, or a vec head on an engine that cannot
+        fuse, bails immediately — those dispatch alone no matter
+        what."""
         window = self._window_s
         if window <= 0:
             return
-        deadline = time.perf_counter() + window
+        t0 = time.perf_counter()
+        deadline = t0 + window
         while True:
             with self._lock:
                 if len(self._pending) >= self._bucket_max or self._closing:
                     return
+                head = self._pending[0] if self._pending else None
+                if head is not None and (head.vec or head.retried):
+                    if head.retried or not (
+                            self._fuse and (
+                                self._fused_warmed
+                                or self._warmup_trace_counts is None)):
+                        return
+                    if len(self._pending) > 1:
+                        # A run is already queued behind the chunk:
+                        # whatever fusion partners exist are HERE —
+                        # _pop_batch mixes them now; waiting longer
+                        # only delays a full-width dispatch.
+                        return
+                    deadline = min(deadline, t0 + self._POLL_S)
             rem = deadline - time.perf_counter()
             if rem <= 0:
                 return
@@ -1352,11 +1660,13 @@ class ServeEngine:
         self._metrics.gauge("serve.window_us", self._window_s * 1e6)
         self._metrics.gauge("serve.queue_depth", backlog)
 
-    def _launch(self, batch: List[_Slot]):
+    def _launch(self, batch: List[_Slot], cost: Optional[_Cost] = None):
         """Build padded device inputs and launch the kernel (async).
         Returns an opaque handle the completion thread syncs + fans
         out. Pad lanes replicate the first request — semantically a
-        repeat, never a new action (module docstring)."""
+        repeat, never a new action (module docstring). `cost` (when
+        accounting is on) picks up the dispatch's kind/bucket/lane
+        shape here; the timing lands at completion."""
         from p2p_dhts_tpu import keyspace
         kern = self._get_kernels()
         jnp, np = kern["jnp"], kern["np"]
@@ -1367,13 +1677,24 @@ class ServeEngine:
         # degenerate shapes hit the pre-traced fused program.
         if len({s.kind for s in batch}) >= 2 or (
                 len(batch) > 1 and any(s.vec for s in batch)):
-            return self._launch_fused(batch, kern, jnp, np)
+            return self._launch_fused(batch, kern, jnp, np, cost)
         if batch[0].vec:
-            return self._launch_vector(batch[0], kern, jnp, np)
+            return self._launch_vector(batch[0], kern, jnp, np, cost)
         kind = batch[0].kind
         size = len(batch)
         bucket = self._bucket_for(size)
         pad = bucket - size
+        if cost is not None:
+            cost.kind = kind
+            cost.live = size
+            if kind in _NO_LANE_KINDS:
+                # One kernel call serves the whole batch — no key
+                # lanes exist, so no padding waste to charge.
+                cost.bucket = 0
+                cost.padded = 0
+            else:
+                cost.bucket = bucket
+                cost.padded = pad
 
         if havoc_mod.enabled():
             # chordax-havoc (ISSUE 10): dispatch-failure injection,
@@ -1551,7 +1872,8 @@ class ServeEngine:
                 self._store = new_store
         return ("dhash_put", ok, prev_store, epoch)
 
-    def _launch_vector(self, slot: _Slot, kern, jnp, np):
+    def _launch_vector(self, slot: _Slot, kern, jnp, np,
+                       cost: Optional[_Cost] = None):
         """Dispatch one VECTOR chunk (chordax-fastlane): the payload's
         numpy arrays pad to the chunk's power-of-two bucket by
         replicating row 0 (a repeat, never a new action — the scalar
@@ -1563,6 +1885,11 @@ class ServeEngine:
         c = slot.vec
         bucket = self._bucket_for(c)
         pad = bucket - c
+        if cost is not None:
+            cost.kind = kind
+            cost.bucket = bucket
+            cost.live = c
+            cost.padded = pad
 
         if havoc_mod.enabled():
             # The engine-level dispatch-failure site applies to vector
@@ -1651,7 +1978,8 @@ class ServeEngine:
             np.zeros((b,), np.int32))
         return block.astype(np.int32, copy=False)
 
-    def _launch_fused(self, batch: List[_Slot], kern, jnp, np):
+    def _launch_fused(self, batch: List[_Slot], kern, jnp, np,
+                      cost: Optional[_Cost] = None):
         """Dispatch one multi-kind FUSED group (chordax-fuse): the
         host-side kind selector (each slot's kind) partitions the
         group's lanes into per-kind blocks, every block pads to ONE
@@ -1694,6 +2022,16 @@ class ServeEngine:
         # and each kind's share of the real lanes.
         n_blocks = 3 if self._store is not None else 2
         fill = total / (bucket * n_blocks)
+        if cost is not None:
+            cost.kind = "fused"
+            cost.bucket = bucket
+            cost.live = total
+            # Padding waste counts EVERY padded block lane the fused
+            # program computes — absent kinds' dummy blocks included —
+            # the honest whole-program denominator (matches
+            # serve.fused_occupancy).
+            cost.padded = bucket * n_blocks - total
+            cost.kinds = len(present)
         with self._lock:
             self.batch_log.append(("fused", total, bucket))
             self.batches_served += 1
@@ -1735,15 +2073,16 @@ class ServeEngine:
             item = self._inflight.get()
             if item is _SENTINEL:
                 return
-            batch, handle, btr = item
+            batch, handle, btr, cost = item
             try:
-                self._complete_one(batch, handle, btr)
+                self._complete_one(batch, handle, btr, cost)
             finally:
                 with self._lock:
                     self._inflight_n -= 1
 
     def _complete_one(self, batch: List[_Slot], handle,
-                      btr: Optional[_BatchTrace] = None) -> None:
+                      btr: Optional[_BatchTrace] = None,
+                      cost: Optional[_Cost] = None) -> None:
         """Device->host sync + fan-out for one launched batch (runs on
         the completion thread, or inline on the dispatcher when the
         engine is idle)."""
@@ -1860,6 +2199,8 @@ class ServeEngine:
         now = time.perf_counter()
         if btr is not None:
             btr.t_results = now
+        if cost is not None:
+            self._account_cost(cost, now)
         # Latencies record per SLOT kind (a fused batch spans kinds;
         # single-kind batches collapse to the old one-key behavior).
         by_kind: Dict[str, List[float]] = {}
@@ -1942,6 +2283,19 @@ class ServeEngine:
         t_end = time.perf_counter()
         size = len(batch)
         bucket = self._bucket_for(size)
+        # chordax-lens satellite (ISSUE 14): a fused batch span carries
+        # the MIX — each kind's share of the real lanes (request spans
+        # already carry the slot's kind; the batch span shows the
+        # anatomy, so a profile can attribute fused device time).
+        extra: Dict[str, Any] = {}
+        if kind == "fused":
+            counts: Dict[str, int] = {}
+            for slot in batch:
+                counts[slot.kind] = counts.get(slot.kind, 0) + \
+                    (slot.vec or 1)
+            total = sum(counts.values()) or 1
+            extra["lane_share"] = {k: round(v / total, 4)
+                                   for k, v in counts.items()}
         # One batch span PER DISTINCT TRACE the batch carries: a trace
         # queried alone (TRACE_STATUS TRACE_ID / export_chrome filter)
         # must resolve its requests' fan-in links without reaching into
@@ -1983,7 +2337,7 @@ class ServeEngine:
                 f"serve.batch.{kind}", btr.t_w0, t_end, trace_id=tid,
                 span_id=batch_sid, cat="serve", links=tuple(req_ids),
                 engine=self._name, size=size, bucket=bucket,
-                fill=round(size / bucket, 4))
+                fill=round(size / bucket, 4), **extra)
             for name, t0, t1 in (
                     ("serve.coalesce", btr.t_w0, btr.t_w1),
                     ("serve.bucket_pad", btr.t_launch0, btr.t_launch1),

@@ -395,6 +395,42 @@ def test_fused_series_reach_pulse(ring_state, seeded):
         eng.close()
 
 
+def test_fused_batch_span_carries_lane_share(engine, seeded):
+    """chordax-lens satellite (ISSUE 14): the serve.batch.fused
+    anatomy span carries per-kind lane-share annotations — PR 13 made
+    request spans carry the slot's kind; the batch span must show the
+    MIX, so a profile can attribute fused device time by kind."""
+    from p2p_dhts_tpu import trace
+    rng = np.random.RandomState(31)
+    keys = _rand_ids(rng, 4)
+    data_keys = seeded[0]
+    with trace.tracing() as tstore:
+        slots = _held_mixed_burst(engine, keys, data_keys)
+        for s in slots:
+            s.wait(120)
+    fused = [s for s in tstore.spans()
+             if s["name"] == "serve.batch.fused"]
+    assert fused, [s["name"] for s in tstore.spans()][:12]
+    share = fused[-1]["args"].get("lane_share")
+    assert share is not None, fused[-1]["args"]
+    # 4 keys x 3 kinds, one lane each: an even three-way split.
+    assert set(share) == {"find_successor", "dhash_get",
+                          "finger_index"}
+    assert sum(share.values()) == pytest.approx(1.0, abs=0.01)
+    for kind in share:
+        assert share[kind] == pytest.approx(1 / 3, abs=0.01)
+    # Single-kind batch spans stay annotation-free (the old shape).
+    with trace.tracing() as tstore2:
+        batch = engine.submit_many(
+            "find_successor", [(k, 0) for k in keys])
+        for s in batch:
+            s.wait(120)
+    plain = [s for s in tstore2.spans()
+             if s["name"].startswith("serve.batch.")]
+    assert plain and all("lane_share" not in (s["args"] or {})
+                         for s in plain)
+
+
 # ---------------------------------------------------------------------------
 # failure paths
 # ---------------------------------------------------------------------------

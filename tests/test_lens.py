@@ -1,0 +1,648 @@
+"""chordax-lens (ISSUE 14): device cost accounting, the
+capacity/headroom model, the CAPACITY verb, and the profiling hooks.
+
+Pins the tentpole's contracts:
+  * cost accounting is ALWAYS ON and exact — per-(kind, bucket) rows
+    count every dispatch, live/padded lane math is arithmetic on the
+    batch shape, the queue-delay signal measures a held queue;
+  * every jit trace lands in the compile-cause ledger with the right
+    cause (warmup / on-demand / fused / degenerate-group) — and a
+    warmed engine's steady state appends NOTHING;
+  * the capacity model is hand-computable: scripted snapshot deltas
+    produce the exact busy / capacity / headroom / saturation row,
+    headroom responds to load, idle windows keep the EWMA estimate;
+  * cost_accounting=False is zero-touch (no keys, no ledger, bounded
+    per-call overhead — the trace.enabled() discipline);
+  * the CAPACITY verb answers over a live server and the lens gauges
+    become pulse series (SLO-selectable);
+  * the profiler loop rotates its on-disk windows to the bound;
+  * the report tools digest a Chrome export / the bench artifacts.
+
+Engines here are small on purpose (one or two buckets, only the kinds
+a test exercises warmed) — each warms its own jit programs, so the
+per-test compile bill stays low on the 1-core CPU host.
+"""
+
+import contextlib
+import json
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.gateway.router import RingBackend, RingRouter
+from p2p_dhts_tpu.health import HealthRegistry
+from p2p_dhts_tpu.lens import (CapacityModel, LensLoop, ProfilerLoop,
+                               SAT_BUSY)
+from p2p_dhts_tpu.lens.bench_report import render_trajectory
+from p2p_dhts_tpu.lens.report import report_from_chrome
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.serve import ServeEngine
+
+pytestmark = pytest.mark.lens
+
+N_PEERS = 48
+SMAX = 4
+
+
+def _rand_ids(rng, n):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def ring_state():
+    rng = np.random.RandomState(20260805)
+    return build_ring(_rand_ids(rng, N_PEERS),
+                      RingConfig(finger_mode="materialized"))
+
+
+def _engine(ring_state, warm, *, store=False, bucket_max=8, **kw):
+    """A small single-bucket engine over a PRIVATE registry."""
+    mets = Metrics()
+    eng = ServeEngine(
+        ring_state,
+        empty_store(capacity=1024, max_segments=SMAX) if store
+        else None,
+        window_cap_s=0.001, bucket_min=8, bucket_max=bucket_max,
+        metrics=mets, name="lens-t", **kw).start()
+    if warm:
+        eng.warmup(warm)
+    return eng, mets
+
+
+# ---------------------------------------------------------------------------
+# cost accounting in the engine
+# ---------------------------------------------------------------------------
+
+def test_cost_table_and_padding_math_exact(ring_state):
+    eng, mets = _engine(ring_state, ["find_successor"])
+    try:
+        rng = np.random.RandomState(1)
+        keys = _rand_ids(rng, 5)
+        eng._test_hold.set()
+        try:
+            slots = eng.submit_many("find_successor",
+                                    [(k, 0) for k in keys])
+        finally:
+            eng._test_hold.clear()
+        for s in slots:
+            s.wait(120)
+        table = eng.cost_table()
+        row = table["find_successor"][8]  # 5 requests pad to bucket 8
+        assert row["n"] == 1
+        assert row["ewma_ms"] > 0 and row["last_ms"] > 0
+        # Padding-waste math: 5 live lanes, 3 padded, waste 3/8.
+        assert row["lanes_live"] == 5 and row["lanes_padded"] == 3
+        assert mets.counter("serve.lanes_live") == 5
+        assert mets.counter("serve.lanes_padded") == 3
+        waste, _ = mets.quantiles("serve.pad_waste.find_successor")
+        assert waste == pytest.approx(3 / 8)
+        snap = eng.cost_snapshot()
+        assert snap["device_time_s"] > 0
+        assert snap["queue_delay_n"] == 1
+        assert mets.counter("serve.device_time_us") > 0
+        assert mets.state()["hist_totals"][
+            "serve.cost_ms.find_successor.b8"] == 1
+        eng.assert_no_retraces()
+    finally:
+        eng.close()
+
+
+def test_fused_batch_charges_dummy_block_lanes(ring_state):
+    """A fused dispatch's padding waste uses the whole-program
+    denominator: every padded block lane, absent kinds' dummy blocks
+    included (matches serve.fused_occupancy)."""
+    eng, mets = _engine(ring_state,
+                        ["find_successor", "finger_index", "fused"])
+    try:
+        rng = np.random.RandomState(3)
+        keys = _rand_ids(rng, 4)
+        eng._test_hold.set()
+        try:
+            slots = []
+            for k in keys:
+                slots.append(eng.submit("find_successor", (k, 0)))
+                slots.append(eng.submit("finger_index", (k, 77)))
+        finally:
+            eng._test_hold.clear()
+        for s in slots:
+            s.wait(120)
+        row = eng.cost_table()["fused"][8]
+        # 8 live lanes; 2 blocks (store-less engine) x 8-bucket = 16.
+        assert row["lanes_live"] == 8
+        assert row["lanes_padded"] == 16 - 8
+        eng.assert_no_retraces()
+    finally:
+        eng.close()
+
+
+def test_queue_delay_signal_measures_held_queue(ring_state):
+    eng, mets = _engine(ring_state, ["find_successor"])
+    try:
+        rng = np.random.RandomState(4)
+        eng._test_hold.set()
+        try:
+            slot = eng.submit("find_successor",
+                              (_rand_ids(rng, 1)[0], 0))
+            time.sleep(0.05)
+        finally:
+            eng._test_hold.clear()
+        slot.wait(120)
+        snap = eng.cost_snapshot()
+        assert snap["queue_delay_sum_ms"] >= 40.0  # held ~50 ms
+        p50, _ = mets.quantiles("serve.queue_delay_ms")
+        assert p50 >= 40.0
+    finally:
+        eng.close()
+
+
+def test_no_lane_kinds_carry_no_padding(ring_state):
+    eng, _ = _engine(ring_state, ["sync_digest"], store=True)
+    try:
+        eng.sync_digest(timeout=120)
+        row = eng.cost_table()["sync_digest"][0]
+        assert row["lanes_padded"] == 0 and row["n"] == 1
+        eng.assert_no_retraces()
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# compile-cause ledger
+# ---------------------------------------------------------------------------
+
+def test_warmup_stamps_ledger_and_steady_state_appends_nothing(
+        ring_state):
+    eng, mets = _engine(
+        ring_state,
+        ["find_successor", "finger_index", "fused"], bucket_max=16)
+    try:
+        ledger = eng.compile_ledger()
+        assert ledger, "warmup left no ledger rows"
+        assert {r["cause"] for r in ledger} == {"warmup"}
+        # One row per (warmed kind, bucket): 3 entities x 2 buckets.
+        assert len(ledger) == 3 * 2
+        assert all(r["ms"] > 0 and r["n"] == 1 for r in ledger)
+        assert mets.counter("serve.compiles.warmup") == 6
+        n0 = len(ledger)
+        rng = np.random.RandomState(5)
+        for k in _rand_ids(rng, 6):
+            eng.find_successor(k, 0, timeout=120)
+        assert len(eng.compile_ledger()) == n0, \
+            "steady state appended ledger rows (a retrace happened)"
+        eng.assert_no_retraces()
+        assert mets.counter("serve.compiles.on-demand") == 0
+    finally:
+        eng.close()
+
+
+def test_on_demand_and_fused_causes(ring_state):
+    eng, mets = _engine(ring_state, None)  # never warmed
+    try:
+        rng = np.random.RandomState(6)
+        keys = _rand_ids(rng, 3)
+        # Never-warmed engine: the first dispatch compiles on demand.
+        eng.find_successor(keys[0], 0, timeout=300)
+        rows = eng.compile_ledger()
+        assert {r["cause"] for r in rows} == {"on-demand"}
+        assert rows[-1]["kind"] == "find_successor"
+        assert mets.counter("serve.compiles.on-demand") >= 1
+        # A mixed burst on the never-warmed engine fuses on demand.
+        eng._test_hold.set()
+        try:
+            slots = [eng.submit("find_successor", (keys[1], 0)),
+                     eng.submit("finger_index", (keys[2], 9))]
+        finally:
+            eng._test_hold.clear()
+        for s in slots:
+            s.wait(300)
+        fused_rows = [r for r in eng.compile_ledger()
+                      if r["kind"] == "fused"]
+        assert fused_rows and fused_rows[-1]["cause"] == "fused"
+        assert mets.counter("serve.compiles.fused") >= 1
+    finally:
+        eng.close()
+
+
+def test_concurrent_warmup_suppresses_dispatch_stamping():
+    """While warmup() is tracing (the mid-serving fused-arming case),
+    the dispatch path's snapshot-diff stamping stands down — a
+    warmup-owned trace must land exactly once, as 'warmup', never be
+    mis-stamped 'on-demand' by a concurrent dispatcher."""
+    eng = ServeEngine(None, bucket_min=8, bucket_max=8,
+                      metrics=Metrics(), name="lens-warm-race")
+    try:
+        from p2p_dhts_tpu.serve import _Cost
+        cost = _Cost()
+        cost.t0 = time.perf_counter()
+        eng._trace_counts["finger_index"] = 1
+        eng._warming = 1   # a warmup is tracing right now
+        eng._stamp_compiles({"finger_index": 0}, cost)
+        assert eng.compile_ledger() == []
+        eng._warming = 0
+        # A warmup that started AND finished inside the launch window
+        # (generation moved past the cost's capture) also suppresses.
+        eng._warm_gen = cost.warm_gen + 1
+        eng._stamp_compiles({"finger_index": 0}, cost)
+        assert eng.compile_ledger() == []
+        eng._warm_gen = cost.warm_gen
+        eng._stamp_compiles({"finger_index": 0}, cost)
+        assert eng.compile_ledger()[-1]["cause"] == "on-demand"
+        # warmup() moves the generation at START and at EXIT: a
+        # launch window overlapping either boundary sees a change.
+        g0 = eng._warm_gen
+        eng.warmup(["finger_index"])
+        assert eng._warm_gen >= g0 + 2
+    finally:
+        eng.close(drain=False)
+
+
+def test_degenerate_group_cause_unit():
+    """The fused program compiling under a SINGLE-kind remnant (what
+    deadline shedding can leave) stamps degenerate-group."""
+    eng = ServeEngine(None, bucket_min=8, bucket_max=8,
+                      metrics=Metrics(), name="lens-dg")
+    try:
+        from p2p_dhts_tpu.serve import _Cost
+        cost = _Cost()
+        cost.t0 = time.perf_counter()
+        cost.kinds = 1
+        eng._trace_counts["fused"] = 1
+        eng._stamp_compiles({"fused": 0}, cost)
+        rows = eng.compile_ledger()
+        assert rows[-1]["cause"] == "degenerate-group"
+    finally:
+        eng.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# disabled state: zero-touch
+# ---------------------------------------------------------------------------
+
+def test_disabled_cost_accounting_is_zero_touch(ring_state):
+    eng, mets = _engine(ring_state, ["find_successor"],
+                        cost_accounting=False)
+    try:
+        rng = np.random.RandomState(7)
+        for k in _rand_ids(rng, 4):
+            eng.find_successor(k, 0, timeout=120)
+        assert eng.cost_table() == {}
+        assert eng.compile_ledger() == []
+        st = mets.state()
+        touched = [k for k in list(st["counters"]) +
+                   list(st["hist_totals"])
+                   if k.startswith(("serve.cost_ms", "serve.compile",
+                                    "serve.lanes", "serve.device_time",
+                                    "serve.pad_waste",
+                                    "serve.queue_delay"))]
+        assert touched == [], touched
+        # Per-call overhead bound: the disabled gate is one attribute
+        # read returning None (generous absolute bound for CI noise).
+        slot = eng.submit("find_successor", (1, 0))
+        slot.wait(120)
+        batch = [slot]
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            assert eng._cost_begin(batch) is None
+        per_call = (time.perf_counter() - t0) / 20_000
+        assert per_call < 5e-6, f"{per_call * 1e6:.2f} us/call"
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# capacity/headroom model (hand-computed closed loop)
+# ---------------------------------------------------------------------------
+
+def _snap(dev_s=0.0, live=0, pad=0, qd_sum=0.0, qd_n=0, by_kind=None,
+          depth=0):
+    return {"device_time_s": dev_s, "lanes_live": live,
+            "lanes_padded": pad, "queue_delay_sum_ms": qd_sum,
+            "queue_delay_n": qd_n,
+            "device_time_by_kind": by_kind or {},
+            "requests_served": live, "queue_depth": depth}
+
+
+def test_capacity_model_hand_computed():
+    model = CapacityModel(alpha=0.5)
+    assert model.observe(_snap(), 0.0) is None  # seeding window
+    # Window 1: 0.5 s device time over 1 s wall, 1000 keys.
+    row = model.observe(
+        _snap(dev_s=0.5, live=1000, qd_sum=20.0, qd_n=10,
+              by_kind={"find_successor": 0.5}), 1.0)
+    assert row["busy"] == pytest.approx(0.5)
+    assert row["current_keys_s"] == pytest.approx(1000.0)
+    assert row["capacity_keys_s"] == pytest.approx(2000.0)
+    assert row["headroom_keys_s"] == pytest.approx(1000.0)
+    assert row["queue_delay_ms"] == pytest.approx(2.0)
+    assert row["saturated"] == 0
+    assert row["mix"] == {"find_successor": 1.0}
+
+
+def test_headroom_responds_to_load_then_idle_keeps_estimate():
+    model = CapacityModel(alpha=0.5)
+    model.observe(_snap(), 0.0)
+    # Saturating window: busy ~1.0, the ring absorbs ~all it can.
+    loaded = model.observe(
+        _snap(dev_s=1.0, live=2000,
+              by_kind={"find_successor": 1.0}), 1.0)
+    assert loaded["busy"] >= SAT_BUSY and loaded["saturated"] == 1
+    assert loaded["headroom_keys_s"] == pytest.approx(0.0)
+    # Idle window: no new observation — the EWMA capacity stands, and
+    # the headroom recovers to the full absorbable rate.
+    idle = model.observe(
+        _snap(dev_s=1.0, live=2000,
+              by_kind={"find_successor": 1.0}), 2.0)
+    assert idle["busy"] == 0.0
+    assert idle["capacity_keys_s"] == pytest.approx(2000.0)
+    assert idle["headroom_keys_s"] == pytest.approx(2000.0)
+    assert idle["headroom_keys_s"] > loaded["headroom_keys_s"]
+
+
+def test_capacity_model_cold_start_falls_back_to_cost_table():
+    model = CapacityModel()
+    model.observe(_snap(), 0.0)
+    table = {"find_successor": {32: {"ewma_ms": 2.0}},
+             "sync_digest": {0: {"ewma_ms": 5.0}}}  # lane-less: skip
+    row = model.observe(_snap(), 1.0, cost_table=table)
+    # 32 lanes / 2 ms = 16000 keys/s; the lane-less row contributes
+    # nothing.
+    assert row["capacity_keys_s"] == pytest.approx(16000.0)
+
+
+def test_saturation_by_queue_delay_alone():
+    model = CapacityModel(saturation_delay_ms=10.0)
+    model.observe(_snap(), 0.0)
+    row = model.observe(
+        _snap(dev_s=0.1, live=100, qd_sum=300.0, qd_n=10,
+              by_kind={"dhash_get": 0.1}), 1.0)
+    assert row["busy"] < SAT_BUSY
+    assert row["queue_delay_ms"] == pytest.approx(30.0)
+    assert row["saturated"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the lens loop over a (stubbed) gateway
+# ---------------------------------------------------------------------------
+
+class _StubEngine:
+    def __init__(self):
+        self.snap = _snap()
+
+    def cost_snapshot(self):
+        return dict(self.snap)
+
+    def cost_table(self):
+        return {}
+
+
+def _stub_gateway(*ring_ids):
+    router = RingRouter()
+    engines = {}
+    for rid in ring_ids:
+        engines[rid] = _StubEngine()
+        router.add_ring(RingBackend(rid, engines[rid]))
+    return types.SimpleNamespace(router=router), engines
+
+
+def test_lens_loop_publishes_and_retires():
+    mets = Metrics()
+    reg = HealthRegistry()
+    gw, engines = _stub_gateway("r1", "r2")
+    lens = LensLoop(gw, metrics=mets, registry=reg)
+    lens.update(now=0.0)
+    engines["r1"].snap = _snap(dev_s=0.25, live=500, qd_sum=5.0,
+                               qd_n=5, by_kind={"dhash_get": 0.25})
+    rows = lens.update(now=1.0)
+    assert rows["r1"]["busy"] == pytest.approx(0.25)
+    st = mets.state()
+    assert st["gauges"]["lens.busy.r1"] == pytest.approx(0.25)
+    assert st["gauges"]["lens.headroom.r1"] == pytest.approx(1500.0)
+    assert "lens.queue_delay_ms.r1" in st["hist_totals"]
+    assert mets.counter("lens.updates") == 2
+    assert lens.headroom("r1") == pytest.approx(1500.0)
+    # r2 never saw traffic: a row exists but with no capacity claim.
+    assert rows["r2"]["capacity_keys_s"] is None
+    # Ring retirement: r1 leaves the router -> its lens keys retire.
+    gw.router.remove_ring("r1")
+    lens.update(now=2.0)
+    st = mets.state()
+    assert "lens.busy.r1" not in st["gauges"]
+    assert "lens.queue_delay_ms.r1" not in st["hist_totals"]
+    assert mets.counter("lens.rings_retired") == 1
+    assert "r1" not in lens.capacity_report()["rings"]
+    # The loop registered in the (private) health registry.
+    assert any(l.loop_kind == "lens" for l in reg.loops())
+
+
+# ---------------------------------------------------------------------------
+# CAPACITY verb + pulse series over a live server
+# ---------------------------------------------------------------------------
+
+def test_capacity_verb_and_pulse_series_live(ring_state):
+    from p2p_dhts_tpu.gateway import (Gateway,
+                                      install_gateway_handlers)
+    from p2p_dhts_tpu.net import wire
+    from p2p_dhts_tpu.net.rpc import Client, Server
+    from p2p_dhts_tpu.pulse import PulseSampler
+
+    mets = Metrics()
+    gw = Gateway(metrics=mets, name="lens-verb")
+    gw.add_ring("lv", ring_state, default=True, bucket_min=8,
+                bucket_max=8, reprobe_s=300.0,
+                warmup=["find_successor"])
+    lens = LensLoop(gw, metrics=mets)
+    gw.attach_lens(lens)
+    # A latency SLO over the lens queue-delay hist: SLO-selectable.
+    sampler = PulseSampler(metrics=mets, interval_s=0.1, slos=[
+        {"name": "lens-qd", "kind": "latency",
+         "hist": "lens.queue_delay_ms.lv", "quantile": 0.99,
+         "bound_ms": 5000.0, "window_s": 30.0}])
+    srv = Server(0, {})
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+    try:
+        rng = np.random.RandomState(8)
+        sampler.sample(now=0.0)
+        lens.update()
+        for k in _rand_ids(rng, 12):
+            gw.find_successor(k, 0, timeout=120)
+        time.sleep(0.01)
+        lens.update()
+        sampler.sample(now=1.0)
+        sampler.sample(now=2.0)
+        resp = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "CAPACITY", "COSTS": True}, timeout=10.0)
+        assert resp["ATTACHED"] is True
+        row = resp["CAPACITY"]["rings"]["lv"]
+        assert row["busy"] > 0 and row["capacity_keys_s"] > 0
+        table = resp["COSTS"]["lv"]["cost_table"]
+        assert table["find_successor"]["8"]["n"] >= 1
+        assert resp["COSTS"]["lv"]["compiles"], "no ledger over wire"
+        # RING filter.
+        resp2 = Client.make_request(
+            "127.0.0.1", srv.port,
+            {"COMMAND": "CAPACITY", "RING": "nope"}, timeout=10.0)
+        assert resp2["CAPACITY"]["rings"] == {}
+        # lens.* series exist in the sampler (pulse integration) and
+        # the latency SLO over the lens hist verdicts OK.
+        assert any(sid.startswith("lens.")
+                   for sid in sampler.series_ids())
+        assert sampler.verdicts()["lens-qd"]["verdict"] == "OK"
+        gw.router.get("lv").engine.assert_no_retraces()
+    finally:
+        srv.kill()
+        wire.reset_pool()
+        sampler.close()
+        lens.close()   # drop the loop's global-HEALTH row with the test
+        gw.close()
+
+
+def test_capacity_verb_unattached_still_serves_costs(ring_state):
+    from p2p_dhts_tpu.gateway import Gateway
+    mets = Metrics()
+    gw = Gateway(metrics=mets, name="lens-noattach")
+    gw.add_ring("nv", ring_state, bucket_min=8, bucket_max=8,
+                reprobe_s=300.0, warmup=["find_successor"])
+    try:
+        gw.find_successor(123456789, 0, timeout=120)
+        resp = gw.handle_capacity({"COSTS": True})
+        assert resp["ATTACHED"] is False and "CAPACITY" not in resp
+        assert resp["COSTS"]["nv"]["cost_table"]["find_successor"]
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# profiler loop
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _touch_tracer(path):
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("window")
+    yield
+
+
+def test_profiler_rotation_bound(tmp_path):
+    mets = Metrics()
+    loop = ProfilerLoop(str(tmp_path / "prof"), capture_s=0.0,
+                        max_windows=3, tracer=_touch_tracer,
+                        metrics=mets, registry=HealthRegistry())
+    for _ in range(7):
+        loop.capture()
+    names = [os.path.basename(w) for w in loop.windows()]
+    # Only the NEWEST max_windows survive rotation.
+    assert names == ["window-000004", "window-000005",
+                     "window-000006"]
+    assert mets.counter("lens.profile_windows") == 7
+    assert loop.status()["captured"] == 7
+    assert loop.status()["on_disk"] == 3
+
+
+def test_profiler_numbering_survives_restart(tmp_path):
+    """A new loop over a directory with leftover windows resumes
+    numbering PAST them — restarting at 0 would make rotation delete
+    every fresh capture while keeping the stale high-numbered ones."""
+    d = tmp_path / "prof"
+    d.mkdir()
+    (d / "window-000042").write_text("stale")
+    (d / "window-000043").write_text("stale")
+    loop = ProfilerLoop(str(d), capture_s=0.0, max_windows=2,
+                        tracer=_touch_tracer, metrics=Metrics(),
+                        registry=HealthRegistry())
+    loop.capture()
+    loop.capture()
+    names = [os.path.basename(w) for w in loop.windows()]
+    # The fresh captures are the newest names and survive rotation.
+    assert names == ["window-000044", "window-000045"]
+    assert loop.status()["captured"] == 2
+
+
+def test_profiler_loop_lifecycle(tmp_path):
+    mets = Metrics()
+    reg = HealthRegistry()
+    loop = ProfilerLoop(str(tmp_path / "prof"), capture_s=0.01,
+                        max_windows=2, interval_s=0.01,
+                        tracer=_touch_tracer, metrics=mets,
+                        registry=reg)
+    assert "lens-profiler" in reg.snapshot()
+    loop.start()
+    deadline = time.time() + 20.0
+    while loop.rounds < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    loop.close()
+    assert loop.rounds >= 2
+    assert len(loop.windows()) <= 2
+    assert not loop.thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# report tools
+# ---------------------------------------------------------------------------
+
+def test_profile_report_from_chrome_export():
+    from p2p_dhts_tpu.trace import SpanStore, record_span, set_store
+    store = SpanStore()
+    old = set_store(store)
+    try:
+        tid = "a" * 32
+        record_span("serve.batch.find_successor", 0.0, 0.004,
+                    trace_id=tid, cat="serve", fill=0.5)
+        record_span("serve.batch.fused", 0.004, 0.010, trace_id=tid,
+                    cat="serve", fill=0.25,
+                    lane_share={"find_successor": 0.75,
+                                "dhash_get": 0.25})
+        record_span("serve.device_dispatch", 0.001, 0.003,
+                    trace_id=tid, cat="serve")
+        record_span("serve.coalesce", 0.0, 0.001, trace_id=tid,
+                    cat="serve")
+        record_span("serve.request.dhash_get", 0.0, 0.008,
+                    trace_id=tid, cat="serve")
+    finally:
+        set_store(old)
+    doc = json.loads(store.export_chrome())
+    text = report_from_chrome(doc)
+    assert "| `fused` | 1 | 6.000" in text
+    assert "| `find_successor` | 1 | 4.000" in text
+    # Fused time attributed by lane share: 6 ms * 0.75 / 0.25.
+    assert "## Fused batch time, attributed by lane share" in text
+    assert "| `find_successor` | 4.500 |" in text
+    assert "| `dhash_get` | 1.500 |" in text
+    assert "`serve.device_dispatch`" in text
+    assert "## Request-path latency" in text
+
+
+def test_bench_report_flags_stale_rows(tmp_path):
+    (tmp_path / "BENCH_LKG.json").write_text(json.dumps({
+        "chord16": {"config": "chord16", "value": 1619012.9,
+                    "unit": "lookups/sec", "device": "TPU v5 lite0",
+                    "utc": "2026-07-31"},
+        "gateway": {"config": "gateway", "value": None,
+                    "unit": "keys/sec", "stale": True,
+                    "device": "none (cpu container)",
+                    "utc": "2026-08-04"},
+    }))
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "parsed": {"config": "lookup_1m", "value": 459171.4,
+                           "unit": "lookups/sec",
+                           "device": "TPU v5 lite0"}}))
+    (tmp_path / "SOAK_RESULTS.jsonl").write_text(
+        json.dumps({"test": "t::a", "outcome": "passed",
+                    "utc": "2026-07-31T21:23:49Z"}) + "\n" +
+        json.dumps({"test": "t::b", "outcome": "failed",
+                    "utc": "2026-07-31T21:24:49Z"}) + "\n")
+    text = render_trajectory(str(tmp_path))
+    assert "** STALE **" in text
+    assert "| `gateway` | — | none (cpu container)" in text
+    assert "| `lookup_1m` | 459171 lookups/sec" in text
+    assert "1 passed, 1 not-passed" in text
+    assert "`t::b`" in text
+    # The stale summary line counts the flagged rows.
+    assert "stale/value-less row(s)" in text

@@ -1,34 +1,37 @@
 #!/bin/bash
-# TPU recovery watcher, round 13: thirteen configs want on-chip
-# records (greens from r07-r12 carry over; chordax-fuse joins the
+# TPU recovery watcher, round 14: fourteen configs want on-chip
+# records (greens from r07-r13 carry over; chordax-lens joins the
 # want list). Wait for the chip to be free, probe the remote-compile
 # service (dead since round 4: connection-refused on its port while
 # cached programs kept executing), and when it answers, run the
 # configs without a green record one at a time into
-# BENCH_ATTEMPT_r13.jsonl (bench's _record_lkg promotes each green
+# BENCH_ATTEMPT_r14.jsonl (bench's _record_lkg promotes each green
 # on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# --trace device-timeline archiving (now into BENCH_TRACE_r13). All
+# --trace device-timeline archiving (now into BENCH_TRACE_r14). All
 # prior gates stay (wire-isolated binary >= 3x JSON keys/s at <= 1/2
 # p50, traced chain, havoc scenario matrix >= 99% availability, pulse
-# + fastlane smokes, zero retraces). NEW in round 13 (chordax-fuse):
-# a FUSE SMOKE pre-bench gate — mixed-kind closed-loop throughput
-# >= 1.25x the unfused kind-by-kind drain at equal-or-better p50,
-# byte-exact three-kind parity inside one fused batch, the FIFO
-# straddle assert, zero retraces, and the IDA backend registry
-# (dot/MAC/pallas) decoding byte-identical fragments — must pass on
-# CPU before anything claims the chip. THE WANT-LIST HEADLINE for
-# this round's chip window: (a) the fuse config's on-chip record —
-# the multi-kind super-batch win the whole round is named for — and
-# (b) the IDA BACKEND A/B the r12 verdict left open: the fuse
-# config's microbench (and the ida config's re-record) measure dot
-# vs VPU-MAC vs the compiled pallas kernel side by side, replacing
-# the stale 93.3 MB/s pre-fix dot-cliff row in BENCH_LKG. Never
-# kills anything mid-TPU-work; every probe and bench attempt runs to
-# completion (a blocked fresh-shape jit takes ~25 min to fail — that
-# is the probe's cost when the service is down, accepted).
+# + fastlane + fuse smokes, zero retraces). NEW in round 14
+# (chordax-lens): a LENS SMOKE pre-bench gate — cost-accounting
+# overhead <= 5% closed-loop p50 vs the cost_accounting=False
+# baseline, the headroom estimate within 2x of the measured
+# saturation keys/s, non-empty per-(kind, bucket) cost table +
+# warmup-only compile-cause ledger (zero steady-state retraces), and
+# the CAPACITY verb + lens.* pulse series polled live mid-bench —
+# must pass on CPU before anything claims the chip, and the lens
+# config archives an ANALYZED timeline: CHORDAX_LENS_PROFILE writes
+# the traced window's Chrome export (.json) plus its rendered
+# per-kind cost-breakdown report (.md) next to this round's records
+# (ROADMAP item 4's "profile the traced device timeline and attack
+# what it shows" finally has its digestion tool). The want-list
+# headline stays the fuse on-chip record + the IDA A/B, now joined
+# by the lens config's on-chip cost table — the first per-kind
+# device-cost evidence since round 2. Never kills anything
+# mid-TPU-work; every probe and bench attempt runs to completion (a
+# blocked fresh-shape jit takes ~25 min to fail — that is the
+# probe's cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-13 watcher start (thirteen configs + wire/havoc/pulse/fastlane/fuse smoke gates)"
+log "round-14 watcher start (fourteen configs + wire/havoc/pulse/fastlane/fuse/lens smoke gates)"
 
 needed() {  # configs without a green record yet (r07-r12 greens count)
   python - <<'EOF'
@@ -37,7 +40,7 @@ ok = set()
 for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
                 "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl",
                 "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl",
-                "BENCH_ATTEMPT_r13.jsonl"):
+                "BENCH_ATTEMPT_r13.jsonl", "BENCH_ATTEMPT_r14.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -50,7 +53,7 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
         pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership",
-        "pulse", "fastlane", "fuse"]
+        "pulse", "fastlane", "fuse", "lens"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -62,7 +65,7 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all thirteen configs recorded green — done"
+    log "all fourteen configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
@@ -127,9 +130,9 @@ for i in $(seq 1 80); do
   # mid-bench), one linked digest->diff->heal repair trace, zero
   # retraces — on CPU before anything claims the chip. The sampled
   # series artifact lands next to this round's records.
-  mkdir -p BENCH_TRACE_r13
+  mkdir -p BENCH_TRACE_r14
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_PULSE_SERIES=BENCH_TRACE_r13/pulse_series_smoke.json \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r14/pulse_series_smoke.json \
       python bench.py --config pulse --smoke \
       >> tpu_watch.log 2>&1; then
     log "pulse smoke FAILED - fix the telemetry plane before benching"
@@ -161,6 +164,22 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Lens smoke (ISSUE 14): the cost-accounting/capacity plane must
+  # hold — accounting overhead <= 5% closed-loop p50 vs the disabled
+  # baseline, headroom within 2x of measured saturation keys/s,
+  # non-empty cost table + warmup-only compile-cause ledger with zero
+  # retraces, CAPACITY verb + lens.* pulse series polled live — on
+  # CPU before anything claims the chip. The smoke's profile report
+  # (Chrome export + rendered per-kind cost breakdown) archives next
+  # to this round's records.
+  if ! JAX_PLATFORMS=cpu \
+      CHORDAX_LENS_PROFILE=BENCH_TRACE_r14/lens_profile_smoke \
+      python bench.py --config lens --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "lens smoke FAILED - fix the cost/capacity plane before benching"
+    sleep 300
+    continue
+  fi
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -171,16 +190,23 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r13
+    mkdir -p BENCH_TRACE_r14
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r13/$c)"
-      # The pulse config archives its sampled series + verdicts next
-      # to this round's records (the mid-bench PULSE/HEALTH polls are
-      # inside the config itself).
-      CHORDAX_PULSE_SERIES="BENCH_TRACE_r13/pulse_series_$c.json" \
-        python bench.py --config "$c" --trace "BENCH_TRACE_r13" \
-        >> BENCH_ATTEMPT_r13.jsonl 2>> BENCH_ATTEMPT_r13.err
+      log "running --config $c (device trace -> BENCH_TRACE_r14/$c)"
+      # The pulse config archives its sampled series + verdicts, and
+      # the lens config its ANALYZED profile (Chrome export + per-kind
+      # cost-breakdown markdown), next to this round's records (the
+      # mid-bench PULSE/HEALTH/CAPACITY polls are inside the configs
+      # themselves).
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r14/pulse_series_$c.json" \
+        CHORDAX_LENS_PROFILE="BENCH_TRACE_r14/lens_profile_$c" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r14" \
+        >> BENCH_ATTEMPT_r14.jsonl 2>> BENCH_ATTEMPT_r14.err
       log "config $c rc=$?"
+      # Digest the round's trajectory after each record lands: the
+      # stale-flagged table is the artifact a reviewer reads first.
+      python -m p2p_dhts_tpu.lens.bench_report \
+        --out BENCH_TRACE_r14/trajectory.md >> tpu_watch.log 2>&1
     done
   else
     log "compile service still down"
