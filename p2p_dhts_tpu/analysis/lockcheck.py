@@ -74,6 +74,10 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "pulse.py"),
     os.path.join("p2p_dhts_tpu", "ops", "ida_backend.py"),
     os.path.join("p2p_dhts_tpu", "lens", "__init__.py"),
+    os.path.join("p2p_dhts_tpu", "mesh", "routes.py"),
+    os.path.join("p2p_dhts_tpu", "mesh", "coalescer.py"),
+    os.path.join("p2p_dhts_tpu", "mesh", "plane.py"),
+    os.path.join("p2p_dhts_tpu", "mesh", "peer.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

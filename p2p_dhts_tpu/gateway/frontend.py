@@ -95,16 +95,34 @@ FINGER_RING_ID = "__finger__"
 #: busy-fraction / capacity / headroom row plus (COSTS) the engines'
 #: per-(kind, bucket) cost tables and compile-cause ledgers — the
 #: subscription surface the elastic policy loop consumes.
+#: MESH_ROUTES is the chordax-mesh gossip/observability verb
+#: (ISSUE 15): the attached MeshPlane's epoch-stamped shard -> address
+#: table (any mesh gateway answers it; peers pull it when a heartbeat
+#: reply's ROUTES_EPOCH moves). HAVOC is the mesh chaos-control verb:
+#: install/uninstall a seeded FaultPlan in THIS process over the wire,
+#: so a multi-process scenario (partition one whole gateway) is seeded
+#: into every process replayably — a test/bench control surface, same
+#: trust domain as the metrics/trace verbs.
 GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
                     "SYNC_RANGE", "REPAIR_STATUS", "JOIN_RING",
                     "HEARTBEAT", "MEMBER_STATUS", "METRICS",
-                    "TRACE_STATUS", "HEALTH", "PULSE", "CAPACITY")
+                    "TRACE_STATUS", "HEALTH", "PULSE", "CAPACITY",
+                    "MESH_ROUTES", "HAVOC")
 
 
 def _key_int(v) -> int:
     """Wire key form: hex string (the overlay's Key serialization) or
     plain int."""
     return (int(v, 16) if isinstance(v, str) else int(v)) % KEYS_IN_RING
+
+
+def _lift_key_lanes(keys) -> np.ndarray:
+    """Legacy list-form KEYS under a mesh: lift to a lane array ONCE —
+    the split/forward machinery is array-native, and the JSON encoder
+    lowers the arrays back on the way out. One home for the rule so
+    the FIND_SUCCESSOR and GET handlers cannot drift."""
+    from p2p_dhts_tpu import keyspace
+    return keyspace.ints_to_lanes([_key_int(k) for k in keys])
 
 
 class _VectorRun:
@@ -185,6 +203,11 @@ class Gateway:
         # chordax-lens wiring (ISSUE 14): the attached LensLoop the
         # CAPACITY verb serves (same read-side-reference rule).
         self._lens: Optional[Any] = None
+        # chordax-mesh wiring (ISSUE 15): the attached MeshPlane — the
+        # ownership lookup -> local-or-forward split every no-explicit-
+        # ring FIND_SUCCESSOR/GET/PUT consults. Lifecycle stays with
+        # whoever built it (the detach-never-close rule).
+        self._mesh: Optional[Any] = None
 
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
@@ -273,6 +296,30 @@ class Gateway:
     def lens_model(self):
         with self._rings_lock:
             return self._lens
+
+    # -- mesh plane (chordax-mesh, ISSUE 15) ---------------------------------
+    def attach_mesh(self, mesh) -> None:
+        """Register (or, with None, detach) the MeshPlane that shards
+        this gateway into a multi-process topology. The plane's
+        lifecycle — close() — belongs to its creator."""
+        with self._rings_lock:
+            self._mesh = mesh
+
+    def mesh_plane(self):
+        with self._rings_lock:
+            return self._mesh
+
+    def _mesh_for(self, ring_id, fwd: bool = False):
+        """The mesh split applies to NO-EXPLICIT-RING requests on a
+        routed mesh (an explicit RING always serves locally — the
+        repair/membership control paths are per-process by design).
+        Forwarded requests still consult the plane (the one-hop
+        owner-side check), hence fwd."""
+        with self._rings_lock:
+            mesh = self._mesh
+        if mesh is None or (ring_id is not None and not fwd):
+            return None
+        return mesh if (fwd or len(mesh.routes)) else None
 
     # -- membership control plane (chordax-membership, ISSUE 7) --------------
     def attach_membership(self, manager) -> None:
@@ -1110,9 +1157,20 @@ class Gateway:
     def handle_find_successor(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
+        # chordax-mesh (ISSUE 15): with a routed MeshPlane attached,
+        # every no-explicit-ring request takes the ownership lookup ->
+        # local-or-forward split; FWD-marked requests take the OWNER
+        # side (answer locally or bounce NOT_OWNED — the one-hop rule).
+        fwd = bool(req.get("FWD"))
+        mesh = self._mesh_for(ring_id, fwd)
         if "KEYS" in req:
             lanes = self._vector_lanes(req["KEYS"])
+            if lanes is None and mesh is not None and req["KEYS"]:
+                lanes = _lift_key_lanes(req["KEYS"])
             if lanes is not None:
+                if mesh is not None:
+                    return mesh.find_successor_vector(req, lanes, dl,
+                                                      fwd)
                 # chordax-fastlane: the binary transport's packed u128
                 # run flows to the device as ONE lane-array view —
                 # zero per-key python on this path (guarded by test).
@@ -1134,6 +1192,12 @@ class Gateway:
                                        dtype=np.int32),
                     "RINGS": [r[2] for r in res]}
         key = _key_int(req["KEY"])
+        if mesh is not None and not mesh.owns_local(key):
+            if fwd:
+                raise mesh.not_owner_error(key)
+            owner, hops, label = mesh.find_successor_one(
+                key, int(req.get("START", 0)), dl)
+            return {"OWNER": owner, "HOPS": hops, "RING": label}
         backend = self.router.route(key_int=key, ring_id=ring_id)
         owner, hops = self._find_successor_routed(
             backend, key, int(req.get("START", 0)), dl)
@@ -1283,9 +1347,15 @@ class Gateway:
     def handle_get(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
+        fwd = bool(req.get("FWD"))
+        mesh = self._mesh_for(ring_id, fwd)
         if "KEYS" in req:
             lanes = self._vector_lanes(req["KEYS"])
+            if lanes is None and mesh is not None and req["KEYS"]:
+                lanes = _lift_key_lanes(req["KEYS"])
             if lanes is not None:
+                if mesh is not None:
+                    return mesh.get_vector(lanes, dl, fwd)
                 return self._handle_get_fast(lanes, ring_id, dl)
             keys = [_key_int(k) for k in req["KEYS"]]
             if not keys:
@@ -1320,6 +1390,12 @@ class Gateway:
             if ring_errors:
                 out["RING_ERRORS"] = ring_errors
             return out
+        key = _key_int(req["KEY"])
+        if mesh is not None and not mesh.owns_local(key):
+            if fwd:
+                raise mesh.not_owner_error(key)
+            segs, ok = mesh.get_one(key, dl)
+            return {"SEGMENTS": segs, "OK": bool(ok)}
         segs, ok = self.dhash_get(req["KEY"], ring_id=ring_id, deadline=dl)
         return {"SEGMENTS": segs, "OK": bool(ok)}
 
@@ -1395,11 +1471,19 @@ class Gateway:
     def handle_put(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
         ring_id = req.get("RING")
+        fwd = bool(req.get("FWD"))
+        mesh = self._mesh_for(ring_id, fwd)
         if "ENTRIES" in req:
             entries = req["ENTRIES"]
             if not entries:
                 return {"OK": [], "RINGS": []}
             try:
+                if mesh is not None:
+                    out = mesh.put_entries(
+                        entries, dl, fwd,
+                        key_of=lambda e: _key_int(e["KEY"]))
+                    if out is not None:
+                        return out
                 return self._handle_put_entries(entries, ring_id, dl)
             finally:
                 # Vector PUT (both the replicated and the grouped
@@ -1407,6 +1491,18 @@ class Gateway:
                 # the single-key paths.
                 self._invalidate_reads("put_entries")
         segments = req["SEGMENTS"]
+        if mesh is not None:
+            key = _key_int(req["KEY"])
+            # put_is_remote raises on a forwarded write we don't own
+            # (the one-hop rule: writes get no silent re-resolution).
+            addr = mesh.put_is_remote(key, fwd)
+            if addr is not None:
+                ok = mesh.forward_put_one(
+                    addr, key, segments,
+                    int(req.get("LENGTH", len(segments))),
+                    int(req.get("START", 0)), dl)
+                return {"OK": bool(ok),
+                        "RING": f"mesh:{addr[0]}:{addr[1]}"}
         ok = self.dhash_put(req["KEY"], segments,
                             int(req.get("LENGTH", len(segments))),
                             int(req.get("START", 0)),
@@ -1521,6 +1617,14 @@ class Gateway:
         else:
             raise ValueError("JOIN_RING needs MEMBER or IP+PORT")
         accepted = mgr.request_join(member)
+        # chordax-mesh: a joiner that announced IP+PORT is a mesh PEER
+        # — its address feeds the coordinator's shard book, so an
+        # applied join re-splits the route table without any side
+        # channel.
+        mesh = self.mesh_plane()
+        if accepted and mesh is not None and "IP" in req \
+                and "PORT" in req:
+            mesh.note_peer(member, str(req["IP"]), int(req["PORT"]))
         return {"ACCEPTED": bool(accepted), "RING": mgr.ring_id,
                 "MEMBER": format(member, "x"),
                 "HEARTBEAT_S": mgr.heartbeat_interval_s}
@@ -1531,7 +1635,14 @@ class Gateway:
         restarted peer to JOIN_RING again."""
         mgr = self._membership_required(req.get("RING"))
         known = mgr.heartbeat(_key_int(req["MEMBER"]))
-        return {"KNOWN": bool(known), "RING": mgr.ring_id}
+        out = {"KNOWN": bool(known), "RING": mgr.ring_id}
+        # chordax-mesh: the heartbeat reply piggybacks the route
+        # epoch — a peer whose table is older pulls MESH_ROUTES next,
+        # so gossip costs one extra int until something changes.
+        mesh = self.mesh_plane()
+        if mesh is not None:
+            out["ROUTES_EPOCH"] = mesh.routes.epoch
+        return out
 
     def handle_member_status(self, req: dict) -> dict:
         """Membership observability: one ring's status, or every
@@ -1593,10 +1704,36 @@ class Gateway:
                        "recorded": _FLIGHT.recorded},
             "NET": net_snapshot(),
         }
+        # chordax-mesh (ISSUE 15): per-ring engine telemetry rows —
+        # trace counts + steady-state retraces pollable over the wire,
+        # so a mesh watcher can assert "zero retraces in EVERY
+        # process" without a local engine handle.
+        engines = {}
+        for backend in self.router.snapshot()[0]:
+            row_fn = getattr(backend.engine, "telemetry_row", None)
+            if row_fn is not None:
+                engines[backend.ring_id] = row_fn()
+        out["ENGINES"] = engines
         tail = int(req.get("TAIL", 0) or 0)
         if tail > 0:
             out["FLIGHT"]["tail"] = _FLIGHT.recent(tail)
-        return {"HEALTH": out}
+        resp = {"HEALTH": out}
+        self._merge_mesh_rows("HEALTH", req, resp)
+        return resp
+
+    def _merge_mesh_rows(self, command: str, req: dict,
+                         out: dict) -> None:
+        """MESH:true on an introspection verb (CAPACITY / HEALTH /
+        PULSE) additionally collects every live route peer's own
+        answer (chordax-mesh): the merged decision input the elastic
+        loop reads from any ONE gateway. A dead peer's row is its
+        error string; no mesh attached means no MESH section, never
+        an RPC error."""
+        if not req.get("MESH"):
+            return
+        mesh = self.mesh_plane()
+        if mesh is not None:
+            out["MESH"] = mesh.collect_peer_rows(command, req)
 
     def handle_pulse(self, req: dict) -> dict:
         """The chordax-pulse verb (ISSUE 11). Payload sections, each
@@ -1632,6 +1769,7 @@ class Gateway:
                 out["SLO"] = sampler.verdicts()
         if req.get("PROM"):
             out["PROM"] = pulse_mod.expose_prometheus(self.metrics.base)
+        self._merge_mesh_rows("PULSE", req, out)
         return out
 
     def handle_capacity(self, req: dict) -> dict:
@@ -1674,7 +1812,53 @@ class Gateway:
                                  if ledger_fn is not None else []),
                 }
             out["COSTS"] = costs
+        self._merge_mesh_rows("CAPACITY", req, out)
         return out
+
+    # -- mesh verbs (chordax-mesh, ISSUE 15) ---------------------------------
+    def handle_mesh_routes(self, req: dict) -> dict:
+        """The mesh gossip/observability verb: the attached plane's
+        epoch-stamped shard -> address table (any mesh gateway answers
+        from its own view — peers pull from the seed, watchers from
+        anyone). SET_COALESCE toggles the forward coalescer between
+        its configured batching and the per-key-forward baseline (the
+        bench's A/B knob). ATTACHED=false means no mesh plane — never
+        an RPC error."""
+        mesh = self.mesh_plane()
+        if mesh is None:
+            return {"ATTACHED": False}
+        if "SET_COALESCE" in req:
+            mesh.coalescer.set_coalesce(bool(req["SET_COALESCE"]))
+        out = {"ATTACHED": True, "STATUS": mesh.mesh_status()}
+        out.update(mesh.routes_doc())
+        return out
+
+    def handle_havoc(self, req: dict) -> dict:
+        """Chaos control over the wire: install/uninstall a seeded
+        havoc FaultPlan in THIS process — how a multi-process mesh
+        scenario (partition one whole gateway) is seeded into every
+        process from one driver, replayably (the plan is (seed, spec);
+        the reply carries the describe() line the incident log wants).
+        A test/bench control surface in the same trust domain as the
+        metrics/trace verbs."""
+        from p2p_dhts_tpu import havoc as havoc_mod
+        action = str(req.get("ACTION", "describe")).lower()
+        if action == "install":
+            plan = havoc_mod.FaultPlan(int(req["SEED"]),
+                                       dict(req.get("SPEC") or {}))
+            # One plan at a time (the replay contract): an install
+            # over a live plan supersedes it visibly.
+            prev = havoc_mod.uninstall()
+            havoc_mod.install(plan)
+            return {"ACTIVE": plan.describe(),
+                    "SUPERSEDED": (prev.describe()
+                                   if prev is not None else None)}
+        if action == "uninstall":
+            plan = havoc_mod.uninstall()
+            return {"ACTIVE": None,
+                    "UNINSTALLED": (plan.describe()
+                                    if plan is not None else None)}
+        return {"ACTIVE": havoc_mod.describe_active()}
 
     def handle_finger_index(self, req: dict) -> dict:
         dl = Deadline.from_budget_ms(req.get("DEADLINE_MS"))
@@ -1732,10 +1916,12 @@ class Gateway:
             self._memberships.clear()
             writer, self._repl_writer = self._repl_writer, None
             self._repl_policy = None
-            # Detach (never close) the pulse sampler and the lens
-            # loop: their lifecycles belong to whoever built them.
+            # Detach (never close) the pulse sampler, the lens loop
+            # and the mesh plane: their lifecycles belong to whoever
+            # built them.
             self._pulse = None
             self._lens = None
+            self._mesh = None
         # Membership loops stop FIRST (they submit churn batches and
         # nudge schedulers); then repair, then the writer.
         scheds = managers + scheds
@@ -1805,5 +1991,7 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "HEALTH": gw.handle_health,
         "PULSE": gw.handle_pulse,
         "CAPACITY": gw.handle_capacity,
+        "MESH_ROUTES": gw.handle_mesh_routes,
+        "HAVOC": gw.handle_havoc,
     })
     return gw

@@ -281,6 +281,10 @@ class RingRouter:
         self._lock = threading.Lock()
         self._rings: Dict[str, RingBackend] = {}
         self._default: Optional[str] = None
+        # Topology epoch (chordax-mesh): bumped on every ownership-
+        # moving registry change — the cheap "did anything move?"
+        # cursor route/mesh observers poll instead of diffing ranges.
+        self._epoch = 0
         # Topology listeners (chordax-fastlane): fired AFTER any change
         # that can move a key's owner — add/remove/set_key_range — so
         # the gateway's hot-key cache can epoch-invalidate (a cached
@@ -308,9 +312,16 @@ class RingRouter:
 
     def _fire_topology(self, change: str) -> None:
         with self._lock:
+            self._epoch += 1
             listeners = list(self._topology_listeners)
         for cb in listeners:
             cb(change)
+
+    @property
+    def epoch(self) -> int:
+        """Monotonic count of ownership-moving registry changes."""
+        with self._lock:
+            return self._epoch
 
     # -- registry ------------------------------------------------------------
     def add_ring(self, backend: RingBackend, default: bool = False) -> None:

@@ -48,6 +48,21 @@ keys):
                               the engine's quarantine answers (matched
                               solo retries keep failing; clean ones
                               succeed). Key: the batch's key ints.
+  * ``rpc.server.accept``   — accept-loop reset: a just-accepted
+                              connection is closed before a byte is
+                              read (chordax-mesh, the PR-10 "server
+                              side of the wire" item). Key: the
+                              server's port (str).
+  * ``rpc.server.reply``    — a reply frame/envelope is dropped (the
+                              caller's deadline bounds the wait) or
+                              delayed ``delay_s``. Key: the server's
+                              port (str).
+  * ``mesh.partition``      — whole-process partition building block:
+                              OUTBOUND requests from THIS process to a
+                              matched ``"ip:port"`` fail (install one
+                              matched rule in every mesh process — via
+                              the HAVOC verb — and the victim is
+                              partitioned mesh-wide, replayably).
   * ``membership.heartbeat`` — a member's heartbeat is dropped or
                               arrives late. Key: the member id.
   * ``membership.clock``    — the failure detector sees a member's
@@ -103,6 +118,9 @@ SITES: Dict[str, frozenset] = {
     "net.partition": frozenset({"block", "drop", "fail"}),
     "rpc.server.stall": frozenset({"stall", "fail"}),
     "rpc.server.deferred_loss": frozenset({"loss", "drop", "fail"}),
+    "rpc.server.accept": frozenset({"reset", "fail"}),
+    "rpc.server.reply": frozenset({"drop", "delay", "fail"}),
+    "mesh.partition": frozenset({"block", "drop", "fail"}),
     "serve.launch": frozenset({"fail"}),
     "serve.poison": frozenset({"fail"}),
     "membership.heartbeat": frozenset({"drop", "delay"}),

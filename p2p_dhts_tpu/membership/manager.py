@@ -185,6 +185,12 @@ class MembershipManager(PacedLoop):
         self._members: Dict[int, _Member] = {}
         self._recent_transfers: Deque[Tuple[int, int]] = \
             collections.deque(maxlen=64)
+        # Applied-batch listeners (chordax-mesh, ISSUE 15): fired
+        # AFTER a churn batch lands on the device AND the mirror, with
+        # the applied [(op, member_id)] rows — the mesh coordinator's
+        # re-split trigger. Fired outside every lock; callbacks must
+        # be cheap and never call back into step().
+        self._applied_listeners: List = []
 
         # Host mirror of the device table: ALL table ids (sorted
         # ascending, dead rows included) + parallel alive flags, seeded
@@ -216,6 +222,27 @@ class MembershipManager(PacedLoop):
         # verbs (JOIN_RING / HEARTBEAT / MEMBER_STATUS) find us here.
         self.backend.membership = self
         gateway.attach_membership(self)
+
+    def add_applied_listener(self, cb) -> None:
+        """Register cb(applied_rows) to fire after every churn batch
+        that applied at least one row (applied_rows =
+        [(op_code, member_id)] of the rows whose per-lane flag was
+        True). The mesh coordinator subscribes here so a join/fail
+        landing on the control ring re-splits the shard map without a
+        polling loop."""
+        with self._lock:
+            self._applied_listeners.append(cb)
+
+    def _fire_applied(self, applied_rows) -> None:
+        with self._lock:
+            listeners = list(self._applied_listeners)
+        for cb in listeners:
+            try:
+                cb(applied_rows)
+            # chordax-lint: disable=bare-except -- a listener error must never fail the membership round that already applied
+            except Exception:
+                self.metrics.inc(
+                    f"membership.listener_errors.{self.ring_id}")
 
     # -- wire-facing membership API ------------------------------------------
     def request_join(self, member_id: int) -> bool:
@@ -504,6 +531,9 @@ class MembershipManager(PacedLoop):
             self.batches_applied += 1
             self.rows_applied += applied_n
             self.converged = False
+            if applied_n:
+                self._fire_applied(
+                    [row for row, ok in zip(batch, flags) if ok])
             # Lost rows AND post-heal resurrections re-transfer
             # custody: both schedule the maintenance pass + repair
             # nudge (the rectify-style post-heal reconcile).

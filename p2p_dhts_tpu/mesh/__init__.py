@@ -1,0 +1,45 @@
+"""chordax-mesh: multi-process sharded serving (ISSUE 15).
+
+The horizontal-scale layer ROADMAP item 2 asked for: N gateway
+PROCESSES each own a shard of the 2^128 keyspace (the Chord successor
+rule over mesh peer ids — SHA1("ip:port"), the reference's identity),
+every gateway answers ANY request via an ownership lookup →
+local-or-forward split, and cross-shard forwarding rides the pooled/
+pipelined binary wire with a per-destination FORWARD COALESCER that
+folds concurrent single-key and vector misses into ONE packed-u128
+KEYS-vector RPC (the fastlane zero-copy lane format end-to-end).
+
+Modules:
+  routes     RouteTable — versioned shard -> address map (epoch-
+             guarded installs, successor-rule ownership, vectorized
+             whole-array splits)
+  coalescer  ForwardCoalescer — per-(destination, verb) micro-batching
+             with deadline/TRACE propagation and BUSY/breaker handling
+  plane      MeshPlane — the local-or-forward gateway attachment:
+             FWD one-hop rule (the owner answers or errors; no forward
+             chains), NOT_OWNED + piggybacked-routes refresh-retry,
+             mesh-wide CAPACITY/HEALTH/PULSE merging, departed-peer
+             telemetry/connection retirement
+  peer       MeshPeer — the real JOIN_RING/HEARTBEAT driver (closes
+             the PR-7 "no peer drives them" thread) with the
+             KNOWN:false rejoin path; MeshCoordinator — the seed-side
+             shard split over the control ring's MembershipManager
+  serve      ``python -m p2p_dhts_tpu.mesh.serve`` — one mesh gateway
+             process (the bench's 4-process localhost ring is four of
+             these)
+
+Importing this package never initializes a jax backend (the overlay
+etiquette); device work happens only once requests flow.
+"""
+
+from p2p_dhts_tpu.mesh.coalescer import (  # noqa: F401
+    ForwardCoalescer,
+    ForwardError,
+)
+from p2p_dhts_tpu.mesh.peer import MeshCoordinator, MeshPeer  # noqa: F401
+from p2p_dhts_tpu.mesh.plane import MeshPlane  # noqa: F401
+from p2p_dhts_tpu.mesh.routes import (  # noqa: F401
+    RouteTable,
+    addr_str,
+    member_for,
+)

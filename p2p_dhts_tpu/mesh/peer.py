@@ -1,0 +1,221 @@
+"""MeshPeer + MeshCoordinator: the mesh's membership plane (ISSUE 15).
+
+The JOIN_RING / HEARTBEAT wire verbs have been wire-complete since
+PR 7 — and until now NO peer drove them (the standing PR-7 open item).
+`MeshPeer` is that peer: a health.PacedLoop that
+
+  * bootstraps by JOIN_RING-ing a SEED gateway (IP+PORT form, so its
+    mesh id is the reference's SHA1("ip:port") — the same id the
+    RouteTable shards by),
+  * HEARTBEATs every interval; the seed's reply piggybacks the
+    coordinator's current ROUTES_EPOCH, and a peer whose table is
+    older fetches MESH_ROUTES and installs it (gossip by pull — one
+    tiny RPC only when the epoch actually moved),
+  * rejoins when HEARTBEAT answers ``KNOWN: false`` (the failure
+    detector applied our OP_FAIL while we were partitioned; the row
+    must be re-joined, which resurrects it device-side — the PR-10
+    post-heal rejoin path, now driven end-to-end over the wire),
+  * backs off on RPC failure exactly like every other PacedLoop (a
+    partitioned peer probes gently, never storms the seed).
+
+`MeshCoordinator` is the seed-side half: it keeps the member -> address
+book that JOIN_RING feeds (`Gateway.handle_join_ring` ->
+`MeshPlane.note_peer`), subscribes to the control ring's
+MembershipManager for APPLIED churn batches, and on any change to the
+live membership recomputes the shard split (the Chord successor rule —
+each peer owns (pred+1 .. id]), stamps it with the next epoch, and
+installs it locally; peers pull it on their next heartbeat. Failure
+detection is the REAL phi-accrual machinery from PR 7 — the
+coordinator adds no second detector, it just reacts to the one the
+membership plane already runs.
+
+LOCK ORDER: `MeshCoordinator._lock` is a LEAF (address-book reads/
+writes only; recompute reads the manager and calls apply_routes
+outside it). MeshPeer holds no locks of its own.
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from p2p_dhts_tpu.health import PacedLoop
+from p2p_dhts_tpu.mesh.routes import Addr, member_for
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net.rpc import Client, RpcError
+
+
+class MeshPeer(PacedLoop):
+    """One gateway process's membership driver: join, heartbeat,
+    route-gossip, rejoin."""
+
+    def __init__(self, plane, seed_addr: Addr, *,
+                 heartbeat_s: float = 0.5,
+                 ring_id: Optional[str] = None,
+                 rpc_timeout_s: Optional[float] = None,
+                 metrics: Optional[Metrics] = None):
+        self.plane = plane
+        self.seed_addr = (str(seed_addr[0]), int(seed_addr[1]))
+        #: The seed's CONTROL ring (None = the seed's only attached
+        #: membership manager, the single-manager wire convention).
+        self.ring_id = ring_id
+        self.member_id = plane.member_id
+        self.joined = False
+        self._was_live = False
+        self.rpc_timeout_s = (float(rpc_timeout_s)
+                              if rpc_timeout_s is not None
+                              else max(2.0, heartbeat_s * 4))
+        PacedLoop.__init__(
+            self, name=f"mesh-peer:{plane.routes.self_addr[1]}",
+            kind="mesh", interval_s=float(heartbeat_s),
+            interval_idle_s=float(heartbeat_s),
+            backoff_base_s=max(float(heartbeat_s) / 2, 0.05),
+            backoff_cap_s=max(float(heartbeat_s) * 8, 2.0),
+            metrics=metrics if metrics is not None else METRICS,
+            failure_metric="mesh.peer_round_failures",
+            thread_name=f"mesh-peer-{plane.routes.self_addr[1]}")
+
+    # -- one membership round -------------------------------------------------
+    def step(self) -> dict:
+        """Join (or re-join) if needed, heartbeat, pull routes when
+        the seed's epoch moved — the deterministic foreground form
+        (the background loop runs exactly this)."""
+        ip, port = self.plane.routes.self_addr
+        if not self.joined:
+            req = {"COMMAND": "JOIN_RING", "IP": ip, "PORT": port}
+            if self.ring_id is not None:
+                req["RING"] = self.ring_id
+            resp = self._rpc(req)
+            if resp.get("ACCEPTED"):
+                self.joined = True
+                if self._was_live:
+                    self.metrics.inc("mesh.rejoins")
+                else:
+                    self.metrics.inc("mesh.peer_joins")
+            return {"joined": self.joined, "epoch":
+                    self.plane.routes.epoch}
+        req = {"COMMAND": "HEARTBEAT",
+               "MEMBER": format(self.member_id, "x")}
+        if self.ring_id is not None:
+            req["RING"] = self.ring_id
+        resp = self._rpc(req)
+        self.metrics.inc("mesh.heartbeats")
+        if not resp.get("KNOWN"):
+            # The detector failed us while we were unreachable and the
+            # row was applied: JOIN again (resurrects the device row).
+            self.joined = False
+            self._was_live = True
+            self.metrics.inc("mesh.rejoin_required")
+            return self.step()
+        self._was_live = True
+        epoch = resp.get("ROUTES_EPOCH")
+        if epoch is not None and int(epoch) > self.plane.routes.epoch:
+            self.fetch_routes()
+        return {"joined": True, "epoch": self.plane.routes.epoch}
+
+    def fetch_routes(self) -> bool:
+        """Pull MESH_ROUTES from the seed and install it (epoch-
+        guarded — stale gossip drops on the floor)."""
+        resp = self._rpc({"COMMAND": "MESH_ROUTES"})
+        self.metrics.inc("mesh.routes_fetched")
+        if not resp.get("ATTACHED"):
+            raise RpcError("seed gateway has no mesh plane attached")
+        return self.plane.apply_routes_doc(resp)
+
+    def _rpc(self, req: dict) -> dict:
+        resp = Client.make_request(self.seed_addr[0], self.seed_addr[1],
+                                   req, timeout=self.rpc_timeout_s)
+        if not resp.get("SUCCESS"):
+            raise RpcError(f"seed {self.seed_addr[0]}:"
+                           f"{self.seed_addr[1]} errored on "
+                           f"{req['COMMAND']}: {resp.get('ERRORS')}")
+        return resp
+
+    def _round(self) -> None:
+        self.step()
+        self.mark_round()
+
+    def _busy(self) -> bool:
+        return True  # heartbeats never idle down
+
+
+class MeshCoordinator:
+    """Seed-side shard coordinator over the control ring's
+    MembershipManager."""
+
+    def __init__(self, plane, manager, *,
+                 metrics: Optional[Metrics] = None):
+        self.plane = plane
+        self.manager = manager
+        self.metrics = metrics if metrics is not None \
+            else plane.metrics
+        self._lock = threading.Lock()
+        # Serializes epoch-read + apply: two concurrent recomputes
+        # (the membership loop's applied listener racing a JOIN_RING
+        # worker's note_peer) must not both stamp epoch N+1 — the
+        # loser's map would be silently dropped by the route table's
+        # monotonic-epoch guard even when it was computed from the
+        # NEWER membership state.
+        self._recompute_lock = threading.Lock()
+        self._addrs: Dict[int, Addr] = {}
+        with plane._lock:
+            plane.coordinator = self
+        manager.add_applied_listener(self._on_applied)
+
+    # -- bootstrap ------------------------------------------------------------
+    def register_self(self) -> None:
+        """Enter the seed's own address + membership: the seed is a
+        serving shard like any other, just one whose control plane is
+        local."""
+        ip, port = self.plane.routes.self_addr
+        member = member_for((ip, port))
+        self.note_peer(member, ip, port)
+        self.manager.request_join(member)
+        self.recompute()
+
+    # -- address book ---------------------------------------------------------
+    def note_peer(self, member: int, ip: str, port: int) -> None:
+        member = int(member)
+        with self._lock:
+            changed = self._addrs.get(member) != (str(ip), int(port))
+            self._addrs[member] = (str(ip), int(port))
+        if changed:
+            # A re-addressed (or first-seen) peer may already be alive
+            # in the membership plane — recompute picks it up.
+            self.recompute()
+
+    def addresses(self) -> Dict[int, Addr]:
+        with self._lock:
+            return dict(self._addrs)
+
+    # -- the shard split ------------------------------------------------------
+    def _on_applied(self, rows) -> None:
+        """The control ring applied a churn batch (join/fail/leave):
+        the live membership moved, so the split recomputes and the
+        epoch bumps — peers pull it on their next heartbeat."""
+        self.recompute()
+
+    def recompute(self) -> bool:
+        """Rebuild the shard map from (alive control-ring members ∩
+        known addresses); install it with the NEXT epoch when it
+        changed. Returns whether a new epoch was installed. The whole
+        read-compute-install runs under _recompute_lock (membership
+        state is re-read INSIDE it), so concurrent triggers serialize
+        and the last installed map always reflects the newest
+        membership the coordinator has seen."""
+        with self._recompute_lock:
+            alive = set(self.manager.alive_ids())
+            with self._lock:
+                peers = {m: a for m, a in self._addrs.items()
+                         if m in alive}
+            if not peers:
+                return False
+            current = self.plane.routes.peers()
+            if peers == current:
+                return False
+            installed = self.plane.apply_routes(
+                peers, self.plane.routes.epoch + 1)
+        if installed:
+            self.metrics.inc("mesh.resplits")
+        return installed

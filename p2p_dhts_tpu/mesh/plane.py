@@ -1,0 +1,649 @@
+"""MeshPlane: the local-or-forward split behind a mesh gateway's front
+door (chordax-mesh, ISSUE 15 — the refactor ROADMAP item 2 named as the
+horizontal-scale unlock).
+
+One of these attaches to a Gateway (`gateway.attach_mesh`) and turns
+the process-global front door into ONE SHARD of a multi-process
+serving topology:
+
+  request -> ownership lookup (RouteTable, the Chord successor rule
+  over mesh peer ids) -> LOCAL  : the existing router/engine path,
+                                  untouched — zero new cost when the
+                                  key is ours;
+                         REMOTE : the ForwardCoalescer folds it into a
+                                  packed-u128 KEYS-vector RPC to the
+                                  owner gateway over the pooled/
+                                  pipelined binary wire.
+
+ONE-HOP RULE: a forwarded request (``FWD: 1``) is answered by the owner
+from LOCAL ownership only — keys the owner no longer owns come back as
+``NOT_OWNED`` rows with the owner's fresher route table piggybacked,
+never forwarded onward (no forward chains; tail latency stays one
+extra hop, bounded). The ORIGIN applies the piggybacked routes and
+re-resolves the bounced rows ONCE (a re-resolution is a fresh first
+hop, not a chain); rows that still miss fail visibly.
+
+Forwarded READ answers are NEVER memoized in the PR-12 hot-key cache:
+the owner's writes invalidate the owner's epoch, not ours, so a cached
+forwarded answer could serve stale bytes forever. Local answers keep
+the cache exactly as before.
+
+MESH-WIDE VERBS: CAPACITY / HEALTH / PULSE requests carrying
+``MESH: true`` additionally collect every live route peer's own row
+(bounded per-peer timeout; a dead peer reads as its error string), so
+the elastic loop's decision input spans processes from any one
+gateway. Per-peer `mesh.*` telemetry retires with the peer when a
+re-split drops it (the PR-8 stale-telemetry rule), and the departed
+peer's pooled wire connections close with it.
+
+LOCK ORDER: the plane itself holds only `_lock` (a LEAF guarding the
+coordinator/stats references); routing reads go through RouteTable's
+leaf lock and every forward runs lock-free. This module never imports
+jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.keyspace import ints_to_lanes
+from p2p_dhts_tpu.mesh.coalescer import ForwardCoalescer, ForwardError
+from p2p_dhts_tpu.mesh.routes import Addr, RouteTable, addr_str, \
+    member_for
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client
+
+#: Bounded per-peer wait when merging a mesh-wide verb: one dead peer
+#: costs its row an error string, never the whole verb.
+PEER_VERB_TIMEOUT_S = 3.0
+
+
+class MeshPlane:
+    """Local-or-forward ownership routing for one gateway process."""
+
+    def __init__(self, gateway, self_addr: Addr,
+                 ring_id: Optional[str] = None, *,
+                 coalesce: bool = True,
+                 forward_max_batch: int = 4096,
+                 forward_retries: int = 1,
+                 peer_verb_timeout_s: float = PEER_VERB_TIMEOUT_S,
+                 metrics: Optional[Metrics] = None):
+        self.gateway = gateway
+        #: The local shard ring whose key_range tracks this process's
+        #: shard (None = don't manage any ring's range).
+        self.ring_id = ring_id
+        self.routes = RouteTable(self_addr)
+        self.member_id = member_for(self.routes.self_addr)
+        self.metrics = metrics if metrics is not None \
+            else gateway.metrics.base
+        self.coalescer = ForwardCoalescer(
+            metrics=self.metrics,
+            max_batch=forward_max_batch if coalesce else 1,
+            retries=forward_retries)
+        self.peer_verb_timeout_s = float(peer_verb_timeout_s)
+        self._lock = threading.Lock()
+        self.coordinator = None   # set by MeshCoordinator
+        self._applying = False    # reentrancy guard for our own
+        #                         # set_key_range during apply_routes
+        self._topo_cb = self._on_topology
+        gateway.router.add_topology_listener(self._topo_cb)
+        gateway.attach_mesh(self)
+
+    # -- topology reactions ---------------------------------------------------
+    def _on_topology(self, change: str) -> None:
+        # An OPERATOR set_key_range (not one we applied ourselves) is
+        # a local re-split the blessed route table has not seen yet:
+        # bump the generation so MESH_ROUTES shows the divergence. The
+        # PR-12 hot-key cache is already epoch-bumped by the router's
+        # own listener, independent of this one.
+        if change == "set_key_range" and not self._applying:
+            self.routes.bump()
+            self.metrics.inc("mesh.local_resplits")
+
+    # -- route installation ---------------------------------------------------
+    def apply_routes(self, peers: Dict[int, Addr], epoch: int) -> bool:
+        old = {addr_str(a) for a in self.routes.addresses()}
+        if not self.routes.apply(peers, epoch):
+            return False
+        self._after_routes_change(old)
+        return True
+
+    def apply_routes_doc(self, doc: dict) -> bool:
+        old = {addr_str(a) for a in self.routes.addresses()}
+        if not self.routes.apply_doc(doc):
+            return False
+        self._after_routes_change(old)
+        return True
+
+    def _after_routes_change(self, old_addrs: set) -> None:
+        shard = self.routes.shard_of(self.member_id)
+        if self.ring_id is not None:
+            # Our own shard lands as the local ring's key_range — ONE
+            # atomic swap (PR-7's set_key_range), which also fires the
+            # router topology listeners and so epoch-bumps the PR-12
+            # hot-key cache: no cached read survives a re-split.
+            self._applying = True
+            try:
+                self.gateway.router.set_key_range(self.ring_id, shard)
+            finally:
+                self._applying = False
+        new_addrs = {addr_str(a) for a in self.routes.addresses()}
+        for a in sorted(old_addrs - new_addrs):
+            # Departed-peer hygiene (the PR-8 retire rule, applied
+            # mesh-wide): its telemetry keys leave the registry and
+            # its pooled wire connections close.
+            self.metrics.remove_prefix(f"mesh.peer_alive.{a}")
+            ip, _, port = a.rpartition(":")
+            wire.pool().close_dest((ip, int(port)))
+            self.metrics.inc("mesh.peers_retired")
+        for a in sorted(new_addrs):
+            self.metrics.gauge(f"mesh.peer_alive.{a}", 1.0)
+        self.metrics.gauge("mesh.peers", len(new_addrs))
+        self.metrics.gauge("mesh.route_epoch", self.routes.epoch)
+
+    def note_peer(self, member: int, ip: str, port: int) -> None:
+        """JOIN_RING address capture: the frontend hands every joiner's
+        (id, ip, port) here; the coordinator (when this process is the
+        seed) folds it into the address book."""
+        with self._lock:
+            coord = self.coordinator
+        if coord is not None:
+            coord.note_peer(member, ip, port)
+
+    # -- wire docs ------------------------------------------------------------
+    def routes_doc(self) -> dict:
+        return self.routes.doc()
+
+    def mesh_status(self) -> dict:
+        return {
+            "self": addr_str(self.routes.self_addr),
+            "member": format(self.member_id, "x"),
+            "epoch": self.routes.epoch,
+            "generation": self.routes.generation,
+            "peers": len(self.routes),
+        }
+
+    # -- ownership ------------------------------------------------------------
+    def owns_local(self, key_int: int) -> bool:
+        return self.routes.is_local(key_int)
+
+    def not_owner_error(self, key_int: int):
+        """THE one-hop-rule error, single home (the frontend's
+        FIND_SUCCESSOR/GET handlers and the PUT split all raise
+        exactly this, so a bounce classifies identically on the wire
+        whatever the verb)."""
+        from p2p_dhts_tpu.gateway.router import RingUnavailableError
+        return RingUnavailableError(
+            f"mesh: not the owner of key {int(key_int):#x} (route "
+            f"epoch {self.routes.epoch}); forwarded requests are "
+            f"answered or errored, never re-forwarded")
+
+    # -- FIND_SUCCESSOR -------------------------------------------------------
+    def find_successor_vector(self, req: dict, lanes: np.ndarray,
+                              dl, fwd: bool) -> dict:
+        """The mesh body of the vector FIND_SUCCESSOR handler: local
+        rows ride the gateway's zero-copy fast lane unchanged; remote
+        rows coalesce per owner. Per-destination failure semantics
+        mirror the per-ring rule: a dead owner fails only ITS rows,
+        reported under RING_ERRORS as ``mesh:<addr>``."""
+        n = lanes.shape[0]
+        starts = req.get("STARTS")
+        starts_arr = None
+        if starts is not None and len(starts) > 0:
+            starts_arr = np.asarray(starts, dtype=np.int32)
+            if starts_arr.shape != (n,):
+                raise ValueError("STARTS length must match KEYS")
+        if fwd:
+            return self._serve_forwarded(
+                "FIND_SUCCESSOR", lanes, starts_arr, dl)
+        local_rows, remote = self.routes.split_lanes(lanes)
+        if local_rows is None:
+            return self.gateway._handle_find_successor_fast(
+                {"STARTS": starts_arr}, lanes, None, dl)
+        owners = np.full(n, -1, np.int64)
+        hops = np.full(n, -1, np.int32)
+        rings = np.empty(n, dtype=object)
+        rings[:] = ""
+        ring_errors: Dict[str, str] = {}
+        if local_rows.size:
+            sub_starts = (starts_arr[local_rows]
+                          if starts_arr is not None else None)
+            out = self.gateway._handle_find_successor_fast(
+                {"STARTS": sub_starts}, lanes[local_rows], None, dl)
+            owners[local_rows] = np.asarray(out["OWNERS"], np.int64)
+            hops[local_rows] = np.asarray(out["HOPS"], np.int32)
+            for j, r in zip(local_rows, out["RINGS"]):
+                rings[j] = r
+        for addr, rows in remote:
+            sub_starts = (starts_arr[rows]
+                          if starts_arr is not None else None)
+            o, h, _, _, failed, err = self._forward_read(
+                "FIND_SUCCESSOR", addr, lanes[rows], sub_starts, dl)
+            rings[rows] = f"mesh:{addr_str(addr)}"
+            if err is not None:
+                ring_errors[f"mesh:{addr_str(addr)}"] = err
+            if o is not None:
+                live = ~failed
+                owners[rows[live]] = o[live]
+                hops[rows[live]] = h[live]
+        out = {"OWNERS": owners, "HOPS": hops, "RINGS": rings.tolist()}
+        if ring_errors:
+            out["RING_ERRORS"] = ring_errors
+        return out
+
+    def find_successor_one(self, k: int, start: int, dl
+                           ) -> Tuple[int, int, str]:
+        """(owner_row, hops, 'mesh:<addr>') for one REMOTE key — the
+        single-key miss that rides the coalescer (folding with every
+        concurrent miss to the same owner)."""
+        own = self.routes.owner(k)
+        assert own is not None  # caller checked owns_local first
+        addr = own[1]
+        lanes = ints_to_lanes([int(k)])
+        starts = np.asarray([int(start)], np.int32)
+        o, h, _, _, failed, err = self._forward_read(
+            "FIND_SUCCESSOR", addr, lanes, starts, dl)
+        if err is not None or o is None or bool(failed[0]):
+            from p2p_dhts_tpu.gateway.router import RingUnavailableError
+            raise RingUnavailableError(
+                f"mesh forward to {addr_str(addr)} failed: "
+                f"{err or 'owner bounced the key'}")
+        return int(o[0]), int(h[0]), f"mesh:{addr_str(addr)}"
+
+    # -- GET ------------------------------------------------------------------
+    def get_vector(self, lanes: np.ndarray, dl, fwd: bool) -> dict:
+        """The mesh body of the vector GET handler. The stacked
+        SEGMENTS hot path survives when every row answered with one
+        geometry and nothing failed (byte parity with the owner's own
+        stacked reply — the bench gate); otherwise the legacy per-key
+        list shape carries partial failure exactly as PR-12 defined
+        it."""
+        n = lanes.shape[0]
+        if fwd:
+            return self._serve_forwarded("GET", lanes, None, dl)
+        local_rows, remote = self.routes.split_lanes(lanes)
+        if local_rows is None:
+            return self.gateway._handle_get_fast(lanes, None, dl)
+        rows_out: List[Any] = [None] * n
+        ok_out = np.zeros(n, dtype=bool)
+        rings = np.empty(n, dtype=object)
+        rings[:] = ""
+        ring_errors: Dict[str, str] = {}
+        if local_rows.size:
+            out = self.gateway._handle_get_fast(lanes[local_rows],
+                                                None, dl)
+            lsegs, lok = out["SEGMENTS"], np.asarray(out["OK"], bool)
+            for i, j in enumerate(local_rows):
+                rows_out[int(j)] = lsegs[i]
+                ok_out[int(j)] = bool(lok[i])
+                rings[int(j)] = out["RINGS"][i]
+            for rid, msg in (out.get("RING_ERRORS") or {}).items():
+                ring_errors[rid] = msg
+        for addr, rrows in remote:
+            _, _, segs, ok, failed, err = self._forward_read(
+                "GET", addr, lanes[rrows], None, dl)
+            rings[rrows] = f"mesh:{addr_str(addr)}"
+            if err is not None:
+                ring_errors[f"mesh:{addr_str(addr)}"] = err
+            for i, j in enumerate(rrows):
+                if ok is not None and not failed[i]:
+                    rows_out[int(j)] = segs[i]
+                    ok_out[int(j)] = bool(ok[i])
+        return self._assemble_get(rows_out, ok_out, rings, ring_errors)
+
+    def get_one(self, k: int, dl) -> Tuple[Any, bool]:
+        """One REMOTE key's (segments, ok) through the coalescer.
+        NEVER cached locally: only the owner's epoch sees the owner's
+        writes."""
+        own = self.routes.owner(k)
+        assert own is not None
+        addr = own[1]
+        _, _, segs, ok, failed, err = self._forward_read(
+            "GET", addr, ints_to_lanes([int(k)]), None, dl)
+        if err is not None or ok is None or bool(failed[0]):
+            from p2p_dhts_tpu.gateway.router import RingUnavailableError
+            raise RingUnavailableError(
+                f"mesh forward to {addr_str(addr)} failed: "
+                f"{err or 'owner bounced the key'}")
+        return segs[0], bool(ok[0])
+
+    @staticmethod
+    def _assemble_get(rows_out: List[Any], ok_out: np.ndarray,
+                      rings: np.ndarray,
+                      ring_errors: Dict[str, str]) -> dict:
+        filled = [r for r in rows_out if isinstance(r, np.ndarray)]
+        shapes = {r.shape for r in filled}
+        if (not ring_errors and len(filled) == len(rows_out)
+                and len(shapes) == 1):
+            out: dict = {"SEGMENTS": np.stack(filled).astype(np.int32),
+                         "OK": ok_out, "RINGS": rings.tolist()}
+        else:
+            out = {"SEGMENTS": [r if r is not None else []
+                                for r in rows_out],
+                   "OK": ok_out, "RINGS": rings.tolist()}
+        if ring_errors:
+            out["RING_ERRORS"] = ring_errors
+        return out
+
+    # -- PUT ------------------------------------------------------------------
+    def put_is_remote(self, k: int, fwd: bool) -> Optional[Addr]:
+        """The single-key PUT split: the owner's addr for a remote key
+        on a non-forwarded request, else None (serve locally). A
+        forwarded PUT for a key we don't own errors — the one-hop
+        rule; writes get no silent re-resolution."""
+        if self.owns_local(k):
+            return None
+        if fwd:
+            raise self.not_owner_error(k)
+        own = self.routes.owner(k)
+        return own[1] if own is not None else None
+
+    def forward_put_one(self, addr: Addr, key_int: int, segments,
+                        length: int, start: int, dl) -> bool:
+        """Direct (uncoalesced) single-key PUT forward: writes are
+        rarer and order-sensitive, so they ride their own RPC. The
+        caller's deadline rides the frame (and clamps the transport
+        wait) — the gateway deadline-propagation chain crosses the
+        process boundary intact."""
+        req = {"COMMAND": "PUT", "KEY": format(int(key_int), "x"),
+               "SEGMENTS": np.asarray(segments), "LENGTH": int(length),
+               "START": int(start), "FWD": 1}
+        rem = dl.remaining()
+        if rem is not None:
+            req["DEADLINE_MS"] = max(rem * 1e3, 1.0)
+        return bool(self._forward_direct(addr, req,
+                                         deadline=dl).get("OK"))
+
+    def put_entries(self, entries: Sequence[dict], dl, fwd: bool,
+                    key_of) -> Optional[dict]:
+        """The ENTRIES vector-PUT split. Returns None when every entry
+        is local (the caller keeps its existing path); otherwise the
+        merged response. Forwarded requests answer local entries and
+        bounce the rest as NOT_OWNED + OK:false."""
+        keys = [key_of(e) for e in entries]
+        lanes = ints_to_lanes(keys)
+        local_rows, remote = self.routes.split_lanes(lanes)
+        if local_rows is None:
+            if not fwd:
+                return None
+            local_rows = np.arange(len(entries))
+            remote = []
+        n = len(entries)
+        ok_out = [False] * n
+        rings_out = [""] * n
+        ring_errors: Dict[str, str] = {}
+        not_owned: List[int] = []
+        if local_rows.size:
+            sub = [entries[int(i)] for i in local_rows]
+            out = self.gateway._handle_put_entries(sub, None, dl)
+            for i, j in enumerate(local_rows):
+                ok_out[int(j)] = bool(out["OK"][i])
+                rings_out[int(j)] = out["RINGS"][i]
+            for rid, msg in (out.get("RING_ERRORS") or {}).items():
+                ring_errors[rid] = msg
+        for addr, rrows in remote:
+            a = f"mesh:{addr_str(addr)}"
+            if fwd:
+                # One-hop rule: a forwarded write is never re-routed.
+                not_owned.extend(int(j) for j in rrows)
+                for j in rrows:
+                    rings_out[int(j)] = a
+                continue
+            req = {"COMMAND": "PUT", "FWD": 1,
+                   "ENTRIES": [entries[int(j)] for j in rrows]}
+            rem = dl.remaining()
+            if rem is not None:
+                req["DEADLINE_MS"] = max(rem * 1e3, 1.0)
+            self.metrics.inc("gateway.forward.puts", len(rrows))
+            try:
+                resp = self._forward_direct(addr, req, deadline=dl)
+            except ForwardError as exc:
+                ring_errors[a] = str(exc)
+                for j in rrows:
+                    rings_out[int(j)] = a
+                continue
+            for i, j in zip(range(len(rrows)), rrows):
+                ok_out[int(j)] = bool(resp["OK"][i])
+                rings_out[int(j)] = a
+            bounced = resp.get("NOT_OWNED")
+            if bounced:
+                # Route churn mid-write: the bounced entries' OK:false
+                # must read as CHURN, not a store failure, and the
+                # owner's fresher table installs so the NEXT write
+                # resolves correctly (writes themselves are never
+                # silently re-routed — the one-hop rule).
+                self.metrics.inc("gateway.forward.not_owner",
+                                 len(bounced))
+                ring_errors[a] = (
+                    f"{len(bounced)} entr{'y' if len(bounced) == 1 else 'ies'} "
+                    f"bounced NOT_OWNED by {addr_str(addr)} (route "
+                    f"epoch {resp.get('EPOCH')}); re-issue after the "
+                    f"route refresh")
+                if resp.get("ROUTES_DOC") is not None:
+                    self.apply_routes_doc(resp["ROUTES_DOC"])
+        out = {"OK": ok_out, "RINGS": rings_out}
+        if ring_errors:
+            out["RING_ERRORS"] = ring_errors
+        if fwd and not_owned:
+            out["NOT_OWNED"] = not_owned
+            out["EPOCH"] = self.routes.epoch
+            out["ROUTES_DOC"] = self.routes_doc()
+        return out
+
+    def _forward_direct(self, addr: Addr, req: dict,
+                        deadline=None) -> dict:
+        timeout = self.peer_verb_timeout_s * 4
+        if deadline is not None:
+            timeout = deadline.clamp(timeout)
+        try:
+            resp = Client.make_request(addr[0], addr[1], req,
+                                       timeout=max(timeout or 0.0,
+                                                   0.001),
+                                       retries=1)
+        # chordax-lint: disable=bare-except -- a peer failure becomes the caller's per-destination error row, never a handler crash
+        except Exception as exc:
+            self.metrics.inc("gateway.forward.errors")
+            raise ForwardError(
+                f"forward to {addr_str(addr)} failed: {exc}") from exc
+        if not resp.get("SUCCESS"):
+            self.metrics.inc("gateway.forward.errors")
+            raise ForwardError(
+                f"owner {addr_str(addr)} errored: {resp.get('ERRORS')}")
+        return resp
+
+    # -- the owner side of a forward ------------------------------------------
+    def _serve_forwarded(self, verb: str, lanes: np.ndarray,
+                         starts: Optional[np.ndarray], dl) -> dict:
+        """Answer a forwarded run from LOCAL ownership only (the
+        one-hop rule): owned rows serve through the gateway's fast
+        lane, the rest come back NOT_OWNED with our fresher route
+        table piggybacked so the origin can re-resolve once."""
+        n = lanes.shape[0]
+        local_rows, remote = self.routes.split_lanes(lanes)
+        self.metrics.inc("mesh.fwd_served", n - sum(
+            r.size for _, r in remote) if remote else n)
+        if local_rows is None:
+            if verb == "FIND_SUCCESSOR":
+                return self.gateway._handle_find_successor_fast(
+                    {"STARTS": starts}, lanes, None, dl)
+            return self.gateway._handle_get_fast(lanes, None, dl)
+        owned = local_rows
+        bounced = sorted(int(j) for _, rr in remote for j in rr)
+        if verb == "FIND_SUCCESSOR":
+            owners = np.full(n, -1, np.int64)
+            hops = np.full(n, -1, np.int32)
+            rings = [""] * n
+            if owned.size:
+                sub_starts = starts[owned] if starts is not None \
+                    else None
+                out = self.gateway._handle_find_successor_fast(
+                    {"STARTS": sub_starts}, lanes[owned], None, dl)
+                owners[owned] = np.asarray(out["OWNERS"], np.int64)
+                hops[owned] = np.asarray(out["HOPS"], np.int32)
+                for i, j in enumerate(owned):
+                    rings[int(j)] = out["RINGS"][i]
+            resp: dict = {"OWNERS": owners, "HOPS": hops,
+                          "RINGS": rings}
+        else:
+            rows_out: List[Any] = [[]] * n
+            ok_out = np.zeros(n, dtype=bool)
+            rings = [""] * n
+            if owned.size:
+                out = self.gateway._handle_get_fast(lanes[owned],
+                                                    None, dl)
+                lsegs = out["SEGMENTS"]
+                lok = np.asarray(out["OK"], bool)
+                for i, j in enumerate(owned):
+                    rows_out[int(j)] = lsegs[i]
+                    ok_out[int(j)] = bool(lok[i])
+                    rings[int(j)] = out["RINGS"][i]
+            resp = self._assemble_get(
+                [r if isinstance(r, np.ndarray) else None
+                 for r in rows_out], ok_out,
+                np.asarray(rings, dtype=object), {})
+            resp["RINGS"] = rings
+        if bounced:
+            resp["NOT_OWNED"] = bounced
+            resp["EPOCH"] = self.routes.epoch
+            resp["ROUTES_DOC"] = self.routes_doc()
+        return resp
+
+    # -- forward + one refresh-retry ------------------------------------------
+    def _forward_read(self, verb: str, addr: Addr, lanes: np.ndarray,
+                      starts: Optional[np.ndarray], dl
+                      ) -> Tuple[Optional[np.ndarray],
+                                 Optional[np.ndarray],
+                                 Optional[list],
+                                 Optional[np.ndarray],
+                                 np.ndarray, Optional[str]]:
+        """One coalesced forward plus at most ONE refresh-and-retry of
+        the rows the owner bounced (the origin's half of the one-hop
+        rule). Returns (owners, hops, segments_rows, ok, failed_mask,
+        error): arrays are row-aligned with `lanes`; failed rows carry
+        no answer."""
+        n = lanes.shape[0]
+        failed = np.zeros(n, dtype=bool)
+        try:
+            res = self.coalescer.forward(addr, verb, lanes, starts,
+                                         dl.at)
+        # chordax-lint: disable=bare-except -- a dead owner fails only its rows; the caller folds the error into per-destination RING_ERRORS
+        except Exception as exc:
+            failed[:] = True
+            return None, None, None, None, failed, str(exc)
+        owners = res.owners
+        hops = res.hops
+        ok = res.ok
+        segments = (list(res.segments)
+                    if res.segments is not None else None)
+        if not res.not_owned:
+            return owners, hops, segments, ok, failed, None
+        # Retrying mutates per-row answers in place — and wire-decoded
+        # arrays are READ-ONLY frombuffer views, so copy first.
+        owners = np.array(owners) if owners is not None else None
+        hops = np.array(hops) if hops is not None else None
+        ok = np.array(ok) if ok is not None else None
+        # The owner's table is fresher than ours: install it, then
+        # re-resolve the bounced rows ONCE (local or one new owner).
+        if res.routes_doc is not None:
+            self.apply_routes_doc(res.routes_doc)
+        self.metrics.inc("gateway.forward.retries")
+        bounced = np.asarray(sorted(res.not_owned), np.int64)
+        failed[bounced] = True
+        sub_lanes = lanes[bounced]
+        sub_starts = starts[bounced] if starts is not None else None
+        local_rows, remote = self.routes.split_lanes(sub_lanes)
+        if local_rows is None:
+            local_rows = np.arange(sub_lanes.shape[0])
+            remote = []
+        err: Optional[str] = None
+        if local_rows.size:
+            j = bounced[local_rows]
+            if verb == "FIND_SUCCESSOR":
+                out = self.gateway._handle_find_successor_fast(
+                    {"STARTS": (sub_starts[local_rows]
+                                if sub_starts is not None else None)},
+                    sub_lanes[local_rows], None, dl)
+                owners[j] = np.asarray(out["OWNERS"], np.int64)
+                hops[j] = np.asarray(out["HOPS"], np.int32)
+            else:
+                out = self.gateway._handle_get_fast(
+                    sub_lanes[local_rows], None, dl)
+                ok[j] = np.asarray(out["OK"], bool)
+                for i, jj in enumerate(j):
+                    segments[int(jj)] = out["SEGMENTS"][i]
+            failed[j] = False
+        for new_addr, rrows in remote:
+            j = bounced[rrows]
+            if new_addr == addr:
+                err = (f"owner {addr_str(addr)} bounced "
+                       f"{len(rrows)} key(s) it still maps to itself")
+                continue
+            try:
+                res2 = self.coalescer.forward(
+                    new_addr, verb, sub_lanes[rrows],
+                    sub_starts[rrows] if sub_starts is not None
+                    else None, dl.at)
+            # chordax-lint: disable=bare-except -- the single retry's failure stays a per-row verdict, never a handler crash
+            except Exception as exc:
+                err = str(exc)
+                continue
+            live = np.asarray(
+                [i for i in range(len(rrows))
+                 if i not in set(res2.not_owned)], np.int64)
+            if verb == "FIND_SUCCESSOR":
+                owners[j[live]] = res2.owners[live]
+                hops[j[live]] = res2.hops[live]
+            else:
+                ok[j[live]] = res2.ok[live]
+                for i in live:
+                    segments[int(j[i])] = res2.segments[int(i)]
+            failed[j[live]] = False
+            if res2.not_owned:
+                err = (f"{len(res2.not_owned)} key(s) still unowned "
+                       f"after one re-resolution (route churn)")
+        return owners, hops, segments, ok, failed, err
+
+    # -- mesh-wide verb merging ------------------------------------------------
+    def collect_peer_rows(self, command: str, req: dict
+                          ) -> Dict[str, dict]:
+        """Every live route peer's own answer to `command` (bounded
+        timeout each; a dead peer's row is its error string) — the
+        proxy/merge half of the mesh-wide CAPACITY/HEALTH/PULSE
+        verbs. Peers are polled CONCURRENTLY, so the verb costs
+        max(peer latency), never sum — N-1 partitioned peers must not
+        park a serving worker for N-1 timeouts back to back."""
+        base = {k: v for k, v in req.items()
+                if k not in ("MESH", trace_mod.WIRE_KEY)}
+        base["COMMAND"] = command
+        peers = [a for a in self.routes.addresses()
+                 if a != self.routes.self_addr]
+        if not peers:
+            return {}
+
+        def one(addr: Addr) -> dict:
+            try:
+                resp = Client.make_request(
+                    addr[0], addr[1], dict(base),
+                    timeout=self.peer_verb_timeout_s)
+                resp.pop("SUCCESS", None)
+                return resp
+            # chordax-lint: disable=bare-except -- a dead peer's row is its error string; the merge must answer regardless
+            except Exception as exc:
+                return {"ERROR": str(exc)}
+
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=min(len(peers), 16),
+                thread_name_prefix="mesh-verb") as pool:
+            answers = list(pool.map(one, peers))
+        return {addr_str(a): r for a, r in zip(peers, answers)}
+
+    # -- lifecycle ------------------------------------------------------------
+    def close(self) -> None:
+        self.gateway.router.remove_topology_listener(self._topo_cb)
+        self.coalescer.close()

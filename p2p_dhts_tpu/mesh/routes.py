@@ -1,0 +1,221 @@
+"""The mesh route table: the cluster-wide shard -> address map.
+
+chordax-mesh (ISSUE 15) shards the 2^128 identifier circle across N
+gateway PROCESSES exactly the way Chord shards it across peers: every
+mesh peer carries a 128-bit id (keyspace.peer_id of its ip:port — the
+reference's SHA1("ip:port") rule, abstract_chord_peer.cpp:13-28), and
+the peer with id p owns the clockwise-inclusive range
+(pred(p) + 1 .. p] — i.e. the owner of key k is the RING SUCCESSOR of
+k among the live peer ids. That is byte-for-byte the reference's
+StoredLocally rule (abstract_chord_peer.cpp:720-725) lifted one level,
+from device rows to serving processes, and it is what
+tests/test_mesh.py pins against tests/oracle.py across re-splits.
+
+The table is VERSIONED: the membership plane's coordinator stamps each
+recomputed split with a monotonically increasing EPOCH, peers install
+a map only when its epoch is newer than theirs (stale gossip can never
+roll a peer backwards), and a local `set_key_range` re-split bumps a
+GENERATION counter so watchers can see an operator override that the
+coordinator has not blessed yet. Lookups are lock-cheap: the vector
+split classifies a whole [N, LANES] key array with one range mask per
+peer (the chordax-fastlane rule — zero per-key python), and the
+single-key owner is one bisect.
+
+LOCK ORDER: `RouteTable._lock` is a LEAF — held only for table reads/
+swaps, never across an RPC, an engine call, or any other lock.
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu.keyspace import (KEYS_IN_RING, lanes_in_range_mask,
+                                   peer_id)
+
+#: An address is ("ip", port); the mesh key form "ip:port" joins them.
+Addr = Tuple[str, int]
+
+
+def addr_str(addr: Addr) -> str:
+    return f"{addr[0]}:{addr[1]}"
+
+
+def member_for(addr: Addr) -> int:
+    """The mesh peer id of one gateway process: the reference's
+    SHA1("ip:port") identity, so a process's shard is a pure function
+    of where it listens."""
+    return peer_id(addr[0], int(addr[1]))
+
+
+class RouteTable:
+    """Versioned shard -> address map with successor-rule ownership."""
+
+    def __init__(self, self_addr: Optional[Addr] = None):
+        self.self_addr: Optional[Addr] = (
+            (str(self_addr[0]), int(self_addr[1]))
+            if self_addr is not None else None)
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._generation = 0
+        self._ids: List[int] = []
+        self._addrs: Dict[int, Addr] = {}
+
+    # -- versioning ----------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def bump(self) -> int:
+        """Record a LOCAL ownership change (an operator set_key_range
+        the coordinator did not drive): the generation counter moves so
+        route observers see the table is ahead of its blessed epoch."""
+        with self._lock:
+            self._generation += 1
+            return self._generation
+
+    def apply(self, peers: Dict[int, Addr], epoch: int) -> bool:
+        """Install a coordinator-stamped map; returns True when it was
+        NEWER (stale gossip is dropped, never applied backwards). An
+        equal-epoch map is also dropped — the coordinator bumps the
+        epoch on every recompute, so equal means already installed."""
+        epoch = int(epoch)
+        norm = {int(m) % KEYS_IN_RING: (str(a[0]), int(a[1]))
+                for m, a in peers.items()}
+        with self._lock:
+            if epoch <= self._epoch:
+                return False
+            self._epoch = epoch
+            self._generation = 0
+            self._ids = sorted(norm)
+            self._addrs = norm
+        return True
+
+    # -- snapshots -----------------------------------------------------------
+    def peers(self) -> Dict[int, Addr]:
+        with self._lock:
+            return dict(self._addrs)
+
+    def addresses(self) -> List[Addr]:
+        """Every peer address in id order (self included)."""
+        with self._lock:
+            return [self._addrs[m] for m in self._ids]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ids)
+
+    def shard_of(self, member: int) -> Optional[Tuple[int, int]]:
+        """(lo, hi) clockwise-inclusive range the member owns, or None
+        for an unknown member. A single-peer table owns everything."""
+        member = int(member) % KEYS_IN_RING
+        with self._lock:
+            if member not in self._addrs:
+                return None
+            i = bisect.bisect_left(self._ids, member)
+            pred = self._ids[(i - 1) % len(self._ids)]
+        if pred == member:
+            return ((member + 1) % KEYS_IN_RING, member)
+        return ((pred + 1) % KEYS_IN_RING, member)
+
+    # -- ownership -----------------------------------------------------------
+    def owner(self, key_int: int) -> Optional[Tuple[int, Addr]]:
+        """(member_id, addr) of the key's owner — the ring successor of
+        the key among the table's ids (the oracle's _ring_successor
+        rule) — or None for an empty table."""
+        key_int = int(key_int) % KEYS_IN_RING
+        with self._lock:
+            if not self._ids:
+                return None
+            i = bisect.bisect_left(self._ids, key_int)
+            mid = self._ids[i] if i < len(self._ids) else self._ids[0]
+            return mid, self._addrs[mid]
+
+    def is_local(self, key_int: int) -> bool:
+        """True when the key's owner is THIS process (or the table is
+        empty / self-less — an unrouted mesh serves everything
+        locally, the single-process degenerate case)."""
+        own = self.owner(key_int)
+        if own is None or self.self_addr is None:
+            return True
+        return own[1] == self.self_addr
+
+    def split_lanes(self, lanes: np.ndarray
+                    ) -> Tuple[Optional[np.ndarray],
+                               List[Tuple[Addr, np.ndarray]]]:
+        """Classify a whole [N, LANES] uint32 key array:
+        (local_rows, [(addr, row_indices)...]) where local_rows is
+        None when EVERY row is local (the no-copy common case) and an
+        index array (possibly empty) otherwise. One range mask per
+        peer (peers are few; keys are many) — zero per-key python, the
+        fastlane discipline. An empty table (or a table without a self
+        address) is all-local."""
+        n = lanes.shape[0]
+        with self._lock:
+            ids = list(self._ids)
+            addrs = dict(self._addrs)
+        if not ids or self.self_addr is None:
+            return None, []
+        assigned = np.full(n, -1, np.int32)
+        for j, mid in enumerate(ids):
+            i = bisect.bisect_left(ids, mid)
+            pred = ids[(i - 1) % len(ids)]
+            lo = (pred + 1) % KEYS_IN_RING if pred != mid \
+                else (mid + 1) % KEYS_IN_RING
+            mask = lanes_in_range_mask(lanes, lo, mid) & (assigned < 0)
+            if mask.any():
+                assigned[mask] = j
+        # The shards tile the whole circle, so every row is assigned;
+        # a defensive residue (impossible by construction) stays local.
+        local_js = [j for j, mid in enumerate(ids)
+                    if addrs[mid] == self.self_addr]
+        local_mask = np.isin(assigned, local_js) | (assigned < 0)
+        if local_mask.all():
+            return None, []
+        remote: List[Tuple[Addr, np.ndarray]] = []
+        for j, mid in enumerate(ids):
+            if j in local_js:
+                continue
+            rows = np.nonzero(assigned == j)[0]
+            if rows.size:
+                remote.append((addrs[mid], rows))
+        return np.nonzero(local_mask)[0], remote
+
+    # -- wire form -----------------------------------------------------------
+    def doc(self) -> dict:
+        """The gossip/observability document the MESH_ROUTES verb
+        serves: epoch + generation + one row per peer with its id,
+        address, and derived shard bounds (hex — the overlay's Key
+        serialization)."""
+        with self._lock:
+            ids = list(self._ids)
+            addrs = dict(self._addrs)
+            epoch = self._epoch
+            gen = self._generation
+        rows = []
+        for i, mid in enumerate(ids):
+            pred = ids[(i - 1) % len(ids)]
+            lo = (pred + 1) % KEYS_IN_RING if pred != mid \
+                else (mid + 1) % KEYS_IN_RING
+            ip, port = addrs[mid]
+            rows.append({"MEMBER": format(mid, "x"), "IP": ip,
+                         "PORT": int(port), "LO": format(lo, "x"),
+                         "HI": format(mid, "x"),
+                         "SELF": addrs[mid] == self.self_addr})
+        return {"EPOCH": epoch, "GENERATION": gen, "ROUTES": rows}
+
+    def apply_doc(self, doc: dict) -> bool:
+        """Install a MESH_ROUTES-shaped document (epoch-guarded)."""
+        peers = {int(r["MEMBER"], 16): (str(r["IP"]), int(r["PORT"]))
+                 for r in doc.get("ROUTES", ())}
+        return self.apply(peers, int(doc.get("EPOCH", 0)))

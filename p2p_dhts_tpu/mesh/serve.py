@@ -1,0 +1,143 @@
+"""One mesh gateway process: ``python -m p2p_dhts_tpu.mesh.serve``.
+
+The unit the chordax-mesh bench composes four of: build a device ring
+(one shard's serving backend), front it with a Gateway + RPC server
+on one port, attach a MeshPlane, and drive membership — as the SEED
+(control ring + MembershipManager + MeshCoordinator: the process
+every peer joins and heartbeats) or as a PEER (a MeshPeer loop
+JOIN_RING-ing the seed, heartbeating, and pulling routes when the
+epoch moves).
+
+Protocol with the parent (the bench / an operator script):
+
+  * stdout line 1: ``MESH_READY {"port": ..., "member": "<hex>"}`` —
+    emitted once the server answers and (seed) the initial routes are
+    installed. Everything else logs to stderr.
+  * stdin EOF = graceful shutdown (peer loop, plane, server, gateway,
+    in that order), exit 0. SIGTERM stays the hard kill.
+
+Every process builds the SAME device-ring member set (--members-seed):
+the mesh shards by ROUTE ownership, not ring content, so identical
+rings make forwarded-vs-direct answers byte-comparable — exactly the
+parity the bench gates on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--seed", default=None, metavar="IP:PORT",
+                    help="seed gateway to join; absent = BE the seed")
+    ap.add_argument("--ring-peers", type=int, default=256)
+    ap.add_argument("--members-seed", type=int, default=0x5EED)
+    ap.add_argument("--store-capacity", type=int, default=4096)
+    ap.add_argument("--smax", type=int, default=4)
+    ap.add_argument("--bucket-min", type=int, default=8)
+    ap.add_argument("--bucket-max", type=int, default=256)
+    ap.add_argument("--heartbeat-s", type=float, default=0.25)
+    ap.add_argument("--phi", type=float, default=3.0)
+    ap.add_argument("--ctl-capacity", type=int, default=16,
+                    help="seed only: control-ring capacity (max peers)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from p2p_dhts_tpu.config import RingConfig
+    from p2p_dhts_tpu.core.ring import build_ring
+    from p2p_dhts_tpu.dhash.store import empty_store
+    from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+    from p2p_dhts_tpu.membership.kernels import padded_capacity
+    from p2p_dhts_tpu.mesh.plane import MeshPlane
+    from p2p_dhts_tpu.net import wire
+    from p2p_dhts_tpu.net.rpc import Server
+
+    rng = np.random.RandomState(args.members_seed)
+    member_rows = [int.from_bytes(rng.bytes(16), "little")
+                   for _ in range(args.ring_peers)]
+
+    srv = Server(args.port, {}, host=args.host)
+    self_addr = (args.host, srv.port)
+    gw = Gateway(name=f"mesh-{srv.port}")
+    gw.add_ring("shard",
+                build_ring(member_rows,
+                           RingConfig(finger_mode="materialized")),
+                empty_store(args.store_capacity, args.smax),
+                default=True, bucket_min=args.bucket_min,
+                bucket_max=args.bucket_max, max_queue=65536,
+                warmup=["find_successor", "dhash_get", "dhash_put"])
+    plane = MeshPlane(gw, self_addr, ring_id="shard")
+    install_gateway_handlers(srv, gw)
+    srv.run_in_background()
+
+    mgr = None
+    coord = None
+    peer = None
+    if args.seed is None:
+        # THE SEED: a tiny control ring whose members are the mesh
+        # peers themselves (SHA1("ip:port") ids), driven by the REAL
+        # PR-7 membership machinery — joins/heartbeats/phi detection —
+        # with the coordinator recomputing the shard split on every
+        # applied batch.
+        from p2p_dhts_tpu.membership import MembershipManager
+        from p2p_dhts_tpu.mesh.peer import MeshCoordinator
+        from p2p_dhts_tpu.mesh.routes import member_for
+        ctl_cap = padded_capacity(args.ctl_capacity)
+        gw.add_ring("mesh-ctl",
+                    build_ring([member_for(self_addr)],
+                               RingConfig(finger_mode="materialized"),
+                               capacity=ctl_cap),
+                    bucket_min=4, bucket_max=16,
+                    warmup=["churn_apply", "stabilize_sweep"])
+        mgr = MembershipManager(
+            gw, "mesh-ctl",
+            heartbeat_interval_s=args.heartbeat_s,
+            phi_threshold=args.phi, min_heartbeats=3,
+            confirm_rounds=2, interval_s=args.heartbeat_s / 4,
+            interval_idle_s=args.heartbeat_s,
+            round_timeout_s=600.0)
+        coord = MeshCoordinator(plane, mgr)
+        coord.register_self()
+        mgr.quiesce(max_rounds=8)
+        mgr.start()
+    else:
+        from p2p_dhts_tpu.mesh.peer import MeshPeer
+        ip, _, port = args.seed.rpartition(":")
+        peer = MeshPeer(plane, (ip, int(port)),
+                        heartbeat_s=args.heartbeat_s)
+        peer.step()           # join NOW so READY means "in the mesh"
+        peer.fetch_routes()
+        peer.start()
+
+    sys.stdout.write("MESH_READY " + json.dumps(
+        {"port": srv.port, "member": format(plane.member_id, "x")})
+        + "\n")
+    sys.stdout.flush()
+
+    try:
+        while True:
+            line = sys.stdin.readline()
+            if not line:
+                break  # parent closed the pipe: graceful shutdown
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if peer is not None:
+            peer.close()
+        if mgr is not None:
+            mgr.close()
+        plane.close()
+        srv.kill()
+        gw.close()
+        wire.reset_pool()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

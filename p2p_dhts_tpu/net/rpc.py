@@ -329,6 +329,14 @@ class Client:
         if havoc_mod.enabled():
             act = havoc_mod.decide("net.partition",
                                    key=f"{ip_addr}:{port}")
+            if act is None:
+                # chordax-mesh (ISSUE 15): the whole-process-partition
+                # building block — same outbound-failure shape as
+                # net.partition, its own site so mesh scenarios can be
+                # seeded into EVERY process (HAVOC verb) without
+                # colliding with a socket-level plan's cursors.
+                act = havoc_mod.decide("mesh.partition",
+                                       key=f"{ip_addr}:{port}")
             if act is not None:
                 # Injected ASYMMETRIC partition: OUTBOUND requests to
                 # this destination fail while its own inbound traffic
@@ -719,6 +727,18 @@ class Server:
                 conn, _ = self._sock.accept()
             except (BlockingIOError, OSError):
                 return
+            if havoc_mod.enabled() and havoc_mod.decide(
+                    "rpc.server.accept", key=str(self.port)) is not None:
+                # Injected accept-loop reset (chordax-mesh, the PR-10
+                # server-side item): the connection closes before a
+                # byte is read — the client sees a refused/reset dial,
+                # exactly the shape its breaker and retry paths own.
+                METRICS.inc("rpc.server.accept_reset")
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             # Blocking socket + level-triggered readiness: recv only
             # runs after the selector reports data, sendall may block a
             # WORKER (bounded by the timeout below) but never the
@@ -1032,7 +1052,26 @@ class Server:
             command=req.get("COMMAND", "")
             if isinstance(req, dict) else "?")
 
+    def _reply_fault(self) -> bool:
+        """Consult the rpc.server.reply havoc site for ONE outbound
+        reply (chordax-mesh, the PR-10 server-side item). Returns True
+        when the reply must be DROPPED (the caller's deadline bounds
+        the wait); a delay action sleeps here, on the worker/shed
+        thread, no lock held."""
+        if not havoc_mod.enabled():
+            return False
+        act = havoc_mod.decide("rpc.server.reply", key=str(self.port))
+        if act is None:
+            return False
+        if act.get("action") == "delay":
+            time.sleep(float(act.get("delay_s", 0.05)))
+            return False
+        METRICS.inc("rpc.server.reply_dropped")
+        return True
+
     def _send_reply(self, conn: socket.socket, resp: JsonObj) -> None:
+        if self._reply_fault():
+            return
         conn.sendall(json.dumps(resp, separators=(",", ":"),
                                 default=_json_default).encode())
         try:
@@ -1042,6 +1081,8 @@ class Server:
 
     def _send_frame(self, st: _ConnState, req_id: int,
                     resp: JsonObj) -> None:
+        if self._reply_fault():
+            return
         try:
             frame = wire.encode_frame(wire.FRAME_RESPONSE, req_id, resp,
                                       compress=st.compress)

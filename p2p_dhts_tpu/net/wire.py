@@ -946,6 +946,23 @@ class WirePool:
         # (an older server) == an ordinary uncompressed binary session.
         return _Conn(sock, dest, compress=(echo == HELLO_V2))
 
+    def close_dest(self, dest: Tuple[str, int]) -> int:
+        """Close ONE destination's pooled connections and forget its
+        negotiation verdict + breaker state (chordax-mesh departed-peer
+        hygiene: a peer a re-split dropped must not pin sockets, a
+        stale legacy verdict, or a tripped breaker that would fast-fail
+        its future rejoin). In-flight requests on the closed
+        connections fail with the sibling-abort error — the peer IS
+        gone. Returns the number of connections closed."""
+        dest = (str(dest[0]), int(dest[1]))
+        with self._lock:
+            conns = self._conns.pop(dest, [])
+            self._legacy.pop(dest, None)
+            self._breakers.pop(dest, None)
+        for c in conns:
+            c.close()
+        return len(conns)
+
     def close_all(self) -> None:
         with self._lock:
             conns = [c for lst in self._conns.values() for c in lst]

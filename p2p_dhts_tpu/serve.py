@@ -1060,6 +1060,25 @@ class ServeEngine:
                 f"serve loop retraced {n} time(s) after warmup — a "
                 f"dispatch missed the pre-traced buckets")
 
+    def telemetry_row(self) -> dict:
+        """One pollable engine-health row (chordax-mesh, ISSUE 15):
+        the HEALTH verb inlines this per ring so a REMOTE watcher —
+        the mesh bench's "zero steady-state retraces in EVERY
+        process" gate — reads trace counts without a local handle.
+        Reading it also refreshes the `serve.steady_retraces.<engine>`
+        gauge, so the same number rides METRICS / pulse series for
+        free (-1 = never warmed, nothing to measure against)."""
+        retr = self.steady_state_retraces
+        self._metrics.gauge(f"serve.steady_retraces.{self._name}",
+                            retr)
+        return {
+            "name": self._name,
+            "queue_depth": self.queue_depth,
+            "requests_served": self.requests_served,
+            "steady_retraces": retr,
+            "trace_counts": self.trace_counts,
+        }
+
     # -- device-cost accounting (chordax-lens, ISSUE 14) --------------------
 
     @property

@@ -281,6 +281,15 @@ def current() -> Optional[TraceContext]:
     return None if ctx is UNSAMPLED else ctx
 
 
+def current_raw() -> Optional[TraceContext]:
+    """The thread's active context INCLUDING the UNSAMPLED sentinel —
+    the cross-thread handoff form (chordax-mesh): a worker that will
+    issue RPCs on another thread's behalf must carry the sampled-OUT
+    verdict too, or it would mint a fresh root trace for a request
+    whose root said no. Pair with activate() on the other thread."""
+    return getattr(_TLS, "ctx", None)
+
+
 @contextlib.contextmanager
 def activate(ctx: Optional[TraceContext]) -> Iterator[None]:
     """Make `ctx` the thread's current context for the block (the RPC

@@ -1,0 +1,637 @@
+"""chordax-mesh tests (ISSUE 15): route-table oracle parity, the
+local-or-forward split, forward coalescing byte parity, the one-hop
+rule, NOT_OWNED refresh-retry, cross-process deadline/trace chains,
+the JOIN_RING/HEARTBEAT peer loop with the KNOWN:false rejoin path,
+the server-side havoc sites, and mesh-wide verb merging.
+
+Topology under test: TWO real gateway processes' worth of stack — two
+Gateways, two RPC servers on localhost sockets, two MeshPlanes — in
+ONE test process (the dryrun's "in-proc-spawned ring" shape; the
+bench's 4-SUBPROCESS ring covers the true multi-process story).
+Gateway A is the seed: a control ring + MembershipManager +
+MeshCoordinator; B joined through the real JOIN_RING wire verb via a
+foreground-driven MeshPeer, so every test sees the membership plane
+the production bootstrap uses. All membership rounds are driven
+foreground (mgr.step()) for determinism."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu import havoc as havoc_mod
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+from p2p_dhts_tpu.keyspace import KEYS_IN_RING
+from p2p_dhts_tpu.membership import MembershipManager
+from p2p_dhts_tpu.membership.kernels import padded_capacity
+from p2p_dhts_tpu.mesh import (MeshCoordinator, MeshPeer, MeshPlane,
+                               RouteTable, addr_str, member_for)
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client, Server
+
+pytestmark = pytest.mark.mesh
+
+RNG = np.random.RandomState(0xE5B)
+RING_ROWS = [int.from_bytes(RNG.bytes(16), "little") for _ in range(48)]
+
+
+def _rand_keys(n, rng=RNG):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+class _Node:
+    def __init__(self, name, seed_node=False):
+        self.metrics = Metrics()
+        self.server = Server(0, {})
+        self.gateway = Gateway(metrics=self.metrics, name=name)
+        self.gateway.add_ring(
+            "shard",
+            build_ring(RING_ROWS, RingConfig(finger_mode="materialized")),
+            empty_store(640, 4), default=True, bucket_min=8,
+            bucket_max=32, reprobe_s=300.0,
+            warmup=["find_successor", "dhash_get", "dhash_put"])
+        self.addr = ("127.0.0.1", self.server.port)
+        self.plane = MeshPlane(self.gateway, self.addr, ring_id="shard")
+        self.member = self.plane.member_id
+        self.manager = self.coordinator = None
+        if seed_node:
+            self.gateway.add_ring(
+                "mesh-ctl",
+                build_ring([self.member],
+                           RingConfig(finger_mode="materialized"),
+                           capacity=padded_capacity(8)),
+                bucket_min=4, bucket_max=16,
+                warmup=["churn_apply", "stabilize_sweep"])
+            self.manager = MembershipManager(
+                self.gateway, "mesh-ctl", heartbeat_interval_s=0.05,
+                min_heartbeats=2, confirm_rounds=1, interval_s=0.01,
+                interval_idle_s=0.05, round_timeout_s=600.0,
+                metrics=self.metrics)
+            self.coordinator = MeshCoordinator(self.plane, self.manager)
+            self.coordinator.register_self()
+            self.manager.quiesce(max_rounds=8)
+        install_gateway_handlers(self.server, self.gateway)
+        self.server.run_in_background()
+
+    def close(self):
+        self.plane.close()
+        self.server.kill()
+        self.gateway.close()
+
+
+class _Mesh:
+    def __init__(self):
+        self.a = _Node("mesh-a", seed_node=True)
+        self.b = _Node("mesh-b")
+        self.peer_b = MeshPeer(self.b.plane, self.a.addr,
+                               heartbeat_s=0.05,
+                               metrics=self.b.metrics)
+        self.peer_b.step()                      # JOIN_RING over the wire
+        self.settle()
+        assert len(self.a.plane.routes) == 2
+        assert len(self.b.plane.routes) == 2
+
+    def settle_seed(self, rounds=24):
+        """Drive ONLY the seed's membership foreground (no peer
+        heartbeat — tests that stage a KNOWN:false rejoin need the
+        peer to stay silent)."""
+        for _ in range(rounds):
+            self.a.manager.step()
+            if self.a.manager.pending_ops == 0 \
+                    and self.a.manager.converged:
+                break
+
+    def settle(self, rounds=24):
+        """Drive the seed's membership foreground until the route
+        table covers the joined members, then sync B."""
+        self.settle_seed(rounds)
+        self.peer_b.step()                      # heartbeat + route pull
+
+    def reset_routes(self):
+        """Re-bless the canonical 2-peer split on both planes (tests
+        that churned the table restore it here)."""
+        peers = {self.a.member: self.a.addr, self.b.member: self.b.addr}
+        epoch = max(self.a.plane.routes.epoch,
+                    self.b.plane.routes.epoch) + 1
+        self.a.plane.apply_routes(peers, epoch)
+        self.b.plane.apply_routes(peers, epoch)
+
+    def owned_by(self, node, n, rng=None):
+        rng = rng if rng is not None else RNG
+        out = []
+        while len(out) < n:
+            k = int.from_bytes(rng.bytes(16), "little")
+            own = self.a.plane.routes.owner(k)
+            if own is not None and own[1] == node.addr:
+                out.append(k)
+        return out
+
+    def close(self):
+        self.peer_b.stop()
+        if self.a.manager is not None:
+            self.a.manager.stop()
+        self.b.close()
+        self.a.close()
+        wire.reset_pool()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    m = _Mesh()
+    yield m
+    m.close()
+
+
+def _rpc(node, req, timeout=120.0):
+    return Client.make_request("127.0.0.1", node.server.port, req,
+                               timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# route table
+# ---------------------------------------------------------------------------
+
+def test_route_table_oracle_parity_across_resplits():
+    """Route ownership == the oracle's ring-successor rule (the
+    reference's StoredLocally, lifted to processes) — held across
+    joins and departures (re-splits)."""
+    import bisect
+    rng = np.random.RandomState(11)
+    ids = sorted(int.from_bytes(rng.bytes(16), "little")
+                 for _ in range(7))
+    addrs = {m: ("127.0.0.1", 9000 + i) for i, m in enumerate(ids)}
+    table = RouteTable(addrs[ids[0]])
+    assert table.apply(addrs, 1)
+    keys = [int.from_bytes(rng.bytes(16), "little") for _ in range(256)]
+
+    def oracle_owner(live, k):
+        i = bisect.bisect_left(live, k)
+        return live[i] if i < len(live) else live[0]
+
+    def check(live):
+        for k in keys:
+            assert table.owner(k)[0] == oracle_owner(sorted(live), k)
+        # the vectorized split agrees with the scalar rule
+        from p2p_dhts_tpu.keyspace import ints_to_lanes
+        lanes = ints_to_lanes(keys)
+        local_rows, remote = table.split_lanes(lanes)
+        assigned = {}
+        if local_rows is None:
+            for j in range(len(keys)):
+                assigned[j] = table.self_addr
+        else:
+            for j in local_rows:
+                assigned[int(j)] = table.self_addr
+            for addr, rows in remote:
+                for j in rows:
+                    assigned[int(j)] = addr
+        for j, k in enumerate(keys):
+            assert assigned[j] == addrs[oracle_owner(sorted(live), k)]
+
+    check(ids)
+    # re-split 1: two peers depart
+    live = [m for m in ids if m not in (ids[2], ids[5])]
+    assert table.apply({m: addrs[m] for m in live}, 2)
+    check(live)
+    # re-split 2: one rejoins
+    live = sorted(live + [ids[2]])
+    assert table.apply({m: addrs[m] for m in live}, 3)
+    check(live)
+    # stale gossip never applies backwards
+    assert not table.apply({m: addrs[m] for m in ids}, 2)
+    check(live)
+    # edge keys: a shard boundary is clockwise-INCLUSIVE at the id
+    for m in live:
+        assert table.owner(m)[0] == m
+        assert table.owner((m + 1) % KEYS_IN_RING)[0] != m or \
+            len(live) == 1
+
+
+# ---------------------------------------------------------------------------
+# local-or-forward + coalescing
+# ---------------------------------------------------------------------------
+
+def test_forward_parity_and_coalescing(mesh):
+    """Byte parity: any key asked of the WRONG gateway answers
+    identically to the owner's direct answer — single-key and vector
+    forms — and concurrent single-key misses FOLD into shared
+    forwarded batches (gateway.forward.keys > batches)."""
+    rng = np.random.RandomState(21)
+    b_keys = mesh.owned_by(mesh.b, 24, rng)
+    a_keys = mesh.owned_by(mesh.a, 8, rng)
+    # vector: mixed ownership through A == B's direct (explicit-ring)
+    mixed = a_keys[:8] + b_keys[:8]
+    via_a = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                          "KEYS": wire.U128Keys(mixed)})
+    direct = _rpc(mesh.b, {"COMMAND": "FIND_SUCCESSOR",
+                           "KEYS": wire.U128Keys(mixed),
+                           "RING": "shard"})
+    assert via_a.get("SUCCESS"), via_a.get("ERRORS")
+    assert list(via_a["OWNERS"]) == list(direct["OWNERS"])
+    assert list(via_a["HOPS"]) == list(direct["HOPS"])
+    assert {r for r in via_a["RINGS"]} == \
+        {"shard", f"mesh:{addr_str(mesh.b.addr)}"}
+    # the legacy JSON list form lifts to lanes and takes the same
+    # split (identical answers on the reference wire shape)
+    with wire.forced("json"):
+        via_json = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                                 "KEYS": [format(k, "x")
+                                          for k in mixed]})
+    assert via_json.get("SUCCESS"), via_json.get("ERRORS")
+    assert list(via_json["OWNERS"]) == list(via_a["OWNERS"])
+    assert list(via_json["HOPS"]) == list(via_a["HOPS"])
+    # concurrent single-key misses fold
+    keys0 = mesh.a.metrics.counter("gateway.forward.keys")
+    batches0 = mesh.a.metrics.counter("gateway.forward.batches")
+    errs = []
+
+    def storm(ks):
+        for k in ks:
+            try:
+                r = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                                  "KEY": format(k, "x")})
+                assert r.get("SUCCESS"), r.get("ERRORS")
+            except BaseException as exc:  # noqa: BLE001 — re-raised in the main thread
+                errs.append(exc)
+
+    threads = [threading.Thread(target=storm, args=(b_keys[i::4],))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[0]
+    keys_n = mesh.a.metrics.counter("gateway.forward.keys") - keys0
+    batches_n = mesh.a.metrics.counter("gateway.forward.batches") \
+        - batches0
+    assert keys_n == len(b_keys)
+    assert batches_n < keys_n, \
+        f"{keys_n} forwarded keys cost {batches_n} RPCs — nothing folded"
+    # and each forwarded single answers exactly like the owner
+    for k in b_keys[:4]:
+        via = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                            "KEY": format(k, "x")})
+        own = _rpc(mesh.b, {"COMMAND": "FIND_SUCCESSOR",
+                            "KEY": format(k, "x")})
+        assert (via["OWNER"], via["HOPS"]) == (own["OWNER"],
+                                               own["HOPS"])
+        assert via["RING"] == f"mesh:{addr_str(mesh.b.addr)}"
+
+
+def test_forward_get_put_parity(mesh):
+    """Writes route to the owner; forwarded reads are byte-identical
+    to the owner's own stacked reply — and forwarded answers are
+    NEVER memoized in the origin's hot-key cache."""
+    rng = np.random.RandomState(22)
+    b_keys = mesh.owned_by(mesh.b, 6, rng)
+    segs = [rng.randint(0, 200, size=(4, 10)).astype(np.int32)
+            for _ in b_keys]
+    for k, s in zip(b_keys, segs):
+        r = _rpc(mesh.a, {"COMMAND": "PUT", "KEY": format(k, "x"),
+                          "SEGMENTS": s, "LENGTH": 4})
+        assert r.get("SUCCESS") and r.get("OK"), r
+        assert r.get("RING") == f"mesh:{addr_str(mesh.b.addr)}"
+    via_a = _rpc(mesh.a, {"COMMAND": "GET",
+                          "KEYS": wire.U128Keys(b_keys)})
+    direct = _rpc(mesh.b, {"COMMAND": "GET",
+                           "KEYS": wire.U128Keys(b_keys),
+                           "RING": "shard"})
+    assert via_a.get("SUCCESS"), via_a.get("ERRORS")
+    assert list(via_a["OK"]) == list(direct["OK"]) == [True] * 6
+    assert np.array_equal(np.asarray(via_a["SEGMENTS"]),
+                          np.asarray(direct["SEGMENTS"]))
+    for j, s in enumerate(segs):
+        assert np.array_equal(
+            np.asarray(via_a["SEGMENTS"][j])[:4], s)
+    # the stored bytes live on B, not A
+    a_direct = _rpc(mesh.a, {"COMMAND": "GET",
+                             "KEYS": wire.U128Keys(b_keys),
+                             "RING": "shard"})
+    assert not any(a_direct["OK"]), \
+        "forwarded PUT leaked into the origin's local store"
+    # forwarded reads bypass the origin's cache (stale-byte guard)
+    hits0 = mesh.a.metrics.counter("gateway.cache.hits")
+    for _ in range(3):
+        r = _rpc(mesh.a, {"COMMAND": "GET",
+                          "KEY": format(b_keys[0], "x")})
+        assert r.get("OK")
+    assert mesh.a.metrics.counter("gateway.cache.hits") == hits0, \
+        "a forwarded read served from the origin's hot-key cache"
+
+
+def test_one_hop_rule(mesh):
+    """A forwarded request is answered or errored by the receiver,
+    NEVER forwarded onward: FWD rows outside the receiver's shard come
+    back NOT_OWNED (with the receiver's routes piggybacked) and the
+    receiver issues zero forward RPCs of its own."""
+    rng = np.random.RandomState(23)
+    a_keys = mesh.owned_by(mesh.a, 3, rng)
+    b_batches0 = mesh.b.metrics.counter("gateway.forward.batches")
+    resp = _rpc(mesh.b, {"COMMAND": "FIND_SUCCESSOR",
+                         "KEYS": wire.U128Keys(a_keys), "FWD": 1})
+    assert resp.get("SUCCESS"), resp.get("ERRORS")
+    assert resp.get("NOT_OWNED") == [0, 1, 2]
+    assert resp.get("EPOCH") == mesh.b.plane.routes.epoch
+    assert resp.get("ROUTES_DOC", {}).get("ROUTES"), \
+        "bounce did not piggyback the owner's route table"
+    assert all(int(o) == -1 for o in resp["OWNERS"])
+    assert mesh.b.metrics.counter("gateway.forward.batches") == \
+        b_batches0, "the one-hop rule forwarded onward"
+    # single-key FWD for a foreign key errors (no silent re-route)
+    single = _rpc(mesh.b, {"COMMAND": "FIND_SUCCESSOR",
+                           "KEY": format(a_keys[0], "x"), "FWD": 1})
+    assert single.get("SUCCESS") is False
+    assert "not the owner" in single.get("ERRORS", "")
+
+
+def test_not_owner_refresh_retry(mesh):
+    """Route churn mid-flight: the origin's stale table forwards to a
+    peer that no longer owns the key; the bounce's piggybacked routes
+    install and the origin re-resolves ONCE — answering correctly and
+    catching its epoch up."""
+    try:
+        rng = np.random.RandomState(24)
+        k = mesh.owned_by(mesh.b, 1, rng)[0]
+        # B learns a NEWER split in which A owns everything; A stays
+        # stale and still maps k to B.
+        epoch = mesh.b.plane.routes.epoch + 1
+        mesh.b.plane.apply_routes({mesh.a.member: mesh.a.addr}, epoch)
+        assert not mesh.a.plane.routes.is_local(k)
+        retries0 = mesh.a.metrics.counter("gateway.forward.retries")
+        via = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                            "KEYS": wire.U128Keys([k])})
+        assert via.get("SUCCESS"), via.get("ERRORS")
+        assert int(via["OWNERS"][0]) >= 0
+        assert mesh.a.metrics.counter("gateway.forward.retries") == \
+            retries0 + 1
+        assert mesh.a.plane.routes.epoch == epoch, \
+            "origin did not install the piggybacked routes"
+        # parity with the (now-)owner's direct answer
+        direct = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                               "KEY": format(k, "x"), "RING": "shard"})
+        assert int(via["OWNERS"][0]) == direct["OWNER"]
+    finally:
+        mesh.reset_routes()
+
+
+def test_cross_process_deadline_and_trace_chain(mesh):
+    """One forwarded request is ONE trace across both processes —
+    client root -> origin server -> gateway -> mesh.forward -> second
+    rpc.client hop -> owner server — and DEADLINE_MS rides the
+    forwarded frame (an expired budget fails fast, never serves)."""
+    rng = np.random.RandomState(25)
+    k = mesh.owned_by(mesh.b, 1, rng)[0]
+    seen = {}
+    orig = mesh.b.server.handlers["FIND_SUCCESSOR"]
+
+    def spy(req, _orig=orig):
+        seen["deadline_ms"] = req.get("DEADLINE_MS")
+        seen["fwd"] = req.get("FWD")
+        return _orig(req)
+
+    mesh.b.server.update_handlers({"FIND_SUCCESSOR": spy})
+    try:
+        with trace_mod.tracing() as store:
+            resp = Client.make_request(
+                "127.0.0.1", mesh.a.server.port,
+                {"COMMAND": "FIND_SUCCESSOR", "KEY": format(k, "x"),
+                 "DEADLINE_MS": 60000.0}, timeout=120.0)
+            assert resp.get("SUCCESS"), resp.get("ERRORS")
+            spans = store.spans()
+        assert seen.get("fwd") == 1
+        assert seen.get("deadline_ms") is not None \
+            and 0 < float(seen["deadline_ms"]) <= 60000.0, seen
+        names = {s["name"] for s in spans}
+        for want in ("rpc.client.FIND_SUCCESSOR",
+                     "rpc.server.FIND_SUCCESSOR", "mesh.forward"):
+            assert want in names, (want, sorted(names))
+        fwd_span = next(s for s in spans if s["name"] == "mesh.forward")
+        chain_ids = {s["trace_id"] for s in spans
+                     if s["name"] in ("rpc.client.FIND_SUCCESSOR",
+                                      "rpc.server.FIND_SUCCESSOR",
+                                      "mesh.forward")}
+        assert chain_ids == {fwd_span["trace_id"]}, \
+            "the forwarded hop forked a fresh trace"
+        # both server dispatches (origin + owner) share the trace
+        assert sum(1 for s in spans
+                   if s["name"] == "rpc.server.FIND_SUCCESSOR") >= 2
+        # an expired budget fails fast instead of serving
+        dead = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                             "KEYS": wire.U128Keys([k]),
+                             "DEADLINE_MS": 0.001})
+        assert dead.get("SUCCESS") is False or \
+            int(np.asarray(dead.get("OWNERS", [-1]))[0]) == -1
+    finally:
+        mesh.b.server.update_handlers({"FIND_SUCCESSOR": orig})
+
+
+# ---------------------------------------------------------------------------
+# membership plane: join / heartbeat / rejoin
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_known_false_rejoins(mesh):
+    """The PR-7 closure regression: a peer whose membership row was
+    failed-and-applied gets HEARTBEAT KNOWN:false and REJOINS through
+    the real JOIN_RING verb; the coordinator re-splits it back in."""
+    try:
+        assert mesh.peer_b.joined
+        mesh.a.manager.fail_member(mesh.b.member)
+        mesh.settle_seed()
+        assert len(mesh.a.plane.routes) == 1, \
+            "failed peer still in the route table"
+        rejoins0 = mesh.b.metrics.counter("mesh.rejoins")
+        required0 = mesh.b.metrics.counter("mesh.rejoin_required")
+        mesh.peer_b.step()        # KNOWN:false -> JOIN_RING, same round
+        assert mesh.peer_b.joined
+        assert mesh.b.metrics.counter("mesh.rejoin_required") == \
+            required0 + 1
+        assert mesh.b.metrics.counter("mesh.rejoins") == rejoins0 + 1
+        mesh.settle()
+        assert len(mesh.a.plane.routes) == 2, \
+            "rejoined peer did not re-enter the split"
+        assert len(mesh.b.plane.routes) == 2
+    finally:
+        mesh.reset_routes()
+
+
+def test_resplit_retires_peer_telemetry_and_cache(mesh):
+    """A re-split that drops a peer retires its mesh.* telemetry and
+    pooled connections (the PR-8 rule at mesh scope) and epoch-bumps
+    the PR-12 hot-key cache via set_key_range."""
+    try:
+        b_str = addr_str(mesh.b.addr)
+        assert f"mesh.peer_alive.{b_str}" in \
+            mesh.a.metrics.snapshot()["gauges"]
+        inval0 = mesh.a.metrics.counter("gateway.cache.invalidations")
+        epoch = mesh.a.plane.routes.epoch + 1
+        mesh.a.plane.apply_routes({mesh.a.member: mesh.a.addr}, epoch)
+        gauges = mesh.a.metrics.snapshot()["gauges"]
+        assert f"mesh.peer_alive.{b_str}" not in gauges, \
+            "departed peer's telemetry survived the re-split"
+        assert gauges["mesh.peers"] == 1
+        assert gauges["mesh.route_epoch"] == epoch
+        assert mesh.a.metrics.counter("mesh.peers_retired") >= 1
+        assert mesh.a.metrics.counter(
+            "gateway.cache.invalidations") > inval0, \
+            "re-split did not epoch-bump the hot-key cache"
+    finally:
+        mesh.reset_routes()
+
+
+def test_operator_resplit_bumps_generation(mesh):
+    """A raw set_key_range the coordinator did not drive is visible:
+    the route table's GENERATION moves (MESH_ROUTES shows the
+    divergence) while the blessed epoch stands."""
+    gen0 = mesh.a.plane.routes.generation
+    backend = mesh.a.gateway.router.get("shard")
+    prev = backend.key_range
+    try:
+        mesh.a.gateway.router.set_key_range("shard", (1, 2))
+        assert mesh.a.plane.routes.generation == gen0 + 1
+        assert mesh.a.metrics.counter("mesh.local_resplits") >= 1
+    finally:
+        mesh.a.gateway.router.set_key_range("shard", prev)
+
+
+# ---------------------------------------------------------------------------
+# partition behavior + server-side havoc sites
+# ---------------------------------------------------------------------------
+
+def test_partition_fails_only_remote_rows(mesh):
+    """A mesh.partition blocking the owner fails ONLY its rows —
+    local rows keep answering (per-destination failure isolation) —
+    and heals on uninstall."""
+    rng = np.random.RandomState(26)
+    a_keys = mesh.owned_by(mesh.a, 4, rng)
+    b_keys = mesh.owned_by(mesh.b, 4, rng)
+    mixed = a_keys + b_keys
+    with havoc_mod.injected(havoc_mod.FaultPlan(
+            0x9E5, {"mesh.partition":
+                    {"match": [addr_str(mesh.b.addr)]}})):
+        resp = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                             "KEYS": wire.U128Keys(mixed)})
+    assert resp.get("SUCCESS"), resp.get("ERRORS")
+    owners = list(resp["OWNERS"])
+    assert all(int(o) >= 0 for o in owners[:4]), \
+        "a partitioned OWNER took down local rows"
+    assert all(int(o) == -1 for o in owners[4:]), \
+        "rows owned by a partitioned process answered"
+    assert resp.get("RING_ERRORS"), resp
+    # healed: the same vector answers fully
+    resp = _rpc(mesh.a, {"COMMAND": "FIND_SUCCESSOR",
+                         "KEYS": wire.U128Keys(mixed)})
+    assert all(int(o) >= 0 for o in resp["OWNERS"])
+
+
+def test_server_side_havoc_sites():
+    """The PR-10 'server side of the wire' sites: accept-loop reset
+    (dials fail) and reply drop/delay (the caller's own timeout bounds
+    the wait) — both deterministic, both visible in counters."""
+    from p2p_dhts_tpu.metrics import METRICS
+    srv = Server(0, {"PING": lambda req: {"PONG": 1}})
+    srv.run_in_background()
+    try:
+        # healthy round trip first (and a negotiated binary session)
+        r = Client.make_request("127.0.0.1", srv.port,
+                                {"COMMAND": "PING"}, timeout=10.0)
+        assert r.get("PONG") == 1
+        reset0 = METRICS.counter("rpc.server.accept_reset")
+        with havoc_mod.injected(havoc_mod.FaultPlan(
+                0xACC, {"rpc.server.accept":
+                        {"match": [str(srv.port)]}})):
+            # fresh dials die at accept; the pool's existing session
+            # is untouched by design (reset is an ACCEPT fault).
+            wire.reset_pool()
+            with pytest.raises(Exception):
+                Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=1.0)
+        assert METRICS.counter("rpc.server.accept_reset") > reset0
+        wire.reset_pool()
+        dropped0 = METRICS.counter("rpc.server.reply_dropped")
+        with havoc_mod.injected(havoc_mod.FaultPlan(
+                0xDE1, {"rpc.server.reply":
+                        {"match": [str(srv.port)], "limit": 1}})):
+            t0 = time.perf_counter()
+            with pytest.raises(Exception):
+                Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=0.5)
+            assert time.perf_counter() - t0 < 5.0, \
+                "dropped reply was not bounded by the caller timeout"
+            # the NEXT request on the same connection still answers
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=10.0)
+            assert r.get("PONG") == 1
+        assert METRICS.counter("rpc.server.reply_dropped") == \
+            dropped0 + 1
+        with havoc_mod.injected(havoc_mod.FaultPlan(
+                0xDE2, {"rpc.server.reply":
+                        {"match": [str(srv.port)],
+                         "actions": [{"action": "delay",
+                                      "delay_s": 0.15}],
+                         "limit": 1}})):
+            t0 = time.perf_counter()
+            r = Client.make_request("127.0.0.1", srv.port,
+                                    {"COMMAND": "PING"}, timeout=10.0)
+            assert r.get("PONG") == 1
+            assert time.perf_counter() - t0 >= 0.14
+    finally:
+        srv.kill()
+        wire.reset_pool()
+
+
+# ---------------------------------------------------------------------------
+# mesh-wide verbs + havoc control verb
+# ---------------------------------------------------------------------------
+
+def test_mesh_wide_verb_merge_and_engine_rows(mesh):
+    """HEALTH/CAPACITY/PULSE with MESH:true merge every live peer's
+    row; HEALTH inlines per-ring engine telemetry (the remote
+    zero-retrace gate's data source)."""
+    b_str = addr_str(mesh.b.addr)
+    health = _rpc(mesh.a, {"COMMAND": "HEALTH", "MESH": True})
+    assert health.get("SUCCESS"), health.get("ERRORS")
+    engines = health["HEALTH"]["ENGINES"]
+    assert engines["shard"]["steady_retraces"] == 0
+    assert engines["shard"]["requests_served"] > 0
+    assert b_str in health.get("MESH", {}), health.get("MESH")
+    peer_row = health["MESH"][b_str]
+    assert peer_row["HEALTH"]["ENGINES"]["shard"]["steady_retraces"] \
+        == 0
+    cap = _rpc(mesh.a, {"COMMAND": "CAPACITY", "MESH": True})
+    assert cap.get("SUCCESS") and b_str in cap.get("MESH", {})
+    pulse = _rpc(mesh.a, {"COMMAND": "PULSE", "MESH": True,
+                          "PROM": True})
+    assert pulse.get("SUCCESS") and b_str in pulse.get("MESH", {})
+    assert "PROM" in pulse["MESH"][b_str]
+    # MESH_ROUTES answers from any gateway, and SET_COALESCE toggles
+    routes = _rpc(mesh.b, {"COMMAND": "MESH_ROUTES"})
+    assert routes.get("ATTACHED") and len(routes["ROUTES"]) == 2
+    assert routes["EPOCH"] == mesh.b.plane.routes.epoch
+    _rpc(mesh.a, {"COMMAND": "MESH_ROUTES", "SET_COALESCE": False})
+    assert mesh.a.plane.coalescer.max_batch == 1
+    _rpc(mesh.a, {"COMMAND": "MESH_ROUTES", "SET_COALESCE": True})
+    assert mesh.a.plane.coalescer.max_batch > 1
+
+
+def test_havoc_wire_verb(mesh):
+    """The HAVOC chaos-control verb installs/uninstalls a seeded plan
+    in the serving process over the wire — the multi-process scenario
+    seeder."""
+    r = _rpc(mesh.b, {"COMMAND": "HAVOC"})
+    assert r.get("SUCCESS") and r.get("ACTIVE") is None
+    r = _rpc(mesh.b, {"COMMAND": "HAVOC", "ACTION": "install",
+                      "SEED": 0xBEEF,
+                      "SPEC": {"mesh.partition":
+                               {"match": ["10.0.0.1:1"]}}})
+    assert r.get("SUCCESS"), r.get("ERRORS")
+    assert "0xbeef" in r["ACTIVE"]
+    assert havoc_mod.active() is not None
+    r = _rpc(mesh.b, {"COMMAND": "HAVOC", "ACTION": "uninstall"})
+    assert r.get("SUCCESS") and r.get("UNINSTALLED")
+    assert havoc_mod.active() is None

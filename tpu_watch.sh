@@ -1,37 +1,35 @@
 #!/bin/bash
-# TPU recovery watcher, round 14: fourteen configs want on-chip
-# records (greens from r07-r13 carry over; chordax-lens joins the
+# TPU recovery watcher, round 15: fifteen configs want on-chip
+# records (greens from r07-r14 carry over; chordax-mesh joins the
 # want list). Wait for the chip to be free, probe the remote-compile
 # service (dead since round 4: connection-refused on its port while
 # cached programs kept executing), and when it answers, run the
 # configs without a green record one at a time into
-# BENCH_ATTEMPT_r14.jsonl (bench's _record_lkg promotes each green
+# BENCH_ATTEMPT_r15.jsonl (bench's _record_lkg promotes each green
 # on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# --trace device-timeline archiving (now into BENCH_TRACE_r14). All
+# --trace device-timeline archiving (now into BENCH_TRACE_r15). All
 # prior gates stay (wire-isolated binary >= 3x JSON keys/s at <= 1/2
 # p50, traced chain, havoc scenario matrix >= 99% availability, pulse
-# + fastlane + fuse smokes, zero retraces). NEW in round 14
-# (chordax-lens): a LENS SMOKE pre-bench gate — cost-accounting
-# overhead <= 5% closed-loop p50 vs the cost_accounting=False
-# baseline, the headroom estimate within 2x of the measured
-# saturation keys/s, non-empty per-(kind, bucket) cost table +
-# warmup-only compile-cause ledger (zero steady-state retraces), and
-# the CAPACITY verb + lens.* pulse series polled live mid-bench —
-# must pass on CPU before anything claims the chip, and the lens
-# config archives an ANALYZED timeline: CHORDAX_LENS_PROFILE writes
-# the traced window's Chrome export (.json) plus its rendered
-# per-kind cost-breakdown report (.md) next to this round's records
-# (ROADMAP item 4's "profile the traced device timeline and attack
-# what it shows" finally has its digestion tool). The want-list
-# headline stays the fuse on-chip record + the IDA A/B, now joined
-# by the lens config's on-chip cost table — the first per-kind
-# device-cost evidence since round 2. Never kills anything
-# mid-TPU-work; every probe and bench attempt runs to completion (a
-# blocked fresh-shape jit takes ~25 min to fail — that is the
-# probe's cost when the service is down, accepted).
+# + fastlane + fuse + lens smokes, zero retraces). NEW in round 15
+# (chordax-mesh): a MESH SMOKE pre-bench gate — a REAL 4-process
+# localhost ring bootstrapped over JOIN_RING/HEARTBEAT, byte-exact
+# forwarded-vs-local parity over 1000 keys, the coalesced forward
+# path >= 3x the per-key-forward baseline at equal-or-better p50 and
+# >= 0.5x the local path, >= 99% availability through the
+# one-process-partitioned churn storm with the rejoin observed, and
+# zero steady-state retraces polled from EVERY process over HEALTH —
+# must pass on CPU before anything claims the chip (the mesh config
+# always serves from CPU processes; what the chip adds is each
+# shard's on-chip engine numbers riding the other configs). The
+# want-list headline stays the fuse on-chip record + the IDA A/B +
+# the lens cost table, now joined by the mesh config's 4-process
+# record. Never kills anything mid-TPU-work; every probe and bench
+# attempt runs to completion (a blocked fresh-shape jit takes ~25 min
+# to fail — that is the probe's cost when the service is down,
+# accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-14 watcher start (fourteen configs + wire/havoc/pulse/fastlane/fuse/lens smoke gates)"
+log "round-15 watcher start (fifteen configs + wire/havoc/pulse/fastlane/fuse/lens/mesh smoke gates)"
 
 needed() {  # configs without a green record yet (r07-r12 greens count)
   python - <<'EOF'
@@ -40,7 +38,8 @@ ok = set()
 for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
                 "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl",
                 "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl",
-                "BENCH_ATTEMPT_r13.jsonl", "BENCH_ATTEMPT_r14.jsonl"):
+                "BENCH_ATTEMPT_r13.jsonl", "BENCH_ATTEMPT_r14.jsonl",
+                "BENCH_ATTEMPT_r15.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -53,7 +52,7 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
         pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership",
-        "pulse", "fastlane", "fuse", "lens"]
+        "pulse", "fastlane", "fuse", "lens", "mesh"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -65,7 +64,7 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all fourteen configs recorded green — done"
+    log "all fifteen configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
@@ -130,9 +129,9 @@ for i in $(seq 1 80); do
   # mid-bench), one linked digest->diff->heal repair trace, zero
   # retraces — on CPU before anything claims the chip. The sampled
   # series artifact lands next to this round's records.
-  mkdir -p BENCH_TRACE_r14
+  mkdir -p BENCH_TRACE_r15
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_PULSE_SERIES=BENCH_TRACE_r14/pulse_series_smoke.json \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r15/pulse_series_smoke.json \
       python bench.py --config pulse --smoke \
       >> tpu_watch.log 2>&1; then
     log "pulse smoke FAILED - fix the telemetry plane before benching"
@@ -173,10 +172,24 @@ for i in $(seq 1 80); do
   # (Chrome export + rendered per-kind cost breakdown) archives next
   # to this round's records.
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_LENS_PROFILE=BENCH_TRACE_r14/lens_profile_smoke \
+      CHORDAX_LENS_PROFILE=BENCH_TRACE_r15/lens_profile_smoke \
       python bench.py --config lens --smoke \
       >> tpu_watch.log 2>&1; then
     log "lens smoke FAILED - fix the cost/capacity plane before benching"
+    sleep 300
+    continue
+  fi
+  # Mesh smoke (ISSUE 15): the multi-process topology must hold — a
+  # real 4-process localhost ring bootstrapped over JOIN_RING/
+  # HEARTBEAT, byte-exact forwarded-vs-local parity over 1000 keys,
+  # the coalesced forward path >= 3x the per-key-forward baseline at
+  # equal-or-better p50 (and >= 0.5x the local path), >= 99%
+  # availability while one whole process is havoc-partitioned and
+  # rejoins, zero steady-state retraces in EVERY process polled over
+  # HEALTH — on CPU before anything claims the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config mesh --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "mesh smoke FAILED - fix the sharded topology before benching"
     sleep 300
     continue
   fi
@@ -190,23 +203,23 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r14
+    mkdir -p BENCH_TRACE_r15
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r14/$c)"
+      log "running --config $c (device trace -> BENCH_TRACE_r15/$c)"
       # The pulse config archives its sampled series + verdicts, and
       # the lens config its ANALYZED profile (Chrome export + per-kind
       # cost-breakdown markdown), next to this round's records (the
       # mid-bench PULSE/HEALTH/CAPACITY polls are inside the configs
       # themselves).
-      CHORDAX_PULSE_SERIES="BENCH_TRACE_r14/pulse_series_$c.json" \
-        CHORDAX_LENS_PROFILE="BENCH_TRACE_r14/lens_profile_$c" \
-        python bench.py --config "$c" --trace "BENCH_TRACE_r14" \
-        >> BENCH_ATTEMPT_r14.jsonl 2>> BENCH_ATTEMPT_r14.err
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r15/pulse_series_$c.json" \
+        CHORDAX_LENS_PROFILE="BENCH_TRACE_r15/lens_profile_$c" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r15" \
+        >> BENCH_ATTEMPT_r15.jsonl 2>> BENCH_ATTEMPT_r15.err
       log "config $c rc=$?"
       # Digest the round's trajectory after each record lands: the
       # stale-flagged table is the artifact a reviewer reads first.
       python -m p2p_dhts_tpu.lens.bench_report \
-        --out BENCH_TRACE_r14/trajectory.md >> tpu_watch.log 2>&1
+        --out BENCH_TRACE_r15/trajectory.md >> tpu_watch.log 2>&1
     done
   else
     log "compile service still down"
