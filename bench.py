@@ -4013,6 +4013,470 @@ def _bench_mesh_phases(procs, n_procs, parity_keys, data_keys,
     })
 
 
+# ---------------------------------------------------------------------------
+# config 17: chordax-elastic — autoscaling control plane (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def bench_elastic(n_peers: int = 192, data_keys: int = 24,
+                  target_rings: int = 8, sat_workers: int = 3,
+                  sat_vector_rows: int = 256, writer_max: int = 96,
+                  tick_s: float = 0.12, saturate_ticks: int = 3,
+                  idle_ticks: int = 8, cooldown_ticks: int = 2,
+                  retry_budget_s: float = 2.5,
+                  max_ramp_s: float = 3600.0,
+                  max_drain_s: float = 1800.0,
+                  heal_max_keys: int = 512, smax: int = 4,
+                  bucket_min: int = 8, bucket_max: int = 32,
+                  mesh_phase: bool = True, mesh_ring_peers: int = 96,
+                  mesh_procs: int = 3, mesh_storm_workers: int = 4,
+                  mesh_vector_rows: int = 256,
+                  mesh_data_keys: int = 12,
+                  mesh_grow_timeout_s: float = 300.0,
+                  mesh_shrink_timeout_s: float = 300.0) -> dict:
+    """chordax-elastic end to end (ISSUE 16): the autoscaling control
+    plane over a live in-process ring, plus (full runs) the mesh
+    tier's process autoscaler. Hard gates:
+
+      * an open-loop saturation ramp splits ONE ring into
+        `target_rings` (smoke 1->2, full 1->8) through the REAL
+        RingPolicy — hysteresis, cooldown, seeded ledger and all —
+        then merges all the way back to 1 once the load stops;
+      * availability >= 99% for byte-parity reads of the seeded keys
+        THROUGH every split/merge swap (retry budget per probe, the
+        mesh-storm discipline), and every write acked during the ramp
+        reads back byte-identical at the end;
+      * ZERO steady-state retraces on every engine at peak and on the
+        survivor at the end (the CHILD_WARMUP contract);
+      * EXACTLY 2*(target_rings-1) executed actions — a ramp that
+        flaps fails the count, not a vibe check;
+      * the decision ledger REPLAYS to an identical digest from seed
+        + recorded inputs (PolicyCore.replay) with dropped == 0;
+        CHORDAX_ELASTIC_LEDGER=<path> archives it (the mesh tier's
+        lands at <path>.mesh.json).
+
+    The full config then boots a mesh seed with --elastic 1 and
+    storms it over RPC: the MeshPolicy must SPAWN >= 1 child process
+    (routes grow), RETIRE it after the storm (RETIRING/DRAINED
+    handshake, routes shrink back to 1), with >= 99% storm
+    availability and every acked mesh PUT readable afterwards."""
+    from p2p_dhts_tpu.dhash.store import empty_store
+    from p2p_dhts_tpu.elastic import PolicyConfig, RingPolicy
+    from p2p_dhts_tpu.gateway import Gateway
+    from p2p_dhts_tpu.lens import LensLoop
+    from p2p_dhts_tpu.metrics import METRICS
+
+    rng = np.random.RandomState(0x0E1A)
+    member_ids = [int.from_bytes(rng.bytes(16), "little")
+                  for _ in range(n_peers)]
+    gw = Gateway(name="bench-elastic")
+    # The parent warms the heal's control ops too: the zero-retrace
+    # gate covers the split/merge data motion, not just serving.
+    warm = ["find_successor", "dhash_get", "dhash_put", "sync_digest",
+            "repair_reindex"]
+    gw.add_ring("el",
+                build_ring(member_ids,
+                           RingConfig(finger_mode="materialized")),
+                empty_store((data_keys + writer_max + 64) * 14, smax),
+                default=True, bucket_min=bucket_min,
+                bucket_max=bucket_max, reprobe_s=300.0, warmup=warm)
+    lens = LensLoop(gw, metrics=METRICS, interval_s=tick_s)
+    gw.attach_lens(lens)
+    policy = RingPolicy(
+        gw, lens,
+        config=PolicyConfig(saturate_ticks=saturate_ticks,
+                            idle_ticks=idle_ticks,
+                            cooldown_ticks=cooldown_ticks,
+                            max_rings=target_rings),
+        seed=0x0E1A571C, interval_s=tick_s,
+        split_kwargs={"heal_max_keys": heal_max_keys})
+    splits0 = METRICS.counter("elastic.splits")
+    merges0 = METRICS.counter("elastic.merges")
+    try:
+        out = _bench_elastic_ring_phases(
+            gw, lens, policy, rng, data_keys, target_rings,
+            sat_workers, sat_vector_rows, writer_max, tick_s,
+            retry_budget_s, max_ramp_s, max_drain_s, smax,
+            splits0, merges0)
+    finally:
+        # close() drops the (never-started) policy/lens loops from the
+        # global HEALTH registry — no zombie rows for later configs.
+        policy.close()
+        lens.close()
+        gw.close()
+    if mesh_phase:
+        out["mesh"] = _bench_elastic_mesh(
+            mesh_ring_peers, mesh_procs, mesh_storm_workers,
+            mesh_vector_rows, mesh_data_keys, mesh_grow_timeout_s,
+            mesh_shrink_timeout_s, retry_budget_s, smax)
+    else:
+        out["mesh"] = None
+    out.update({"config": "elastic", "vs_baseline": None,
+                "device": str(jax.devices()[0])})
+    return _emit(out)
+
+
+def _bench_elastic_ring_phases(gw, lens, policy, rng, data_keys,
+                               target_rings, sat_workers,
+                               sat_vector_rows, writer_max, tick_s,
+                               retry_budget_s, max_ramp_s,
+                               max_drain_s, smax, splits0,
+                               merges0) -> dict:
+    import threading
+
+    from p2p_dhts_tpu.elastic import PolicyCore
+    from p2p_dhts_tpu.metrics import METRICS
+    from p2p_dhts_tpu.serve import gather_vector
+
+    eng = gw.router.get("el").engine
+
+    def _key(r):
+        return int.from_bytes(r.bytes(16), "little")
+
+    # -- phase 0: seed data ----------------------------------------------
+    seeded = []
+    for _ in range(data_keys):
+        k = _key(rng)
+        s = rng.randint(0, 200, size=(smax, 10)).astype(np.int32)
+        assert gw.dhash_put(k, s, smax, 0, ring_id="el"), \
+            "elastic bench seed PUT failed"
+        seeded.append((k, s))
+    trickle_key = seeded[0][0]
+
+    def ring_ids():
+        out = ["el"]
+        for cs in policy.children().values():
+            out.extend(cs)
+        return out
+
+    # -- phase 1: open-loop saturation ramp -> target_rings --------------
+    # Payloads are PRE-BUILT (the PR-9 rule: the drive saturates the
+    # serving path, not keygen). The hammer targets the PARENT engine
+    # directly, so the parent stays saturated and keeps splitting
+    # until the ring-count band caps it.
+    prebuilt = []
+    for w in range(sat_workers):
+        wrng = np.random.RandomState(0xE1A0 + w)
+        prebuilt.append([
+            keyspace.ints_to_lanes(
+                [_key(wrng) for _ in range(sat_vector_rows)])
+            for _ in range(4)])
+    hstop = threading.Event()
+    pstop = threading.Event()
+    herrs: list = []
+    avail = {"ok": 0, "bad": 0}
+    acked: list = []
+
+    def hammer(w):
+        i = 0
+        try:
+            while not hstop.is_set():
+                lanes = prebuilt[w][i % len(prebuilt[w])]
+                i += 1
+                slots = eng.submit_vector("find_successor", lanes)
+                gather_vector(slots, timeout=600)
+        # chordax-lint: disable=bare-except -- worker failures are re-raised on the main thread below
+        except Exception as exc:  # noqa: BLE001 — re-raised below
+            herrs.append(exc)
+
+    def prober():
+        # Byte-parity availability through every swap: one logical
+        # probe = one seeded key with a retry budget (the mesh-storm
+        # accounting discipline).
+        i = 0
+        n_ok = n_bad = 0
+        while not pstop.is_set():
+            k, s = seeded[i % len(seeded)]
+            i += 1
+            deadline = time.perf_counter() + retry_budget_s
+            good = False
+            while time.perf_counter() < deadline:
+                try:
+                    segs, ok = gw.dhash_get(k, timeout=30)
+                    if ok and np.array_equal(
+                            np.asarray(segs)[:smax], s):
+                        good = True
+                        break
+                # chordax-lint: disable=bare-except -- availability accounting: a failed read retries within the budget
+                except Exception:
+                    pass
+                time.sleep(0.02)
+            n_ok += good
+            n_bad += not good
+            time.sleep(0.01)    # ~100 Hz sampling; leave CPU for the
+            #                     compiles the ramp is actually timing
+        avail["ok"], avail["bad"] = n_ok, n_bad
+
+    def writer():
+        # Acked-write durability: every put the gateway acked must
+        # read back byte-identical after the full 1->N->1 cycle.
+        wrng = np.random.RandomState(0x3B17)
+        while not pstop.is_set() and len(acked) < writer_max:
+            k = _key(wrng)
+            s = wrng.randint(0, 200,
+                             size=(smax, 10)).astype(np.int32)
+            deadline = time.perf_counter() + retry_budget_s
+            while time.perf_counter() < deadline:
+                try:
+                    if gw.dhash_put(k, s, smax, 0, timeout=30):
+                        acked.append((k, s))
+                        break
+                # chordax-lint: disable=bare-except -- an unacked write retries within the budget (never counted durable)
+                except Exception:
+                    pass
+                time.sleep(0.02)
+            time.sleep(0.04)
+
+    threads = [threading.Thread(target=hammer, args=(w,),
+                                daemon=True)
+               for w in range(sat_workers)]
+    threads.append(threading.Thread(target=prober, daemon=True))
+    threads.append(threading.Thread(target=writer, daemon=True))
+
+    def control_tick():
+        # One trickle read per child keeps every ring's lens window
+        # non-empty (rows derive from engine snapshots; the policy
+        # reads utilization, never absence) — then one lens tick, one
+        # policy tick: the exact loop the started RingPolicy runs.
+        for rid in ring_ids()[1:]:
+            try:
+                gw.dhash_get(trickle_key, ring_id=rid, timeout=30)
+            # chordax-lint: disable=bare-except -- a ring mid-retirement may reject the trickle read; the next tick drops it
+            except Exception:
+                pass
+        lens.update()
+        policy.tick()
+
+    try:
+        for t in threads:
+            t.start()
+        lens.update()               # seed the capacity windows
+        t0 = time.perf_counter()
+        while len(ring_ids()) < target_rings:
+            if herrs:
+                raise herrs[0]
+            assert time.perf_counter() - t0 < max_ramp_s, (
+                f"elastic ramp stalled at {len(ring_ids())}"
+                f"/{target_rings} rings after {max_ramp_s:.0f}s")
+            control_tick()
+            time.sleep(tick_s)
+        ramp_s = time.perf_counter() - t0
+        peak_ids = ring_ids()
+        # Peak: parent + every policy-built child in steady state.
+        for rid in peak_ids:
+            gw.router.get(rid).engine.assert_no_retraces()
+        # -- phase 2: stop the load; the policy shrinks back to 1 --------
+        hstop.set()
+        t0 = time.perf_counter()
+        while len(ring_ids()) > 1:
+            assert time.perf_counter() - t0 < max_drain_s, (
+                f"elastic drain stalled at {len(ring_ids())} rings "
+                f"after {max_drain_s:.0f}s")
+            control_tick()
+            time.sleep(tick_s)
+        drain_s = time.perf_counter() - t0
+    finally:
+        hstop.set()
+        pstop.set()
+        for t in threads:
+            t.join(timeout=120)
+    if herrs:
+        raise herrs[0]
+    assert policy.children() == {}, policy.children()
+
+    # -- phase 3: the hard gates -----------------------------------------
+    total = avail["ok"] + avail["bad"]
+    availability = avail["ok"] / max(total, 1)
+    assert total > 0, "availability prober served no probes"
+    assert availability >= 0.99, (
+        f"availability {availability:.4f} < 0.99 through the "
+        f"split/merge ramp ({avail})")
+    assert acked, "writer acked no writes during the ramp"
+    for k, s in acked:
+        segs, ok = gw.dhash_get(k, timeout=60)
+        assert ok and np.array_equal(np.asarray(segs)[:smax], s), \
+            f"acked write {k:x} lost through the 1->N->1 cycle"
+    eng.assert_no_retraces()
+    splits = METRICS.counter("elastic.splits") - splits0
+    merges = METRICS.counter("elastic.merges") - merges0
+    assert splits == target_rings - 1 \
+        and merges == target_rings - 1, (
+            f"expected {target_rings - 1} splits + merges, got "
+            f"{splits}/{merges}")
+    entries = policy.ledger.entries()
+    executed = [e["executed"] for e in entries if e.get("executed")]
+    assert len(executed) == 2 * (target_rings - 1), (
+        f"{len(executed)} executed actions for a 1->{target_rings}"
+        f"->1 ramp — the hysteresis flapped: {executed}")
+    assert policy.ledger.dropped == 0, "ledger clipped its prefix"
+
+    # -- phase 4: the determinism proof ----------------------------------
+    replayed = PolicyCore.replay(policy.core.seed,
+                                 policy.core.config, entries)
+    assert replayed.digest() == policy.ledger.digest(), \
+        "ledger replay diverged — the decision core leaked wall-clock"
+    artifact = os.environ.get("CHORDAX_ELASTIC_LEDGER")
+    if artifact:
+        policy.ledger.dump(artifact)
+    return {
+        "metric": f"elastic autoscale 1->{target_rings}->1 "
+                  f"availability",
+        "value": round(availability, 5),
+        "unit": "fraction (>= 0.99 gated)",
+        "rings_peak": len(peak_ids),
+        "splits": int(splits),
+        "merges": int(merges),
+        "ramp_s": round(ramp_s, 2),
+        "drain_s": round(drain_s, 2),
+        "probes": total,
+        "acked_writes": len(acked),
+        "ticks": policy.core.tick_n,
+        "ledger": {"entries": len(entries),
+                   "digest": policy.ledger.digest(),
+                   "replay_ok": True,
+                   "artifact": artifact or None},
+        "steady_state_retraces": 0,
+        "parity": f"ok (byte parity on {len(seeded)} seeded + "
+                  f"{len(acked)} acked keys through every swap; "
+                  f"exactly {2 * (target_rings - 1)} executed "
+                  f"actions; replayed ledger digest equal)",
+    }
+
+
+def _bench_elastic_mesh(ring_peers, max_procs, storm_workers,
+                        vector_rows, data_keys, grow_timeout_s,
+                        shrink_timeout_s, retry_budget_s,
+                        smax) -> dict:
+    """The mesh-tier phase: one --elastic seed, an RPC storm, and the
+    spawn -> retire cycle observed purely over the wire."""
+    import threading
+
+    from p2p_dhts_tpu.net import wire as wire_mod
+
+    rng = np.random.RandomState(0xE1A5)
+    kw = dict(ring_peers=ring_peers, smax=smax, bucket_min=8,
+              bucket_max=64, heartbeat_s=0.25,
+              ctl_capacity=max_procs * 2, elastic=1,
+              elastic_max_procs=max_procs, elastic_interval_s=0.4,
+              elastic_saturate_ticks=2, elastic_idle_ticks=5,
+              elastic_cooldown_ticks=3, lens_interval_s=0.2)
+    artifact = os.environ.get("CHORDAX_ELASTIC_LEDGER")
+    if artifact:
+        kw["elastic_ledger"] = artifact + ".mesh.json"
+    seed = _MeshProc(**kw)
+    try:
+        seed.wait_ready()
+        # Acked data BEFORE the cycle: whatever the rebalancer moves
+        # out (spawn) and back (retire) must survive byte-exact.
+        dkeys = [int.from_bytes(rng.bytes(16), "little")
+                 for _ in range(data_keys)]
+        dsegs = [rng.randint(0, 200,
+                             size=(smax, 10)).astype(np.int32)
+                 for _ in range(data_keys)]
+        for k, s in zip(dkeys, dsegs):
+            r = seed.rpc({"COMMAND": "PUT", "KEY": format(k, "x"),
+                          "SEGMENTS": s, "LENGTH": smax,
+                          "DEADLINE_MS": 60000.0})
+            assert r.get("OK"), f"elastic mesh PUT failed: {r}"
+        skeys = [int.from_bytes(rng.bytes(16), "little")
+                 for _ in range(vector_rows)]
+        sruns = wire_mod.U128Keys(skeys)
+        stop = threading.Event()
+        avail = {"ok": 0, "bad": 0}
+        alock = threading.Lock()
+
+        def storm():
+            n_ok = n_bad = 0
+            while not stop.is_set():
+                deadline = time.perf_counter() + retry_budget_s
+                good = False
+                while time.perf_counter() < deadline:
+                    try:
+                        r = seed.rpc(
+                            {"COMMAND": "FIND_SUCCESSOR",
+                             "KEYS": sruns,
+                             "DEADLINE_MS": 60000.0}, timeout=90.0)
+                        if int((np.asarray(r["OWNERS"]) < 0)
+                               .sum()) == 0:
+                            good = True
+                            break
+                    # chordax-lint: disable=bare-except -- availability accounting: a failed vector retries within the budget
+                    except Exception:
+                        pass
+                    time.sleep(0.02)
+                n_ok += good
+                n_bad += not good
+            with alock:
+                avail["ok"] += n_ok
+                avail["bad"] += n_bad
+
+        threads = [threading.Thread(target=storm, daemon=True)
+                   for _ in range(storm_workers)]
+        grow_s = shrink_s = None
+        procs_peak = 1
+        try:
+            for t in threads:
+                t.start()
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < grow_timeout_s:
+                d = seed.rpc({"COMMAND": "MESH_ROUTES"})
+                procs_peak = max(procs_peak, len(d["ROUTES"]))
+                if len(d["ROUTES"]) >= 2:
+                    grow_s = time.perf_counter() - t0
+                    break
+                time.sleep(0.25)
+            assert grow_s is not None, (
+                f"mesh tier never spawned under the storm "
+                f"({grow_timeout_s:.0f}s)")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < shrink_timeout_s:
+            d = seed.rpc({"COMMAND": "MESH_ROUTES"})
+            procs_peak = max(procs_peak, len(d["ROUTES"]))
+            if len(d["ROUTES"]) == 1:
+                shrink_s = time.perf_counter() - t0
+                break
+            time.sleep(0.5)
+        assert shrink_s is not None, (
+            f"mesh tier never retired back to 1 process "
+            f"({shrink_timeout_s:.0f}s)")
+        got = seed.rpc({"COMMAND": "GET",
+                        "KEYS": wire_mod.U128Keys(dkeys),
+                        "DEADLINE_MS": 120000.0}, timeout=180.0)
+        assert all(bool(o) for o in got["OK"]), \
+            "acked mesh keys lost through the spawn/retire cycle"
+        for j, s in enumerate(dsegs):
+            assert np.array_equal(
+                np.asarray(got["SEGMENTS"][j])[:smax], s), \
+                f"mesh GET byte parity FAIL at {j} after the cycle"
+        m = seed.rpc({"COMMAND": "METRICS", "PREFIX": "elastic."})
+        spawns = m["COUNTERS"].get("elastic.spawns", 0)
+        retires = m["COUNTERS"].get("elastic.retires", 0)
+        assert spawns >= 1 and retires >= 1, m["COUNTERS"]
+        total = avail["ok"] + avail["bad"]
+        availability = avail["ok"] / max(total, 1)
+        assert total > 0 and availability >= 0.99, (
+            f"mesh availability {availability:.4f} < 0.99 through "
+            f"the spawn/retire cycle ({avail})")
+        h = seed.rpc({"COMMAND": "HEALTH"})
+        retr = {ring: row["steady_retraces"]
+                for ring, row in h["HEALTH"]["ENGINES"].items()}
+        assert all(v == 0 for v in retr.values()), retr
+        return {"availability": round(availability, 5),
+                "requests": total,
+                "grow_s": round(grow_s, 2),
+                "shrink_s": round(shrink_s, 2),
+                "procs_peak": procs_peak,
+                "spawns": int(spawns), "retires": int(retires),
+                "acked_keys": data_keys,
+                "ledger_artifact": kw.get("elastic_ledger")}
+    finally:
+        seed.close()
+        wire_mod.reset_pool()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -4021,7 +4485,7 @@ def main() -> None:
                              "lookup_1m", "sweep_10m", "serve",
                              "gateway", "repair", "membership",
                              "havoc", "pulse", "fastlane", "fuse",
-                             "lens", "mesh"])
+                             "lens", "mesh", "elastic"])
     ap.add_argument("--report", action="store_true",
                     help="render the bench/soak trajectory table "
                          "(BENCH_r*.json + BENCH_LKG.json + "
@@ -4103,6 +4567,12 @@ def main() -> None:
                 vector_rows=128, perkey_reqs_each=2,
                 storm_workers=2, storm_s=12.0, bucket_min=8,
                 bucket_max=64),
+            "elastic": lambda: bench_elastic(
+                n_peers=64, data_keys=12, target_rings=2,
+                sat_workers=2, sat_vector_rows=128, writer_max=32,
+                tick_s=0.1, saturate_ticks=3, idle_ticks=5,
+                cooldown_ticks=2, heal_max_keys=256,
+                mesh_phase=False),
         }
     else:
         runs = {
@@ -4122,6 +4592,7 @@ def main() -> None:
             "fuse": bench_fuse,
             "lens": bench_lens,
             "mesh": bench_mesh,
+            "elastic": bench_elastic,
         }
     if args.config:
         runs = {args.config: runs[args.config]}

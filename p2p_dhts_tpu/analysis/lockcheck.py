@@ -78,6 +78,10 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "mesh", "coalescer.py"),
     os.path.join("p2p_dhts_tpu", "mesh", "plane.py"),
     os.path.join("p2p_dhts_tpu", "mesh", "peer.py"),
+    os.path.join("p2p_dhts_tpu", "elastic", "ledger.py"),
+    os.path.join("p2p_dhts_tpu", "elastic", "policy.py"),
+    os.path.join("p2p_dhts_tpu", "elastic", "actuator.py"),
+    os.path.join("p2p_dhts_tpu", "elastic", "mesh.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

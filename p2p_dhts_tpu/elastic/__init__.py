@@ -1,0 +1,37 @@
+"""chordax-elastic (ISSUE 16): the autoscaling control plane.
+
+Two tiers over one deliberately boring, seeded, replayable decision
+core:
+
+  * RING tier — `RingPolicy` reads chordax-lens capacity rows +
+    chordax-pulse SLO verdicts each tick and splits a hot ring's
+    served arc onto a freshly churn-grown sibling (merging it back
+    when idle), entirely through existing machinery: churn_apply,
+    anti-entropy heal, ONE atomic epoch-bumping router swap.
+  * MESH tier — `MeshPolicy` (on the coordinator seed) feeds the
+    MESH:true CAPACITY merge through the same core and spawns/retires
+    whole ``mesh.serve`` processes, with `ShardRebalancer` moving the
+    data behind every re-split.
+
+Every decision lands in the `DecisionLedger`: same seed + same report
+stream = same actions (`PolicyCore.replay` proves it), so a whole
+autoscaling ramp is a unit test, not a wall-clock experiment.
+"""
+
+from p2p_dhts_tpu.elastic.ledger import DecisionLedger
+from p2p_dhts_tpu.elastic.mesh import MeshPolicy, ShardRebalancer, \
+    SpawnedPeer, serve_retire
+from p2p_dhts_tpu.elastic.policy import PolicyConfig, PolicyCore, \
+    RingPolicy, compact_row
+
+__all__ = [
+    "DecisionLedger",
+    "MeshPolicy",
+    "PolicyConfig",
+    "PolicyCore",
+    "RingPolicy",
+    "ShardRebalancer",
+    "SpawnedPeer",
+    "compact_row",
+    "serve_retire",
+]

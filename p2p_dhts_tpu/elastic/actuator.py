@@ -1,0 +1,199 @@
+"""chordax-elastic actuation: SPLIT/MERGE through existing machinery.
+
+A split is the PR-7 "key-range re-splitting ON churn" thread closed:
+grow a capacity-padded RingState for the new half via `churn_apply`
+(shape-stable batched joins + stabilize sweeps — never a rebuild),
+heal the data motion with the auto-enrolled repair pair
+(`run_sync_round` until the Merkle roots agree: both rings hold the
+union), and only THEN move ownership — one atomic, epoch-bumping
+`RingRouter.set_key_ranges` swap hands the top half to the child in
+the same instant the parent's range shrinks. Reads stay available the
+whole time: before the swap the parent still owns (and holds) every
+key; after it the child holds everything it now owns because the heal
+ran FIRST. A post-swap sync round sweeps the race window (writes that
+landed on the parent between the last pre-swap heal and the swap),
+and `nudge_repair` keeps the pair active until converged.
+
+MERGE is the inverse, overnight: heal until converged (the parent
+re-acquires the child's accumulated writes), one atomic swap widens
+the parent's arc and strips the child's, a post-swap sync catches the
+window, then `Gateway.remove_ring` retires the child — engine drained
+and closed, repair pairs retired, admission/membership popped, and
+every per-ring metric family removed (the satellite-2 hygiene
+contract the tests loop on).
+
+These are plain functions, not a class: the policy loop owns all
+state (the split tree); actuation is stateless and leaves nothing to
+leak. No locks are held here — every call is a gateway/router public
+entry point. This module imports jax only transitively (ring/store
+construction).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.gateway.router import merge_key_ranges, \
+    split_key_range
+
+logger = logging.getLogger(__name__)
+
+#: Default warmup set for a policy-built child ring: everything the
+#: split itself drives — churn joins, stabilize sweeps, the heal's
+#: digest/reindex control ops — plus the serving verbs, so the child's
+#: steady state never compiles mid-ramp (any post-warmup trace counts
+#: as a retrace and fails the zero-retrace gate).
+CHILD_WARMUP = ("churn_apply", "stabilize_sweep", "dhash_get",
+                "dhash_put", "sync_digest", "repair_reindex")
+
+#: churn_apply join batch bound (matches the engine's bucketing sweet
+#: spot; membership manager batches similarly).
+JOIN_BATCH = 256
+
+
+class HealStalledError(RuntimeError):
+    """Anti-entropy did not converge within the round budget — the
+    swap is REFUSED (moving ownership onto an un-healed ring loses
+    reads)."""
+
+
+def _parent_members(backend) -> Tuple[list, int]:
+    """(alive member ids, padded capacity) from the parent's current
+    chained RingState."""
+    import numpy as np
+
+    from p2p_dhts_tpu.keyspace import lanes_to_ints
+    from p2p_dhts_tpu.membership.kernels import padded_capacity
+
+    state = backend.engine.ring_snapshot()
+    if state is None:
+        raise ValueError(f"ring {backend.ring_id!r} has no RingState; "
+                         "elastic split needs a device ring")
+    nv = int(state.n_valid)
+    ids_np = np.asarray(state.ids)[:nv]
+    alive_np = np.asarray(state.alive)[:nv]
+    ids = [i for i, a in zip(lanes_to_ints(ids_np), alive_np) if a]
+    if not ids:
+        raise ValueError(f"ring {backend.ring_id!r} has no alive "
+                         "members")
+    return ids, padded_capacity(len(ids))
+
+
+def _heal_until_converged(gateway, ring_a: str, ring_b: str, *,
+                          rounds: int, max_keys: int,
+                          metrics=None) -> int:
+    """Bidirectional sync rounds until converged; returns rounds run.
+    Raises HealStalledError when the budget runs out."""
+    from p2p_dhts_tpu.repair.scheduler import run_sync_round
+    for i in range(1, rounds + 1):
+        res = run_sync_round(gateway, ring_a, ring_b,
+                             max_keys=max_keys, metrics=metrics)
+        if res.converged:
+            return i
+    raise HealStalledError(
+        f"sync {ring_a!r}<->{ring_b!r} not converged after {rounds} "
+        "rounds")
+
+
+def split_ring(gateway, ring_id: str, new_ring_id: str, *,
+               ring_config=None,
+               warmup: Optional[Sequence[str]] = CHILD_WARMUP,
+               heal_rounds: int = 16,
+               heal_max_keys: int = 256,
+               stabilize_rounds: int = 8,
+               metrics=None) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """Split `ring_id`'s served arc in half, handing the top half to a
+    NEW ring `new_ring_id`. Returns (parent_range, child_range) as
+    installed. Ordering is the whole point:
+
+      1. child ring built (1 member, capacity padded for all) and
+         registered RANGE-LESS — it owns nothing, traffic unaffected;
+      2. remaining members churn-join in batches + stabilize sweeps;
+      3. heal: sync rounds until both rings hold the union;
+      4. ONE atomic set_key_ranges swap moves ownership;
+      5. post-swap sync + nudge_repair for the race window.
+
+    A failure before step 4 leaves ownership untouched; the
+    range-less child is removed so nothing leaks."""
+    from p2p_dhts_tpu.core.ring import DEFAULT_CONFIG, build_ring
+    from p2p_dhts_tpu.dhash.store import empty_store
+    from p2p_dhts_tpu.membership import OP_JOIN
+
+    backend = gateway.router.get(ring_id)
+    bottom, top = split_key_range(backend.key_range)
+    members, capacity = _parent_members(backend)
+    store = backend.engine.store_snapshot()
+    if store is None:
+        raise ValueError(f"ring {ring_id!r} has no FragmentStore; "
+                         "elastic split needs a dhash ring")
+    cfg = ring_config if ring_config is not None else DEFAULT_CONFIG
+
+    gateway.add_ring(
+        new_ring_id,
+        build_ring([members[0]], cfg, capacity=capacity),
+        empty_store(int(store.capacity), int(store.max_segments)),
+        key_range=None, warmup=warmup)
+    try:
+        rest = members[1:]
+        for i in range(0, len(rest), JOIN_BATCH):
+            batch = rest[i:i + JOIN_BATCH]
+            oks = gateway.churn_apply_many(
+                [(OP_JOIN, m) for m in batch], ring_id=new_ring_id)
+            if not all(oks):
+                raise RuntimeError(
+                    f"churn join into {new_ring_id!r} rejected "
+                    f"{len(oks) - sum(oks)}/{len(oks)} members")
+        for _ in range(stabilize_rounds):
+            if gateway.stabilize_ring(new_ring_id):
+                break
+        _heal_until_converged(gateway, ring_id, new_ring_id,
+                              rounds=heal_rounds,
+                              max_keys=heal_max_keys, metrics=metrics)
+    except BaseException:
+        logger.warning("elastic split %r -> %r failed before the "
+                       "ownership swap; removing the range-less child",
+                       ring_id, new_ring_id, exc_info=True)
+        gateway.remove_ring(new_ring_id)
+        raise
+
+    gateway.router.set_key_ranges({ring_id: bottom,
+                                   new_ring_id: top})
+    # Race window: writes acked by the parent between the last heal
+    # and the swap now belong to the child — one more sync moves them.
+    _heal_until_converged(gateway, ring_id, new_ring_id,
+                          rounds=heal_rounds, max_keys=heal_max_keys,
+                          metrics=metrics)
+    gateway.nudge_repair(ring_id)
+    gateway.nudge_repair(new_ring_id)
+    return bottom, top
+
+
+def merge_ring(gateway, ring_id: str, child_id: str, *,
+               heal_rounds: int = 16,
+               heal_max_keys: int = 256,
+               metrics=None, **_ignored) -> Tuple[int, int]:
+    """Fold `child_id`'s arc back into adjacent parent `ring_id` and
+    retire the child. Returns the parent's merged range. Heal-first
+    ordering mirrors split: the parent re-acquires every child write
+    BEFORE the swap, the swap strips the child's range (it serves
+    nothing), a post-swap sync catches the window, and only then does
+    the child's engine drain and close."""
+    parent = gateway.router.get(ring_id)
+    child = gateway.router.get(child_id)
+    if parent.key_range is None or child.key_range is None:
+        raise ValueError(
+            f"merge {child_id!r} -> {ring_id!r}: both rings need "
+            "concrete key ranges")
+    merged = merge_key_ranges(parent.key_range, child.key_range)
+
+    _heal_until_converged(gateway, ring_id, child_id,
+                          rounds=heal_rounds, max_keys=heal_max_keys,
+                          metrics=metrics)
+    gateway.router.set_key_ranges({ring_id: merged, child_id: None})
+    _heal_until_converged(gateway, ring_id, child_id,
+                          rounds=heal_rounds, max_keys=heal_max_keys,
+                          metrics=metrics)
+    gateway.remove_ring(child_id)
+    gateway.nudge_repair(ring_id)
+    return merged

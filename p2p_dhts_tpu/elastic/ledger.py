@@ -1,0 +1,125 @@
+"""The chordax-elastic DECISION LEDGER (ISSUE 16).
+
+The havoc FaultPlan discipline applied to CONTROL: every tick of a
+capacity policy records what it saw (the compacted capacity rows, the
+SLO breach set, the splittable/mergeable candidate sets) and what it
+did (the decision, the vetoes, the cooldown skips, the sheds) into one
+bounded, seeded, replayable log. Same seed + same recorded input
+stream = same actions — `PolicyCore.replay` re-runs a fresh core over
+the recorded inputs and the two ledgers' digests must match, which is
+how the bench proves a whole autoscaling ramp is deterministic without
+reproducing its wall-clock load.
+
+The ledger is an OPERATOR artifact too: `dump()` archives the full
+document (seed, config hash inputs, entries, digest) next to a bench
+round's records, and the HEALTH-adjacent `status()` row is what the
+elastic loops report.
+
+LOCK ORDER: `DecisionLedger._lock` is a LEAF — held only around the
+deque/counter mutation, never across metrics, engine, or RPC calls
+(the occupancy gauge publishes after release). This module never
+imports jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import deque
+from typing import List, Optional
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+#: Default bounded entry count — generous enough that a bench ramp
+#: never drops (replay needs the full prefix; see `replay`'s contract).
+DEFAULT_CAPACITY = 4096
+
+
+def _canonical(doc) -> str:
+    """Canonical JSON for digesting: sorted keys, no whitespace,
+    floats as repr'd by json (deterministic for the rounded values the
+    policy records)."""
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+class DecisionLedger:
+    """Seeded, bounded, digestable record of every policy decision."""
+
+    def __init__(self, seed: int, *, capacity: int = DEFAULT_CAPACITY,
+                 metrics: Optional[Metrics] = None):
+        self.seed = int(seed)
+        self.capacity = max(int(capacity), 1)
+        self.metrics = metrics if metrics is not None else METRICS
+        self._lock = threading.Lock()   # LEAF: deque + counters only
+        self._entries: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._dropped = 0
+
+    def record(self, entry: dict) -> dict:
+        """Append one tick's entry (stamped with the next seq);
+        overflow drops the OLDEST entry (counted — a replay over a
+        clipped ledger is refused by digest mismatch, never silently
+        wrong)."""
+        stamped = dict(entry)
+        with self._lock:
+            stamped["seq"] = self._seq
+            self._seq += 1
+            if len(self._entries) == self.capacity:
+                self._dropped += 1
+            self._entries.append(stamped)
+            occupancy = len(self._entries)
+        self.metrics.gauge("elastic.ledger_occupancy", occupancy)
+        return stamped
+
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def recorded(self) -> int:
+        """Total entries ever recorded (>= len when the deque
+        clipped)."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def digest(self) -> str:
+        """SHA-1 over the canonical (seed, entries) document — the
+        replay-equality token the bench asserts."""
+        doc = {"seed": self.seed, "entries": self.entries()}
+        return hashlib.sha1(_canonical(doc).encode()).hexdigest()
+
+    def document(self) -> dict:
+        """The full archival document (what `dump` writes)."""
+        with self._lock:
+            entries = [dict(e) for e in self._entries]
+            recorded, dropped = self._seq, self._dropped
+        doc = {"seed": self.seed, "capacity": self.capacity,
+               "recorded": recorded, "dropped": dropped,
+               "entries": entries}
+        doc["digest"] = hashlib.sha1(_canonical(
+            {"seed": self.seed, "entries": entries}).encode()).hexdigest()
+        return doc
+
+    def dump(self, path: str) -> str:
+        """Archive the ledger document as JSON; returns the path."""
+        with open(path, "w") as fh:
+            json.dump(self.document(), fh, indent=1, default=str)
+            fh.write("\n")
+        return path
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"seed": self.seed, "capacity": self.capacity,
+                    "occupancy": len(self._entries),
+                    "recorded": self._seq, "dropped": self._dropped}

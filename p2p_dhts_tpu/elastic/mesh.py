@@ -1,0 +1,410 @@
+"""chordax-elastic mesh tier: load-driven process spawn/retire.
+
+The PR-15 coordinator re-splits shards on MEMBERSHIP change only.
+This module closes the loop on LOAD:
+
+  * `MeshPolicy` (runs on the SEED) feeds the mesh-wide CAPACITY
+    merge — its own lens row plus every peer's, unreachable peers as
+    the typed STALE marker — through the same `PolicyCore`
+    hysteresis/cooldown/ledger machine as the ring tier. A sustained-
+    saturation decision SPAWNS one more ``python -m
+    p2p_dhts_tpu.mesh.serve`` process (localhost subprocess,
+    MESH_READY handshake) and forces a coordinator recompute so the
+    new shard split propagates immediately; a sustained-idle decision
+    RETIREs one policy-spawned child (drain via re-split away, then
+    stdin-EOF — the protocol below).
+  * `ShardRebalancer` (runs in EVERY lens-enabled process) watches
+    the route epoch and, after any re-split, re-puts the local
+    shard's no-longer-owned keys through the mesh forwarding path to
+    their new owners — the data motion behind both a spawn (the new
+    process starts EMPTY and must receive its range) and a retire
+    (a peer excluded from the routes owns nothing, so a full drain is
+    just the rebalance rule applied to a self-less table).
+  * `SpawnedPeer` is the subprocess driver (the bench's _MeshProc
+    idiom, promoted to the runtime).
+
+RETIRE protocol (seed -> child over the stdin/stdout pipe):
+
+    seed: "RETIRE\\n" on child stdin
+    child: stops its MeshPeer heartbeat loop FIRST (a heartbeat after
+           the leave applies would read KNOWN:false and auto-rejoin —
+           the PR-15 rejoin rule working against us), then answers
+           "MESH_RETIRING"
+    seed: request_leave(child member) on the control ring; the
+          applied batch recomputes routes WITHOUT the child
+    child: polls MESH_ROUTES until it is excluded, installs the
+           self-less table, drains every stored key to its new owner
+           through the forwarding path, answers "MESH_DRAINED <n>"
+    seed: closes the child's stdin (EOF = the existing graceful
+          shutdown), waits, reaps
+
+No lost acked writes: after the re-split no NEW write lands on the
+child (its front door forwards everything), and every key it already
+acked is re-put before MESH_DRAINED. Reads for moving keys may need
+the prober's retry budget mid-drain — the bench's availability gate
+covers exactly that window.
+
+LOCK ORDER: both loops hold no locks of their own beyond PacedLoop's
+machinery; every data touch goes through gateway/plane public entry
+points. This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import select
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from p2p_dhts_tpu.elastic.ledger import DecisionLedger
+from p2p_dhts_tpu.elastic.policy import PolicyConfig, PolicyCore
+from p2p_dhts_tpu.health import HealthRegistry, PacedLoop
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+logger = logging.getLogger(__name__)
+
+#: Child-side answer lines of the RETIRE protocol.
+RETIRING_LINE = "MESH_RETIRING"
+DRAINED_LINE = "MESH_DRAINED"
+
+
+class SpawnedPeer:
+    """One policy-spawned mesh gateway process on localhost."""
+
+    def __init__(self, seed_port: int,
+                 child_args: Sequence[str] = (), *,
+                 host: str = "127.0.0.1"):
+        cmd = [sys.executable, "-u", "-m", "p2p_dhts_tpu.mesh.serve",
+               "--host", host, "--port", "0",
+               "--seed", f"{host}:{int(seed_port)}"]
+        cmd += [str(a) for a in child_args]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   CHORDAX_LINT_GATE="0")
+        self.host = host
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=env, text=True)
+        self.port: Optional[int] = None
+        self.member: Optional[str] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _read_line(self, timeout_s: float) -> Optional[str]:
+        """One stdout line within the budget (select before readline —
+        a wedged child trips the timeout, never blocks the policy
+        loop). None = timeout; raises when the child exited."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            rem = timeout_s - (time.monotonic() - t0)
+            ready, _, _ = select.select([self.proc.stdout], [], [],
+                                        max(rem, 0.0))
+            if not ready:
+                return None
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"mesh child exited rc={self.proc.poll()}")
+            return line.rstrip("\n")
+        return None
+
+    def wait_ready(self, timeout_s: float = 300.0) -> None:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            line = self._read_line(timeout_s - (time.monotonic() - t0))
+            if line is None:
+                break
+            if line.startswith("MESH_READY "):
+                doc = json.loads(line[len("MESH_READY "):])
+                self.port = int(doc["port"])
+                self.member = doc["member"]
+                return
+        raise TimeoutError("spawned mesh child never reported "
+                           "MESH_READY")
+
+    def send(self, line: str) -> None:
+        self.proc.stdin.write(line + "\n")
+        self.proc.stdin.flush()
+
+    def expect(self, prefix: str, timeout_s: float) -> str:
+        """Read stdout lines until one starts with `prefix`."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            line = self._read_line(timeout_s - (time.monotonic() - t0))
+            if line is None:
+                break
+            if line.startswith(prefix):
+                return line
+        raise TimeoutError(
+            f"spawned mesh child :{self.port} never answered "
+            f"{prefix!r} within {timeout_s:.0f}s")
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.close()   # EOF = graceful shutdown
+                self.proc.wait(timeout=timeout_s)
+            # chordax-lint: disable=bare-except -- teardown best-effort; the kill below is the backstop
+            except Exception:
+                self.proc.kill()
+        if self.proc.poll() is None:
+            self.proc.kill()
+
+
+class ShardRebalancer(PacedLoop):
+    """Post-re-split data motion for one mesh process's shard ring.
+
+    Watches the route epoch; after a change, every stored key this
+    process no longer owns is read locally (decoded through the
+    normal dhash path) and re-PUT WITHOUT a ring pin, so the mesh
+    forwarding split delivers it to its new owner. Old local rows are
+    left in place — the ring no longer owns them, reads route away,
+    and the store's own maintenance purges them; a drain never needs
+    a delete verb."""
+
+    def __init__(self, gateway, plane, *, ring_id: str = "shard",
+                 interval_s: float = 0.5, batch: int = 256,
+                 metrics: Optional[Metrics] = None,
+                 registry: Optional[HealthRegistry] = None):
+        mets = metrics if metrics is not None else METRICS
+        PacedLoop.__init__(
+            self, name="elastic-rebalance", kind="elastic",
+            interval_s=float(interval_s),
+            interval_idle_s=float(interval_s),
+            backoff_base_s=max(float(interval_s), 0.1),
+            backoff_cap_s=10.0, metrics=mets,
+            failure_metric="elastic.rebalance_failures",
+            thread_name="elastic-rebalance", registry=registry)
+        self.gateway = gateway
+        self.plane = plane
+        self.ring_id = str(ring_id)
+        self.batch = int(batch)
+        self._seen_epoch = -1
+
+    def _round(self) -> None:
+        epoch = self.plane.routes.epoch
+        if epoch != self._seen_epoch:
+            self._seen_epoch = epoch
+            self.rebalance()
+        self.rounds += 1
+        self.mark_round()
+
+    def rebalance(self) -> int:
+        """Re-put every stored key whose owner is now another peer;
+        returns the moved-key count. Also THE drain: a peer excluded
+        from the routes owns nothing, so this moves everything."""
+        from p2p_dhts_tpu.keyspace import lanes_to_ints
+        import numpy as np
+        backend = self.gateway.router.get(self.ring_id)
+        store = backend.engine.store_snapshot()
+        if store is None:
+            return 0
+        used = np.asarray(store.used)
+        if not used.any():
+            return 0
+        keys = list(dict.fromkeys(
+            lanes_to_ints(np.asarray(store.keys)[used])))
+        moving = [k for k in keys
+                  if not self.plane.routes.is_local(k)]
+        if not moving:
+            return 0
+        drained = 0
+        for i in range(0, len(moving), self.batch):
+            entries = []
+            for k in moving[i:i + self.batch]:
+                segments, ok = self.gateway.dhash_get(
+                    k, ring_id=self.ring_id)
+                if not ok:
+                    continue  # a fragment row we cannot decode alone
+                entries.append({"KEY": format(int(k), "x"),
+                                "SEGMENTS": segments,
+                                "LENGTH": len(segments)})
+            if not entries:
+                continue
+            out = self.gateway.handle_put({"COMMAND": "PUT",
+                                           "ENTRIES": entries})
+            drained += sum(1 for ok in out.get("OK", ()) if ok)
+        if drained:
+            self.metrics.inc("elastic.drained_keys", drained)
+        return drained
+
+
+class MeshPolicy(PacedLoop):
+    """The seed-side mesh tier: CAPACITY merge in, spawn/retire out.
+
+    Same PolicyCore as the ring tier (hysteresis, cooldown, bounded
+    queue, SLO veto, seeded ledger), with processes as the scaling
+    unit: a split decision spawns one more mesh.serve child and
+    forces a coordinator recompute (the load-driven re-split —
+    `mesh.load_resplits` counts both directions); a merge decision
+    retires one policy-spawned child through the RETIRE protocol.
+    Only children THIS policy spawned are retire candidates — an
+    operator's processes are never killed by the autoscaler."""
+
+    def __init__(self, plane, coordinator, manager, lens, *,
+                 child_args: Sequence[str] = (),
+                 config: Optional[PolicyConfig] = None,
+                 seed: int = 0x0E1A571C,
+                 interval_s: float = 1.0,
+                 ledger_capacity: int = 4096,
+                 spawn_timeout_s: float = 300.0,
+                 retire_timeout_s: float = 120.0,
+                 metrics: Optional[Metrics] = None,
+                 registry: Optional[HealthRegistry] = None):
+        mets = metrics if metrics is not None else METRICS
+        PacedLoop.__init__(
+            self, name="elastic-mesh", kind="elastic",
+            interval_s=float(interval_s),
+            interval_idle_s=float(interval_s),
+            backoff_base_s=max(float(interval_s) / 2, 0.1),
+            backoff_cap_s=max(float(interval_s) * 16, 10.0),
+            metrics=mets,
+            failure_metric="elastic.mesh_round_failures",
+            thread_name="elastic-mesh-policy", registry=registry)
+        self.plane = plane
+        self.coordinator = coordinator
+        self.manager = manager
+        self.lens = lens
+        self.child_args = list(child_args)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.retire_timeout_s = float(retire_timeout_s)
+        self.ledger = DecisionLedger(seed, capacity=ledger_capacity,
+                                     metrics=mets)
+        self.core = PolicyCore(config, seed=seed, ledger=self.ledger,
+                               metrics=mets)
+        #: addr string -> SpawnedPeer, for children we own. Only the
+        #: loop thread (or a foreground tick) touches it — the
+        #: PulseSampler single-driver rule, so no lock.
+        self.spawned: Dict[str, SpawnedPeer] = {}
+
+    # -- inputs ---------------------------------------------------------------
+    def _capacity_rows(self) -> Dict[str, dict]:
+        """{addr: capacity row} for every mesh process: the local lens
+        row plus each peer's own CAPACITY answer (typed STALE markers
+        ride through untouched — compact_row freezes those streaks)."""
+        from p2p_dhts_tpu.mesh.routes import addr_str
+        ring_id = self.plane.ring_id or "shard"
+        rows: Dict[str, dict] = {}
+        local = self.lens.capacity_report().get("rings", {}).get(
+            ring_id)
+        self_a = addr_str(self.plane.routes.self_addr)
+        rows[self_a] = local if local is not None \
+            else {"STALE": True, "ERROR": "no local lens row yet"}
+        peer_rows = self.plane.collect_peer_rows(
+            "CAPACITY", {"COMMAND": "CAPACITY", "MESH": True})
+        for addr, resp in peer_rows.items():
+            if resp.get("STALE"):
+                rows[addr] = resp
+                continue
+            row = (resp.get("CAPACITY") or {}).get(
+                "rings", {}).get(ring_id)
+            rows[addr] = row if row is not None else {
+                "STALE": True,
+                "ERROR": "peer has no lens row for the shard ring"}
+        return rows
+
+    # -- one tick -------------------------------------------------------------
+    def _round(self) -> None:
+        self.tick()
+
+    def tick(self) -> Optional[dict]:
+        rows = self._capacity_rows()
+        cfg = self.core.config
+        n_procs = len(rows)
+        splittable = (sorted(rows) if n_procs < cfg.max_rings else [])
+        mergeable = ([a for a in sorted(self.spawned) if a in rows]
+                     if n_procs > cfg.min_rings else [])
+        action = self.core.observe(rows, splittable=splittable,
+                                   mergeable=mergeable)
+        if action is not None:
+            if action["action"] == "split":
+                self._spawn()
+            else:
+                self._retire(action["ring"])
+        self.rounds += 1
+        self.mark_round()
+        return action
+
+    # -- actuation ------------------------------------------------------------
+    def _spawn(self) -> SpawnedPeer:
+        """One more mesh process: spawn, MESH_READY, join observed,
+        then a FORCED recompute so the new split propagates this tick
+        (membership alone would also get there, one heartbeat later)."""
+        seed_port = int(self.plane.routes.self_addr[1])
+        child = SpawnedPeer(seed_port, self.child_args)
+        try:
+            child.wait_ready(self.spawn_timeout_s)
+        except BaseException:
+            child.close(timeout_s=5.0)
+            raise
+        self.spawned[child.addr] = child
+        self.coordinator.recompute(force=True)
+        self.metrics.inc("elastic.spawns")
+        self.metrics.inc("mesh.load_resplits")
+        logger.info("elastic mesh spawned %s (member %s)", child.addr,
+                    child.member)
+        return child
+
+    def _retire(self, addr: str) -> None:
+        """The RETIRE protocol, seed side (see module docstring)."""
+        from p2p_dhts_tpu.mesh.routes import member_for
+        child = self.spawned.get(addr)
+        if child is None:
+            self.metrics.inc("elastic.retire_orphans")
+            return
+        child.send("RETIRE")
+        child.expect(RETIRING_LINE, self.retire_timeout_s)
+        member = member_for((child.host, int(child.port)))
+        self.manager.request_leave(member)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < self.retire_timeout_s:
+            if member not in self.plane.routes.peers():
+                break
+            # The manager's own loop applies the leave and the
+            # coordinator recomputes on its applied listener — we only
+            # poll (the single-driver rule: never step() a started
+            # manager from another thread).
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(
+                f"routes still include retiring peer {addr} after "
+                f"{self.retire_timeout_s:.0f}s")
+        child.expect(DRAINED_LINE, self.retire_timeout_s)
+        child.close()
+        self.spawned.pop(addr, None)
+        self.metrics.inc("elastic.retires")
+        self.metrics.inc("mesh.load_resplits")
+        logger.info("elastic mesh retired %s", addr)
+
+    # -- lifecycle ------------------------------------------------------------
+    def close(self, timeout: float = 30.0) -> None:
+        PacedLoop.close(self, timeout=timeout)
+        for child in list(self.spawned.values()):
+            child.close()
+        self.spawned.clear()
+
+
+def serve_retire(plane, peer, rebalancer, *,
+                 poll_s: float = 0.25,
+                 timeout_s: float = 120.0) -> int:
+    """The CHILD side of the RETIRE protocol (called by mesh.serve
+    when the parent writes "RETIRE"): heartbeats already stopped by
+    the caller; poll the seed's routes until we are excluded, install
+    the self-less table, drain everything, return the drained count."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if peer is not None:
+            try:
+                peer.fetch_routes()
+            # chordax-lint: disable=bare-except -- a flaky seed poll retries; the timeout is the backstop
+            except Exception:
+                pass
+        if plane.member_id not in plane.routes.peers():
+            break
+        time.sleep(poll_s)
+    return rebalancer.rebalance() if rebalancer is not None else 0

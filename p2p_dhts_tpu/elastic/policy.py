@@ -1,0 +1,395 @@
+"""chordax-elastic decision core + ring-tier policy loop (ISSUE 16).
+
+`PolicyCore` is the deliberately BORING, hand-checkable state machine
+both tiers (ring and mesh) share. One `observe()` call is one tick:
+
+  * HYSTERESIS BANDS — a ring scales OUT only after `saturate_ticks`
+    CONSECUTIVE saturated windows; it scales IN only after its
+    utilization (current/capacity keys-per-second) has held at or
+    below `low_water_util` for the LONGER `idle_ticks` window. The
+    middle band resets both streaks, so load oscillating around
+    either threshold produces ZERO actions (the flap-suppression
+    contract the tests pin).
+  * COOLDOWN — after any decision, no new decision enqueues for
+    `cooldown_ticks` ticks (counted `elastic.cooldown_skips`).
+  * BOUNDED ACTION QUEUE — decisions queue up to `max_actions`; at
+    most ONE executes per tick; overflow is SHED visibly
+    (`elastic.shed`), never silently reordered.
+  * SLO VETO — any chordax-pulse BREACH verdict blocks scale-IN
+    (merging under a burning error budget only makes the burn worse);
+    counted `elastic.vetoes`.
+  * STALE SKIP — a row carrying the typed stale/unreachable marker
+    (a briefly-partitioned mesh peer, an aged lens row) FREEZES that
+    ring's streaks for the tick (`elastic.stale_rows`): missing data
+    is never read as zero capacity.
+
+Every tick is recorded in the seeded `DecisionLedger` with its full
+compacted input, so `PolicyCore.replay` re-derives the identical
+action stream from the record alone — no wall-clock anywhere in the
+core (ticks are counted, not timed).
+
+`RingPolicy` is the ring tier: a `health.PacedLoop` whose tick reads
+`LensLoop.capacity_report()` (or any injected `capacity_source` — the
+dryrun/tests drive synthetic report streams through the REAL loop)
+plus the pulse sampler's SLO verdicts, runs the core, and actuates
+SPLIT/MERGE through `elastic.actuator` (which only drives existing
+machinery: churn_apply, run_sync_round, the router's atomic
+multi-swap).
+
+LOCK ORDER: `RingPolicy._lock` is a LEAF guarding the parent/child
+split tree only — never held across the actuator (engine calls), the
+lens, metrics, or the ledger. PolicyCore itself is single-threaded by
+contract (one driver at a time — the loop thread, or a foreground
+tick while the loop is not started; the PulseSampler rule). This
+module never imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from p2p_dhts_tpu.elastic.ledger import DecisionLedger
+from p2p_dhts_tpu.health import HealthRegistry, PacedLoop
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyConfig:
+    """The hand-tunable knobs. Defaults suit a ~1 s tick."""
+
+    #: Consecutive saturated ticks before a ring is a SPLIT candidate.
+    saturate_ticks: int = 3
+    #: Consecutive low-water ticks before a ring is a MERGE candidate
+    #: (longer than saturate_ticks by design: growing is urgent,
+    #: shrinking is overnight housekeeping).
+    idle_ticks: int = 6
+    #: Scale-in band: utilization (current/capacity) at or below this
+    #: counts toward the idle streak.
+    low_water_util: float = 0.25
+    #: Ticks after a decision during which no NEW decision enqueues.
+    cooldown_ticks: int = 5
+    #: Bounded decision queue (one executes per tick; overflow sheds).
+    max_actions: int = 4
+    #: Ring-count band the executor enforces via the candidate sets.
+    min_rings: int = 1
+    max_rings: int = 8
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def compact_row(row) -> dict:
+    """Reduce one capacity row to exactly what the core reads —
+    {saturated, util, stale} — so ledger entries stay small and replay
+    is closed under compaction (a compact row compacts to itself).
+    Accepts lens rows, mesh CAPACITY rows, typed stale markers, and
+    anything malformed (malformed = stale, never a parse error)."""
+    if not isinstance(row, dict) or row.get("STALE") or row.get("stale"):
+        return {"saturated": 0, "util": None, "stale": True}
+    if "util" in row:
+        util = row["util"]
+        return {"saturated": int(row.get("saturated") or 0),
+                "util": round(float(util), 6) if util is not None
+                else None,
+                "stale": False}
+    cur = row.get("current_keys_s")
+    cap = row.get("capacity_keys_s")
+    util = None
+    if cur is not None and cap:
+        util = round(float(cur) / float(cap), 6)
+    return {"saturated": int(row.get("saturated") or 0),
+            "util": util, "stale": False}
+
+
+class PolicyCore:
+    """The seeded hysteresis/cooldown/veto state machine (pure —
+    no wall-clock, no I/O; metrics and the ledger are its only
+    side channels)."""
+
+    def __init__(self, config: Optional[PolicyConfig] = None, *,
+                 seed: int = 0, ledger: Optional[DecisionLedger] = None,
+                 metrics: Optional[Metrics] = None):
+        self.config = config if config is not None else PolicyConfig()
+        self.seed = int(seed)
+        self.metrics = metrics if metrics is not None else METRICS
+        self.ledger = ledger if ledger is not None \
+            else DecisionLedger(self.seed, metrics=self.metrics)
+        self._rng = random.Random(self.seed)
+        self.tick_n = 0
+        self._sat: Dict[str, int] = {}
+        self._idle: Dict[str, int] = {}
+        self._last_decision_tick: Optional[int] = None
+        self._queue: deque = deque()
+
+    # -- introspection -------------------------------------------------------
+    def streaks(self) -> Dict[str, dict]:
+        return {rid: {"sat": self._sat.get(rid, 0),
+                      "idle": self._idle.get(rid, 0)}
+                for rid in set(self._sat) | set(self._idle)}
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    # -- one tick ------------------------------------------------------------
+    def observe(self, rows: Dict[str, dict], *,
+                splittable: Iterable[str] = (),
+                mergeable: Iterable[str] = (),
+                slo: Optional[Dict[str, dict]] = None
+                ) -> Optional[dict]:
+        """One tick over {ring id: capacity row}. Returns the action
+        to EXECUTE now ({"action": "split"|"merge", "ring": rid}) or
+        None. `splittable`/`mergeable` are the executor's eligibility
+        sets (ring-count bands, split-tree leaves, spawned mesh
+        children); they are recorded so replay is self-contained."""
+        cfg = self.config
+        self.tick_n += 1
+        inputs = {rid: compact_row(rows[rid]) for rid in sorted(rows)}
+        breach = sorted(name for name, v in (slo or {}).items()
+                        if isinstance(v, dict)
+                        and v.get("verdict") == "BREACH")
+        events: List[dict] = []
+
+        # Streak update — stale rows FREEZE their ring's streaks.
+        for rid, row in inputs.items():
+            if row["stale"]:
+                self.metrics.inc("elastic.stale_rows")
+                events.append({"event": "stale_skip", "ring": rid})
+                continue
+            sat = self._sat.get(rid, 0)
+            idle = self._idle.get(rid, 0)
+            if row["saturated"]:
+                sat, idle = sat + 1, 0
+            elif row["util"] is not None \
+                    and row["util"] <= cfg.low_water_util:
+                sat, idle = 0, idle + 1
+            else:
+                sat, idle = 0, 0          # the middle band: hysteresis
+            self._sat[rid] = sat
+            self._idle[rid] = idle
+        for rid in [r for r in self._sat if r not in inputs]:
+            self._sat.pop(rid, None)
+            self._idle.pop(rid, None)
+
+        split_set = sorted(set(splittable))
+        merge_set = sorted(set(mergeable))
+        live = {rid for rid, row in inputs.items() if not row["stale"]}
+        split_cands = [r for r in split_set if r in live
+                       and self._sat.get(r, 0) >= cfg.saturate_ticks]
+        merge_cands = [r for r in merge_set if r in live
+                       and self._idle.get(r, 0) >= cfg.idle_ticks]
+        in_cooldown = (
+            self._last_decision_tick is not None
+            and self.tick_n - self._last_decision_tick
+            < cfg.cooldown_ticks)
+
+        # Candidate order is the SEED's one job: deterministic for a
+        # given seed, different across seeds when candidates tie.
+        self._rng.shuffle(split_cands)
+        self._rng.shuffle(merge_cands)
+
+        decisions: List[dict] = []
+        for action, cands in (("split", split_cands),
+                              ("merge", merge_cands)):
+            for ring in cands:
+                if action == "merge" and breach:
+                    self.metrics.inc("elastic.vetoes")
+                    events.append({"event": "slo_veto", "ring": ring,
+                                   "breach": breach})
+                    continue
+                if in_cooldown:
+                    self.metrics.inc("elastic.cooldown_skips")
+                    events.append({"event": "cooldown_skip",
+                                   "ring": ring, "action": action})
+                    continue
+                if len(self._queue) >= cfg.max_actions:
+                    self.metrics.inc("elastic.shed")
+                    events.append({"event": "shed", "ring": ring,
+                                   "action": action})
+                    continue
+                decision = {"action": action, "ring": ring}
+                self._queue.append(decision)
+                decisions.append(decision)
+                self._last_decision_tick = self.tick_n
+                in_cooldown = True        # one trigger burst, one slot
+                self._sat[ring] = 0
+                self._idle[ring] = 0
+
+        executed = self._queue.popleft() if self._queue else None
+        if executed is not None:
+            self.metrics.inc("elastic.actions")
+        self.ledger.record({
+            "tick": self.tick_n,
+            "inputs": inputs,
+            "splittable": split_set,
+            "mergeable": merge_set,
+            "breach": breach,
+            "events": events,
+            "decisions": decisions,
+            "executed": executed,
+        })
+        return executed
+
+    # -- replay --------------------------------------------------------------
+    @classmethod
+    def replay(cls, seed: int, config: Optional[PolicyConfig],
+               entries: Sequence[dict], *,
+               metrics: Optional[Metrics] = None) -> DecisionLedger:
+        """Re-run a fresh core over a recorded entry stream's INPUTS
+        and return the resulting ledger. Same seed + same inputs =>
+        `replay(...).digest() == original.digest()` — the determinism
+        proof the bench and the dryrun assert. The entries must be the
+        COMPLETE record (a ledger that clipped its prefix replays to a
+        different digest by construction — `dropped` says whether)."""
+        mets = metrics if metrics is not None else Metrics()
+        core = cls(config, seed=seed,
+                   ledger=DecisionLedger(seed, capacity=max(
+                       len(entries), 1), metrics=mets),
+                   metrics=mets)
+        for entry in entries:
+            core.observe(
+                entry.get("inputs") or {},
+                splittable=entry.get("splittable") or (),
+                mergeable=entry.get("mergeable") or (),
+                slo={name: {"verdict": "BREACH"}
+                     for name in entry.get("breach") or []})
+        return core.ledger
+
+
+class RingPolicy(PacedLoop):
+    """The ring tier: lens rows in, router/churn/repair actuation out.
+
+    Each tick: read the capacity report (the attached LensLoop's, or
+    an injected `capacity_source` — any callable returning the
+    CAPACITY-verb payload shape), read the pulse sampler's SLO
+    verdicts, run the PolicyCore, and execute at most one action via
+    `elastic.actuator.split_ring` / `merge_ring`. The split tree
+    (which child came from which parent) lives here so MERGE always
+    reverses the most specific SPLIT (leaves first)."""
+
+    def __init__(self, gateway, lens=None, *,
+                 capacity_source=None,
+                 sampler=None,
+                 config: Optional[PolicyConfig] = None,
+                 seed: int = 0x0E1A571C,
+                 exclude: Iterable[str] = (),
+                 interval_s: float = 1.0,
+                 ledger_capacity: int = 4096,
+                 split_kwargs: Optional[dict] = None,
+                 metrics: Optional[Metrics] = None,
+                 registry: Optional[HealthRegistry] = None):
+        if capacity_source is None and lens is None:
+            raise ValueError("RingPolicy needs a LensLoop or an "
+                             "explicit capacity_source")
+        mets = metrics if metrics is not None else METRICS
+        PacedLoop.__init__(
+            self, name="elastic-ring", kind="elastic",
+            interval_s=float(interval_s),
+            interval_idle_s=float(interval_s),
+            backoff_base_s=max(float(interval_s) / 2, 0.1),
+            backoff_cap_s=max(float(interval_s) * 16, 10.0),
+            metrics=mets,
+            failure_metric="elastic.policy_round_failures",
+            thread_name="elastic-ring-policy", registry=registry)
+        self.gateway = gateway
+        self.lens = lens
+        self._source = (capacity_source if capacity_source is not None
+                        else lens.capacity_report)
+        self._sampler = sampler
+        self.exclude = set(exclude)
+        self.ledger = DecisionLedger(seed, capacity=ledger_capacity,
+                                     metrics=mets)
+        self.core = PolicyCore(config, seed=seed, ledger=self.ledger,
+                               metrics=mets)
+        self.split_kwargs = dict(split_kwargs or {})
+        self._lock = threading.Lock()   # LEAF: the split tree only
+        self._children: Dict[str, List[str]] = {}
+        self._parent: Dict[str, str] = {}
+        self._split_n = 0
+
+    # -- introspection -------------------------------------------------------
+    def children(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {p: list(cs) for p, cs in self._children.items()}
+
+    def status(self) -> dict:
+        with self._lock:
+            n_children = sum(len(cs) for cs in self._children.values())
+        return {"tick": self.core.tick_n, "children": n_children,
+                "queued": self.core.queued,
+                "ledger": self.ledger.status()}
+
+    # -- one tick ------------------------------------------------------------
+    def _round(self) -> None:
+        self.tick()
+
+    def tick(self) -> Optional[dict]:
+        """One deterministic policy tick (the foreground form the
+        bench/dryrun/tests drive; the background loop runs exactly
+        this). Returns the executed action, if any."""
+        report = self._source() or {}
+        rows = dict(report.get("rings") or {})
+        for rid in self.exclude:
+            rows.pop(rid, None)
+        sampler = (self._sampler if self._sampler is not None
+                   else self.gateway.pulse_sampler())
+        slo = sampler.verdicts() if sampler is not None else None
+        with self._lock:
+            # LIFO merge eligibility: per parent, only its LATEST
+            # child (and only while that child is itself a leaf) —
+            # the one arc guaranteed adjacent to the parent, so every
+            # merge exactly reverses the most recent split and the
+            # range algebra can never face a gap.
+            leaves = [cs[-1] for cs in self._children.values()
+                      if cs and not self._children.get(cs[-1])]
+        cfg = self.core.config
+        n_managed = len(rows)
+        splittable = (list(rows) if n_managed < cfg.max_rings else [])
+        mergeable = ([c for c in leaves if c in rows]
+                     if n_managed > cfg.min_rings else [])
+        action = self.core.observe(rows, splittable=splittable,
+                                   mergeable=mergeable, slo=slo)
+        if action is not None:
+            self._execute(action)
+        self.rounds += 1
+        self.mark_round()
+        return action
+
+    # -- actuation -----------------------------------------------------------
+    def _execute(self, action: dict) -> None:
+        from p2p_dhts_tpu.elastic.actuator import merge_ring, \
+            split_ring
+        if action["action"] == "split":
+            parent = action["ring"]
+            with self._lock:
+                self._split_n += 1
+                child = f"{parent}-el{self._split_n}"
+            split_ring(self.gateway, parent, child,
+                       **self.split_kwargs)
+            with self._lock:
+                self._children.setdefault(parent, []).append(child)
+                self._parent[child] = parent
+            self.metrics.inc("elastic.splits")
+        else:
+            child = action["ring"]
+            with self._lock:
+                parent = self._parent.get(child)
+            if parent is None:
+                # A merge decision for a ring we did not split (a
+                # stale queue entry racing an operator remove): noop
+                # visibly rather than guess a target range.
+                self.metrics.inc("elastic.merge_orphans")
+                return
+            merge_ring(self.gateway, parent, child,
+                       **self.split_kwargs)
+            with self._lock:
+                self._parent.pop(child, None)
+                if child in self._children.get(parent, ()):
+                    self._children[parent].remove(child)
+                if not self._children.get(parent):
+                    self._children.pop(parent, None)
+            self.metrics.inc("elastic.merges")

@@ -1726,9 +1726,11 @@ class Gateway:
         """MESH:true on an introspection verb (CAPACITY / HEALTH /
         PULSE) additionally collects every live route peer's own
         answer (chordax-mesh): the merged decision input the elastic
-        loop reads from any ONE gateway. A dead peer's row is its
-        error string; no mesh attached means no MESH section, never
-        an RPC error."""
+        loop reads from any ONE gateway. An unreachable peer's row is
+        the plane's TYPED stale marker ({"STALE": true, "ERROR": ...,
+        age-stamped "LAST_GOOD"}), so `elastic.MeshPolicy` never
+        parses an error string; no mesh attached means no MESH
+        section, never an RPC error."""
         if not req.get("MESH"):
             return
         mesh = self.mesh_plane()

@@ -31,9 +31,13 @@ from typing import Dict, Iterable, Optional
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
 #: The gateway op vocabulary (the engine's kinds, served over the wire;
-#: sync_digest / repair_reindex are the chordax-repair control ops).
+#: sync_digest / repair_reindex are the chordax-repair control ops,
+#: churn_apply / stabilize_sweep the membership/actuation control ops —
+#: policy-driven split/merge cycles count them per ring, so retirement
+#: must enumerate them too or a retired child leaks its rows).
 OPS = ("find_successor", "dhash_get", "dhash_put", "finger_index",
-       "sync_digest", "repair_reindex")
+       "sync_digest", "repair_reindex", "churn_apply",
+       "stabilize_sweep")
 
 #: Every per-ring membership key family (membership.<fam>.<ring> —
 #: manager.py's schema, mirrored in README's metric-key inventory).

@@ -89,6 +89,42 @@ def keys_in_range_mask(lanes, lo: int, hi: int):
     return lanes_in_range_mask(lanes, lo, hi)
 
 
+def split_key_range(key_range: Optional[Tuple[int, int]]
+                    ) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """Halve a clockwise-inclusive [lo, hi] arc into two adjacent
+    arcs — ((lo, mid), (mid+1, hi)) on the 2^128 circle. None (the
+    range-less default ring) splits as the FULL circle. The halves
+    are exact complements: merge_key_ranges inverts this (the
+    chordax-elastic SPLIT/MERGE algebra)."""
+    if key_range is None:
+        lo, hi = 0, KEYS_IN_RING - 1
+    else:
+        lo = int(key_range[0]) % KEYS_IN_RING
+        hi = int(key_range[1]) % KEYS_IN_RING
+    span = (hi - lo) % KEYS_IN_RING + 1
+    if span < 2:
+        raise ValueError(f"key range ({lo:#x}, {hi:#x}) spans {span} "
+                         "key(s); nothing to split")
+    mid = (lo + span // 2 - 1) % KEYS_IN_RING
+    return (lo, mid), ((mid + 1) % KEYS_IN_RING, hi)
+
+
+def merge_key_ranges(a: Tuple[int, int],
+                     b: Tuple[int, int]) -> Tuple[int, int]:
+    """Join two ADJACENT clockwise-inclusive arcs back into one
+    (either argument order). Raises ValueError for non-adjacent arcs —
+    a merge across a gap would silently claim keys neither ring owns."""
+    a_lo, a_hi = (int(a[0]) % KEYS_IN_RING, int(a[1]) % KEYS_IN_RING)
+    b_lo, b_hi = (int(b[0]) % KEYS_IN_RING, int(b[1]) % KEYS_IN_RING)
+    if (a_hi + 1) % KEYS_IN_RING == b_lo:
+        return (a_lo, b_hi)
+    if (b_hi + 1) % KEYS_IN_RING == a_lo:
+        return (b_lo, a_hi)
+    raise ValueError(
+        f"key ranges ({a_lo:#x}, {a_hi:#x}) and ({b_lo:#x}, {b_hi:#x}) "
+        "are not adjacent")
+
+
 class RingBackend:
     """One named serving backend: engine + key range + health machine.
 
@@ -368,6 +404,31 @@ class RingRouter:
                 (int(key_range[0]) % KEYS_IN_RING,
                  int(key_range[1]) % KEYS_IN_RING)
                 if key_range is not None else None)
+        self._fire_topology("set_key_range")
+
+    def set_key_ranges(
+            self,
+            changes: Dict[str, Optional[Tuple[int, int]]]) -> None:
+        """Atomically update SEVERAL rings' ownership entries in one
+        lock acquisition + ONE topology epoch bump (chordax-elastic:
+        a split hands the top half to the child in the same instant
+        the parent's range shrinks — no window where both own the
+        half, or neither does). All ids are validated before any entry
+        mutates, so a bad id leaves the registry untouched."""
+        if not changes:
+            return
+        with self._lock:
+            backends = {}
+            for ring_id in changes:
+                backend = self._rings.get(ring_id)
+                if backend is None:
+                    raise UnknownRingError(f"no ring {ring_id!r}")
+                backends[ring_id] = backend
+            for ring_id, key_range in changes.items():
+                backends[ring_id].key_range = (
+                    (int(key_range[0]) % KEYS_IN_RING,
+                     int(key_range[1]) % KEYS_IN_RING)
+                    if key_range is not None else None)
         self._fire_topology("set_key_range")
 
     def route(self, key_int: Optional[int] = None,

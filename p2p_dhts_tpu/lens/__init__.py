@@ -199,6 +199,7 @@ class LensLoop(PacedLoop):
                  rate_alpha: float = RATE_EWMA_ALPHA,
                  backoff_base_s: float = 0.5,
                  backoff_cap_s: float = 10.0,
+                 stale_after_s: Optional[float] = None,
                  registry: Optional[HealthRegistry] = None):
         mets = metrics if metrics is not None else METRICS
         PacedLoop.__init__(
@@ -210,6 +211,12 @@ class LensLoop(PacedLoop):
         self.gateway = gateway
         self.saturation_delay_ms = float(saturation_delay_ms)
         self.rate_alpha = float(rate_alpha)
+        #: Row age beyond which capacity_report marks it STALE (the
+        #: typed unreachable/aged marker a policy tick can trust
+        #: without string parsing). Default: three update intervals.
+        self.stale_after_s = float(
+            stale_after_s if stale_after_s is not None
+            else 3.0 * float(interval_s))
         self._lock = threading.Lock()  # LEAF: models + rows only
         self._models: Dict[str, CapacityModel] = {}
         self._rows: Dict[str, dict] = {}
@@ -292,14 +299,29 @@ class LensLoop(PacedLoop):
 
     def capacity_report(self) -> dict:
         """The CAPACITY verb payload: every ring's derived capacity
-        row — the elastic policy loop's one-call decision input."""
+        row — the elastic policy loop's one-call decision input. Each
+        row is age-stamped against the LAST update tick (`age_s` =
+        updated_t - row t; recorded timestamps only, no wall clock, so
+        a replayed stream ages identically) and carries the typed
+        `stale` flag once older than `stale_after_s` — a ring whose
+        model stopped producing rows (a wedged engine, a ring mid-
+        retirement) reads as STALE last-good data, never as fresh zero
+        capacity."""
         with self._lock:
-            return {
-                "updated_t": self._updated_t,
-                "interval_s": self.interval_s,
-                "rings": {rid: dict(row)
-                          for rid, row in self._rows.items()},
-            }
+            updated_t = self._updated_t
+            rows = {rid: dict(row)
+                    for rid, row in self._rows.items()}
+        for row in rows.values():
+            age = (max(float(updated_t) - float(row.get("t", updated_t)),
+                       0.0) if updated_t is not None else 0.0)
+            row["age_s"] = round(age, 6)
+            row["stale"] = bool(age > self.stale_after_s)
+        return {
+            "updated_t": updated_t,
+            "interval_s": self.interval_s,
+            "stale_after_s": self.stale_after_s,
+            "rings": rows,
+        }
 
 
 class ProfilerLoop(PacedLoop):

@@ -284,6 +284,15 @@ class MembershipManager(PacedLoop):
         self.metrics.inc(f"membership.join_requests.{self.ring_id}")
         return True
 
+    def request_join_many(self, member_ids) -> int:
+        """Policy-initiated churn entry point (chordax-elastic): admit
+        a whole batch of joins through the SAME bounded, idempotent
+        per-id gate as request_join — an elastic grow never bypasses
+        admission, it just amortizes the call. Returns the accepted
+        count; refusals are the usual visible
+        `membership.join_rejected.<ring>` rows."""
+        return sum(1 for m in member_ids if self.request_join(m))
+
     def heartbeat(self, member_id: int) -> bool:
         """Record one heartbeat; returns False for unknown members
         (they must JOIN_RING first — counted, not an error).

@@ -196,14 +196,18 @@ class MeshCoordinator:
         epoch bumps — peers pull it on their next heartbeat."""
         self.recompute()
 
-    def recompute(self) -> bool:
+    def recompute(self, force: bool = False) -> bool:
         """Rebuild the shard map from (alive control-ring members ∩
         known addresses); install it with the NEXT epoch when it
         changed. Returns whether a new epoch was installed. The whole
         read-compute-install runs under _recompute_lock (membership
         state is re-read INSIDE it), so concurrent triggers serialize
         and the last installed map always reflects the newest
-        membership the coordinator has seen."""
+        membership the coordinator has seen. `force=True` installs a
+        fresh epoch even when the peer SET is unchanged — the elastic
+        mesh tier's lever after a spawn it must propagate immediately
+        (every peer re-pulls routes on the epoch move) rather than
+        waiting for a membership delta to coincide."""
         with self._recompute_lock:
             alive = set(self.manager.alive_ids())
             with self._lock:
@@ -212,7 +216,7 @@ class MeshCoordinator:
             if not peers:
                 return False
             current = self.plane.routes.peers()
-            if peers == current:
+            if peers == current and not force:
                 return False
             installed = self.plane.apply_routes(
                 peers, self.plane.routes.epoch + 1)

@@ -30,9 +30,13 @@ the cache exactly as before.
 
 MESH-WIDE VERBS: CAPACITY / HEALTH / PULSE requests carrying
 ``MESH: true`` additionally collect every live route peer's own row
-(bounded per-peer timeout; a dead peer reads as its error string), so
-the elastic loop's decision input spans processes from any one
-gateway. Per-peer `mesh.*` telemetry retires with the peer when a
+(bounded per-peer timeout; an unreachable peer reads as a TYPED
+stale marker — ``{"STALE": true, "ERROR": ..., "AGE_S": ...,
+"LAST_GOOD": <its previous answer>}`` — never a bare error string a
+policy tick would have to parse), so the elastic loop's decision
+input spans processes from any one gateway. A briefly-partitioned
+peer therefore reads as "stale, last seen N seconds ago with THIS
+capacity", not as zero capacity. Per-peer `mesh.*` telemetry retires with the peer when a
 re-split drops it (the PR-8 stale-telemetry rule), and the departed
 peer's pooled wire connections close with it.
 
@@ -45,6 +49,7 @@ jax.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -87,6 +92,13 @@ class MeshPlane:
             retries=forward_retries)
         self.peer_verb_timeout_s = float(peer_verb_timeout_s)
         self._lock = threading.Lock()
+        # Last successful mesh-wide-verb answer per peer addr string
+        # (monotonic timestamp, response), so an unreachable peer's
+        # row can carry an age-stamped LAST_GOOD instead of nothing.
+        # Guarded by _lock (a leaf — recorded AFTER the RPC returns,
+        # never around it); evicted with the peer's other state when a
+        # re-split drops it.
+        self._last_good: Dict[str, Tuple[float, dict]] = {}
         self.coordinator = None   # set by MeshCoordinator
         self._applying = False    # reentrancy guard for our own
         #                         # set_key_range during apply_routes
@@ -140,6 +152,8 @@ class MeshPlane:
             self.metrics.remove_prefix(f"mesh.peer_alive.{a}")
             ip, _, port = a.rpartition(":")
             wire.pool().close_dest((ip, int(port)))
+            with self._lock:
+                self._last_good.pop(a, None)
             self.metrics.inc("mesh.peers_retired")
         for a in sorted(new_addrs):
             self.metrics.gauge(f"mesh.peer_alive.{a}", 1.0)
@@ -612,11 +626,15 @@ class MeshPlane:
     def collect_peer_rows(self, command: str, req: dict
                           ) -> Dict[str, dict]:
         """Every live route peer's own answer to `command` (bounded
-        timeout each; a dead peer's row is its error string) — the
-        proxy/merge half of the mesh-wide CAPACITY/HEALTH/PULSE
-        verbs. Peers are polled CONCURRENTLY, so the verb costs
-        max(peer latency), never sum — N-1 partitioned peers must not
-        park a serving worker for N-1 timeouts back to back."""
+        timeout each; an unreachable peer's row is the TYPED stale
+        marker — ``STALE: true`` + ``ERROR`` + age-stamped
+        ``LAST_GOOD`` when we have one — so a consuming policy tick
+        never parses an error string and a brief partition never
+        reads as zero capacity) — the proxy/merge half of the
+        mesh-wide CAPACITY/HEALTH/PULSE verbs. Peers are polled
+        CONCURRENTLY, so the verb costs max(peer latency), never
+        sum — N-1 partitioned peers must not park a serving worker
+        for N-1 timeouts back to back."""
         base = {k: v for k, v in req.items()
                 if k not in ("MESH", trace_mod.WIRE_KEY)}
         base["COMMAND"] = command
@@ -626,15 +644,26 @@ class MeshPlane:
             return {}
 
         def one(addr: Addr) -> dict:
+            a = addr_str(addr)
             try:
                 resp = Client.make_request(
                     addr[0], addr[1], dict(base),
                     timeout=self.peer_verb_timeout_s)
                 resp.pop("SUCCESS", None)
+                with self._lock:
+                    self._last_good[a] = (time.monotonic(), resp)
                 return resp
-            # chordax-lint: disable=bare-except -- a dead peer's row is its error string; the merge must answer regardless
+            # chordax-lint: disable=bare-except -- an unreachable peer's row is its typed stale marker; the merge must answer regardless
             except Exception as exc:
-                return {"ERROR": str(exc)}
+                self.metrics.inc("mesh.peer_rows_stale")
+                marker = {"STALE": True, "ERROR": str(exc)}
+                with self._lock:
+                    good = self._last_good.get(a)
+                if good is not None:
+                    marker["AGE_S"] = round(
+                        max(time.monotonic() - good[0], 0.0), 3)
+                    marker["LAST_GOOD"] = good[1]
+                return marker
 
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(

@@ -13,8 +13,20 @@ Protocol with the parent (the bench / an operator script):
   * stdout line 1: ``MESH_READY {"port": ..., "member": "<hex>"}`` —
     emitted once the server answers and (seed) the initial routes are
     installed. Everything else logs to stderr.
+  * stdin line ``RETIRE`` (chordax-elastic): stop heartbeating FIRST
+    (so the leave cannot auto-rejoin), answer ``MESH_RETIRING``, wait
+    to be excluded from the routes, drain every stored key to its new
+    owner through the forwarding path, answer ``MESH_DRAINED <n>``,
+    then await the EOF below.
   * stdin EOF = graceful shutdown (peer loop, plane, server, gateway,
     in that order), exit 0. SIGTERM stays the hard kill.
+
+chordax-elastic flags: ``--lens`` attaches + starts a LensLoop (the
+CAPACITY rows the mesh tier reads); ``--rebalance`` starts the
+ShardRebalancer (post-re-split data motion — every elastic child runs
+it); ``--elastic`` (seed only, implies both) starts the MeshPolicy
+loop that spawns/retires children from live capacity,
+``--elastic-ledger PATH`` archiving its decision ledger at shutdown.
 
 Every process builds the SAME device-ring member set (--members-seed):
 the mesh shards by ROUTE ownership, not ring content, so identical
@@ -45,7 +57,27 @@ def main(argv=None) -> int:
     ap.add_argument("--phi", type=float, default=3.0)
     ap.add_argument("--ctl-capacity", type=int, default=16,
                     help="seed only: control-ring capacity (max peers)")
+    ap.add_argument("--lens", type=int, default=0,
+                    help="attach + start a LensLoop (0/1)")
+    ap.add_argument("--lens-interval-s", type=float, default=0.25)
+    ap.add_argument("--rebalance", type=int, default=0,
+                    help="start the elastic ShardRebalancer (0/1)")
+    ap.add_argument("--elastic", type=int, default=0,
+                    help="seed only: start the elastic MeshPolicy "
+                         "(implies --lens --rebalance) (0/1)")
+    ap.add_argument("--elastic-min-procs", type=int, default=1)
+    ap.add_argument("--elastic-max-procs", type=int, default=4)
+    ap.add_argument("--elastic-interval-s", type=float, default=1.0)
+    ap.add_argument("--elastic-saturate-ticks", type=int, default=3)
+    ap.add_argument("--elastic-idle-ticks", type=int, default=6)
+    ap.add_argument("--elastic-cooldown-ticks", type=int, default=5)
+    ap.add_argument("--elastic-seed", type=int, default=0x0E1A571C)
+    ap.add_argument("--elastic-ledger", default="",
+                    help="archive the decision ledger here at shutdown")
     args = ap.parse_args(argv)
+    if args.elastic:
+        args.lens = 1
+        args.rebalance = 1
 
     import numpy as np
 
@@ -76,9 +108,22 @@ def main(argv=None) -> int:
     install_gateway_handlers(srv, gw)
     srv.run_in_background()
 
+    lens = None
+    if args.lens:
+        from p2p_dhts_tpu.lens import LensLoop
+        lens = LensLoop(gw, interval_s=args.lens_interval_s)
+        gw.attach_lens(lens)
+        lens.start()
+    rebalancer = None
+    if args.rebalance:
+        from p2p_dhts_tpu.elastic import ShardRebalancer
+        rebalancer = ShardRebalancer(gw, plane, ring_id="shard")
+        rebalancer.start()
+
     mgr = None
     coord = None
     peer = None
+    policy = None
     if args.seed is None:
         # THE SEED: a tiny control ring whose members are the mesh
         # peers themselves (SHA1("ip:port") ids), driven by the REAL
@@ -106,6 +151,31 @@ def main(argv=None) -> int:
         coord.register_self()
         mgr.quiesce(max_rounds=8)
         mgr.start()
+        if args.elastic:
+            from p2p_dhts_tpu.elastic import MeshPolicy, PolicyConfig
+            child_args = [
+                "--ring-peers", str(args.ring_peers),
+                "--members-seed", str(args.members_seed),
+                "--store-capacity", str(args.store_capacity),
+                "--smax", str(args.smax),
+                "--bucket-min", str(args.bucket_min),
+                "--bucket-max", str(args.bucket_max),
+                "--heartbeat-s", str(args.heartbeat_s),
+                "--lens", "1", "--rebalance", "1",
+                "--lens-interval-s", str(args.lens_interval_s),
+            ]
+            policy = MeshPolicy(
+                plane, coord, mgr, lens,
+                child_args=child_args,
+                config=PolicyConfig(
+                    saturate_ticks=args.elastic_saturate_ticks,
+                    idle_ticks=args.elastic_idle_ticks,
+                    cooldown_ticks=args.elastic_cooldown_ticks,
+                    min_rings=args.elastic_min_procs,
+                    max_rings=args.elastic_max_procs),
+                seed=args.elastic_seed,
+                interval_s=args.elastic_interval_s)
+            policy.start()
     else:
         from p2p_dhts_tpu.mesh.peer import MeshPeer
         ip, _, port = args.seed.rpartition(":")
@@ -125,9 +195,33 @@ def main(argv=None) -> int:
             line = sys.stdin.readline()
             if not line:
                 break  # parent closed the pipe: graceful shutdown
+            if line.strip() == "RETIRE":
+                # chordax-elastic retire: heartbeats STOP before the
+                # ack so the seed's leave cannot observe a late
+                # heartbeat and auto-rejoin us (the KNOWN:false rule).
+                if peer is not None:
+                    peer.stop()
+                sys.stdout.write("MESH_RETIRING\n")
+                sys.stdout.flush()
+                from p2p_dhts_tpu.elastic import serve_retire
+                drained = serve_retire(plane, peer, rebalancer)
+                sys.stdout.write(f"MESH_DRAINED {drained}\n")
+                sys.stdout.flush()
     except KeyboardInterrupt:
         pass
     finally:
+        if policy is not None:
+            if args.elastic_ledger:
+                try:
+                    policy.ledger.dump(args.elastic_ledger)
+                # chordax-lint: disable=bare-except -- the archive is best-effort; shutdown must proceed
+                except Exception:
+                    pass
+            policy.close()
+        if rebalancer is not None:
+            rebalancer.close()
+        if lens is not None:
+            lens.close()
         if peer is not None:
             peer.close()
         if mgr is not None:

@@ -484,6 +484,92 @@ def test_resplit_retires_peer_telemetry_and_cache(mesh):
         mesh.reset_routes()
 
 
+def test_policy_resplit_loop_retires_cleanly(mesh):
+    """The ISSUE-16 satellite-2 extension of the test above: N
+    policy-driven re-split cycles (peer out, peer back — what an
+    elastic mesh tier does all day) leak NOTHING — no telemetry
+    ghosts, no last-good ghosts, a cache epoch bump per swap,
+    `mesh.peers_retired` counting every drop."""
+    b_str = addr_str(mesh.b.addr)
+    peers_full = {mesh.a.member: mesh.a.addr,
+                  mesh.b.member: mesh.b.addr}
+    try:
+        mesh.a.plane.collect_peer_rows("CAPACITY", {})  # seed last-good
+        assert b_str in mesh.a.plane._last_good
+        retired0 = mesh.a.metrics.counter("mesh.peers_retired")
+        inval0 = mesh.a.metrics.counter("gateway.cache.invalidations")
+        for n in range(1, 6):
+            epoch = mesh.a.plane.routes.epoch + 1
+            mesh.a.plane.apply_routes({mesh.a.member: mesh.a.addr},
+                                      epoch)
+            gauges = mesh.a.metrics.snapshot()["gauges"]
+            assert f"mesh.peer_alive.{b_str}" not in gauges, \
+                f"cycle {n}: departed peer's telemetry survived"
+            assert b_str not in mesh.a.plane._last_good, \
+                f"cycle {n}: departed peer's last-good row survived"
+            mesh.a.plane.apply_routes(dict(peers_full), epoch + 1)
+            gauges = mesh.a.metrics.snapshot()["gauges"]
+            assert gauges.get(f"mesh.peer_alive.{b_str}") == 1.0
+            assert mesh.a.metrics.counter("mesh.peers_retired") == \
+                retired0 + n
+            mesh.a.plane.collect_peer_rows("CAPACITY", {})  # re-seed
+        assert mesh.a.metrics.counter(
+            "gateway.cache.invalidations") >= inval0 + 10, \
+            "every re-split swap must epoch-bump the hot-key cache"
+        alive = [k for k in mesh.a.metrics.snapshot()["gauges"]
+                 if k.startswith("mesh.peer_alive.")]
+        assert sorted(alive) == sorted(
+            f"mesh.peer_alive.{addr_str(a)}"
+            for a in (mesh.a.addr, mesh.b.addr)), \
+            "ghost mesh.peer_alive keys after the re-split loop"
+    finally:
+        mesh.reset_routes()
+
+
+def test_collect_peer_rows_stale_marker(mesh):
+    """ISSUE-16 satellite 1: an unreachable peer's mesh-wide verb row
+    is the TYPED stale marker — STALE:true + ERROR + an age-stamped
+    LAST_GOOD when one exists — never a bare error string, and the
+    policy compacts it to a streak-freezing stale row (missing data
+    is never read as zero capacity). Retiring the peer evicts its
+    last-good row."""
+    import socket
+
+    from p2p_dhts_tpu.elastic import compact_row
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_addr = ("127.0.0.1", s.getsockname()[1])
+    s.close()
+    dead = addr_str(dead_addr)
+    b_str = addr_str(mesh.b.addr)
+    rows = mesh.a.plane.collect_peer_rows("CAPACITY", {})
+    assert b_str in rows and not rows[b_str].get("STALE")
+    stale0 = mesh.a.metrics.counter("mesh.peer_rows_stale")
+    try:
+        mesh.a.plane.apply_routes(
+            {mesh.a.member: mesh.a.addr, mesh.b.member: mesh.b.addr,
+             member_for(dead_addr): dead_addr},
+            mesh.a.plane.routes.epoch + 1)
+        with mesh.a.plane._lock:
+            mesh.a.plane._last_good[dead] = (
+                time.monotonic() - 1.0, {"ATTACHED": False})
+        rows = mesh.a.plane.collect_peer_rows("CAPACITY", {})
+        marker = rows[dead]
+        assert isinstance(marker, dict) and marker.get("STALE") is True
+        assert "ERROR" in marker
+        assert marker.get("AGE_S", 0.0) >= 1.0, marker
+        assert marker.get("LAST_GOOD") == {"ATTACHED": False}
+        assert not rows[b_str].get("STALE"), \
+            "one dead peer must not stale the live peers' rows"
+        assert mesh.a.metrics.counter("mesh.peer_rows_stale") > stale0
+        assert compact_row(marker) == {"saturated": 0, "util": None,
+                                       "stale": True}
+    finally:
+        mesh.reset_routes()
+    assert dead not in mesh.a.plane._last_good, \
+        "retired peer's last-good row survived the re-split"
+
+
 def test_operator_resplit_bumps_generation(mesh):
     """A raw set_key_range the coordinator did not drive is visible:
     the route table's GENERATION moves (MESH_ROUTES shows the
