@@ -82,6 +82,10 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "elastic", "policy.py"),
     os.path.join("p2p_dhts_tpu", "elastic", "actuator.py"),
     os.path.join("p2p_dhts_tpu", "elastic", "mesh.py"),
+    os.path.join("p2p_dhts_tpu", "mesh", "fold.py"),
+    os.path.join("p2p_dhts_tpu", "edge", "routes.py"),
+    os.path.join("p2p_dhts_tpu", "edge", "hedge.py"),
+    os.path.join("p2p_dhts_tpu", "edge", "client.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",

@@ -1,0 +1,30 @@
+"""chordax-edge: the zero-hop client SDK (ISSUE 17).
+
+The mesh (ISSUE 15) made every gateway routing-aware — but the CLIENT
+stayed the reference's one-shot dumb socket, so every cross-shard key
+paid a gateway forward hop the epoch-stamped route table already knew
+how to skip. This package moves ownership resolution to the rim:
+
+  client     edge.Client — the application entry point: resolves each
+             key's owner against the cached route table and sends
+             DIRECTLY to it (zero-hop), folds concurrent bursts per
+             (destination, verb) through the shared mesh/fold.py core,
+             hedges tail reads, and backs off BUSY owners.
+  routes     RouteCache — the client-side epoch-stamped shard ->
+             address table: one MESH_ROUTES pull to seed, NOT_OWNED
+             piggybacked docs to self-heal, epochs never applied
+             backwards.
+  hedge      HedgePolicy — the adaptive per-destination p99 hedge
+             timer + the ~5% fairness budget that keeps hedges from
+             amplifying an overload.
+
+When to use what: `edge.Client` for application traffic against a
+mesh ring (it needs the MESH_ROUTES verb and the one-hop ``FWD``
+protocol); the raw `net/rpc.py` Client for control-plane verbs,
+single-process rings, and anything that must not carry a route cache.
+This package never imports jax.
+"""
+
+from p2p_dhts_tpu.edge.client import Client, EdgeError, EdgeResult  # noqa: F401
+from p2p_dhts_tpu.edge.hedge import HedgePolicy  # noqa: F401
+from p2p_dhts_tpu.edge.routes import RouteCache  # noqa: F401

@@ -1,0 +1,500 @@
+"""edge.Client: the zero-hop, coalescing, hedging client SDK
+(chordax-edge, ISSUE 17 — the tentpole).
+
+One application call runs four planes:
+
+  1. ROUTE — resolve every key's owner against the cached
+     epoch-stamped table (`edge/routes.py`) and send DIRECTLY to it
+     with ``FWD: 1``: the owner answers from local ownership and
+     bounces stale rows NOT_OWNED with its fresher table piggybacked —
+     the client installs it and re-resolves the bounced rows exactly
+     ONCE (the mesh plane's origin discipline, lifted to the rim).
+  2. FOLD — concurrent bursts to the same (destination, verb) ride
+     ONE packed-u128 vector RPC through the shared `mesh/fold.py`
+     core (`edge.*` metrics, `edge.flush` span).
+  3. HEDGE — a read still unanswered past the destination's adaptive
+     p99 timer is re-issued WITHOUT ``FWD`` to an alternate gateway
+     (which serves or forwards under the one-hop rule); first answer
+     wins, the loser is cancelled (its late reply counts
+     `rpc.wire.discarded`), and hedges stay under the ~5% fairness
+     budget (`edge/hedge.py`).
+  4. BACKOFF — a per-destination breaker honoring BUSY sheds and
+     RingBusyError verdicts with jittered doubling cooldowns: rows
+     owned by a shedding/dead gateway fail fast and alone; every
+     other destination's rows are untouched.
+
+`edge.request` is the trace ROOT: the chordax-scope chain of a routed
+read is edge.request -> edge.flush -> rpc.client.<VERB> ->
+rpc.server.<VERB> -> gateway.*, across processes.
+
+LOCK ORDER: `Client._lock` (backoff table) is a LEAF — held for
+state reads/updates only, never across an RPC, a wait, or another
+lock. The hedged send runs entirely lock-free.
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.edge.hedge import HedgePolicy
+from p2p_dhts_tpu.edge.routes import RouteCache
+from p2p_dhts_tpu.keyspace import LANES, ints_to_lanes
+from p2p_dhts_tpu.mesh.fold import FoldCore, FoldError
+from p2p_dhts_tpu.mesh.routes import Addr, addr_str
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client as RpcClient
+from p2p_dhts_tpu.net.rpc import RpcError
+
+#: Consecutive transport failures before a destination's backoff
+#: window opens without a BUSY verdict (a dead owner must fail fast,
+#: not burn one timeout per row-batch).
+BACKOFF_THRESHOLD = 3
+
+#: Jittered backoff window base/cap (doubles per consecutive open).
+BACKOFF_BASE_S = 0.05
+BACKOFF_CAP_S = 2.0
+
+#: Private jitter stream (never the seeded global RNG — backoff noise
+#: must not perturb seeded test/bench schedules).
+_JITTER = random.Random()
+
+
+class EdgeError(FoldError):
+    """An edge request failed for (some of) its rows."""
+
+
+class EdgeResult:
+    """Row-aligned answers for one edge vector call. `failed` marks
+    rows that carry no answer; `errors` maps "ip:port" -> message for
+    every destination that failed (one dead owner fails only its
+    rows)."""
+
+    __slots__ = ("owners", "hops", "segments", "ok", "failed",
+                 "errors")
+
+    def __init__(self, n: int, verb: str) -> None:
+        self.owners = (np.full(n, -1, np.int64)
+                       if verb == "FIND_SUCCESSOR" else None)
+        self.hops = (np.full(n, -1, np.int32)
+                     if verb == "FIND_SUCCESSOR" else None)
+        self.segments: Optional[List] = ([None] * n if verb == "GET"
+                                         else None)
+        self.ok = (np.zeros(n, dtype=bool) if verb == "GET" else None)
+        self.failed = np.zeros(n, dtype=bool)
+        self.errors: Dict[str, str] = {}
+
+    @property
+    def all_ok(self) -> bool:
+        return not self.failed.any()
+
+
+class _Backoff:
+    """One destination's breaker row (client-lock guarded)."""
+
+    __slots__ = ("fails", "until", "opens")
+
+    def __init__(self) -> None:
+        self.fails = 0
+        self.until = 0.0
+        self.opens = 0
+
+
+class _EdgeCoalescer(FoldCore):
+    """The rim identity of the shared fold core: `edge.*` metric keys,
+    `edge.flush` spans, `edge-*` lane threads, and the hedged/
+    breaker-guarded transport owned by the Client."""
+
+    error_cls = EdgeError
+    closed_msg = "edge client closed"
+    span_name = "edge.flush"
+    span_cat = "edge"
+    thread_prefix = "edge"
+
+    def __init__(self, owner: "Client", metrics: Optional[Metrics],
+                 max_batch: int, retries: int):
+        super().__init__(metrics=metrics, max_batch=max_batch,
+                         retries=retries)
+        self.owner = owner
+
+    # -- metric identity (LITERAL keys — the doc-drift gate scans these) -----
+    def _record_flush(self, n_keys: int, folded: int) -> None:
+        self.metrics.inc("edge.batches")
+        self.metrics.observe_hist("edge.batch_size", n_keys)
+        if folded > 1:
+            self.metrics.inc("edge.coalesced", folded - 1)
+
+    def _record_error(self) -> None:
+        self.metrics.inc("edge.errors")
+
+    def _record_latency(self, dt: float) -> None:
+        self.metrics.observe("edge.latency", dt)
+
+    def _record_not_owner(self, k: int) -> None:
+        self.metrics.inc("edge.not_owner", k)
+
+    def _transport(self, dest: Tuple[str, int], verb: str, req: dict,
+                   timeout: float,
+                   deadline_at: Optional[float]) -> dict:
+        return self.owner._send(dest, verb, req, timeout, deadline_at)
+
+
+class Client:
+    """The zero-hop client: route-cached, coalescing, hedging,
+    backing off. One instance is a process-wide rim (thread-safe);
+    `close()` drains the fold lanes."""
+
+    def __init__(self, gateways: Sequence[Addr], *,
+                 metrics: Optional[Metrics] = None,
+                 max_batch: int = 4096, coalesce: bool = True,
+                 retries: int = 1,
+                 hedge: Optional[HedgePolicy] = None,
+                 hedge_enabled: bool = True,
+                 pull_timeout_s: float = 5.0):
+        self.metrics = metrics if metrics is not None else METRICS
+        self.routes = RouteCache(gateways, metrics=self.metrics,
+                                 pull_timeout_s=pull_timeout_s)
+        self.hedge = hedge if hedge is not None else HedgePolicy(
+            metrics=self.metrics, enabled=hedge_enabled)
+        self._fold = _EdgeCoalescer(self, self.metrics,
+                                    max_batch if coalesce else 1,
+                                    retries)
+        self._lock = threading.Lock()   # LEAF: the backoff table
+        self._backoff: Dict[Tuple[str, int], _Backoff] = {}
+
+    # -- public API ----------------------------------------------------------
+    def find_successor(self, keys, starts=None,
+                       deadline_ms: Optional[float] = None
+                       ) -> EdgeResult:
+        """Vector FIND_SUCCESSOR, client-routed: owners/hops row-
+        aligned with `keys` ([N, LANES] uint32 lanes or a sequence of
+        ints)."""
+        return self._vector("FIND_SUCCESSOR", keys, starts,
+                            deadline_ms)
+
+    def get(self, keys,
+            deadline_ms: Optional[float] = None) -> EdgeResult:
+        """Vector DHash GET, client-routed: segments/ok row-aligned
+        with `keys`."""
+        return self._vector("GET", keys, None, deadline_ms)
+
+    def set_coalesce(self, on: bool) -> None:
+        """The SET_COALESCE A/B knob, client-side."""
+        self._fold.set_coalesce(on)
+
+    def close(self) -> None:
+        self._fold.close()
+
+    # -- the routed vector path ----------------------------------------------
+    @staticmethod
+    def _as_lanes(keys) -> np.ndarray:
+        if isinstance(keys, np.ndarray) and keys.ndim == 2 \
+                and keys.shape[1] == LANES:
+            return np.ascontiguousarray(keys, dtype=np.uint32)
+        return ints_to_lanes(int(k) for k in keys)
+
+    def _vector(self, verb: str, keys, starts,
+                deadline_ms: Optional[float]) -> EdgeResult:
+        lanes = self._as_lanes(keys)
+        n = lanes.shape[0]
+        starts_arr = (None if starts is None
+                      else np.ascontiguousarray(starts, np.int32))
+        deadline_at = (time.perf_counter() + float(deadline_ms) / 1e3
+                       if deadline_ms is not None else None)
+        self.metrics.inc("edge.requests")
+        self.metrics.inc("edge.keys", n)
+        self.hedge.note_request()
+        out = EdgeResult(n, verb)
+        if n == 0:
+            return out
+        # The ROOT span of the cross-process chain: edge.request ->
+        # edge.flush -> rpc.client.<VERB> -> rpc.server.<VERB> -> ...
+        with trace_mod.span("edge.request", cat="edge", verb=verb,
+                            n=n):
+            plan = self.routes.resolve(lanes)
+            if not plan:
+                raise EdgeError("route cache is empty (no mesh?)")
+            if len(plan) == 1:
+                addr, rows = plan[0]
+                self._dest_rows(verb, addr, lanes, starts_arr, rows,
+                                deadline_at, out)
+            else:
+                # Destinations run CONCURRENTLY: the call costs
+                # max(owner latency), never the sum — and each
+                # worker's fold entry still coalesces with every
+                # other caller's burst to that destination.
+                from concurrent.futures import ThreadPoolExecutor
+                ctx = trace_mod.current_raw()
+
+                def one(item):
+                    addr, rows = item
+                    with trace_mod.activate(ctx):
+                        self._dest_rows(verb, addr, lanes, starts_arr,
+                                        rows, deadline_at, out)
+
+                with ThreadPoolExecutor(
+                        max_workers=min(len(plan), 8),
+                        thread_name_prefix="edge-vec") as pool:
+                    list(pool.map(one, plan))
+        return out
+
+    def _dest_rows(self, verb: str, addr: Addr, lanes: np.ndarray,
+                   starts: Optional[np.ndarray], rows: np.ndarray,
+                   deadline_at: Optional[float],
+                   out: EdgeResult) -> None:
+        """One destination's rows: fold-forward, then at most ONE
+        install-and-re-resolve of whatever bounced NOT_OWNED. Writes
+        into `out` row-slices are disjoint per destination — no lock
+        needed."""
+        sub_lanes = lanes[rows]
+        sub_starts = starts[rows] if starts is not None else None
+        try:
+            res = self._fold.forward(addr, verb, sub_lanes, sub_starts,
+                                     deadline_at)
+        # chordax-lint: disable=bare-except -- one dead owner fails only its rows; every other destination's answers stand
+        except Exception as exc:
+            out.failed[rows] = True
+            out.errors[addr_str(addr)] = str(exc)
+            return
+        self._merge(verb, out, rows, res, exclude=res.not_owned)
+        self.routes.observe_epoch(res.routes_epoch, addr)
+        if not res.not_owned:
+            return
+        # The owner's table is fresher: install the piggybacked doc,
+        # re-resolve the bounced rows ONCE. A row that bounces again
+        # (or re-resolves to the SAME stale owner) fails — route churn
+        # faster than one refresh round is the caller's retry.
+        self.metrics.inc("edge.retries")
+        if res.routes_doc is not None:
+            self.routes.install_doc(res.routes_doc)
+        bounced = rows[np.asarray(sorted(res.not_owned), np.int64)]
+        out.failed[bounced] = True
+        replan = self.routes.table.split_lanes_all(lanes[bounced])
+        for new_addr, rr in replan:
+            j = bounced[rr]
+            if new_addr == addr:
+                out.errors[addr_str(addr)] = (
+                    f"owner {addr_str(addr)} bounced {len(rr)} key(s) "
+                    f"it still maps to itself")
+                continue
+            try:
+                res2 = self._fold.forward(
+                    new_addr, verb, lanes[j],
+                    starts[j] if starts is not None else None,
+                    deadline_at)
+            # chordax-lint: disable=bare-except -- the single retry's failure stays a per-row verdict, never a client crash
+            except Exception as exc:
+                out.errors[addr_str(new_addr)] = str(exc)
+                continue
+            still = set(res2.not_owned)
+            live = np.asarray([i for i in range(len(rr))
+                               if i not in still], np.int64)
+            self._merge(verb, out, j[live], res2, rows_slice=live)
+            out.failed[j[live]] = False
+            if still:
+                out.errors[addr_str(new_addr)] = (
+                    f"{len(still)} key(s) still unowned after one "
+                    f"re-resolution (route churn)")
+
+    @staticmethod
+    def _merge(verb: str, out: EdgeResult, at: np.ndarray, res,
+               exclude: Sequence[int] = (),
+               rows_slice: Optional[np.ndarray] = None) -> None:
+        """Copy one FoldResult (or its `rows_slice` subset) into the
+        result rows `at`, skipping `exclude` (entry-relative bounced
+        indices)."""
+        if exclude:
+            keep = np.asarray([i for i in range(len(at))
+                               if i not in set(exclude)], np.int64)
+            at = at[keep]
+            src = keep
+        elif rows_slice is not None:
+            src = rows_slice
+        else:
+            src = np.arange(len(at))
+        if len(at) == 0:
+            return
+        if verb == "FIND_SUCCESSOR":
+            out.owners[at] = np.asarray(res.owners)[src]
+            out.hops[at] = np.asarray(res.hops)[src]
+        else:
+            out.ok[at] = np.asarray(res.ok)[src]
+            for i, j in zip(src, at):
+                out.segments[int(j)] = res.segments[int(i)]
+
+    # -- backoff (BUSY / RingBusyError / dead-owner breaker) -----------------
+    def _backoff_admit(self, dest: Tuple[str, int]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            b = self._backoff.get(dest)
+            blocked = b is not None and now < b.until
+        if blocked:
+            self.metrics.inc("edge.backoff.fastfail")
+            raise EdgeError(
+                f"destination {dest[0]}:{dest[1]} backing off "
+                f"(BUSY/unreachable); retry after the window")
+
+    def _backoff_ok(self, dest: Tuple[str, int]) -> None:
+        with self._lock:
+            self._backoff.pop(dest, None)
+
+    def _backoff_fail(self, dest: Tuple[str, int],
+                      busy: bool) -> None:
+        """A BUSY/RingBusyError verdict opens the window immediately
+        (the server TOLD us to go away); plain transport failures
+        open it after BACKOFF_THRESHOLD in a row."""
+        if busy:
+            self.metrics.inc("edge.backoff.busy")
+        with self._lock:
+            b = self._backoff.setdefault(dest, _Backoff())
+            b.fails += 1
+            if not busy and b.fails < BACKOFF_THRESHOLD:
+                return
+            b.opens += 1
+            base = min(BACKOFF_BASE_S * (2 ** (b.opens - 1)),
+                       BACKOFF_CAP_S)
+            # Jittered: N clients shed by the same gateway must not
+            # come back in lockstep (the retry-storm rule).
+            b.until = time.monotonic() + _JITTER.uniform(
+                base * 0.5, base)
+        self.metrics.inc("edge.backoff.open")
+
+    @staticmethod
+    def _is_busy_error(exc: BaseException) -> bool:
+        """A shed verdict: the RPC BUSY envelope ("RPC server busy")
+        or a RingBusyError the owner folded into its ERRORS reply."""
+        msg = str(exc)
+        return "busy" in msg.lower()
+
+    # -- the guarded/hedged send (the fold core's transport) -----------------
+    def _send(self, dest: Tuple[str, int], verb: str, req: dict,
+              timeout: float, deadline_at: Optional[float]) -> dict:
+        self._backoff_admit(dest)
+        delay = self.hedge.delay_s(dest)
+        try:
+            if delay is None or delay >= timeout:
+                resp = RpcClient.make_request(
+                    dest[0], dest[1], req, timeout=timeout,
+                    retries=self._fold.retries, deadline=deadline_at)
+            else:
+                resp = self._send_hedged(dest, verb, req, timeout,
+                                         delay)
+        # chordax-lint: disable=bare-except -- every failure shape feeds the breaker verdict before re-raising to the fold funnel
+        except Exception as exc:
+            self._backoff_fail(dest, busy=self._is_busy_error(exc))
+            raise
+        if not resp.get("SUCCESS") and \
+                "busy" in str(resp.get("ERRORS", "")).lower():
+            # The owner answered, but with a RingBusyError verdict:
+            # an admission shed, not a route problem — open the
+            # window so this destination's next rows fail fast.
+            self._backoff_fail(dest, busy=True)
+        else:
+            self._backoff_ok(dest)
+        return resp
+
+    def _alternate(self, dest: Tuple[str, int]
+                   ) -> Optional[Tuple[str, int]]:
+        """The hedge target: the next route-table gateway after
+        `dest` (id order) that is not itself backing off."""
+        addrs = self.routes.addresses()
+        if len(addrs) < 2:
+            return None
+        try:
+            i = addrs.index((str(dest[0]), int(dest[1])))
+        except ValueError:
+            i = -1
+        now = time.monotonic()
+        for k in range(1, len(addrs)):
+            cand = addrs[(i + k) % len(addrs)]
+            if cand == dest:
+                continue
+            with self._lock:
+                b = self._backoff.get(cand)
+                blocked = b is not None and now < b.until
+            if not blocked:
+                return cand
+        return None
+
+    def _send_hedged(self, dest: Tuple[str, int], verb: str,
+                     req: dict, timeout: float,
+                     delay: float) -> dict:
+        """Primary to the owner (FWD), and — past the adaptive timer,
+        budget permitting — a hedge WITHOUT FWD to an alternate
+        gateway. First answer wins; the loser is cancelled and its
+        late reply counts `rpc.wire.discarded`. Legacy (JSON-only)
+        destinations fall back to the plain blocking path: hedging
+        needs the pipelined binary wire."""
+        deadline = time.perf_counter() + timeout
+        # Mirror rpc.Client.make_request: this span is the wire-level
+        # client span, and ITS context rides the TRACE field (an
+        # unsampled root rides the explicit not-sampled marker).
+        with trace_mod.span(f"rpc.client.{verb}", cat="rpc",
+                            peer=f"{dest[0]}:{dest[1]}",
+                            hedged=1) as span_ctx:
+            wire_req = dict(req)
+            if span_ctx is not None:
+                wire_req[trace_mod.WIRE_KEY] = span_ctx.to_wire()
+            elif trace_mod.enabled():
+                wire_req[trace_mod.WIRE_KEY] = \
+                    trace_mod.UNSAMPLED_WIRE
+            try:
+                primary = wire.submit(dest[0], dest[1], wire_req)
+            except wire.NegotiationFallback:
+                return RpcClient.make_request(
+                    dest[0], dest[1], req, timeout=timeout,
+                    retries=self._fold.retries)
+            if primary.wait_done(min(delay, timeout)):
+                return self._settle(primary, deadline)
+            # Timer passed with no answer: hedge if an alternate
+            # exists and the fairness budget admits it.
+            alt = self._alternate(dest)
+            if alt is None or not self.hedge.admit():
+                return self._settle(primary, deadline)
+            self.metrics.inc("edge.hedges")
+            hedge_req = dict(wire_req)
+            hedge_req.pop("FWD", None)   # the alternate may forward
+            try:
+                rival = wire.submit(alt[0], alt[1], hedge_req)
+            except (wire.NegotiationFallback, OSError,
+                    RuntimeError):
+                return self._settle(primary, deadline)
+            # First answer wins. The poll alternates short waits on
+            # the two events; 1 ms granularity is far below any
+            # latency a hedge fires at.
+            while time.perf_counter() < deadline:
+                if primary.done():
+                    rival.cancel()
+                    return self._settle(primary, deadline)
+                if rival.done():
+                    primary.cancel()
+                    self.metrics.inc("edge.hedge_wins")
+                    return self._settle(rival, deadline)
+                primary.wait_done(0.001)
+                rival.wait_done(0.001)
+            rival.cancel()
+            return self._settle(primary, deadline)  # raises timeout
+
+    @staticmethod
+    def _settle(call: "wire.PendingCall", deadline: float) -> dict:
+        """Consume one pending call's reply, translating transport
+        and BUSY-envelope failures exactly as the rpc client does."""
+        try:
+            resp = call.wait(max(deadline - time.perf_counter(),
+                                 0.001))
+        except TimeoutError as exc:
+            raise RpcError(f"RPC reply timed out: {exc}") from exc
+        except (OSError, RuntimeError) as exc:
+            raise RpcError(f"RPC transport failure: {exc}") from exc
+        if resp.get("BUSY"):
+            METRICS.inc("rpc.client.busy")
+            raise RpcError(
+                "RPC server busy (connection flow-control shed)")
+        return resp

@@ -1,0 +1,103 @@
+"""The edge hedge policy: adaptive per-destination tail timers under a
+global fairness budget (chordax-edge, ISSUE 17 — the tail half).
+
+A read whose primary gateway is having a bad moment (GC pause, queue
+convoy, one slow device step) can be answered sooner by ANY other
+gateway — under the one-hop rule an alternate either serves the keys
+or forwards them once. The policy decides WHEN re-issuing is worth it
+and HOW MUCH of it the fleet can afford:
+
+  * TIMER — hedge only after the destination's observed p99 (the wire
+    pool's per-destination latency reservoir, `dest_snapshot`), so a
+    healthy destination is never hedged on the common path. Before
+    enough samples exist the timer falls back to a configured floor —
+    the policy never hedges blind below it.
+  * BUDGET — hedges are admitted against a running ~5% fairness cap
+    of REQUESTS SEEN (`ratio`): at most one hedge per 1/ratio
+    requests, so hedging can never amplify an overload into a retry
+    storm. Denials are counted, not queued.
+
+LOCK ORDER: `HedgePolicy._lock` is a LEAF — pure counter bookkeeping,
+never held across an RPC or a snapshot call.
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net import wire
+
+#: Default fairness cap: hedged traffic <= 5% of requests (the ISSUE
+#: 17 acceptance bound).
+DEFAULT_HEDGE_RATIO = 0.05
+
+#: Timer floor (ms) — also the fallback while the destination's
+#: latency reservoir is still filling.
+DEFAULT_FLOOR_MS = 25.0
+
+#: Reservoir samples required before the adaptive p99 takes over from
+#: the floor.
+DEFAULT_MIN_SAMPLES = 32
+
+
+class HedgePolicy:
+    """Per-destination hedge timers + the global hedge budget."""
+
+    def __init__(self, metrics: Optional[Metrics] = None,
+                 ratio: float = DEFAULT_HEDGE_RATIO,
+                 floor_ms: float = DEFAULT_FLOOR_MS,
+                 min_samples: int = DEFAULT_MIN_SAMPLES,
+                 enabled: bool = True):
+        self.metrics = metrics if metrics is not None else METRICS
+        self.ratio = float(ratio)
+        self.floor_ms = float(floor_ms)
+        self.min_samples = int(min_samples)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()   # LEAF: budget counters only
+        self._requests = 0
+        self._hedges = 0
+
+    # -- introspection -------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"requests": self._requests, "hedges": self._hedges,
+                    "ratio": self.ratio, "enabled": self.enabled}
+
+    # -- the timer -----------------------------------------------------------
+    def delay_s(self, dest: Tuple[str, int]) -> Optional[float]:
+        """Seconds to wait on the primary before re-issuing, or None
+        when hedging is off. Adaptive: the destination's observed p99
+        once the reservoir holds `min_samples`, the floor before
+        that — and never below the floor (a sub-floor p99 means the
+        destination is fast; hedging it would be pure amplification)."""
+        if not self.enabled:
+            return None
+        snap = wire.pool().dest_snapshot(dest[0], dest[1])
+        p99 = snap.get("p99_ms")
+        if p99 is None or snap.get("samples", 0) < self.min_samples:
+            return self.floor_ms / 1e3
+        return max(float(p99), self.floor_ms) / 1e3
+
+    # -- the budget ----------------------------------------------------------
+    def note_request(self) -> None:
+        """Every edge request feeds the fairness denominator."""
+        with self._lock:
+            self._requests += 1
+
+    def admit(self) -> bool:
+        """Claim one hedge against the budget: admitted while hedges
+        (including this one) stay within `ratio` of requests seen.
+        A denial is final for this request — denials count
+        `edge.hedge_capped`, they are never queued."""
+        with self._lock:
+            if (self._hedges + 1) <= self.ratio * self._requests:
+                self._hedges += 1
+                admitted = True
+            else:
+                admitted = False
+        if not admitted:
+            self.metrics.inc("edge.hedge_capped")
+        return admitted

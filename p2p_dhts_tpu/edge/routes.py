@@ -1,0 +1,149 @@
+"""The edge route cache: a client-side, epoch-stamped shard -> address
+table (chordax-edge, ISSUE 17 — the zero-hop half).
+
+The cache IS a `mesh.routes.RouteTable` with no self address (every
+row resolves REMOTE — the rim is not a mesh peer), plus the client's
+lifecycle around it:
+
+  * SEED — one MESH_ROUTES pull from any configured gateway the first
+    time a key needs resolving (lazy; a client that never sends never
+    pulls);
+  * SELF-HEAL — a NOT_OWNED bounce carries the owner's fresher table
+    piggybacked (`install_doc`), and every mesh vector reply carries
+    the serving process's ROUTES_EPOCH so a stale cache re-pulls even
+    when its keys happened to land right (`observe_epoch`);
+  * MONOTONIC — installs go through the table's epoch guard: stale
+    gossip is dropped, never applied backwards.
+
+Convergence contract (the bench gate): an operator re-split costs each
+client at most ONE refresh round — the first bounced (or beaconed)
+request installs the new table, every later resolve is zero-hop again.
+
+LOCK ORDER: `RouteCache._lock` is a LEAF guarding refresh bookkeeping
+only — never held across the MESH_ROUTES RPC (the pull runs unlocked;
+the epoch guard makes concurrent pulls converge).
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu.mesh.routes import Addr, RouteTable, addr_str
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net.rpc import Client, RpcError
+
+
+class RouteCacheError(RuntimeError):
+    """No gateway would serve MESH_ROUTES (cache cannot seed)."""
+
+
+class RouteCache:
+    """Client-side route table + its pull/install/observe lifecycle."""
+
+    def __init__(self, gateways: Sequence[Addr],
+                 metrics: Optional[Metrics] = None,
+                 pull_timeout_s: float = 5.0):
+        if not gateways:
+            raise ValueError("RouteCache needs at least one gateway")
+        self.gateways: List[Addr] = [(str(g[0]), int(g[1]))
+                                     for g in gateways]
+        self.metrics = metrics if metrics is not None else METRICS
+        self.pull_timeout_s = float(pull_timeout_s)
+        self.table = RouteTable()          # self_addr=None: all-remote
+        self._lock = threading.Lock()      # LEAF: counters/rotation only
+        self._pull_rr = 0                  # seed-gateway rotation cursor
+        self._refreshes = 0
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self.table.epoch
+
+    @property
+    def refreshes(self) -> int:
+        """MESH_ROUTES pulls performed (the convergence gate counts
+        these: one re-split must cost at most one per client)."""
+        with self._lock:
+            return self._refreshes
+
+    def addresses(self) -> List[Addr]:
+        """Route-table gateways when seeded, the configured seed list
+        before that (the hedger needs an alternate either way)."""
+        addrs = self.table.addresses()
+        return addrs if addrs else list(self.gateways)
+
+    # -- lifecycle -----------------------------------------------------------
+    def install_doc(self, doc: dict) -> bool:
+        """Install a piggybacked MESH_ROUTES document (a NOT_OWNED
+        bounce's fresher table). Epoch-guarded: returns True only when
+        it was NEWER."""
+        if self.table.apply_doc(doc):
+            self.metrics.inc("edge.routes_installed")
+            self.metrics.gauge("edge.route_epoch", self.table.epoch)
+            return True
+        return False
+
+    def refresh(self, via: Optional[Addr] = None) -> bool:
+        """One MESH_ROUTES pull — from `via` (the gateway whose reply
+        told us we are stale) or the rotating seed list. Runs entirely
+        unlocked; the table's epoch guard serializes installs."""
+        candidates: List[Addr] = []
+        if via is not None:
+            candidates.append((str(via[0]), int(via[1])))
+        with self._lock:
+            rr = self._pull_rr
+            self._pull_rr += 1
+            self._refreshes += 1
+        known = self.addresses()
+        candidates.extend(known[(rr + i) % len(known)]
+                          for i in range(len(known)))
+        self.metrics.inc("edge.routes_refreshed")
+        last_err: Optional[str] = None
+        for addr in candidates:
+            try:
+                resp = Client.make_request(
+                    addr[0], addr[1], {"COMMAND": "MESH_ROUTES"},
+                    timeout=self.pull_timeout_s)
+            except RpcError as exc:
+                last_err = f"{addr_str(addr)}: {exc}"
+                continue
+            if not resp.get("SUCCESS") or not resp.get("ATTACHED"):
+                last_err = f"{addr_str(addr)}: no mesh plane attached"
+                continue
+            fresher = self.table.apply_doc(resp)
+            self.metrics.gauge("edge.route_epoch", self.table.epoch)
+            return fresher
+        raise RouteCacheError(
+            f"MESH_ROUTES pull failed everywhere (last: {last_err})")
+
+    def ensure(self) -> None:
+        """Seed the cache (one pull) if it has never installed a map."""
+        if len(self.table) == 0:
+            self.refresh()
+
+    def observe_epoch(self, seen_epoch: Optional[int],
+                      via: Addr) -> None:
+        """A reply carried the serving process's ROUTES_EPOCH: when it
+        is ahead of ours, pull its table — the staleness beacon that
+        heals a cache whose keys happened to land right anyway."""
+        if seen_epoch is None:
+            return
+        if int(seen_epoch) > self.table.epoch:
+            self.metrics.inc("edge.route_stale")
+            try:
+                self.refresh(via=via)
+            except RouteCacheError:
+                pass  # the next bounce (or beacon) retries the pull
+
+    # -- resolution ----------------------------------------------------------
+    def resolve(self, lanes: np.ndarray
+                ) -> List[Tuple[Addr, np.ndarray]]:
+        """Owner split for a whole [N, LANES] key array — seeds the
+        cache on first use; every row resolves to a gateway address
+        (the all-remote rim split)."""
+        self.ensure()
+        return self.table.split_lanes_all(lanes)

@@ -1169,8 +1169,15 @@ class Gateway:
                 lanes = _lift_key_lanes(req["KEYS"])
             if lanes is not None:
                 if mesh is not None:
-                    return mesh.find_successor_vector(req, lanes, dl,
-                                                      fwd)
+                    out = mesh.find_successor_vector(req, lanes, dl,
+                                                     fwd)
+                    # chordax-edge (ISSUE 17): every mesh vector reply
+                    # carries the serving process's route epoch — the
+                    # heartbeat piggyback rule applied to the data
+                    # path, so a route-caching client detects a stale
+                    # table without waiting for a NOT_OWNED bounce.
+                    out["ROUTES_EPOCH"] = mesh.routes.epoch
+                    return out
                 # chordax-fastlane: the binary transport's packed u128
                 # run flows to the device as ONE lane-array view —
                 # zero per-key python on this path (guarded by test).
@@ -1355,7 +1362,11 @@ class Gateway:
                 lanes = _lift_key_lanes(req["KEYS"])
             if lanes is not None:
                 if mesh is not None:
-                    return mesh.get_vector(lanes, dl, fwd)
+                    out = mesh.get_vector(lanes, dl, fwd)
+                    # Route-epoch piggyback on the vector data path
+                    # (chordax-edge, ISSUE 17 — see FIND_SUCCESSOR).
+                    out["ROUTES_EPOCH"] = mesh.routes.epoch
+                    return out
                 return self._handle_get_fast(lanes, ring_id, dl)
             keys = [_key_int(k) for k in req["KEYS"]]
             if not keys:

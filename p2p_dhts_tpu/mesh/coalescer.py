@@ -5,35 +5,21 @@ A mesh gateway that merely proxied every cross-shard key as its own RPC
 would pay one frame encode/decode + one handler dispatch + one engine
 slot PER KEY — the exact per-request overhead chordax-wire/fastlane
 spent three PRs amortizing away. This module folds concurrent misses to
-the SAME destination into ONE packed-u128 KEYS-vector RPC instead:
+the SAME destination into ONE packed-u128 KEYS-vector RPC instead.
 
-  * every forward (a single-key miss OR a whole vector run) enqueues on
-    its (destination, verb) lane and waits on its own waiter;
-  * one worker per lane drains everything queued — while one RPC is in
-    flight, new arrivals pile up and ride the NEXT flush, so load
-    coalesces naturally with ZERO added latency when idle (the serve
-    engine's adaptive-window shape, applied to forwarding);
-  * the batch rides the pooled/pipelined binary transport as packed
-    little-endian u128 runs (`wire.U128Keys.from_lanes` — the fastlane
-    zero-copy lane format END-TO-END: wire bytes at the origin ARE the
-    device layout at the owner);
-  * DEADLINE_MS is the MINIMUM remaining budget across the folded
-    entries (already-expired entries are failed before the flush, and
-    the min keeps one impatient caller from widening anyone's bound);
-  * the chordax-scope trace context of the FIRST folded entry rides the
-    batch (one RPC carries one root), so a solo forwarded request keeps
-    its unbroken rpc.client -> rpc.server -> gateway -> forward ->
-    rpc.client -> rpc.server chain and a folded batch records how many
-    strangers shared the frame;
-  * BUSY shed replies and breaker fast-fails surface as the transport
-    RpcError every entry's waiter receives — the caller's retry policy
-    (gateway not-owner refresh, bench failover) owns what happens next.
+Since ISSUE 17 the fold/flush engine itself lives in `mesh/fold.py`
+(the chordax-edge client rim shares it verbatim); this module is the
+GATEWAY identity of that core — the `gateway.forward.*` metric keys,
+the `mesh.forward` span, the `mesh-fwd-*` lane threads, and the plain
+`Client.make_request` transport. See fold.py for the shared rules
+(lane workers, min-deadline folding, first-entry trace root, the
+one-hop ``FWD: 1`` / ``NOT_OWNED`` protocol).
 
-One-hop rule: a forwarded request carries ``FWD: 1`` and the OWNER
-answers it from local ownership only — keys the owner no longer owns
-come back in ``NOT_OWNED`` (with the owner's fresher route table
-piggybacked) instead of being forwarded onward. The coalescer reports
-those rows per entry; the mesh plane owns the single refresh-and-retry.
+BUSY shed replies and breaker fast-fails surface as the transport
+RpcError every entry's waiter receives — the caller's retry policy
+(gateway not-owner refresh, bench failover) owns what happens next.
+The coalescer reports NOT_OWNED rows per entry; the mesh plane owns
+the single refresh-and-retry.
 
 LOCK ORDER: `_Lane._lock` and `ForwardCoalescer._lock` are LEAVES —
 held only for queue/table bookkeeping, never across the RPC, an
@@ -43,358 +29,50 @@ This module never imports jax.
 
 from __future__ import annotations
 
-import threading
-import time
-from typing import Dict, List, Optional, Tuple
-
-import numpy as np
-
-from p2p_dhts_tpu import trace as trace_mod
-from p2p_dhts_tpu.metrics import METRICS, Metrics
-from p2p_dhts_tpu.net import wire
-from p2p_dhts_tpu.net.rpc import Client, RpcError
+from p2p_dhts_tpu.mesh.fold import (DEFAULT_FOLD_WAIT_S, FOLD_VERBS,
+                                    FoldCore, FoldError, FoldResult)
 
 #: Verbs the coalescer knows how to batch (KEYS-vector read forms).
-FORWARD_VERBS = ("FIND_SUCCESSOR", "GET")
+FORWARD_VERBS = FOLD_VERBS
 
 #: Forward wait bound when the caller set no deadline (the gateway's
 #: DEFAULT_WAIT_S rule: a forward must never park a worker forever).
-DEFAULT_FORWARD_WAIT_S = 60.0
+DEFAULT_FORWARD_WAIT_S = DEFAULT_FOLD_WAIT_S
 
 
-class ForwardError(RuntimeError):
+class ForwardError(FoldError):
     """The forwarded batch failed at the transport or the owner."""
 
 
-class ForwardResult:
-    """One entry's slice of a flushed batch: the per-row result arrays
-    plus the owner's not-owned verdicts and piggybacked routes."""
-
-    __slots__ = ("owners", "hops", "segments", "ok", "not_owned",
-                 "routes_doc")
-
-    def __init__(self) -> None:
-        self.owners: Optional[np.ndarray] = None
-        self.hops: Optional[np.ndarray] = None
-        self.segments = None          # stacked array or per-row list
-        self.ok: Optional[np.ndarray] = None
-        self.not_owned: List[int] = []    # row indices WITHIN the entry
-        self.routes_doc: Optional[dict] = None
+#: One entry's slice of a flushed batch (fold.py owns the shape).
+ForwardResult = FoldResult
 
 
-class _Entry:
-    __slots__ = ("lanes", "starts", "deadline_at", "ctx", "ev",
-                 "result", "error", "t0")
+class ForwardCoalescer(FoldCore):
+    """Per-destination micro-batching front for cross-shard forwards:
+    the gateway-side identity of the shared `FoldCore`."""
 
-    def __init__(self, lanes: np.ndarray, starts: Optional[np.ndarray],
-                 deadline_at: Optional[float], ctx) -> None:
-        self.lanes = lanes
-        self.starts = starts
-        self.deadline_at = deadline_at
-        self.ctx = ctx
-        self.ev = threading.Event()
-        self.result: Optional[ForwardResult] = None
-        self.error: Optional[BaseException] = None
-        self.t0 = time.perf_counter()
+    error_cls = ForwardError
+    closed_msg = "forward coalescer closed"
+    span_name = "mesh.forward"
+    span_cat = "mesh"
+    thread_prefix = "mesh-fwd"
+    verbs = FORWARD_VERBS
+    default_wait_s = DEFAULT_FORWARD_WAIT_S
 
-
-class _Lane:
-    """One (destination, verb) queue + its drain worker."""
-
-    def __init__(self, owner: "ForwardCoalescer",
-                 dest: Tuple[str, int], verb: str):
-        self.owner = owner
-        self.dest = dest
-        self.verb = verb
-        self._lock = threading.Lock()
-        self._queue: List[_Entry] = []
-        self._event = threading.Event()
-        self._closed = False
-        self.thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"mesh-fwd-{dest[0]}:{dest[1]}-{verb}")
-        self.thread.start()
-
-    def enqueue(self, entry: _Entry) -> None:
-        with self._lock:
-            if self._closed:
-                entry.error = ForwardError("forward coalescer closed")
-                entry.ev.set()
-                return
-            self._queue.append(entry)
-        self._event.set()
-
-    def close(self) -> None:
-        with self._lock:
-            self._closed = True
-            drained = list(self._queue)
-            self._queue.clear()
-        for e in drained:
-            e.error = ForwardError("forward coalescer closed")
-            e.ev.set()
-        self._event.set()
-
-    def _run(self) -> None:
-        while True:
-            self._event.wait(timeout=0.5)
-            with self._lock:
-                if self._closed and not self._queue:
-                    return
-                batch = self._queue[:self.owner.max_batch]
-                del self._queue[:len(batch)]
-                if not self._queue:
-                    self._event.clear()
-            if batch:
-                if self.owner.max_batch == 1:
-                    # The PER-KEY-FORWARD baseline (coalescing off):
-                    # one RPC per ROW — what a naive proxy loop does,
-                    # and what the bench gates the coalescer against.
-                    for e in batch:
-                        self.owner._flush_per_key(self.dest,
-                                                  self.verb, e)
-                else:
-                    self.owner._flush(self.dest, self.verb, batch)
-
-
-class ForwardCoalescer:
-    """Per-destination micro-batching front for cross-shard forwards."""
-
-    def __init__(self, metrics: Optional[Metrics] = None,
-                 max_batch: int = 4096, retries: int = 1):
-        self.metrics = metrics if metrics is not None else METRICS
-        #: Rows per flushed RPC. 1 is the PER-KEY-FORWARD baseline the
-        #: bench measures the coalescer against (set_max_batch).
-        self.max_batch = int(max_batch)
-        self._configured_max_batch = self.max_batch
-        self.retries = int(retries)
-        self._lock = threading.Lock()
-        self._lanes: Dict[Tuple[Tuple[str, int], str], _Lane] = {}
-        self._closed = False
-
-    def set_max_batch(self, n: int) -> int:
-        """Runtime knob (the bench's coalesced-vs-per-key A/B): 1 =
-        one RPC per forwarded entry, the baseline. Returns the previous
-        value. The new value also becomes what set_coalesce(True)
-        restores — an operator's tuning survives a SET_COALESCE
-        A/B cycle."""
-        prev, self.max_batch = self.max_batch, max(int(n), 1)
-        self._configured_max_batch = self.max_batch
-        return prev
-
-    def set_coalesce(self, on: bool) -> None:
-        """Toggle between the configured batching and the per-key-
-        forward baseline (the MESH_ROUTES SET_COALESCE wire knob)."""
-        self.max_batch = self._configured_max_batch if on else 1
-
-    # -- public forwards -----------------------------------------------------
-    def forward(self, dest: Tuple[str, int], verb: str,
-                lanes: np.ndarray, starts: Optional[np.ndarray],
-                deadline_at: Optional[float]) -> ForwardResult:
-        """Forward one run of keys (1..N rows) to `dest`, folded with
-        whatever else is queued there; blocks for this entry's slice."""
-        if verb not in FORWARD_VERBS:
-            raise ValueError(f"unforwardable verb {verb!r}")
-        entry = _Entry(np.ascontiguousarray(lanes, dtype=np.uint32),
-                       None if starts is None
-                       else np.ascontiguousarray(starts, dtype=np.int32),
-                       deadline_at, trace_mod.current_raw())
-        lane = self._lane(dest, verb)
-        lane.enqueue(entry)
-        wait_s = DEFAULT_FORWARD_WAIT_S
-        if deadline_at is not None:
-            wait_s = max(min(wait_s, deadline_at - time.perf_counter()),
-                         0.0)
-        # The flush worker always completes every entry it popped (the
-        # RPC itself is deadline-bounded), so a small grace on top of
-        # the caller budget keeps the error attribution exact.
-        if not entry.ev.wait(wait_s + 5.0):
-            raise ForwardError(
-                f"forward to {dest[0]}:{dest[1]} timed out")
-        if entry.error is not None:
-            raise entry.error
-        assert entry.result is not None
-        return entry.result
-
-    def _lane(self, dest: Tuple[str, int], verb: str) -> _Lane:
-        key = ((str(dest[0]), int(dest[1])), verb)
-        with self._lock:
-            if self._closed:
-                raise ForwardError("forward coalescer closed")
-            lane = self._lanes.get(key)
-            if lane is None:
-                lane = self._lanes[key] = _Lane(self, key[0], verb)
-        return lane
-
-    def close(self) -> None:
-        with self._lock:
-            self._closed = True
-            lanes = list(self._lanes.values())
-            self._lanes.clear()
-        for lane in lanes:
-            lane.close()
-
-    def _flush_per_key(self, dest: Tuple[str, int], verb: str,
-                       entry: _Entry) -> None:
-        """Baseline mode: forward one entry's rows as ONE RPC EACH,
-        sequentially — the per-RPC overhead the coalescer exists to
-        amortize, kept runnable so the bench's A/B stays honest. The
-        first transport failure fails the whole entry."""
-        rows = entry.lanes.shape[0]
-        owners = np.full(rows, -1, np.int64)
-        hops = np.full(rows, -1, np.int32)
-        ok = np.zeros(rows, dtype=bool)
-        segments: List = [None] * rows
-        not_owned: List[int] = []
-        routes_doc = None
-        for j in range(rows):
-            sub = _Entry(entry.lanes[j:j + 1],
-                         None if entry.starts is None
-                         else entry.starts[j:j + 1],
-                         entry.deadline_at, entry.ctx)
-            self._flush(dest, verb, [sub])
-            if sub.error is not None:
-                entry.error = sub.error
-                entry.ev.set()
-                return
-            res = sub.result
-            if res.not_owned:
-                not_owned.append(j)
-                routes_doc = res.routes_doc or routes_doc
-                continue
-            if verb == "FIND_SUCCESSOR":
-                owners[j] = res.owners[0]
-                hops[j] = res.hops[0]
-            else:
-                ok[j] = res.ok[0]
-                segments[j] = res.segments[0]
-        out = ForwardResult()
-        out.owners, out.hops = owners, hops
-        out.ok, out.segments = ok, segments
-        out.not_owned = not_owned
-        out.routes_doc = routes_doc
-        entry.result = out
-        entry.ev.set()
-
-    # -- the flush -----------------------------------------------------------
-    def _flush(self, dest: Tuple[str, int], verb: str,
-               batch: List[_Entry]) -> None:
-        now = time.perf_counter()
-        live: List[_Entry] = []
-        for e in batch:
-            if e.deadline_at is not None and now >= e.deadline_at:
-                from p2p_dhts_tpu.serve import DeadlineExpiredError
-                e.error = DeadlineExpiredError(
-                    "forward deadline passed before the flush")
-                e.ev.set()
-            else:
-                live.append(e)
-        if not live:
-            return
-        lanes = (live[0].lanes if len(live) == 1
-                 else np.vstack([e.lanes for e in live]))
-        n = lanes.shape[0]
-        starts = None
-        if verb == "FIND_SUCCESSOR":
-            starts = np.concatenate(
-                [e.starts if e.starts is not None
-                 else np.zeros(e.lanes.shape[0], np.int32)
-                 for e in live])
-        deadlines = [e.deadline_at for e in live
-                     if e.deadline_at is not None]
-        deadline_at = min(deadlines) if deadlines else None
-        timeout = DEFAULT_FORWARD_WAIT_S
-        if deadline_at is not None:
-            timeout = max(min(timeout, deadline_at - now), 0.001)
-        req: dict = {"COMMAND": verb,
-                     "KEYS": wire.U128Keys.from_lanes(lanes),
-                     "FWD": 1}
-        if starts is not None:
-            req["STARTS"] = starts
-        if deadline_at is not None:
-            req["DEADLINE_MS"] = max(
-                (deadline_at - time.perf_counter()) * 1e3, 1.0)
+    # -- metric identity (LITERAL keys — the doc-drift gate scans these) -----
+    def _record_flush(self, n_keys: int, folded: int) -> None:
         self.metrics.inc("gateway.forward.batches")
-        self.metrics.inc("gateway.forward.keys", n)
-        self.metrics.observe_hist("gateway.forward.batch_size", n)
-        if len(live) > 1:
-            self.metrics.inc("gateway.forward.coalesced",
-                             len(live) - 1)
-        t0 = time.perf_counter()
-        try:
-            # The first folded entry's trace context roots the batch
-            # (one RPC carries one context): a solo forward keeps its
-            # unbroken cross-process chain; a shared frame records the
-            # fold size on the forward span.
-            with trace_mod.activate(live[0].ctx):
-                with trace_mod.span("mesh.forward", cat="mesh",
-                                    dest=f"{dest[0]}:{dest[1]}",
-                                    verb=verb, n=n, folded=len(live)):
-                    resp = Client.make_request(
-                        dest[0], dest[1], req, timeout=timeout,
-                        retries=self.retries, deadline=deadline_at)
-        # chordax-lint: disable=bare-except -- the flush is every folded waiter's failure funnel: any error must fan out, never kill the lane thread
-        except Exception as exc:
-            self.metrics.inc("gateway.forward.errors")
-            err = exc if isinstance(exc, (RpcError, ForwardError)) \
-                else ForwardError(f"{type(exc).__name__}: {exc}")
-            for e in live:
-                e.error = err
-                e.ev.set()
-            return
-        self.metrics.observe("gateway.forward.latency",
-                             time.perf_counter() - t0)
-        if not resp.get("SUCCESS"):
-            self.metrics.inc("gateway.forward.errors")
-            err = ForwardError(
-                f"owner {dest[0]}:{dest[1]} errored: "
-                f"{resp.get('ERRORS')}")
-            for e in live:
-                e.error = err
-                e.ev.set()
-            return
-        self._fan_out(verb, live, resp, n)
+        self.metrics.inc("gateway.forward.keys", n_keys)
+        self.metrics.observe_hist("gateway.forward.batch_size", n_keys)
+        if folded > 1:
+            self.metrics.inc("gateway.forward.coalesced", folded - 1)
 
-    def _fan_out(self, verb: str, live: List[_Entry], resp: dict,
-                 n: int) -> None:
-        not_owned = set(int(i) for i in resp.get("NOT_OWNED", ()))
-        if not_owned:
-            self.metrics.inc("gateway.forward.not_owner",
-                             len(not_owned))
-        routes_doc = resp.get("ROUTES_DOC")
-        owners = hops = ok = segs = None
-        if verb == "FIND_SUCCESSOR":
-            owners = np.asarray(resp.get("OWNERS", []), np.int64)
-            hops = np.asarray(resp.get("HOPS", []), np.int32)
-        else:
-            ok = np.asarray(resp.get("OK", []), bool)
-            segs = resp.get("SEGMENTS", [])
-        off = 0
-        for e in live:
-            rows = e.lanes.shape[0]
-            res = ForwardResult()
-            res.routes_doc = routes_doc
-            res.not_owned = [i - off for i in not_owned
-                             if off <= i < off + rows]
-            try:
-                if verb == "FIND_SUCCESSOR":
-                    if owners.shape[0] != n or hops.shape[0] != n:
-                        raise ForwardError(
-                            f"owner answered {owners.shape[0]} rows "
-                            f"for a {n}-row forward")
-                    res.owners = owners[off:off + rows]
-                    res.hops = hops[off:off + rows]
-                else:
-                    if ok.shape[0] != n:
-                        raise ForwardError(
-                            f"owner answered {ok.shape[0]} rows for "
-                            f"a {n}-row forward")
-                    res.ok = ok[off:off + rows]
-                    # stacked [n,S,m] array and per-row list slice the
-                    # same way; rows stay whichever form the owner sent
-                    res.segments = segs[off:off + rows]
-                e.result = res
-            except BaseException as exc:  # noqa: BLE001 — fanned to the waiter
-                e.error = exc if isinstance(exc, ForwardError) \
-                    else ForwardError(f"{type(exc).__name__}: {exc}")
-            e.ev.set()
-            off += rows
+    def _record_error(self) -> None:
+        self.metrics.inc("gateway.forward.errors")
+
+    def _record_latency(self, dt: float) -> None:
+        self.metrics.observe("gateway.forward.latency", dt)
+
+    def _record_not_owner(self, k: int) -> None:
+        self.metrics.inc("gateway.forward.not_owner", k)

@@ -1,0 +1,434 @@
+"""The shared fold core: per-(destination, verb) micro-batching of
+KEYS-vector RPCs (chordax-edge, ISSUE 17 — one coalescing engine).
+
+ISSUE 15 built the forward coalescer inside the mesh gateway; ISSUE 17
+lifts the SAME discipline to the client rim. Rather than fork the
+machinery, this module holds the whole fold/flush engine and the two
+users subclass it:
+
+  * `mesh.coalescer.ForwardCoalescer` — the gateway's cross-shard
+    forward path (`gateway.forward.*` metrics, `mesh.forward` span);
+  * `edge.client` — the zero-hop client SDK's rim coalescer
+    (`edge.*` metrics, `edge.flush` span, hedged transport).
+
+The shared rules (what "ONE implementation" means here):
+
+  * every fold (a single-key miss OR a whole vector run) enqueues on
+    its (destination, verb) lane and waits on its own waiter;
+  * one worker per lane drains everything queued — while one RPC is in
+    flight, new arrivals pile up and ride the NEXT flush, so load
+    coalesces naturally with ZERO added latency when idle;
+  * the batch rides the pooled/pipelined binary transport as packed
+    little-endian u128 runs (`wire.U128Keys.from_lanes`);
+  * DEADLINE_MS is the MINIMUM remaining budget across the folded
+    entries (already-expired entries are failed before the flush);
+  * the chordax-scope trace context of the FIRST folded entry rides
+    the batch (one RPC carries one root);
+  * the request carries ``FWD: 1`` — the one-hop rule: the owner
+    answers from local ownership only and bounces stale rows back in
+    ``NOT_OWNED`` with its fresher route table piggybacked. The core
+    reports those rows per entry; the CALLER owns the single
+    refresh-and-retry (mesh plane or edge client).
+
+Subclass hooks: `_record_*` methods keep the metric keys LITERAL at
+each concrete site (the pass-4 doc-drift gate scans recorder call
+literals), and `_transport` owns the actual RPC so the edge can hedge.
+
+LOCK ORDER: `_Lane._lock` and `FoldCore._lock` are LEAVES — held only
+for queue/table bookkeeping, never across the RPC, an encode, or a
+waiter wait. The flush runs entirely lock-free.
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client, RpcError
+
+#: Verbs the fold core knows how to batch (KEYS-vector read forms).
+FOLD_VERBS = ("FIND_SUCCESSOR", "GET")
+
+#: Flush wait bound when the caller set no deadline (the gateway's
+#: DEFAULT_WAIT_S rule: a fold must never park a worker forever).
+DEFAULT_FOLD_WAIT_S = 60.0
+
+
+class FoldError(RuntimeError):
+    """The folded batch failed at the transport or the owner."""
+
+
+class FoldResult:
+    """One entry's slice of a flushed batch: the per-row result arrays
+    plus the owner's not-owned verdicts and piggybacked routes."""
+
+    __slots__ = ("owners", "hops", "segments", "ok", "not_owned",
+                 "routes_doc", "routes_epoch")
+
+    def __init__(self) -> None:
+        self.owners: Optional[np.ndarray] = None
+        self.hops: Optional[np.ndarray] = None
+        self.segments = None          # stacked array or per-row list
+        self.ok: Optional[np.ndarray] = None
+        self.not_owned: List[int] = []    # row indices WITHIN the entry
+        self.routes_doc: Optional[dict] = None
+        self.routes_epoch: Optional[int] = None
+
+
+class _Entry:
+    __slots__ = ("lanes", "starts", "deadline_at", "ctx", "ev",
+                 "result", "error", "t0")
+
+    def __init__(self, lanes: np.ndarray, starts: Optional[np.ndarray],
+                 deadline_at: Optional[float], ctx) -> None:
+        self.lanes = lanes
+        self.starts = starts
+        self.deadline_at = deadline_at
+        self.ctx = ctx
+        self.ev = threading.Event()
+        self.result: Optional[FoldResult] = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+
+
+class _Lane:
+    """One (destination, verb) queue + its drain worker."""
+
+    def __init__(self, owner: "FoldCore",
+                 dest: Tuple[str, int], verb: str):
+        self.owner = owner
+        self.dest = dest
+        self.verb = verb
+        self._lock = threading.Lock()
+        self._queue: List[_Entry] = []
+        self._event = threading.Event()
+        self._closed = False
+        self.thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"{owner.thread_prefix}-{dest[0]}:{dest[1]}-{verb}")
+        self.thread.start()
+
+    def enqueue(self, entry: _Entry) -> None:
+        with self._lock:
+            if self._closed:
+                entry.error = self.owner.error_cls(self.owner.closed_msg)
+                entry.ev.set()
+                return
+            self._queue.append(entry)
+        self._event.set()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            drained = list(self._queue)
+            self._queue.clear()
+        for e in drained:
+            e.error = self.owner.error_cls(self.owner.closed_msg)
+            e.ev.set()
+        self._event.set()
+
+    def _run(self) -> None:
+        while True:
+            self._event.wait(timeout=0.5)
+            with self._lock:
+                if self._closed and not self._queue:
+                    return
+                batch = self._queue[:self.owner.max_batch]
+                del self._queue[:len(batch)]
+                if not self._queue:
+                    self._event.clear()
+            if batch:
+                if self.owner.max_batch == 1:
+                    # The PER-KEY baseline (coalescing off): one RPC
+                    # per ROW — what a naive proxy loop does, and what
+                    # the bench gates the coalescer against.
+                    for e in batch:
+                        self.owner._flush_per_key(self.dest,
+                                                  self.verb, e)
+                else:
+                    self.owner._flush(self.dest, self.verb, batch)
+
+
+class FoldCore:
+    """Per-destination micro-batching engine; subclasses pin the
+    metric keys, the span identity, and the transport."""
+
+    #: Subclass identity knobs — see module docstring.
+    error_cls = FoldError
+    closed_msg = "fold core closed"
+    span_name = "fold.flush"
+    span_cat = "fold"
+    thread_prefix = "fold"
+    verbs = FOLD_VERBS
+    default_wait_s = DEFAULT_FOLD_WAIT_S
+
+    def __init__(self, metrics: Optional[Metrics] = None,
+                 max_batch: int = 4096, retries: int = 1):
+        self.metrics = metrics if metrics is not None else METRICS
+        #: Rows per flushed RPC. 1 is the PER-KEY baseline the bench
+        #: measures the coalescer against (set_max_batch).
+        self.max_batch = int(max_batch)
+        self._configured_max_batch = self.max_batch
+        self.retries = int(retries)
+        self._lock = threading.Lock()
+        self._lanes: Dict[Tuple[Tuple[str, int], str], _Lane] = {}
+        self._closed = False
+
+    def set_max_batch(self, n: int) -> int:
+        """Runtime knob (the bench's coalesced-vs-per-key A/B): 1 =
+        one RPC per folded entry, the baseline. Returns the previous
+        value. The new value also becomes what set_coalesce(True)
+        restores — an operator's tuning survives a SET_COALESCE
+        A/B cycle."""
+        prev, self.max_batch = self.max_batch, max(int(n), 1)
+        self._configured_max_batch = self.max_batch
+        return prev
+
+    def set_coalesce(self, on: bool) -> None:
+        """Toggle between the configured batching and the per-key
+        baseline (the MESH_ROUTES SET_COALESCE wire knob)."""
+        self.max_batch = self._configured_max_batch if on else 1
+
+    # -- public folds --------------------------------------------------------
+    def forward(self, dest: Tuple[str, int], verb: str,
+                lanes: np.ndarray, starts: Optional[np.ndarray],
+                deadline_at: Optional[float]) -> FoldResult:
+        """Fold one run of keys (1..N rows) toward `dest`, folded with
+        whatever else is queued there; blocks for this entry's slice."""
+        if verb not in self.verbs:
+            raise ValueError(f"unforwardable verb {verb!r}")
+        entry = _Entry(np.ascontiguousarray(lanes, dtype=np.uint32),
+                       None if starts is None
+                       else np.ascontiguousarray(starts, dtype=np.int32),
+                       deadline_at, trace_mod.current_raw())
+        lane = self._lane(dest, verb)
+        lane.enqueue(entry)
+        wait_s = self.default_wait_s
+        if deadline_at is not None:
+            wait_s = max(min(wait_s, deadline_at - time.perf_counter()),
+                         0.0)
+        # The flush worker always completes every entry it popped (the
+        # RPC itself is deadline-bounded), so a small grace on top of
+        # the caller budget keeps the error attribution exact.
+        if not entry.ev.wait(wait_s + 5.0):
+            raise self.error_cls(
+                f"forward to {dest[0]}:{dest[1]} timed out")
+        if entry.error is not None:
+            raise entry.error
+        assert entry.result is not None
+        return entry.result
+
+    def _lane(self, dest: Tuple[str, int], verb: str) -> _Lane:
+        key = ((str(dest[0]), int(dest[1])), verb)
+        with self._lock:
+            if self._closed:
+                raise self.error_cls(self.closed_msg)
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = self._lanes[key] = _Lane(self, key[0], verb)
+        return lane
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            lanes = list(self._lanes.values())
+            self._lanes.clear()
+        for lane in lanes:
+            lane.close()
+
+    # -- subclass hooks ------------------------------------------------------
+    def _record_flush(self, n_keys: int, folded: int) -> None:
+        """One batch left for the wire: count it with LITERAL metric
+        keys at the concrete site (doc-drift gate rule)."""
+
+    def _record_error(self) -> None:
+        """The flush failed (transport error or owner-side ERRORS)."""
+
+    def _record_latency(self, dt: float) -> None:
+        """One successful flush round-trip took `dt` seconds."""
+
+    def _record_not_owner(self, k: int) -> None:
+        """`k` rows bounced back NOT_OWNED (stale route)."""
+
+    def _transport(self, dest: Tuple[str, int], verb: str, req: dict,
+                   timeout: float,
+                   deadline_at: Optional[float]) -> dict:
+        """The actual RPC. Runs inside the batch's activated trace
+        context and flush span; the edge overrides this with the
+        hedged/breaker-guarded send."""
+        return Client.make_request(
+            dest[0], dest[1], req, timeout=timeout,
+            retries=self.retries, deadline=deadline_at)
+
+    def _flush_per_key(self, dest: Tuple[str, int], verb: str,
+                       entry: _Entry) -> None:
+        """Baseline mode: fold one entry's rows as ONE RPC EACH,
+        sequentially — the per-RPC overhead the fold core exists to
+        amortize, kept runnable so the bench's A/B stays honest. The
+        first transport failure fails the whole entry."""
+        rows = entry.lanes.shape[0]
+        owners = np.full(rows, -1, np.int64)
+        hops = np.full(rows, -1, np.int32)
+        ok = np.zeros(rows, dtype=bool)
+        segments: List = [None] * rows
+        not_owned: List[int] = []
+        routes_doc = None
+        routes_epoch = None
+        for j in range(rows):
+            sub = _Entry(entry.lanes[j:j + 1],
+                         None if entry.starts is None
+                         else entry.starts[j:j + 1],
+                         entry.deadline_at, entry.ctx)
+            self._flush(dest, verb, [sub])
+            if sub.error is not None:
+                entry.error = sub.error
+                entry.ev.set()
+                return
+            res = sub.result
+            if res.routes_epoch is not None:
+                routes_epoch = res.routes_epoch
+            if res.not_owned:
+                not_owned.append(j)
+                routes_doc = res.routes_doc or routes_doc
+                continue
+            if verb == "FIND_SUCCESSOR":
+                owners[j] = res.owners[0]
+                hops[j] = res.hops[0]
+            else:
+                ok[j] = res.ok[0]
+                segments[j] = res.segments[0]
+        out = FoldResult()
+        out.owners, out.hops = owners, hops
+        out.ok, out.segments = ok, segments
+        out.not_owned = not_owned
+        out.routes_doc = routes_doc
+        out.routes_epoch = routes_epoch
+        entry.result = out
+        entry.ev.set()
+
+    # -- the flush -----------------------------------------------------------
+    def _flush(self, dest: Tuple[str, int], verb: str,
+               batch: List[_Entry]) -> None:
+        now = time.perf_counter()
+        live: List[_Entry] = []
+        for e in batch:
+            if e.deadline_at is not None and now >= e.deadline_at:
+                from p2p_dhts_tpu.serve import DeadlineExpiredError
+                e.error = DeadlineExpiredError(
+                    "forward deadline passed before the flush")
+                e.ev.set()
+            else:
+                live.append(e)
+        if not live:
+            return
+        lanes = (live[0].lanes if len(live) == 1
+                 else np.vstack([e.lanes for e in live]))
+        n = lanes.shape[0]
+        starts = None
+        if verb == "FIND_SUCCESSOR":
+            starts = np.concatenate(
+                [e.starts if e.starts is not None
+                 else np.zeros(e.lanes.shape[0], np.int32)
+                 for e in live])
+        deadlines = [e.deadline_at for e in live
+                     if e.deadline_at is not None]
+        deadline_at = min(deadlines) if deadlines else None
+        timeout = self.default_wait_s
+        if deadline_at is not None:
+            timeout = max(min(timeout, deadline_at - now), 0.001)
+        req: dict = {"COMMAND": verb,
+                     "KEYS": wire.U128Keys.from_lanes(lanes),
+                     "FWD": 1}
+        if starts is not None:
+            req["STARTS"] = starts
+        if deadline_at is not None:
+            req["DEADLINE_MS"] = max(
+                (deadline_at - time.perf_counter()) * 1e3, 1.0)
+        self._record_flush(n, len(live))
+        t0 = time.perf_counter()
+        try:
+            # The first folded entry's trace context roots the batch
+            # (one RPC carries one context): a solo fold keeps its
+            # unbroken cross-process chain; a shared frame records the
+            # fold size on the flush span.
+            with trace_mod.activate(live[0].ctx):
+                with trace_mod.span(self.span_name, cat=self.span_cat,
+                                    dest=f"{dest[0]}:{dest[1]}",
+                                    verb=verb, n=n, folded=len(live)):
+                    resp = self._transport(dest, verb, req, timeout,
+                                           deadline_at)
+        # chordax-lint: disable=bare-except -- the flush is every folded waiter's failure funnel: any error must fan out, never kill the lane thread
+        except Exception as exc:
+            self._record_error()
+            err = exc if isinstance(exc, (RpcError, FoldError)) \
+                else self.error_cls(f"{type(exc).__name__}: {exc}")
+            for e in live:
+                e.error = err
+                e.ev.set()
+            return
+        self._record_latency(time.perf_counter() - t0)
+        if not resp.get("SUCCESS"):
+            self._record_error()
+            err = self.error_cls(
+                f"owner {dest[0]}:{dest[1]} errored: "
+                f"{resp.get('ERRORS')}")
+            for e in live:
+                e.error = err
+                e.ev.set()
+            return
+        self._fan_out(verb, live, resp, n)
+
+    def _fan_out(self, verb: str, live: List[_Entry], resp: dict,
+                 n: int) -> None:
+        not_owned = set(int(i) for i in resp.get("NOT_OWNED", ()))
+        if not_owned:
+            self._record_not_owner(len(not_owned))
+        routes_doc = resp.get("ROUTES_DOC")
+        routes_epoch = resp.get("ROUTES_EPOCH")
+        if routes_epoch is not None:
+            routes_epoch = int(routes_epoch)
+        owners = hops = ok = segs = None
+        if verb == "FIND_SUCCESSOR":
+            owners = np.asarray(resp.get("OWNERS", []), np.int64)
+            hops = np.asarray(resp.get("HOPS", []), np.int32)
+        else:
+            ok = np.asarray(resp.get("OK", []), bool)
+            segs = resp.get("SEGMENTS", [])
+        off = 0
+        for e in live:
+            rows = e.lanes.shape[0]
+            res = FoldResult()
+            res.routes_doc = routes_doc
+            res.routes_epoch = routes_epoch
+            res.not_owned = [i - off for i in not_owned
+                             if off <= i < off + rows]
+            try:
+                if verb == "FIND_SUCCESSOR":
+                    if owners.shape[0] != n or hops.shape[0] != n:
+                        raise self.error_cls(
+                            f"owner answered {owners.shape[0]} rows "
+                            f"for a {n}-row forward")
+                    res.owners = owners[off:off + rows]
+                    res.hops = hops[off:off + rows]
+                else:
+                    if ok.shape[0] != n:
+                        raise self.error_cls(
+                            f"owner answered {ok.shape[0]} rows for "
+                            f"a {n}-row forward")
+                    res.ok = ok[off:off + rows]
+                    # stacked [n,S,m] array and per-row list slice the
+                    # same way; rows stay whichever form the owner sent
+                    res.segments = segs[off:off + rows]
+                e.result = res
+            except BaseException as exc:  # noqa: BLE001 — fanned to the waiter
+                e.error = exc if isinstance(exc, FoldError) \
+                    else self.error_cls(f"{type(exc).__name__}: {exc}")
+            e.ev.set()
+            off += rows

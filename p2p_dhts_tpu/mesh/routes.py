@@ -191,6 +191,42 @@ class RouteTable:
                 remote.append((addrs[mid], rows))
         return np.nonzero(local_mask)[0], remote
 
+    def split_lanes_all(self, lanes: np.ndarray
+                        ) -> List[Tuple[Addr, np.ndarray]]:
+        """Classify a whole [N, LANES] uint32 key array for a CLIENT
+        that is not itself a mesh peer (the chordax-edge rim): every
+        row goes to its owning gateway — there is no local bucket.
+        Returns [(addr, row_indices)...] in id order; an empty table
+        returns [] (the edge treats that as "no routes yet" and pulls
+        MESH_ROUTES before resolving). Same one-range-mask-per-peer
+        discipline as split_lanes — zero per-key python."""
+        n = lanes.shape[0]
+        with self._lock:
+            ids = list(self._ids)
+            addrs = dict(self._addrs)
+        if not ids or n == 0:
+            return []
+        assigned = np.full(n, -1, np.int32)
+        for j, mid in enumerate(ids):
+            i = bisect.bisect_left(ids, mid)
+            pred = ids[(i - 1) % len(ids)]
+            lo = (pred + 1) % KEYS_IN_RING if pred != mid \
+                else (mid + 1) % KEYS_IN_RING
+            mask = lanes_in_range_mask(lanes, lo, mid) & (assigned < 0)
+            if mask.any():
+                assigned[mask] = j
+        # The shards tile the whole circle, so every row is assigned;
+        # a defensive residue (impossible by construction) rides the
+        # first peer so no row is ever silently dropped.
+        if (assigned < 0).any():
+            assigned[assigned < 0] = 0
+        out: List[Tuple[Addr, np.ndarray]] = []
+        for j, mid in enumerate(ids):
+            rows = np.nonzero(assigned == j)[0]
+            if rows.size:
+                out.append((addrs[mid], rows))
+        return out
+
     # -- wire form -----------------------------------------------------------
     def doc(self) -> dict:
         """The gossip/observability document the MESH_ROUTES verb

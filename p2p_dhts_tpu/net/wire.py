@@ -91,6 +91,7 @@ import struct
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -551,8 +552,12 @@ class _Conn:
         with self._lock:
             return len(self._pending)
 
-    def request(self, obj: dict, timeout: float,
-                fault: Optional[dict] = None) -> dict:
+    def submit(self, obj: dict,
+               fault: Optional[dict] = None) -> Tuple[int, _Waiter]:
+        """Hand one request frame to the writer and return without
+        waiting: (req_id, waiter). The caller pairs it with
+        `wait_reply` — or `cancel` to walk away (the edge hedger's
+        first-answer-wins primitive, ISSUE 17)."""
         waiter = _Waiter()
         with self._lock:
             if self.dead:
@@ -575,17 +580,33 @@ class _Conn:
         else:
             self._sendq.put(frame)
         METRICS.inc("rpc.wire.bytes_sent", len(frame))
+        return req_id, waiter
+
+    def wait_reply(self, req_id: int, waiter: _Waiter,
+                   timeout: float) -> dict:
         if not waiter.event.wait(timeout):
             self._forget(req_id)
             # Leaving the request outstanding is fine — the reader
-            # drops replies for forgotten ids — but a caller timeout
-            # does NOT kill the connection: other pipelined requests
-            # on it are still live.
+            # counts replies for forgotten ids as discarded — but a
+            # caller timeout does NOT kill the connection: other
+            # pipelined requests on it are still live.
             raise TimeoutError("RPC reply timed out")
         if waiter.error is not None:
             raise waiter.error
         assert waiter.response is not None
         return waiter.response
+
+    def request(self, obj: dict, timeout: float,
+                fault: Optional[dict] = None) -> dict:
+        req_id, waiter = self.submit(obj, fault=fault)
+        return self.wait_reply(req_id, waiter, timeout)
+
+    def cancel(self, req_id: int) -> None:
+        """Abandon one submitted request: its reply (if the server
+        still answers) is counted as `rpc.wire.discarded` by the
+        reader, never surfaced as an error. The hedged-then-cancelled
+        path (ISSUE 17)."""
+        self._forget(req_id)
 
     def _forget(self, req_id: int) -> None:
         with self._lock:
@@ -690,6 +711,13 @@ class _Conn:
                     if waiter is not None:
                         waiter.response = obj
                         waiter.event.set()
+                    else:
+                        # A reply for a forgotten id: the caller timed
+                        # out or a hedge was cancelled after its rival
+                        # answered first. Late answers are an expected
+                        # cost of hedging — counted, never an error
+                        # (ISSUE 17).
+                        METRICS.inc("rpc.wire.discarded")
         # chordax-lint: disable=bare-except -- the reader is the connection's failure funnel: every exception becomes a dead-connection verdict delivered to the pending waiters
         except Exception as exc:
             self._fail_all(exc)
@@ -723,12 +751,54 @@ class WirePool:
     connections keep serving regardless; the breaker only gates NEW
     dials."""
 
+    #: Per-destination latency reservoir depth (dest_snapshot's p99
+    #: window): enough samples for a stable tail, small enough that a
+    #: load shift re-centers the hedge timer within one burst.
+    LATENCY_WINDOW = 512
+
     def __init__(self, max_per_dest: int = MAX_CONNS_PER_DEST):
         self._lock = threading.Lock()
         self._conns: Dict[Tuple[str, int], List[_Conn]] = {}
         self._legacy: Dict[Tuple[str, int], float] = {}
         self._breakers: Dict[Tuple[str, int], _Breaker] = {}
+        self._latency: Dict[Tuple[str, int], deque] = {}
         self.max_per_dest = max_per_dest
+
+    # -- per-destination telemetry (the edge hedge timer's feed) -------------
+    def note_latency(self, dest: Tuple[str, int], dt: float) -> None:
+        """Record one successful request round-trip (seconds) against
+        its destination — the bounded reservoir dest_snapshot derives
+        p50/p99 from (ISSUE 17: the hedge timer's input)."""
+        dest = (str(dest[0]), int(dest[1]))
+        with self._lock:
+            lat = self._latency.get(dest)
+            if lat is None:
+                lat = self._latency[dest] = deque(
+                    maxlen=self.LATENCY_WINDOW)
+            lat.append(float(dt))
+
+    def dest_snapshot(self, ip_addr: str, port: int) -> dict:
+        """One destination's live wire state: pooled in-flight depth +
+        observed latency quantiles (ms) over the reservoir window.
+        p50/p99 are None until a sample lands — the hedge policy falls
+        back to its floor delay rather than hedging blind."""
+        dest = (str(ip_addr), int(port))
+        with self._lock:
+            conns = list(self._conns.get(dest, ()))
+            samples = list(self._latency.get(dest, ()))
+        # inflight sums per-connection pending tables AFTER the pool
+        # lock is released (each read takes that conn's leaf lock).
+        inflight = sum(c.inflight for c in conns if not c.dead)
+        p50 = p99 = None
+        if samples:
+            ordered = sorted(samples)
+            # nearest-rank (the metrics module's quantile rule)
+            p50 = ordered[max(
+                int(np.ceil(0.50 * len(ordered))) - 1, 0)] * 1e3
+            p99 = ordered[max(
+                int(np.ceil(0.99 * len(ordered))) - 1, 0)] * 1e3
+        return {"inflight": inflight, "p50_ms": p50, "p99_ms": p99,
+                "samples": len(samples)}
 
     # -- circuit breaker -----------------------------------------------------
     def _breaker_admit(self, dest: Tuple[str, int]) -> None:
@@ -959,6 +1029,7 @@ class WirePool:
             conns = self._conns.pop(dest, [])
             self._legacy.pop(dest, None)
             self._breakers.pop(dest, None)
+            self._latency.pop(dest, None)
         for c in conns:
             c.close()
         return len(conns)
@@ -969,6 +1040,7 @@ class WirePool:
             self._conns.clear()
             self._legacy.clear()
             self._breakers.clear()
+            self._latency.clear()
         for c in conns:
             c.close()
 
@@ -1054,6 +1126,90 @@ def request(ip_addr: str, port: int, obj: dict, timeout: float) -> dict:
             # (connection setup records under rpc.client.connect at
             # the dial site) — the pooled transport and the one-shot
             # JSON path stay comparable.
-            METRICS.observe("rpc.client.request",
-                            time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            METRICS.observe("rpc.client.request", dt)
+            _POOL.note_latency(dest, dt)
             return resp
+
+
+class PendingCall:
+    """One submitted-but-unawaited request on the pooled binary
+    transport (ISSUE 17): `wait()` blocks for the reply, `cancel()`
+    walks away — the server's late answer is then counted as
+    `rpc.wire.discarded` by the connection reader, never surfaced as
+    an error. The edge hedger races two of these and cancels the
+    loser."""
+
+    __slots__ = ("dest", "_conn", "_req_id", "_waiter", "_t0",
+                 "_settled")
+
+    def __init__(self, dest: Tuple[str, int], conn: _Conn,
+                 req_id: int, waiter: _Waiter) -> None:
+        self.dest = dest
+        self._conn = conn
+        self._req_id = req_id
+        self._waiter = waiter
+        self._t0 = time.perf_counter()
+        self._settled = False
+
+    def done(self) -> bool:
+        """True once a reply (or transport verdict) has landed."""
+        return self._waiter.event.is_set()
+
+    def wait_done(self, timeout: float) -> bool:
+        """Block up to `timeout` for the reply WITHOUT consuming it
+        (the hedger's race primitive); returns done()."""
+        return self._waiter.event.wait(timeout)
+
+    def wait(self, timeout: float) -> dict:
+        """Block for the reply; raises TimeoutError / the transport
+        error exactly as `request()` would. Success feeds the
+        per-destination latency reservoir."""
+        try:
+            resp = self._conn.wait_reply(self._req_id, self._waiter,
+                                         timeout)
+        except (OSError, RuntimeError) as exc:
+            if not self._settled:
+                self._settled = True
+                if not isinstance(exc, TimeoutError):
+                    METRICS.inc("rpc.wire.errors")
+                METRICS.observe("rpc.client.request",
+                                time.perf_counter() - self._t0)
+            raise
+        if not self._settled:
+            self._settled = True
+            dt = time.perf_counter() - self._t0
+            METRICS.observe("rpc.client.request", dt)
+            _POOL.note_latency(self.dest, dt)
+        return resp
+
+    def cancel(self) -> None:
+        """Abandon the call (idempotent; a settled call is a no-op)."""
+        if not self._settled:
+            self._settled = True
+            self._conn.cancel(self._req_id)
+
+
+def submit(ip_addr: str, port: int, obj: dict) -> PendingCall:
+    """Submit one request over the pooled binary transport WITHOUT
+    waiting (the hedge primitive). Same at-most-once discipline as
+    `request()`: only ConnDeadError (nothing ever sent) retries the
+    pick/dial internally. Raises NegotiationFallback for a legacy
+    destination — hedging needs the pipelined binary wire; the caller
+    falls back to an ordinary blocking request."""
+    dest = (ip_addr, int(port))
+    if _POOL.known_legacy(dest):
+        raise NegotiationFallback(dest)
+    attempt = 0
+    while True:
+        conn = _POOL.get(dest, timeout=NEGOTIATE_TIMEOUT_S * 2)
+        METRICS.inc("rpc.wire.requests")
+        try:
+            req_id, waiter = conn.submit(obj)
+        except ConnDeadError:
+            METRICS.inc("rpc.wire.errors")
+            attempt += 1
+            if attempt > MAX_CONNS_PER_DEST + 1:
+                raise
+        else:
+            return PendingCall(dest, conn, req_id, waiter)

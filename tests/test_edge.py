@@ -1,0 +1,465 @@
+"""chordax-edge tests (ISSUE 17): zero-hop byte parity against the
+gateway-forwarded path, the client-side stale-route storm converging
+in ONE refresh round, rim coalescing through the shared fold core,
+tail hedging (fires only past the timer, ~5% fairness cap, first
+answer wins with the loser discarded), the per-destination breaker
+(one dead owner fails only its rows; BUSY opens immediately), and the
+cross-process trace chain rooted at `edge.request`.
+
+Topology under test: TWO real gateway processes' worth of stack in
+ONE test process (the mesh tests' in-proc ring shape) with the route
+split operator-blessed directly on both planes — no membership plane,
+because chordax-edge is a CLIENT of the mesh, not a member of it. The
+bench's 4-subprocess ring covers the true multi-process story."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.edge import HedgePolicy
+from p2p_dhts_tpu.edge import client as edge_client_mod
+from p2p_dhts_tpu.edge.client import Client as EdgeClient
+from p2p_dhts_tpu.edge.client import EdgeError
+from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+from p2p_dhts_tpu.mesh import MeshPlane, RouteTable, addr_str, member_for
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client, Server
+
+pytestmark = pytest.mark.edge
+
+RNG = np.random.RandomState(0xED6E)
+RING_ROWS = [int.from_bytes(RNG.bytes(16), "little") for _ in range(48)]
+
+#: A hedge timer no local round trip ever crosses: the module client
+#: exercises the hedged (pipelined wire.submit) send path while firing
+#: ZERO hedges — parity and zero-hop tests stay deterministic.
+NEVER_MS = 250.0
+
+
+def _rand_keys(n, rng=RNG):
+    return [int.from_bytes(rng.bytes(16), "little") for _ in range(n)]
+
+
+class _Node:
+    def __init__(self, name):
+        self.metrics = Metrics()
+        self.server = Server(0, {})
+        self.gateway = Gateway(metrics=self.metrics, name=name)
+        self.gateway.add_ring(
+            "shard",
+            build_ring(RING_ROWS, RingConfig(finger_mode="materialized")),
+            empty_store(640, 4), default=True, bucket_min=8,
+            bucket_max=32, reprobe_s=300.0,
+            warmup=["find_successor", "dhash_get", "dhash_put"])
+        self.addr = ("127.0.0.1", self.server.port)
+        self.plane = MeshPlane(self.gateway, self.addr, ring_id="shard")
+        self.member = self.plane.member_id
+        install_gateway_handlers(self.server, self.gateway)
+        self.server.run_in_background()
+
+    def close(self):
+        self.plane.close()
+        self.server.kill()
+        self.gateway.close()
+
+
+class _Rim:
+    """Two gateways + an operator-blessed 2-way split (no membership
+    plane: the edge is a client of the mesh, not a member)."""
+
+    def __init__(self):
+        self.a = _Node("edge-a")
+        self.b = _Node("edge-b")
+        self.bless()
+
+    def bless(self):
+        """(Re-)install the canonical 2-peer split on both planes."""
+        peers = {self.a.member: self.a.addr, self.b.member: self.b.addr}
+        epoch = max(self.a.plane.routes.epoch,
+                    self.b.plane.routes.epoch) + 1
+        self.a.plane.apply_routes(peers, epoch)
+        self.b.plane.apply_routes(peers, epoch)
+        return epoch
+
+    def owned_by(self, node, n, rng=None):
+        rng = rng if rng is not None else RNG
+        out = []
+        while len(out) < n:
+            k = int.from_bytes(rng.bytes(16), "little")
+            own = self.a.plane.routes.owner(k)
+            if own is not None and own[1] == node.addr:
+                out.append(k)
+        return out
+
+    def close(self):
+        self.b.close()
+        self.a.close()
+        wire.reset_pool()
+
+
+@pytest.fixture(scope="module")
+def rim():
+    r = _Rim()
+    yield r
+    r.close()
+
+
+@pytest.fixture(scope="module")
+def edge(rim):
+    m = Metrics()
+    c = EdgeClient([rim.a.addr, rim.b.addr], metrics=m,
+                   hedge=HedgePolicy(metrics=m, floor_ms=NEVER_MS,
+                                     min_samples=1 << 30))
+    yield c
+    c.close()
+
+
+def _rpc(node, req, timeout=120.0):
+    return Client.make_request("127.0.0.1", node.server.port, req,
+                               timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# zero-hop byte parity
+# ---------------------------------------------------------------------------
+
+def test_zero_hop_byte_parity_1000_keys(rim, edge):
+    """The acceptance gate's parity half: 1000 mixed-ownership keys
+    answered by the client-routed path are BYTE-IDENTICAL to the
+    gateway-forwarded path — FIND_SUCCESSOR and GET — and the routed
+    path forwards NOTHING (zero-hop: neither gateway's forward
+    coalescer moves)."""
+    rng = np.random.RandomState(0x171)
+    keys = _rand_keys(1000, rng)
+    segs = [rng.randint(0, 200, size=(4, 10)).astype(np.int32)
+            for _ in range(24)]
+    for k, s in zip(keys[:24], segs):
+        r = _rpc(rim.a, {"COMMAND": "PUT", "KEY": format(k, "x"),
+                         "SEGMENTS": s, "LENGTH": 4})
+        assert r.get("SUCCESS") and r.get("OK"), r
+    # the forwarded baseline FIRST (it pays the hop we then assert
+    # the routed path never does)
+    via_a = _rpc(rim.a, {"COMMAND": "FIND_SUCCESSOR",
+                         "KEYS": wire.U128Keys(keys)})
+    assert via_a.get("SUCCESS"), via_a.get("ERRORS")
+    gvia = _rpc(rim.a, {"COMMAND": "GET", "KEYS": wire.U128Keys(keys)})
+    assert gvia.get("SUCCESS"), gvia.get("ERRORS")
+    fwd0 = (rim.a.metrics.counter("gateway.forward.batches"),
+            rim.b.metrics.counter("gateway.forward.batches"))
+    res = edge.find_successor(keys)
+    assert res.all_ok, res.errors
+    assert list(res.owners) == [int(o) for o in via_a["OWNERS"]]
+    assert list(res.hops) == [int(h) for h in via_a["HOPS"]]
+    gres = edge.get(keys)
+    assert gres.all_ok, gres.errors
+    assert list(gres.ok) == [bool(o) for o in gvia["OK"]]
+    assert sum(gres.ok) == 24
+    via_segs = np.asarray(gvia["SEGMENTS"])
+    for j in range(len(keys)):
+        assert np.array_equal(np.asarray(gres.segments[j]),
+                              via_segs[j]), f"row {j} segment drift"
+    # zero-hop: the routed calls cost NO forward batches anywhere
+    assert (rim.a.metrics.counter("gateway.forward.batches"),
+            rim.b.metrics.counter("gateway.forward.batches")) == fwd0, \
+        "client-routed traffic paid a gateway forward hop"
+    # ... and the stored bytes round-trip the routed path
+    for j, s in enumerate(segs):
+        assert np.array_equal(np.asarray(gres.segments[j])[:4], s)
+
+
+def test_rim_coalescing_folds_concurrent_singles(rim, edge):
+    """Concurrent single-key edge calls to the same owner FOLD into
+    shared vector RPCs through the one mesh/fold.py core (edge.batches
+    < calls, edge.coalesced counts the folded surplus)."""
+    rng = np.random.RandomState(0x172)
+    b_keys = rim.owned_by(rim.b, 24, rng)
+    batches0 = edge.metrics.counter("edge.batches")
+    coalesced0 = edge.metrics.counter("edge.coalesced")
+    errs = []
+
+    def storm(ks):
+        for k in ks:
+            try:
+                r = edge.find_successor([k])
+                assert r.all_ok, r.errors
+            except BaseException as exc:  # noqa: BLE001 — re-raised in the main thread
+                errs.append(exc)
+
+    threads = [threading.Thread(target=storm, args=(b_keys[i::4],))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[0]
+    batches_n = edge.metrics.counter("edge.batches") - batches0
+    assert batches_n < len(b_keys), \
+        f"{len(b_keys)} single-key calls cost {batches_n} RPCs — nothing folded"
+    assert edge.metrics.counter("edge.coalesced") - coalesced0 >= \
+        len(b_keys) - batches_n
+
+
+# ---------------------------------------------------------------------------
+# stale-route storm: one refresh round per client
+# ---------------------------------------------------------------------------
+
+def test_stale_route_storm_one_refresh_round(rim):
+    """An operator re-split under a seeded client costs exactly ONE
+    MESH_ROUTES refresh: the first bounced batch installs the fresher
+    table (NOT_OWNED piggyback + staleness beacon), the bounced rows
+    re-resolve ONCE and answer, and every later call is zero-retrace
+    steady state."""
+    m = Metrics()
+    c = EdgeClient([rim.a.addr, rim.b.addr], metrics=m,
+                   hedge_enabled=False)
+    try:
+        rng = np.random.RandomState(0x173)
+        b_keys = rim.owned_by(rim.b, 32, rng)
+        warm = c.find_successor(b_keys[:4])
+        assert warm.all_ok, warm.errors
+        old_epoch = c.routes.epoch
+        # operator re-split: A now owns EVERYTHING; the client's
+        # cached table still maps b_keys to B
+        epoch = old_epoch + 1
+        rim.a.plane.apply_routes({rim.a.member: rim.a.addr}, epoch)
+        rim.b.plane.apply_routes({rim.a.member: rim.a.addr}, epoch)
+        refreshes0 = c.routes.refreshes
+        retries0 = m.counter("edge.retries")
+        res = c.find_successor(b_keys)
+        assert res.all_ok, res.errors
+        assert c.routes.epoch == epoch, \
+            "bounce did not install the fresher table"
+        assert c.routes.refreshes - refreshes0 == 1, \
+            "re-split cost more than one refresh round"
+        assert m.counter("edge.retries") - retries0 == 1
+        assert m.counter("edge.not_owner") == len(b_keys)
+        # parity: the healed answers match the new owner's direct ones
+        direct = _rpc(rim.a, {"COMMAND": "FIND_SUCCESSOR",
+                              "KEYS": wire.U128Keys(b_keys),
+                              "RING": "shard"})
+        assert list(res.owners) == [int(o) for o in direct["OWNERS"]]
+        # steady state: a bigger mixed burst re-traces NOTHING
+        res2 = c.find_successor(_rand_keys(64, rng))
+        assert res2.all_ok, res2.errors
+        assert c.routes.refreshes - refreshes0 == 1
+        assert m.counter("edge.retries") - retries0 == 1
+        assert m.counter("edge.not_owner") == len(b_keys)
+    finally:
+        c.close()
+        rim.bless()
+
+
+# ---------------------------------------------------------------------------
+# tail hedging
+# ---------------------------------------------------------------------------
+
+def test_hedge_fires_past_timer_first_answer_wins(rim):
+    """A primary stuck past the timer is hedged to the alternate
+    gateway (which forwards under the one-hop rule); the FIRST answer
+    wins — the caller returns long before the stuck primary — and the
+    loser's late reply is discarded, not an error."""
+    m = Metrics()
+    c = EdgeClient([rim.a.addr, rim.b.addr], metrics=m,
+                   hedge=HedgePolicy(metrics=m, ratio=1.0,
+                                     floor_ms=60.0,
+                                     min_samples=1 << 30))
+    calls = {"n": 0}
+    orig = rim.a.server.handlers["FIND_SUCCESSOR"]
+    try:
+        rng = np.random.RandomState(0x174)
+        a_key = rim.owned_by(rim.a, 1, rng)[0]
+        # fast destination: the timer never passes, nothing hedges
+        for k in rim.owned_by(rim.a, 3, rng):
+            assert c.find_successor([k]).all_ok
+        assert m.counter("edge.hedges") == 0, \
+            "hedge fired under the timer"
+
+        def stall_first(req, _orig=orig):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.5)
+            return _orig(req)
+
+        rim.a.server.update_handlers({"FIND_SUCCESSOR": stall_first})
+        discarded0 = METRICS.counter("rpc.wire.discarded")
+        t0 = time.perf_counter()
+        res = c.find_successor([a_key])
+        dt = time.perf_counter() - t0
+        assert res.all_ok, res.errors
+        assert dt < 0.45, \
+            f"first-answer-wins lost: caller waited {dt:.3f}s on the stuck primary"
+        assert m.counter("edge.hedges") == 1
+        assert m.counter("edge.hedge_wins") == 1
+        direct = _rpc(rim.a, {"COMMAND": "FIND_SUCCESSOR",
+                              "KEYS": wire.U128Keys([a_key]),
+                              "RING": "shard"})
+        assert int(res.owners[0]) == int(direct["OWNERS"][0])
+        # the stuck primary's late reply drains as a DISCARD
+        deadline = time.monotonic() + 2.0
+        while METRICS.counter("rpc.wire.discarded") == discarded0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert METRICS.counter("rpc.wire.discarded") > discarded0, \
+            "the cancelled primary's late reply was not discarded"
+    finally:
+        rim.a.server.update_handlers({"FIND_SUCCESSOR": orig})
+        c.close()
+
+
+def test_hedge_budget_cap(rim):
+    """The ~5% fairness budget: a slow destination that WANTS to hedge
+    every call is admitted at most ratio * requests times; denials
+    count `edge.hedge_capped` and are never queued."""
+    # the policy alone: admission tracks the running ratio exactly
+    mp = Metrics()
+    p = HedgePolicy(metrics=mp, ratio=0.05)
+    for _ in range(19):
+        p.note_request()
+    assert not p.admit(), "admitted a hedge over the 5% budget"
+    p.note_request()                      # request 20: 1 <= 0.05 * 20
+    assert p.admit()
+    assert not p.admit()
+    assert mp.counter("edge.hedge_capped") == 2
+    # end to end: 25 always-slow calls admit exactly ONE hedge
+    m = Metrics()
+    c = EdgeClient([rim.a.addr, rim.b.addr], metrics=m,
+                   hedge=HedgePolicy(metrics=m, ratio=0.05,
+                                     floor_ms=30.0,
+                                     min_samples=1 << 30))
+    orig = rim.a.server.handlers["FIND_SUCCESSOR"]
+
+    def slow(req, _orig=orig):
+        time.sleep(0.06)
+        return _orig(req)
+
+    try:
+        rng = np.random.RandomState(0x175)
+        a_keys = rim.owned_by(rim.a, 25, rng)
+        rim.a.server.update_handlers({"FIND_SUCCESSOR": slow})
+        for k in a_keys:
+            assert c.find_successor([k]).all_ok
+        snap = c.hedge.snapshot()
+        assert snap["requests"] == 25
+        assert m.counter("edge.hedges") == 1, \
+            f"hedged {m.counter('edge.hedges')}/25 — budget breached"
+        assert m.counter("edge.hedge_capped") == 24
+        assert m.counter("edge.hedges") <= \
+            0.05 * snap["requests"] + 1
+    finally:
+        rim.a.server.update_handlers({"FIND_SUCCESSOR": orig})
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# the per-destination breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_dead_owner_fails_only_its_rows(rim, monkeypatch):
+    """One dead owner: its rows fail (with the destination named in
+    `errors`), every other destination's rows answer normally; after
+    BACKOFF_THRESHOLD consecutive failures the breaker opens and
+    further rows fail FAST (edge.backoff.fastfail) instead of burning
+    a connect timeout each."""
+    monkeypatch.setattr(edge_client_mod, "BACKOFF_BASE_S", 2.0)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = ("127.0.0.1", s.getsockname()[1])
+    s.close()
+    peers = {rim.a.member: rim.a.addr, rim.b.member: rim.b.addr,
+             member_for(dead): dead}
+    epoch = rim.a.plane.routes.epoch + 1
+    rim.a.plane.apply_routes(peers, epoch)
+    rim.b.plane.apply_routes(peers, epoch)
+    oracle = RouteTable()
+    oracle.apply(peers, 1)
+
+    def owned_by_addr(addr, n, rng):
+        out = []
+        while len(out) < n:
+            k = int.from_bytes(rng.bytes(16), "little")
+            if oracle.owner(k)[1] == addr:
+                out.append(k)
+        return out
+
+    m = Metrics()
+    c = EdgeClient([rim.a.addr, rim.b.addr], metrics=m,
+                   hedge_enabled=False)
+    try:
+        rng = np.random.RandomState(0x176)
+        dead_keys = owned_by_addr(dead, 6, rng)
+        live_keys = (owned_by_addr(rim.a.addr, 6, rng)
+                     + owned_by_addr(rim.b.addr, 6, rng))
+        mixed = dead_keys + live_keys
+        res = c.find_successor(mixed)
+        assert not res.all_ok
+        assert list(res.failed) == [True] * 6 + [False] * 12
+        assert addr_str(dead) in res.errors
+        assert all(int(o) >= 0 for o in res.owners[6:])
+        # parity for the surviving rows
+        direct = _rpc(rim.a, {"COMMAND": "FIND_SUCCESSOR",
+                              "KEYS": wire.U128Keys(live_keys),
+                              "RING": "shard"})
+        assert list(res.owners[6:]) == [int(o)
+                                        for o in direct["OWNERS"]]
+        # two more strikes open the breaker...
+        for _ in range(2):
+            assert not c.find_successor(dead_keys).all_ok
+        assert m.counter("edge.backoff.open") == 1
+        # ...and the NEXT call fails fast, rows intact elsewhere
+        t0 = time.perf_counter()
+        res4 = c.find_successor(dead_keys + live_keys[:3])
+        dt = time.perf_counter() - t0
+        assert list(res4.failed) == [True] * 6 + [False] * 3
+        assert m.counter("edge.backoff.fastfail") >= 1
+        assert dt < 1.0
+        assert "backing off" in res4.errors[addr_str(dead)]
+        # a BUSY verdict opens the window IMMEDIATELY (no threshold)
+        c._backoff_fail(("203.0.113.9", 19), busy=True)
+        with pytest.raises(EdgeError):
+            c._backoff_admit(("203.0.113.9", 19))
+        assert m.counter("edge.backoff.busy") == 1
+        assert m.counter("edge.backoff.open") == 2
+    finally:
+        c.close()
+        rim.bless()
+
+
+# ---------------------------------------------------------------------------
+# the trace chain
+# ---------------------------------------------------------------------------
+
+def test_trace_chain_rooted_at_edge_request(rim, edge):
+    """One routed read is ONE trace: edge.request (the ROOT) ->
+    edge.flush -> rpc.client.FIND_SUCCESSOR -> rpc.server on the
+    owner — the wire-carried context crosses the socket exactly like
+    the mesh's forwarded hop."""
+    rng = np.random.RandomState(0x177)
+    k = rim.owned_by(rim.b, 1, rng)[0]
+    edge.routes.ensure()                  # seed OUTSIDE the trace
+    with trace_mod.tracing() as store:
+        res = edge.find_successor([k])
+        assert res.all_ok, res.errors
+        spans = store.spans()
+    names = {s["name"] for s in spans}
+    for want in ("edge.request", "edge.flush",
+                 "rpc.client.FIND_SUCCESSOR",
+                 "rpc.server.FIND_SUCCESSOR"):
+        assert want in names, (want, sorted(names))
+    chain = trace_mod.find_chain(spans, "rpc.server.FIND_SUCCESSOR")
+    assert chain, "owner server span unlinked from the chain"
+    assert chain[-1]["name"] == "edge.request", \
+        [s["name"] for s in chain]
+    assert chain[-1]["parent_id"] is None
+    chain_names = [s["name"] for s in chain]
+    assert "edge.flush" in chain_names
+    assert "rpc.client.FIND_SUCCESSOR" in chain_names
+    assert len({s["trace_id"] for s in chain}) == 1, \
+        "the routed hop forked a fresh trace"

@@ -1,37 +1,38 @@
 #!/bin/bash
-# TPU recovery watcher, round 16: sixteen configs want on-chip
-# records (greens from r07-r15 carry over; chordax-elastic joins the
+# TPU recovery watcher, round 17: seventeen configs want on-chip
+# records (greens from r07-r16 carry over; chordax-edge joins the
 # want list). Wait for the chip to be free, probe the remote-compile
 # service (dead since round 4: connection-refused on its port while
 # cached programs kept executing), and when it answers, run the
 # configs without a green record one at a time into
-# BENCH_ATTEMPT_r16.jsonl (bench's _record_lkg promotes each green
+# BENCH_ATTEMPT_r17.jsonl (bench's _record_lkg promotes each green
 # on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# --trace device-timeline archiving (now into BENCH_TRACE_r16). All
+# --trace device-timeline archiving (now into BENCH_TRACE_r17). All
 # prior gates stay (wire-isolated binary >= 3x JSON keys/s at <= 1/2
 # p50, traced chain, havoc scenario matrix >= 99% availability, pulse
-# + fastlane + fuse + lens + mesh smokes, zero retraces). NEW in
-# round 16 (chordax-elastic): an ELASTIC SMOKE pre-bench gate — the
-# REAL RingPolicy rides a saturation ramp on one gateway: sustained
-# saturation splits the ring (1->2) through churn-grow + heal + ONE
-# atomic router swap, sustained idle merges it back, availability
-# >= 99% under the probing reader throughout, every acked write
-# byte-readable after the merge, exactly 2 executed actions (the
-# flap-suppression gate), the seeded decision ledger replaying
-# digest-identical, zero steady-state retraces on every engine the
-# policy built — must pass on CPU before anything claims the chip.
-# The want-list headline stays the fuse on-chip record + the IDA A/B
-# + the lens cost table + the mesh 4-process record, now joined by
-# the elastic config's full 1->8->1 ramp (+ mesh-tier process
-# grow/shrink) with its archived ledger. Never kills anything
-# mid-TPU-work; every probe and bench attempt runs to completion (a
-# blocked fresh-shape jit takes ~25 min to fail — that is the
-# probe's cost when the service is down, accepted).
+# + fastlane + fuse + lens + mesh + elastic smokes, zero retraces).
+# NEW in round 17 (chordax-edge): an EDGE SMOKE pre-bench gate — the
+# zero-hop client SDK against a real 4-process ring: 1000-key
+# routed-vs-forwarded byte parity with the gateway forward counters
+# PROVABLY frozen (the hop is deleted, not hidden), client-routed
+# keys/s beating the gateway-forwarded baseline at equal-or-better
+# p50, the hedged tail run cutting p99 under a seeded 4% stall while
+# staying inside the ~5% hedge budget, the stale-route storm healing
+# in ONE refresh round per client through a live JOIN re-split at
+# >= 99% availability, zero steady-state refresh traffic after
+# convergence, zero retraces in every process polled over HEALTH —
+# must pass on CPU before anything claims the chip. The want-list
+# headline stays the fuse on-chip record + the IDA A/B + the lens
+# cost table + the mesh/elastic process records, now joined by the
+# edge config's zero-hop A/B + hedged-tail + storm record. Never
+# kills anything mid-TPU-work; every probe and bench attempt runs to
+# completion (a blocked fresh-shape jit takes ~25 min to fail — that
+# is the probe's cost when the service is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-16 watcher start (sixteen configs + wire/havoc/pulse/fastlane/fuse/lens/mesh/elastic smoke gates)"
+log "round-17 watcher start (seventeen configs + wire/havoc/pulse/fastlane/fuse/lens/mesh/elastic/edge smoke gates)"
 
-needed() {  # configs without a green record yet (r07-r15 greens count)
+needed() {  # configs without a green record yet (r07-r16 greens count)
   python - <<'EOF'
 import json
 ok = set()
@@ -39,7 +40,8 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
                 "BENCH_ATTEMPT_r09.jsonl", "BENCH_ATTEMPT_r10.jsonl",
                 "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl",
                 "BENCH_ATTEMPT_r13.jsonl", "BENCH_ATTEMPT_r14.jsonl",
-                "BENCH_ATTEMPT_r15.jsonl", "BENCH_ATTEMPT_r16.jsonl"):
+                "BENCH_ATTEMPT_r15.jsonl", "BENCH_ATTEMPT_r16.jsonl",
+                "BENCH_ATTEMPT_r17.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -52,7 +54,8 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
         pass
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership",
-        "pulse", "fastlane", "fuse", "lens", "mesh", "elastic"]
+        "pulse", "fastlane", "fuse", "lens", "mesh", "elastic",
+        "edge"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -64,7 +67,7 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all sixteen configs recorded green — done"
+    log "all seventeen configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
@@ -129,9 +132,9 @@ for i in $(seq 1 80); do
   # mid-bench), one linked digest->diff->heal repair trace, zero
   # retraces — on CPU before anything claims the chip. The sampled
   # series artifact lands next to this round's records.
-  mkdir -p BENCH_TRACE_r16
+  mkdir -p BENCH_TRACE_r17
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_PULSE_SERIES=BENCH_TRACE_r16/pulse_series_smoke.json \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r17/pulse_series_smoke.json \
       python bench.py --config pulse --smoke \
       >> tpu_watch.log 2>&1; then
     log "pulse smoke FAILED - fix the telemetry plane before benching"
@@ -172,7 +175,7 @@ for i in $(seq 1 80); do
   # (Chrome export + rendered per-kind cost breakdown) archives next
   # to this round's records.
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_LENS_PROFILE=BENCH_TRACE_r16/lens_profile_smoke \
+      CHORDAX_LENS_PROFILE=BENCH_TRACE_r17/lens_profile_smoke \
       python bench.py --config lens --smoke \
       >> tpu_watch.log 2>&1; then
     log "lens smoke FAILED - fix the cost/capacity plane before benching"
@@ -203,10 +206,27 @@ for i in $(seq 1 80); do
   # engine the policy built — on CPU before anything claims the
   # chip. The smoke's ledger archives next to this round's records.
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_ELASTIC_LEDGER=BENCH_TRACE_r16/elastic_ledger_smoke.json \
+      CHORDAX_ELASTIC_LEDGER=BENCH_TRACE_r17/elastic_ledger_smoke.json \
       python bench.py --config elastic --smoke \
       >> tpu_watch.log 2>&1; then
     log "elastic smoke FAILED - fix the control plane before benching"
+    sleep 300
+    continue
+  fi
+  # Edge smoke (ISSUE 17): the zero-hop client SDK must hold — 1000-key
+  # routed-vs-forwarded byte parity with every process's gateway
+  # forward counters frozen across the routed run (the hop is deleted,
+  # not hidden), client-routed keys/s beating the gateway-forwarded
+  # baseline at equal-or-better p50, the hedged tail run cutting p99
+  # under a seeded 4% server stall while hedging <= ~5% of requests,
+  # the stale-route storm (a live JOIN re-split mid-burst) healing in
+  # ONE refresh round per client at >= 99% availability with zero
+  # steady-state refresh traffic after convergence, zero retraces in
+  # every process polled over HEALTH — on CPU before anything claims
+  # the chip.
+  if ! JAX_PLATFORMS=cpu python bench.py --config edge --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "edge smoke FAILED - fix the client rim before benching"
     sleep 300
     continue
   fi
@@ -220,25 +240,25 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r16
+    mkdir -p BENCH_TRACE_r17
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r16/$c)"
+      log "running --config $c (device trace -> BENCH_TRACE_r17/$c)"
       # The pulse config archives its sampled series + verdicts, the
       # lens config its ANALYZED profile (Chrome export + per-kind
       # cost-breakdown markdown), and the elastic config its decision
       # ledger (ring tier + mesh tier), next to this round's records
       # (the mid-bench PULSE/HEALTH/CAPACITY polls are inside the
       # configs themselves).
-      CHORDAX_PULSE_SERIES="BENCH_TRACE_r16/pulse_series_$c.json" \
-        CHORDAX_LENS_PROFILE="BENCH_TRACE_r16/lens_profile_$c" \
-        CHORDAX_ELASTIC_LEDGER="BENCH_TRACE_r16/elastic_ledger_$c.json" \
-        python bench.py --config "$c" --trace "BENCH_TRACE_r16" \
-        >> BENCH_ATTEMPT_r16.jsonl 2>> BENCH_ATTEMPT_r16.err
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r17/pulse_series_$c.json" \
+        CHORDAX_LENS_PROFILE="BENCH_TRACE_r17/lens_profile_$c" \
+        CHORDAX_ELASTIC_LEDGER="BENCH_TRACE_r17/elastic_ledger_$c.json" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r17" \
+        >> BENCH_ATTEMPT_r17.jsonl 2>> BENCH_ATTEMPT_r17.err
       log "config $c rc=$?"
       # Digest the round's trajectory after each record lands: the
       # stale-flagged table is the artifact a reviewer reads first.
       python -m p2p_dhts_tpu.lens.bench_report \
-        --out BENCH_TRACE_r16/trajectory.md >> tpu_watch.log 2>&1
+        --out BENCH_TRACE_r17/trajectory.md >> tpu_watch.log 2>&1
     done
   else
     log "compile service still down"
