@@ -1,4 +1,4 @@
-"""chordax-lint: three-pass static analysis for the repo's hard-bug
+"""chordax-lint: seven-pass static analysis for the repo's hard-bug
 classes, with a CLI (`python -m p2p_dhts_tpu.analysis`) and CI gates.
 
   Pass 1  trace-safety     AST: jit-boundary hazards (Python control
@@ -9,24 +9,45 @@ classes, with a CLI (`python -m p2p_dhts_tpu.analysis`) and CI gates.
                            patterns (concat-of-slices on sharded axes,
                            associative_scan under auto-sharding,
                            dynamic_slice with traced starts), traced
-                           over the public kernels on a simulated
-                           8-device mesh.
+                           over the registered kernels on a simulated
+                           8-device mesh; the registry itself is
+                           audited — every public jit'd kernel must be
+                           traced or carry a reasoned exemption.
   Pass 3  lock-discipline  static lock-order graph + blocking-call
-                           audit over the threaded serving layer; an
-                           opt-in runtime watchdog (CHORDAX_LOCK_CHECK=1)
-                           verifies the order during soaks.
+                           audit over every lock-bearing module (the
+                           module list is DISCOVERED, and the curated
+                           DEFAULT_LOCK_MODULES tuple is audited
+                           against the discovery); an opt-in runtime
+                           watchdog (CHORDAX_LOCK_CHECK=1) verifies
+                           the order during soaks.
   Pass 4  metrics          metric-key doc-drift gate (chordax-scope):
                            every dotted key recorded in code must
                            appear in README.md's metric-key inventory
                            table, and every inventory row must still
                            have a recording site.
+  Pass 5  epochs           epoch-monotonicity contract: every write to
+                           an epoch/generation-bearing field must be a
+                           monotonic increment or guard-dominated, and
+                           ordered epoch compares must agree on one
+                           boundary family (`>` vs `>=` drift).
+  Pass 6  lifecycle        zombie-loop + stale-telemetry classes: every
+                           loop/thread/pool starter must have a
+                           reachable stop, and every identity-suffixed
+                           metric family must have a retirement path.
+  Pass 7  verbs            wire-contract drift gate: registered verbs
+                           must be exercised and documented, documented
+                           verbs must exist, envelope header fields and
+                           README's vocabulary cannot drift either way.
 
 Inline suppressions: `# chordax-lint: disable=<rule> -- <reason>`
 (reason mandatory; see analysis.common). `run_all` is the library
-entry the pytest session gate and the dryrun scan stage call.
+entry the pytest session gate and the dryrun scan stage call. An
+`analysis_baseline.json` at the root is applied as a diff valve —
+only NEW findings gate; every baseline entry needs a reason and stale
+entries are themselves findings.
 
-This package imports jax only inside Pass 2 — Pass 1/3 (and the
-runtime watchdog) stay importable in processes whose accelerator
+This package imports jax only inside Pass 2 — the other passes (and
+the runtime watchdog) stay importable in processes whose accelerator
 runtime is unusable, the same hygiene rule as `__graft_entry__`.
 """
 
@@ -38,13 +59,15 @@ from typing import List, Optional, Sequence, Tuple
 from p2p_dhts_tpu.analysis.common import (  # noqa: F401
     Finding,
     SuppressionIndex,
+    apply_baseline,
     apply_suppressions,
     json_report,
     package_files,
     render_report,
 )
 
-ALL_PASSES = ("trace", "gspmd", "locks", "metrics")
+ALL_PASSES = ("trace", "gspmd", "locks", "metrics", "epochs",
+              "lifecycle", "verbs")
 
 
 def default_root() -> str:
@@ -56,20 +79,25 @@ def default_root() -> str:
 def run_all(root: Optional[str] = None,
             passes: Sequence[str] = ALL_PASSES,
             files: Optional[Sequence[str]] = None,
+            baseline: Optional[str] = None,
             ) -> Tuple[List[Finding], int]:
     """Run the selected passes over the shipped tree; returns
-    (unsuppressed findings incl. suppression-hygiene problems,
-    n_suppressed).
+    (unsuppressed findings incl. suppression-hygiene and baseline
+    problems, n_suppressed — inline suppressions plus baselined).
 
     `files` restricts the scan set and is only meaningful for the
-    AST-driven trace pass; the locks pass scans its fixed serving-layer
+    AST-driven trace pass; the locks pass scans its discovered
     module list and the gspmd pass traces the IMPORTED package's
     kernels regardless, so combining `files` with those passes would
-    silently analyze files the caller never named."""
+    silently analyze files the caller never named.
+
+    `baseline` names the diff-mode baseline file; by default
+    `<root>/analysis_baseline.json` is applied when present (a missing
+    file is simply no baseline — see common.apply_baseline)."""
     if files is not None and set(passes) - {"trace"}:
         raise ValueError(
             "run_all(files=...) only supports passes=('trace',); the "
-            "locks/gspmd passes scan fixed module sets")
+            "other passes scan discovered module/registry sets")
     root = root if root is not None else default_root()
     scan_files = list(files) if files is not None else package_files(root)
     raw: List[Finding] = []
@@ -80,11 +108,21 @@ def run_all(root: Optional[str] = None,
         from p2p_dhts_tpu.analysis import lockcheck
         raw.extend(lockcheck.run_default(root))
     if "gspmd" in passes:
-        from p2p_dhts_tpu.analysis import gspmd
+        from p2p_dhts_tpu.analysis import gspmd, registry
         raw.extend(gspmd.run_default(root))
+        raw.extend(registry.coverage_findings(root))
     if "metrics" in passes:
         from p2p_dhts_tpu.analysis import metric_keys
         raw.extend(metric_keys.run_default(root))
+    if "epochs" in passes:
+        from p2p_dhts_tpu.analysis import epochs
+        raw.extend(epochs.run_default(root))
+    if "lifecycle" in passes:
+        from p2p_dhts_tpu.analysis import lifecycle
+        raw.extend(lifecycle.run_default(root))
+    if "verbs" in passes:
+        from p2p_dhts_tpu.analysis import verbs
+        raw.extend(verbs.run_default(root))
     # Index EVERY scanned file up front, not just files with findings:
     # a reasonless or unknown-rule suppression in an otherwise-clean
     # file must still surface as a lint-suppression finding, or stale
@@ -94,4 +132,7 @@ def run_all(root: Optional[str] = None,
     for path in scan_files:
         index.add_file(path, repo_rel(path, root))
     findings, n_sup, _ = apply_suppressions(raw, root, index)
-    return findings, n_sup
+    findings, n_baselined, problems = apply_baseline(
+        findings, root, baseline_path=baseline)
+    findings = sorted(set(findings) | set(problems))
+    return findings, n_sup + n_baselined

@@ -1,5 +1,5 @@
 """CLI: `python -m p2p_dhts_tpu.analysis [--strict] [--json PATH]
-[--passes trace,gspmd,locks] [--root DIR]`.
+[--passes trace,gspmd,locks,...] [--root DIR] [--baseline PATH]`.
 
 --strict is the CI-gate mode: exit 1 on any unsuppressed finding
 (exit 2 on an internal analyzer error). Without it the run is
@@ -39,12 +39,18 @@ def main(argv=None) -> int:
     parser.add_argument("--json", metavar="PATH", default=None,
                         help="write the machine-readable report here "
                              "('-' for stdout)")
-    parser.add_argument("--passes", default="trace,gspmd,locks,metrics",
+    parser.add_argument("--passes",
+                        default="trace,gspmd,locks,metrics,epochs,"
+                                "lifecycle,verbs",
                         help="comma list from {trace,gspmd,locks,"
-                             "metrics}")
+                             "metrics,epochs,lifecycle,verbs}")
     parser.add_argument("--root", default=None,
                         help="repo root (default: the checkout this "
                              "package lives in)")
+    parser.add_argument("--baseline", metavar="PATH", default=None,
+                        help="baseline file for diff mode (default: "
+                             "<root>/analysis_baseline.json when "
+                             "present); only NEW findings gate")
     args = parser.parse_args(argv)
 
     from p2p_dhts_tpu import analysis
@@ -58,7 +64,8 @@ def main(argv=None) -> int:
         _provision_cpu_mesh()
 
     try:
-        findings, n_sup = analysis.run_all(root=args.root, passes=passes)
+        findings, n_sup = analysis.run_all(root=args.root, passes=passes,
+                                           baseline=args.baseline)
     # chordax-lint: disable=bare-except -- CLI boundary: an analyzer crash must become exit 2, not a traceback
     except Exception as exc:
         print(f"chordax-lint: internal analyzer error: {exc!r}",

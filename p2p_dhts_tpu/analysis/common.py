@@ -38,9 +38,29 @@ KNOWN_RULES = {
     "gspmd-dynamic-slice-traced-start",
     # pass 3 — lock discipline
     "lock-order-cycle", "lock-held-across-blocking", "lock-reacquire",
+    "lock-module-uncovered", "lock-module-stale",
+    # pass 2 — registry coverage
+    "gspmd-kernel-untraced",
+    # pass 4 — metric-key doc drift
+    "metric-key-undocumented", "metric-key-stale",
+    # pass 5 — epoch monotonicity
+    "epoch-unguarded-write", "epoch-compare-drift",
+    # pass 6 — lifecycle / telemetry retirement
+    "loop-close-missing", "loop-leak", "telemetry-retire-missing",
+    # pass 7 — wire-contract drift
+    "verb-unreachable", "verb-undocumented", "verb-stale",
+    "verb-unregistered", "field-undocumented", "field-stale",
     # meta
-    "lint-suppression",
+    "lint-suppression", "baseline-missing-reason", "baseline-stale",
 }
+
+#: Rules the baseline diff mode may NOT absorb: suppression hygiene
+#: and the baseline's own integrity findings must stay un-maskable.
+UNBASELINEABLE = {"lint-suppression", "baseline-missing-reason",
+                  "baseline-stale"}
+
+#: Default baseline filename, resolved against the scan root.
+BASELINE_NAME = "analysis_baseline.json"
 
 _SUPPRESS_RE = re.compile(
     r"#\s*chordax-lint:\s*disable=([A-Za-z0-9_,\- ]+?)"
@@ -189,6 +209,85 @@ def apply_suppressions(findings: Iterable[Finding], root: str,
             kept.append(f)
     kept.extend(index.problems)
     return sorted(set(kept)), n_sup, index
+
+
+def apply_baseline(findings: Iterable[Finding], root: str,
+                   baseline_path: Optional[str] = None
+                   ) -> Tuple[List[Finding], int, List[Finding]]:
+    """Diff mode: drop findings recorded in the baseline file so
+    `--strict` gates only NEW findings — the legacy-burn-down valve.
+
+    Returns (kept, n_baselined, problems). Every entry is an object
+    `{"path", "rule", "reason"}` (optional `"line"` pins one site) and
+    the reason is mandatory: a reasonless entry yields a
+    `baseline-missing-reason` finding, an entry matching nothing
+    yields `baseline-stale` — the file can only shrink, never rot.
+    A missing baseline file is simply no baseline."""
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    rel = repo_rel(baseline_path, root)
+    problems: List[Finding] = []
+    try:
+        with open(baseline_path, "r", encoding="utf-8") as fh:
+            entries = json.load(fh)
+    except OSError:
+        return sorted(set(findings)), 0, []
+    except ValueError as exc:
+        problems.append(Finding(
+            rel, 1, "baseline-missing-reason",
+            f"unparseable baseline file: {exc}", "meta"))
+        return sorted(set(findings)), 0, problems
+    if not isinstance(entries, list):
+        problems.append(Finding(
+            rel, 1, "baseline-missing-reason",
+            "baseline must be a JSON list of "
+            "{path, rule, reason[, line]} objects", "meta"))
+        entries = []
+
+    valid: List[Optional[dict]] = []
+    for i, entry in enumerate(entries):
+        reason = entry.get("reason") if isinstance(entry, dict) else None
+        if not isinstance(entry, dict) or \
+                not str(reason or "").strip():
+            problems.append(Finding(
+                rel, 1, "baseline-missing-reason",
+                f"baseline entry #{i} ({entry!r}) has no reason — "
+                f"zero silent baseline entries; state why the finding "
+                f"is tolerated", "meta"))
+            valid.append(None)
+        else:
+            valid.append(entry)
+
+    def _matches(entry: dict, f: Finding) -> bool:
+        if f.rule in UNBASELINEABLE or entry.get("rule") != f.rule:
+            return False
+        if str(entry.get("path", "")).replace("\\", "/") != \
+                f.path.replace(os.sep, "/"):
+            return False
+        return "line" not in entry or int(entry["line"]) == f.line
+
+    kept: List[Finding] = []
+    used = [False] * len(valid)
+    n_baselined = 0
+    for f in sorted(set(findings)):
+        hit = None
+        for i, entry in enumerate(valid):
+            if entry is not None and _matches(entry, f):
+                hit = i
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            used[hit] = True
+            n_baselined += 1
+    for i, entry in enumerate(valid):
+        if entry is not None and not used[i]:
+            problems.append(Finding(
+                rel, 1, "baseline-stale",
+                f"baseline entry {{path: {entry.get('path')!r}, rule: "
+                f"{entry.get('rule')!r}}} matches no current finding — "
+                f"delete it (the baseline only shrinks)", "meta"))
+    return kept, n_baselined, problems
 
 
 def render_report(findings: Sequence[Finding], n_suppressed: int,

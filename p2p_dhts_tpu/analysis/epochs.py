@@ -1,0 +1,225 @@
+"""Pass 5 (epochs): the epoch-monotonicity contract, mechanically.
+
+The route fabric's one load-bearing ordering rule — "stale gossip never
+applies backwards" — lives in three idioms today: RouteTable.apply's
+`if epoch <= self._epoch: return False` guard, the mesh peer's
+`int(epoch) > current` staleness beacon, and the edge cache's twin.
+Nothing stopped a fourth install site from assigning an epoch field
+unguarded, or from flipping `>` to `>=` and re-applying equal-epoch
+docs forever. This pass pins both:
+
+  * `epoch-unguarded-write` — an AST dataflow check over every
+    `self.<attr> = ...` where the attribute is epoch/generation-bearing
+    (`_epoch`, `routes_epoch`, `_generation`, ...): outside `__init__`
+    the write must either be a monotonic self-increment
+    (`self._epoch += 1` / `self._epoch = self._epoch + 1`) or be
+    dominated by an ORDERED epoch compare earlier in the same function
+    (the guard-then-install shape). Mirror/latch fields that follow an
+    authoritative table's epoch by design opt out with the standard
+    `chordax-lint: disable=epoch-unguarded-write` comment (reasoned).
+  * `epoch-compare-drift` — every ordered compare against a
+    self-rooted epoch attribute is normalized to "incoming OP current"
+    (Gt/LtE == the strict family, GtE/Lt == the equal-accepting
+    family); mixing families across install sites is exactly the
+    `>` vs `>=` drift that re-applies same-epoch documents on one path
+    and drops them on another, so the minority family is flagged.
+    Equality tests (`==`/`!=` change-detection latches, the gateway
+    cache's fill-drop) are not ordering claims and never fire.
+
+Pure AST, package-wide (no module registry to forget to append to).
+This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from p2p_dhts_tpu.analysis.common import (Finding, KNOWN_RULES,
+                                          package_files, repo_rel)
+
+PASS = "epochs"
+
+KNOWN_RULES.add("epoch-unguarded-write")
+KNOWN_RULES.add("epoch-compare-drift")
+
+#: Attribute/name shapes that carry epoch-ordered state.
+_EPOCH_ATTR_RE = re.compile(r"epoch|generation", re.IGNORECASE)
+
+_ORDERED_OPS = (ast.Gt, ast.GtE, ast.Lt, ast.LtE)
+
+
+def _is_epoch_name(name: str) -> bool:
+    return bool(_EPOCH_ATTR_RE.search(name))
+
+
+def _mentions_epoch(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and _is_epoch_name(sub.attr):
+            return True
+        if isinstance(sub, ast.Name) and _is_epoch_name(sub.id):
+            return True
+    return False
+
+
+def _self_rooted(node: ast.AST) -> bool:
+    """True when `node` contains an attribute chain rooted at `self`
+    whose terminal attribute is epoch-bearing (`self._epoch`,
+    `self.table.epoch`, ...) — the "current" side of a compare."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and _is_epoch_name(sub.attr):
+            root = sub
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "self":
+                return True
+    return False
+
+
+def _is_self_epoch_target(tgt: ast.AST) -> Optional[str]:
+    if isinstance(tgt, ast.Attribute) and \
+            isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
+            and _is_epoch_name(tgt.attr):
+        return tgt.attr
+    return None
+
+
+def _is_monotonic_increment(stmt: ast.stmt, attr: str) -> bool:
+    """`self.<attr> += k` or `self.<attr> = self.<attr> + k`."""
+    if isinstance(stmt, ast.AugAssign) and isinstance(stmt.op, ast.Add):
+        return True
+    value = getattr(stmt, "value", None)
+    if value is None:
+        return False
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Add):
+            for side in (sub.left, sub.right):
+                if _is_self_epoch_target(side) == attr:
+                    return True
+    return False
+
+
+class _CompareSite:
+    __slots__ = ("rel", "line", "family", "snippet")
+
+    def __init__(self, rel: str, line: int, family: str, snippet: str):
+        self.rel = rel
+        self.line = line
+        self.family = family    # "strict" | "equal"
+        self.snippet = snippet
+
+
+def _classify_compare(node: ast.Compare) -> Optional[str]:
+    """The boundary family of one ordered epoch compare, normalized to
+    "incoming OP current" ("strict" for Gt/LtE, "equal" for GtE/Lt),
+    or None when the compare is not an epoch-ordering claim."""
+    if len(node.ops) != 1 or not isinstance(node.ops[0], _ORDERED_OPS):
+        return None
+    left, right = node.left, node.comparators[0]
+    left_cur, right_cur = _self_rooted(left), _self_rooted(right)
+    if left_cur == right_cur:
+        return None  # both (or neither) sides look authoritative
+    if not (_mentions_epoch(left) or _mentions_epoch(right)):
+        return None
+    op = node.ops[0]
+    if left_cur:
+        # current OP incoming — flip so incoming is on the left.
+        op = {ast.Gt: ast.Lt, ast.Lt: ast.Gt,
+              ast.GtE: ast.LtE, ast.LtE: ast.GtE}[type(op)]()
+    if isinstance(op, (ast.Gt, ast.LtE)):
+        return "strict"
+    return "equal"
+
+
+def _scan_file(path: str, rel: str,
+               findings: List[Finding],
+               compares: List[_CompareSite]) -> None:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        tree = ast.parse(src, filename=path)
+    except (OSError, SyntaxError):
+        return
+    src_lines = src.splitlines()
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # Ordered epoch compares anywhere in the function, by line —
+        # the guard set a later write may be dominated by.
+        guard_lines: List[int] = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Compare):
+                fam = _classify_compare(sub)
+                if fam is None and len(sub.ops) == 1 and \
+                        isinstance(sub.ops[0], _ORDERED_OPS) and \
+                        _mentions_epoch(sub):
+                    # Ordered + epoch-flavored but unclassifiable
+                    # (e.g. two locals): still a guard for the
+                    # dominance check, just not a drift datapoint.
+                    guard_lines.append(sub.lineno)
+                elif fam is not None:
+                    guard_lines.append(sub.lineno)
+                    snippet = ""
+                    if 0 < sub.lineno <= len(src_lines):
+                        snippet = src_lines[sub.lineno - 1].strip()
+                    compares.append(
+                        _CompareSite(rel, sub.lineno, fam, snippet))
+
+        if fn.name == "__init__":
+            continue  # construction-time seeding is not an install
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for tgt in targets:
+                    attr = _is_self_epoch_target(tgt)
+                    if attr is None:
+                        continue
+                    if _is_monotonic_increment(stmt, attr):
+                        continue
+                    if any(g <= stmt.lineno for g in guard_lines):
+                        continue
+                    findings.append(Finding(
+                        rel, stmt.lineno, "epoch-unguarded-write",
+                        f"write to epoch-bearing field self.{attr} in "
+                        f"{fn.name}() is neither a monotonic increment "
+                        f"nor dominated by an ordered epoch compare — "
+                        f"stale gossip could apply backwards",
+                        PASS))
+
+
+def run(files: Sequence[str], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    compares: List[_CompareSite] = []
+    for path in files:
+        _scan_file(path, repo_rel(path, root), findings, compares)
+
+    by_family: Dict[str, List[_CompareSite]] = {}
+    for site in compares:
+        by_family.setdefault(site.family, []).append(site)
+    if len(by_family) > 1:
+        # Mixed boundary families: flag the minority (a tie flags the
+        # equal-accepting side — "stale gossip never applies backwards"
+        # is the strict canonical rule).
+        strict = by_family.get("strict", [])
+        equal = by_family.get("equal", [])
+        minority, majority = (strict, equal) if len(strict) < len(equal) \
+            else (equal, strict)
+        example = majority[0]
+        for site in minority:
+            findings.append(Finding(
+                site.rel, site.line, "epoch-compare-drift",
+                f"epoch compare `{site.snippet}` uses the "
+                f"{site.family}-boundary family while "
+                f"{len(majority)} install site(s) use the other "
+                f"(e.g. {example.rel}:{example.line} "
+                f"`{example.snippet}`) — same-epoch documents apply "
+                f"on one path and drop on another",
+                PASS))
+    return sorted(set(findings))
+
+
+def run_default(root: str) -> List[Finding]:
+    return run(package_files(root), root)

@@ -1,0 +1,450 @@
+"""Pass 6 (lifecycle): zombie loops and stale telemetry, mechanically.
+
+Two bug classes this repo has fixed by hand more than once (PR 14/16:
+PacedLoops haunting the HEALTH registry; PR 8/15/16: per-ring/per-dest
+metric keys surviving retirement) become lint findings:
+
+  * `loop-close-missing` — a class that constructs a thread-backed
+    worker (a PacedLoop subclass, `threading.Thread`, or a WirePool)
+    onto `self` must define or inherit a reachable `close`/`stop`;
+    otherwise nothing can ever retire the worker it started. The
+    PacedLoop class table is DISCOVERED (package-wide subclass walk),
+    not listed — a new loop subclass is covered the moment it exists.
+  * `loop-leak` — a function-local construction site (bench stages,
+    dryrun phases, helpers) that builds a loop, `.start()`s it, and
+    neither stops/closes/joins it nor lets the handle escape (return /
+    yield / attribute / container / call argument) leaks a live thread
+    with no reachable off switch.
+  * `telemetry-retire-missing` — every README metric-inventory row
+    whose dynamic suffix is IDENTITY-scoped (`<ring>`, `<pair>`,
+    `<dest>`, `<addr>`, `<peer>`, `<a>`-`<b>`) must be covered by a
+    retirement site: a `remove_prefix` call whose (f-string) pattern
+    reaches the identity segment. Interpolations of loop variables
+    over literal/module-constant string tuples are EXPANDED
+    (`for fam in MEMBERSHIP_FAMS: remove_prefix(f"membership.{fam}.…")`
+    covers each family precisely), so the check is exact, not
+    prefix-sloppy. Bounded vocabularies (`<op>`, `<kind>`, `<slo>`,
+    `<site>`, `<CMD>`, `<cause>`, `<bucket>`, `<engine>`) are config-
+    chosen, not member-identity, and are exempt by placeholder name.
+
+Pure AST + README parse, package-wide. This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from p2p_dhts_tpu.analysis.common import (Finding, KNOWN_RULES,
+                                          package_files, repo_rel)
+from p2p_dhts_tpu.analysis.metric_keys import (INVENTORY_HEADING,
+                                               WILD, _BACKTICK_RE)
+
+PASS = "lifecycle"
+
+KNOWN_RULES.add("loop-close-missing")
+KNOWN_RULES.add("loop-leak")
+KNOWN_RULES.add("telemetry-retire-missing")
+
+#: Thread-backed worker roots: classes transitively extending these
+#: (or direct constructions of them) start OS threads that outlive the
+#: constructing frame.
+LOOP_ROOTS = {"PacedLoop", "Thread", "Timer", "WirePool"}
+
+#: Method-name verbs that count as a reachable off switch. Matched as
+#: whole words (`close`, `stop`, `kill`, `_stop_maintenance`,
+#: `shutdown_workers`) so reference-parity names still register.
+LIFECYCLE_VERBS = {"close", "stop", "shutdown", "kill", "cancel"}
+
+#: Placeholder NAMES that scope a key to a member identity — rings,
+#: repair pairs, wire destinations, mesh peers — whose departure must
+#: retire the key. Everything else (`<op>`, `<kind>`, `<slo>`, ...) is
+#: a bounded, config-chosen vocabulary.
+IDENTITY_PLACEHOLDERS = {"ring", "rid", "pair", "dest", "addr", "peer",
+                         "member", "a", "b"}
+
+_PLACEHOLDER_NAME_RE = re.compile(r"<([^<>]*)>")
+
+
+def _is_lifecycle_method(name: str) -> bool:
+    words = name.strip("_").split("_")
+    return any(w in LIFECYCLE_VERBS for w in words)
+
+#: Expansion cap for interpolation products (defensive; the real
+#: registries are tens of entries).
+_MAX_EXPANSION = 512
+
+
+# ---------------------------------------------------------------------------
+# loop-class discovery + lifecycle coverage
+# ---------------------------------------------------------------------------
+
+class _ClassInfo:
+    __slots__ = ("rel", "line", "bases", "methods", "loop_ctors")
+
+    def __init__(self, rel: str, line: int, bases: List[str],
+                 methods: Set[str],
+                 loop_ctors: List[Tuple[str, int]]):
+        self.rel = rel
+        self.line = line
+        self.bases = bases
+        self.methods = methods
+        self.loop_ctors = loop_ctors  # (ctor name, line) self-assigns
+
+
+def _last_part(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _collect_classes(files: Sequence[str], root: str
+                     ) -> Dict[str, _ClassInfo]:
+    out: Dict[str, _ClassInfo] = {}
+    for path in files:
+        rel = repo_rel(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = [b for b in (_last_part(x) for x in node.bases)
+                     if b is not None]
+            methods = {s.name for s in node.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            out.setdefault(node.name, _ClassInfo(
+                rel, node.lineno, bases, methods, []))
+    return out
+
+
+def _loop_class_names(classes: Dict[str, _ClassInfo]) -> Set[str]:
+    loops = set(LOOP_ROOTS)
+    changed = True
+    while changed:
+        changed = False
+        for name, info in classes.items():
+            if name not in loops and any(b in loops for b in info.bases):
+                loops.add(name)
+                changed = True
+    return loops
+
+
+def _provides_lifecycle(name: str, classes: Dict[str, _ClassInfo]) -> bool:
+    seen: Set[str] = set()
+    stack = [name]
+    while stack:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        if cur in ("Thread", "Timer"):
+            return True  # stdlib Thread carries join(); Timer cancel()
+        info = classes.get(cur)
+        if info is None:
+            continue
+        if any(_is_lifecycle_method(m) for m in info.methods):
+            return True
+        stack.extend(info.bases)
+    return False
+
+
+def _scan_owners_and_leaks(files: Sequence[str], root: str,
+                           classes: Dict[str, _ClassInfo],
+                           loop_names: Set[str],
+                           findings: List[Finding]) -> None:
+    for path in files:
+        rel = repo_rel(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self_ctors: List[Tuple[str, int]] = []
+                for sub in ast.walk(node):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    value = sub.value
+                    if not isinstance(value, ast.Call):
+                        continue
+                    ctor = _last_part(value.func)
+                    if ctor not in loop_names:
+                        continue
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            self_ctors.append((ctor, sub.lineno))
+                if self_ctors and not _provides_lifecycle(node.name,
+                                                          classes):
+                    ctor, line = self_ctors[0]
+                    findings.append(Finding(
+                        rel, line, "loop-close-missing",
+                        f"class {node.name} constructs a thread-backed "
+                        f"{ctor} but neither defines nor inherits "
+                        f"close/stop — nothing can retire the worker "
+                        f"it starts", PASS))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _scan_function_leaks(node, rel, loop_names, findings)
+
+
+def _scan_function_leaks(fn: ast.AST, rel: str, loop_names: Set[str],
+                         findings: List[Finding]) -> None:
+    # Local loop handles: name -> (ctor, line).
+    local: Dict[str, Tuple[str, int]] = {}
+    for stmt in fn.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            ctor = _last_part(stmt.value.func)
+            if ctor in loop_names and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                local[stmt.targets[0].id] = (ctor, stmt.lineno)
+    if not local:
+        return
+    started: Set[str] = set()
+    stopped: Set[str] = set()
+    escaped: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in local:
+            name, meth = node.func.value.id, node.func.attr
+            if meth == "start":
+                started.add(name)
+            elif meth == "join" or _is_lifecycle_method(meth):
+                stopped.add(name)
+            continue
+        # Any other appearance of the handle is an escape: returned,
+        # yielded, stored, passed on — someone else may own shutdown.
+        for sub in ast.walk(node) if isinstance(
+                node, (ast.Return, ast.Yield, ast.YieldFrom, ast.Call,
+                       ast.Assign, ast.AugAssign, ast.AnnAssign,
+                       ast.Dict, ast.List, ast.Tuple, ast.Set)) else ():
+            if isinstance(sub, ast.Name) and sub.id in local and \
+                    isinstance(sub.ctx, ast.Load):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.value is sub:
+                    continue  # the receiver of a method call, not an arg
+                escaped.add(sub.id)
+    for name in sorted(started - stopped - escaped):
+        ctor, line = local[name]
+        findings.append(Finding(
+            rel, line, "loop-leak",
+            f"{ctor} `{name}` is started here but never "
+            f"stopped/closed/joined and the handle does not escape — "
+            f"a leaked live thread with no off switch", PASS))
+
+
+# ---------------------------------------------------------------------------
+# telemetry retirement coverage
+# ---------------------------------------------------------------------------
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, List[str]]:
+    """Module-level NAME = "lit" / NAME = ("lit", ...) bindings."""
+    out: Dict[str, List[str]] = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1 \
+                or not isinstance(stmt.targets[0], ast.Name):
+            continue
+        v = stmt.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out[stmt.targets[0].id] = [v.value]
+        elif isinstance(v, (ast.Tuple, ast.List)) and v.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            out[stmt.targets[0].id] = [e.value for e in v.elts]
+    return out
+
+
+def _iter_domain(it: ast.AST,
+                 consts: Dict[str, List[str]]) -> Optional[List[str]]:
+    """The literal string values a `for VAR in <iter>` ranges over:
+    a tuple/list of constants, or a module-level constant tuple."""
+    if isinstance(it, (ast.Tuple, ast.List)) and it.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in it.elts):
+        return [e.value for e in it.elts]
+    if isinstance(it, ast.Name) and it.id in consts:
+        return consts[it.id]
+    return None
+
+
+def _expand_pattern(node: ast.AST, domains: Dict[str, List[str]],
+                    consts: Dict[str, List[str]]) -> List[str]:
+    """Every concrete shape of a retirement-key argument: literal
+    pieces verbatim, interpolations of resolvable loop variables /
+    module constants expanded, everything else one `<*>` wildcard."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if not isinstance(node, ast.JoinedStr):
+        return []
+    piece_choices: List[List[str]] = []
+    for piece in node.values:
+        if isinstance(piece, ast.Constant) and isinstance(piece.value, str):
+            piece_choices.append([piece.value])
+        elif isinstance(piece, ast.FormattedValue):
+            v = piece.value
+            if isinstance(v, ast.Name) and v.id in domains:
+                piece_choices.append(domains[v.id])
+            elif isinstance(v, ast.Name) and v.id in consts:
+                piece_choices.append(consts[v.id])
+            else:
+                piece_choices.append([WILD])
+        else:
+            return []
+    total = 1
+    for c in piece_choices:
+        total *= max(len(c), 1)
+        if total > _MAX_EXPANSION:
+            return ["".join(c[0] for c in piece_choices)]
+    return ["".join(combo)
+            for combo in itertools.product(*piece_choices)]
+
+
+def retirement_patterns(files: Sequence[str], root: str
+                        ) -> List[Tuple[str, str, int]]:
+    """(pattern, rel, line) per remove_prefix call in the scan set."""
+    out: List[Tuple[str, str, int]] = []
+    for path in files:
+        rel = repo_rel(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        consts = _module_str_constants(tree)
+
+        def visit(node: ast.AST, domains: Dict[str, List[str]]) -> None:
+            # Loop-variable domains are scoped to their enclosing For:
+            # the same name ranging over different registries in
+            # sibling loops must not bleed between call sites.
+            if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                    isinstance(node.target, ast.Name):
+                dom = _iter_domain(node.iter, consts)
+                inner = dict(domains)
+                if dom is not None:
+                    inner[node.target.id] = dom
+                for child in node.body:
+                    visit(child, inner)
+                for child in node.orelse:
+                    visit(child, domains)
+                return
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "remove_prefix" and node.args:
+                for pat in _expand_pattern(node.args[0], domains,
+                                           consts):
+                    out.append((pat, rel, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, domains)
+
+        visit(tree, {})
+    return out
+
+
+def _segments(key: str) -> List[str]:
+    return key.split(".")
+
+
+def _seg_match(pat_seg: str, key_seg: str) -> bool:
+    return pat_seg == key_seg or pat_seg == WILD or key_seg == WILD
+
+
+def _covers(pattern: str, key_segs: List[str], ident_idx: int) -> bool:
+    """remove_prefix(pattern) retires the family `key_segs` iff the
+    pattern prefix-matches segmentwise AND reaches the first identity
+    segment (a shorter prefix would be a wholesale wipe of unrelated
+    families, not this family's retirement)."""
+    p = _segments(pattern)
+    if len(p) < ident_idx + 1 or len(p) > len(key_segs):
+        return False
+    return all(_seg_match(a, b) for a, b in zip(p, key_segs))
+
+
+def _inventory_rows(readme_path: str) -> List[Tuple[str, int]]:
+    """(raw key, line) rows from the README metric-key inventory."""
+    rows: List[Tuple[str, int]] = []
+    try:
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return rows
+    in_section = False
+    for i, line in enumerate(lines, 1):
+        if line.strip().startswith("#"):
+            in_section = line.strip() == INVENTORY_HEADING
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        m = _BACKTICK_RE.search(line)
+        if m is not None and "." in m.group(1):
+            rows.append((m.group(1).strip(), i))
+    return rows
+
+
+def _identity_segment_index(raw_key: str) -> Optional[int]:
+    """Index of the first dotted segment carrying an identity-scoped
+    placeholder, or None when the key has none."""
+    for i, seg in enumerate(_segments(raw_key)):
+        names = _PLACEHOLDER_NAME_RE.findall(seg)
+        if any(n in IDENTITY_PLACEHOLDERS for n in names):
+            return i
+    return None
+
+
+def _normalize(raw_key: str) -> List[str]:
+    return _segments(_PLACEHOLDER_NAME_RE.sub(WILD, raw_key))
+
+
+def retirement_findings(files: Sequence[str], root: str,
+                        readme_path: str) -> List[Finding]:
+    rows = _inventory_rows(readme_path)
+    patterns = [p for p, _, _ in retirement_patterns(files, root)]
+    findings: List[Finding] = []
+    rel_readme = repo_rel(readme_path, root)
+    for raw, line in rows:
+        idx = _identity_segment_index(raw)
+        if idx is None:
+            continue
+        key_segs = _normalize(raw)
+        if not any(_covers(p, key_segs, idx) for p in patterns):
+            findings.append(Finding(
+                rel_readme, line, "telemetry-retire-missing",
+                f"identity-scoped metric family {raw!r} has no "
+                f"retirement path — no remove_prefix site reaches its "
+                f"identity segment, so the keys outlive the "
+                f"ring/pair/peer that wrote them", PASS))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def run(files: Sequence[str], root: str,
+        readme_path: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    classes = _collect_classes(files, root)
+    loop_names = _loop_class_names(classes)
+    _scan_owners_and_leaks(files, root, classes, loop_names, findings)
+    readme = readme_path if readme_path is not None \
+        else os.path.join(root, "README.md")
+    findings.extend(retirement_findings(files, root, readme))
+    return sorted(set(findings))
+
+
+def run_default(root: str) -> List[Finding]:
+    return run(package_files(root), root)

@@ -45,26 +45,39 @@ import sys
 import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from p2p_dhts_tpu.analysis.common import (Finding, dotted_name as _dotted,
-                                          repo_rel)
+from p2p_dhts_tpu.analysis.common import (Finding, KNOWN_RULES,
+                                          dotted_name as _dotted,
+                                          package_files, repo_rel)
 
 PASS = "lock-discipline"
+
+KNOWN_RULES.add("lock-module-uncovered")
+KNOWN_RULES.add("lock-module-stale")
 
 #: The threaded serving layer — the default static-analysis surface.
 #: The gateway front door (ISSUE 4) is part of it: its documented lock
 #: order (router/backend/admission locks are LEAVES, never held across
 #: engine calls — gateway/router.py docstring) is audited here.
+#: This tuple is a reviewed DECLARATION, not the source of coverage:
+#: discover_lock_modules() scans the whole package for lock/thread/
+#: queue constructors, and registry_findings() fails the gate when a
+#: lock-bearing module is missing here (lock-module-uncovered) or a
+#: listed module stopped constructing any (lock-module-stale).
 DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "serve.py"),
+    os.path.join("p2p_dhts_tpu", "metrics.py"),
     os.path.join("p2p_dhts_tpu", "net", "rpc.py"),
     os.path.join("p2p_dhts_tpu", "net", "wire.py"),
+    os.path.join("p2p_dhts_tpu", "net", "native_rpc.py"),
     os.path.join("p2p_dhts_tpu", "overlay", "finger_table.py"),
     os.path.join("p2p_dhts_tpu", "overlay", "jax_bridge.py"),
+    os.path.join("p2p_dhts_tpu", "overlay", "chord_peer.py"),
+    os.path.join("p2p_dhts_tpu", "overlay", "database.py"),
+    os.path.join("p2p_dhts_tpu", "overlay", "remote_peer.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "router.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "admission.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "cache.py"),
     os.path.join("p2p_dhts_tpu", "gateway", "frontend.py"),
-    os.path.join("p2p_dhts_tpu", "gateway", "metrics_ext.py"),
     os.path.join("p2p_dhts_tpu", "repair", "scheduler.py"),
     os.path.join("p2p_dhts_tpu", "repair", "replication.py"),
     os.path.join("p2p_dhts_tpu", "membership", "manager.py"),
@@ -75,17 +88,15 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "ops", "ida_backend.py"),
     os.path.join("p2p_dhts_tpu", "lens", "__init__.py"),
     os.path.join("p2p_dhts_tpu", "mesh", "routes.py"),
-    os.path.join("p2p_dhts_tpu", "mesh", "coalescer.py"),
     os.path.join("p2p_dhts_tpu", "mesh", "plane.py"),
     os.path.join("p2p_dhts_tpu", "mesh", "peer.py"),
     os.path.join("p2p_dhts_tpu", "elastic", "ledger.py"),
     os.path.join("p2p_dhts_tpu", "elastic", "policy.py"),
-    os.path.join("p2p_dhts_tpu", "elastic", "actuator.py"),
-    os.path.join("p2p_dhts_tpu", "elastic", "mesh.py"),
     os.path.join("p2p_dhts_tpu", "mesh", "fold.py"),
     os.path.join("p2p_dhts_tpu", "edge", "routes.py"),
     os.path.join("p2p_dhts_tpu", "edge", "hedge.py"),
     os.path.join("p2p_dhts_tpu", "edge", "client.py"),
+    os.path.join("p2p_dhts_tpu", "analysis", "lockcheck.py"),
 )
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond",
@@ -437,9 +448,80 @@ def run(paths: Sequence[str], root: str) -> List[Finding]:
     return findings
 
 
+def discover_lock_modules(root: str) -> Dict[str, int]:
+    """Scan the whole package for lock/thread/queue constructor calls:
+    repo-relative path -> first construction line. This is the ground
+    truth `DEFAULT_LOCK_MODULES` is audited against — the tuple is a
+    reviewed declaration, not the source of coverage."""
+    out: Dict[str, int] = {}
+    for path in package_files(root, extra=()):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in _LOCK_CTORS:
+                rel = repo_rel(path, root)
+                if rel not in out or node.lineno < out[rel]:
+                    out[rel] = node.lineno
+    return out
+
+
+def _registry_line() -> int:
+    """Line of the DEFAULT_LOCK_MODULES definition (stale-entry anchor)."""
+    try:
+        with open(_THIS_FILE, "r", encoding="utf-8") as fh:
+            for i, line in enumerate(fh, start=1):
+                if line.startswith("DEFAULT_LOCK_MODULES"):
+                    return i
+    except OSError:
+        pass
+    return 1
+
+
+def registry_findings(root: str,
+                      discovered: Optional[Dict[str, int]] = None
+                      ) -> List[Finding]:
+    """Audit DEFAULT_LOCK_MODULES against the discovered lock surface:
+    a lock-bearing module missing from the tuple is uncovered (the
+    manual-append failure mode), a listed module with no constructor
+    left is stale."""
+    if discovered is None:
+        discovered = discover_lock_modules(root)
+    listed = {p.replace(os.sep, "/") for p in DEFAULT_LOCK_MODULES}
+    self_rel = os.path.join("p2p_dhts_tpu", "analysis", "lockcheck.py")
+    findings: List[Finding] = []
+    for rel, line in sorted(discovered.items()):
+        if rel.replace(os.sep, "/") not in listed:
+            findings.append(Finding(
+                rel, line, "lock-module-uncovered",
+                f"{rel} constructs locks/threads/queues but is missing "
+                f"from DEFAULT_LOCK_MODULES — the static lock pass "
+                f"never audits it", PASS))
+    discovered_norm = {r.replace(os.sep, "/") for r in discovered}
+    for rel in sorted(listed - discovered_norm):
+        findings.append(Finding(
+            self_rel, _registry_line(), "lock-module-stale",
+            f"DEFAULT_LOCK_MODULES lists {rel} but the module no "
+            f"longer constructs any lock/thread/queue", PASS))
+    return findings
+
+
 def run_default(root: str) -> List[Finding]:
-    paths = [os.path.join(root, p) for p in DEFAULT_LOCK_MODULES]
-    return run([p for p in paths if os.path.exists(p)], root)
+    discovered = discover_lock_modules(root)
+    rels = sorted({p for p in DEFAULT_LOCK_MODULES
+                   if os.path.exists(os.path.join(root, p))} |
+                  set(discovered))
+    paths = [os.path.join(root, p) for p in rels]
+    findings = run([p for p in paths if os.path.exists(p)], root)
+    findings.extend(registry_findings(root, discovered))
+    return findings
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,313 @@
+"""Pass 7 (verbs): both-directions wire-contract drift gate.
+
+The protocol surface is three handler installs (the gateway's
+`update_handlers({...})` map, the two overlay peers' `handlers()`
+dicts) plus an envelope vocabulary of ALLCAPS header fields
+(DEADLINE_MS, TRACE, FWD, ROUTES_EPOCH, MESH, ...). Pass 4 proved the
+discipline for metric keys: extract reality from the AST, extract the
+contract from README, and flag drift in BOTH directions. This pass
+applies it to the wire:
+
+  * `verb-unreachable`   — a verb registered in the package has no
+    client call site (`{"COMMAND": "X"}` literal) anywhere in the
+    package, tests, bench, or the graft harness: dead protocol
+    surface nobody can regress-test.
+  * `verb-undocumented`  — a registered verb missing from README's
+    `#### Verbs` table (or, for the gateway, from the
+    `GATEWAY_COMMANDS` declaration tuple next to its install).
+  * `verb-stale`         — a README `#### Verbs` row (or a
+    `GATEWAY_COMMANDS` entry) naming a verb nothing registers.
+  * `verb-unregistered`  — a non-test client site sends a verb no
+    handler install anywhere claims: the request can only ever come
+    back `unknown command`. Tests are exempt (they probe exactly that
+    error path with fabricated verbs).
+  * `field-undocumented` — an envelope field used on the wire that is
+    missing from README's `#### Header fields` table.
+  * `field-stale`        — a documented header field no code reads or
+    writes.
+
+"Used on the wire" means: a non-`COMMAND` ALLCAPS key of a request
+dict literal (a dict literal that carries a `"COMMAND"` key), an
+ALLCAPS key read/written/popped on a message-shaped receiver
+(req/resp/out/msg/base/envelope/header names), or the value of a
+module-level `*_KEY = "ALLCAPS"` constant (trace.py's
+`WIRE_KEY = "TRACE"`). `CHORDAX_*`/`JAX_*`/`XLA_*` names are
+environment variables, not wire fields, and are excluded.
+
+Pure AST + README text; this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from p2p_dhts_tpu.analysis.common import (Finding, KNOWN_RULES,
+                                          package_files, repo_rel)
+from p2p_dhts_tpu.analysis.metric_keys import _BACKTICK_RE
+
+PASS = "verbs"
+
+for _rule in ("verb-unreachable", "verb-undocumented", "verb-stale",
+              "verb-unregistered", "field-undocumented", "field-stale"):
+    KNOWN_RULES.add(_rule)
+
+#: README headings the canonical vocabulary lives under (both inside
+#: the `### Wire-verb vocabulary` section of the chordax-lint docs).
+VERBS_HEADING = "#### Verbs"
+FIELDS_HEADING = "#### Header fields"
+
+_ALLCAPS_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_ENV_PREFIX_RE = re.compile(r"^(CHORDAX_|JAX_|XLA_|TPU_)")
+#: Variable names that carry wire envelopes (requests on the way out,
+#: handler args on the way in, response dicts on the way back).
+_RECEIVER_RE = re.compile(r"req|resp|msg|out|base|envelope|header", re.I)
+#: Accessor methods on a message dict whose first string arg is a field.
+_DICT_ACCESSORS = ("get", "pop", "setdefault")
+
+Site = Tuple[str, int]  # (repo-relative path, line)
+
+
+def _is_field_name(name: object) -> bool:
+    return (isinstance(name, str) and name != "COMMAND"
+            and bool(_ALLCAPS_RE.match(name))
+            and not _ENV_PREFIX_RE.match(name))
+
+
+class WireSurface:
+    """Everything pass 7 extracts from one tree scan."""
+
+    def __init__(self) -> None:
+        #: verb -> first install site inside the package proper.
+        self.registered: Dict[str, Site] = {}
+        #: verbs installed anywhere scanned (package + bench + graft) —
+        #: the "someone answers this" set for the unregistered check.
+        self.known: Set[str] = set()
+        #: verb -> client sites ({"COMMAND": "X"} literals), all files.
+        self.clients: Dict[str, List[Site]] = {}
+        #: verb -> client sites outside tests/ (held to verb-unregistered).
+        self.package_clients: Dict[str, List[Site]] = {}
+        #: field -> first use site inside the package proper.
+        self.fields: Dict[str, Site] = {}
+        #: GATEWAY_COMMANDS-style declaration tuples: verb -> site.
+        self.declared: Dict[str, Site] = {}
+
+
+def _handler_dicts(tree: ast.AST):
+    """Yield every handler-map dict literal: the argument of an
+    `update_handlers({...})` call, or a dict returned from a function
+    named `handlers`."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "update_handlers" and node.args and \
+                isinstance(node.args[0], ast.Dict):
+            yield node.args[0]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name == "handlers":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and \
+                        isinstance(sub.value, ast.Dict):
+                    yield sub.value
+
+
+def _scan_file(path: str, rel: str, in_package: bool, in_tests: bool,
+               surface: WireSurface) -> None:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return
+
+    # -- handler installs --------------------------------------------------
+    for hmap in _handler_dicts(tree):
+        for key in hmap.keys:
+            if isinstance(key, ast.Constant) and \
+                    isinstance(key.value, str) and \
+                    _ALLCAPS_RE.match(key.value):
+                surface.known.add(key.value)
+                if in_package:
+                    surface.registered.setdefault(
+                        key.value, (rel, key.lineno))
+
+    # -- GATEWAY_COMMANDS-style declaration tuples -------------------------
+    if in_package:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_COMMANDS") \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        surface.declared.setdefault(
+                            elt.value, (rel, elt.lineno))
+
+    # -- client call sites + envelope fields -------------------------------
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            verb: Optional[str] = None
+            for key, val in zip(node.keys, node.values):
+                if isinstance(key, ast.Constant) and \
+                        key.value == "COMMAND" and \
+                        isinstance(val, ast.Constant) and \
+                        isinstance(val.value, str):
+                    verb = val.value
+            if verb is None:
+                continue
+            site = (rel, node.lineno)
+            self_clients = surface.clients.setdefault(verb, [])
+            self_clients.append(site)
+            if not in_tests:
+                surface.package_clients.setdefault(verb, []).append(site)
+            if in_package:
+                for key in node.keys:
+                    if isinstance(key, ast.Constant) and \
+                            _is_field_name(key.value):
+                        surface.fields.setdefault(
+                            key.value, (rel, key.lineno))
+
+        if not in_package:
+            continue
+        # Reads/writes/pops on message-shaped receivers.
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                _RECEIVER_RE.search(node.value.id) and \
+                isinstance(node.slice, ast.Constant) and \
+                _is_field_name(node.slice.value):
+            surface.fields.setdefault(
+                node.slice.value, (rel, node.lineno))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _DICT_ACCESSORS and \
+                isinstance(node.func.value, ast.Name) and \
+                _RECEIVER_RE.search(node.func.value.id) and \
+                node.args and isinstance(node.args[0], ast.Constant) and \
+                _is_field_name(node.args[0].value):
+            surface.fields.setdefault(
+                node.args[0].value, (rel, node.lineno))
+        # Module-level wire-key constants: WIRE_KEY = "TRACE".
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id.endswith("_KEY") and \
+                isinstance(node.value, ast.Constant) and \
+                _is_field_name(node.value.value):
+            surface.fields.setdefault(
+                node.value.value, (rel, node.lineno))
+
+
+def extract_surface(files: Sequence[str], root: str) -> WireSurface:
+    """Scan `files` (the package set) plus tests/ for the wire surface."""
+    surface = WireSurface()
+    test_files = sorted(
+        glob.glob(os.path.join(root, "tests", "**", "*.py"),
+                  recursive=True))
+    for path in list(files) + test_files:
+        rel = repo_rel(path, root)
+        in_tests = rel.startswith("tests" + os.sep) or \
+            rel.startswith("tests/")
+        in_package = rel.replace(os.sep, "/").startswith("p2p_dhts_tpu/")
+        _scan_file(path, rel, in_package, in_tests, surface)
+    return surface
+
+
+def _doc_table(readme_path: str, heading: str) -> Dict[str, int]:
+    """First backticked cell of each table row under `heading` ->
+    1-based README line. Empty when the README/section is missing."""
+    rows: Dict[str, int] = {}
+    try:
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return rows
+    in_section = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if stripped == heading:
+            in_section = True
+            continue
+        if in_section and stripped.startswith("#"):
+            break
+        if in_section and stripped.startswith("|"):
+            m = _BACKTICK_RE.search(stripped)
+            if m:
+                rows.setdefault(m.group(1), i)
+    return rows
+
+
+def run(files: Sequence[str], root: str,
+        readme_path: Optional[str] = None) -> List[Finding]:
+    if readme_path is None:
+        readme_path = os.path.join(root, "README.md")
+    surface = extract_surface(files, root)
+    doc_verbs = _doc_table(readme_path, VERBS_HEADING)
+    doc_fields = _doc_table(readme_path, FIELDS_HEADING)
+    readme_rel = repo_rel(readme_path, root)
+
+    findings: List[Finding] = []
+
+    for verb, (rel, line) in sorted(surface.registered.items()):
+        if verb not in surface.clients:
+            findings.append(Finding(
+                rel, line, "verb-unreachable",
+                f"registered verb '{verb}' has no client call site "
+                f"(no {{\"COMMAND\": \"{verb}\"}} literal in the "
+                f"package, tests, bench, or graft harness) — dead "
+                f"protocol surface nobody can regress-test", PASS))
+        if verb not in doc_verbs:
+            findings.append(Finding(
+                rel, line, "verb-undocumented",
+                f"registered verb '{verb}' is missing from README's "
+                f"`{VERBS_HEADING}` vocabulary table", PASS))
+        # Gateway declaration-tuple sync: an installed gateway verb
+        # must appear in GATEWAY_COMMANDS (same-module declaration).
+        if surface.declared and verb not in surface.declared and \
+                any(d[0] == rel for d in surface.declared.values()):
+            findings.append(Finding(
+                rel, line, "verb-undocumented",
+                f"verb '{verb}' is installed but missing from the "
+                f"*_COMMANDS declaration tuple in {rel}", PASS))
+
+    for verb, line in sorted(doc_verbs.items()):
+        if verb not in surface.registered:
+            findings.append(Finding(
+                readme_rel, line, "verb-stale",
+                f"README documents wire verb '{verb}' but no handler "
+                f"install registers it", PASS))
+    for verb, (rel, line) in sorted(surface.declared.items()):
+        if verb not in surface.registered:
+            findings.append(Finding(
+                rel, line, "verb-stale",
+                f"*_COMMANDS declares verb '{verb}' but no handler "
+                f"install registers it", PASS))
+
+    for verb, sites in sorted(surface.package_clients.items()):
+        if verb not in surface.known:
+            rel, line = sites[0]
+            findings.append(Finding(
+                rel, line, "verb-unregistered",
+                f"client sends verb '{verb}' but no handler install "
+                f"anywhere registers it — the request can only come "
+                f"back `unknown command`", PASS))
+
+    for field, (rel, line) in sorted(surface.fields.items()):
+        if field not in doc_fields:
+            findings.append(Finding(
+                rel, line, "field-undocumented",
+                f"wire header field '{field}' is missing from "
+                f"README's `{FIELDS_HEADING}` vocabulary table", PASS))
+    for field, line in sorted(doc_fields.items()):
+        if field not in surface.fields:
+            findings.append(Finding(
+                readme_rel, line, "field-stale",
+                f"README documents wire header field '{field}' but "
+                f"no code reads or writes it", PASS))
+
+    return sorted(set(findings))
+
+
+def run_default(root: str) -> List[Finding]:
+    return run(package_files(root), root)

@@ -338,9 +338,11 @@ def ring_genesis(lanes: jax.Array, cfg: RingConfig = DEFAULT_CONFIG,
 
     # preds at genesis is the pure (row - 1) % n_valid shift, so prev_ids
     # is structurally a roll — NOT ids[preds], a capacity-at-capacity
-    # gather (the TPU compile-cliff op class; see churn.leave).
-    wrap_id = jax.lax.dynamic_slice(
-        ids, (n_valid - 1, 0), (1, LANES))              # ids[n_valid-1]
+    # gather (the TPU compile-cliff op class; see churn.leave). The
+    # single wrap row is a one-index gather, NOT a dynamic_slice: with
+    # ids row-sharded over "peer", a dynamic-slice start derived from
+    # traced data is the gspmd-dynamic-slice-traced-start miscompile.
+    wrap_id = jnp.take(ids, n_valid - 1, axis=0)[None, :]  # ids[n_valid-1]
     prev_ids = jnp.where((rows > 0)[:, None],
                          jnp.roll(ids, 1, axis=0), wrap_id)
     min_key = jnp.where(valid[:, None],

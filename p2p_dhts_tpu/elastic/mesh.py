@@ -189,6 +189,7 @@ class ShardRebalancer(PacedLoop):
     def _round(self) -> None:
         epoch = self.plane.routes.epoch
         if epoch != self._seen_epoch:
+            # chordax-lint: disable=epoch-unguarded-write -- change-detection latch mirroring RouteTable's epoch; monotonicity is enforced at the table's apply() guard, so != here is equivalent to >
             self._seen_epoch = epoch
             self.rebalance()
         self.rounds += 1

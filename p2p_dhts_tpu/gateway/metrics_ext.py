@@ -48,7 +48,8 @@ MEMBERSHIP_FAMS = (
     "suspects", "suspicion_cleared", "failures_detected", "batches",
     "rows_applied", "rows_regenerated", "ranges_transferred",
     "heal_enqueued", "stalled_rounds", "round_failures",
-    "handoff_failover", "pending", "members_alive", "converged")
+    "handoff_failover", "pending", "members_alive", "converged",
+    "fail_vetoed", "flap_suppressed", "rejoins", "listener_errors")
 
 #: Per-ring repair key families (repair.<fam>.<ring> /
 #: repair.replication.<fam>.<ring>). Pair-keyed repair telemetry

@@ -85,10 +85,17 @@ def _build_library() -> str:
 def load_library() -> ctypes.CDLL:
     """Build-if-needed and load the engine; cached process-wide."""
     global _lib
+    if _lib is not None:
+        return _lib
+    # Build OUTSIDE _lib_lock: _build_library is concurrency-safe on
+    # its own (tempfile + atomic os.replace — concurrent builders both
+    # win), and a cold g++ build takes seconds, which would otherwise
+    # stall every caller behind the first loader.
+    lib_path = _build_library()
     with _lib_lock:
         if _lib is not None:
             return _lib
-        lib = ctypes.CDLL(_build_library())
+        lib = ctypes.CDLL(lib_path)
         lib.ns_free.argtypes = [ctypes.c_void_p]
         lib.ns_sha1.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                 ctypes.c_char_p]

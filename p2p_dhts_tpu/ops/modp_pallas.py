@@ -92,6 +92,7 @@ def _decode_tile_kernel(idx_ref, rows_ref, out_ref, *, m: int, p: int):
 
 
 @functools.partial(jax.jit, static_argnames=("p", "interpret"))
+# chordax-lint: disable=gspmd-kernel-untraced -- single-core Pallas primitive (no GSPMD partitioning decisions in its body); traced in interpret mode and pinned against ida.decode_kernel by tests/test_ida.py
 def decode_kernel_pallas(rows: jax.Array, indices: jax.Array, p: int,
                          interpret: bool = False) -> jax.Array:
     """Pallas twin of ida.decode_kernel: [B, m, S] rows + [B, m] 1-based

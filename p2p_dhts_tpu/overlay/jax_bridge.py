@@ -60,6 +60,7 @@ def _load_kernel():
             from p2p_dhts_tpu.ops import u128
 
             @jax.jit
+            # chordax-lint: disable=gspmd-kernel-untraced -- thin bridge over the same closed form the registry traces as serve.finger_index (ring.finger_index_batch); only host-side glue differs
             def finger_index(keys, start):
                 # dist==0 -> bit_length 0 -> index -1: the "key is the
                 # table's own starting key" LookupError case.

@@ -715,12 +715,14 @@ class RepairScheduler:
             # haunt dashboards forever.
             for loop in victims:
                 if isinstance(loop, _DriftLoop):
-                    self.metrics.remove_prefix(
-                        f"repair.converged.{loop.ring_id}-drift")
+                    for fam in ("converged", "round_failures"):
+                        self.metrics.remove_prefix(
+                            f"repair.{fam}.{loop.ring_id}-drift")
                     continue
                 pair_key = f"{loop.pair[0]}-{loop.pair[1]}"
                 for fam in ("backlog", "converged", "tokens",
-                            "round_ms"):
+                            "round_ms", "round_failures",
+                            "stalled_rounds"):
                     self.metrics.remove_prefix(
                         f"repair.{fam}.{pair_key}")
         return len(victims)

@@ -1,0 +1,50 @@
+"""Seeded epoch-monotonicity violations for pass 5 (epochs).
+
+Parsed (never imported) by tests/test_analysis.py only —
+``package_files()`` excludes tests/, so the shipped-tree strict gate
+never scans this corpus. Every violating line carries a
+``LINT-EXPECT: <rule>`` marker; the clean counterpart idioms ride
+along to pin the pass's false-positive behavior, file:line-exact in
+both directions.
+"""
+
+
+class UnguardedInstall:
+    """The bug class: a fourth install site assigning wholesale."""
+
+    def __init__(self):
+        self._epoch = 0  # construction-time seeding: exempt
+
+    def apply(self, epoch, rows):
+        self.rows = rows
+        self._epoch = epoch  # LINT-EXPECT: epoch-unguarded-write
+
+    def bump(self):
+        self._epoch += 1  # monotonic self-increment: exempt
+
+    def rebuild(self):
+        self._generation = self._generation + 1  # spelled-out: exempt
+
+
+class GuardedInstall:
+    """The blessed guard-then-install shape (RouteTable.apply)."""
+
+    def apply(self, epoch, rows):
+        if epoch <= self._epoch:  # strict family: equal drops too
+            return False
+        self.rows = rows
+        self._epoch = epoch  # dominated by the ordered compare: exempt
+        return True
+
+    def is_newer(self, epoch):
+        return int(epoch) > self._epoch  # strict family (beacon twin)
+
+
+class DriftingInstall:
+    """Equal-accepting boundary against two strict siblings above —
+    same-epoch maps re-apply on this path and drop on the others."""
+
+    def apply(self, epoch, rows):
+        if epoch >= self._epoch:  # LINT-EXPECT: epoch-compare-drift
+            self.rows = rows
+            self._epoch = epoch  # dominated (by the drifting guard)

@@ -1,0 +1,55 @@
+"""Seeded lifecycle/retirement violations for pass 6 (lifecycle).
+
+Parsed (never imported) by tests/test_analysis.py only, paired with
+``lifecycle_readme.md`` for the telemetry-retirement rows. Violating
+lines carry ``LINT-EXPECT: <rule>`` markers; the clean counterparts
+(close-providing owner, inherited off switch, joined/escaping local
+handles, a covering remove_prefix site) pin the pass's
+false-positive behavior.
+"""
+
+
+class ZombieOwner:
+    """Constructs a paced worker, provides no off switch."""
+
+    def __init__(self, fn, period):
+        self._loop = PacedLoop(fn, period)  # LINT-EXPECT: loop-close-missing
+
+
+class ClosedOwner:
+    """Same construction, reachable close(): clean."""
+
+    def __init__(self, fn, period):
+        self._loop = PacedLoop(fn, period)
+
+    def close(self):
+        self._loop.close()
+
+
+class InheritedOwner(ClosedOwner):
+    """Inherits the off switch from its base: clean."""
+
+    def __init__(self, fn, period):
+        self._watch = Thread(target=fn)
+
+
+def leaky_stage(fn):
+    pacer = PacedLoop(fn, 0.1)  # LINT-EXPECT: loop-leak
+    pacer.start()
+
+
+def joined_stage(fn):
+    worker = Thread(target=fn)
+    worker.start()
+    worker.join()
+
+
+def escaping_stage(fn):
+    pacer = PacedLoop(fn, 0.1)
+    pacer.start()
+    return pacer  # caller owns shutdown: clean
+
+
+def retire_fixture(metrics, ring_id):
+    """Covers `fixture.retired.<ring>` in lifecycle_readme.md."""
+    metrics.remove_prefix(f"fixture.retired.{ring_id}")

@@ -1,0 +1,39 @@
+"""Seeded wire-contract violations for pass 7 (verbs).
+
+tests/test_analysis.py copies this file VERBATIM (line numbers
+preserved) into a scratch tree's ``p2p_dhts_tpu/`` package next to
+``verbs_readme.md``, so every drift rule fires against a closed
+vocabulary; under tests/ the shipped-tree gate never scans it. The
+PING verb and STATUS field are the fully-consistent control:
+registered, declared, exercised, documented.
+"""
+
+FIXTURE_COMMANDS = (
+    "PING",
+    "GHOST",  # LINT-EXPECT: verb-stale
+)
+
+
+def handlers():
+    return {
+        "PING": _on_ping,
+        "ORPHAN": _on_orphan,  # LINT-EXPECT: verb-unreachable, verb-undocumented
+    }
+
+
+def _on_ping(req):
+    return {"STATUS": "ok"}
+
+
+def _on_orphan(req):
+    return {"STATUS": "gone"}
+
+
+def client_probe(send):
+    req = {
+        "COMMAND": "PING",
+        "SEQ": 7,  # LINT-EXPECT: field-undocumented
+    }
+    resp = send(req)
+    lost = send({"COMMAND": "MISSING_VERB"})  # LINT-EXPECT: verb-unregistered
+    return resp["STATUS"], lost

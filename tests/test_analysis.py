@@ -2,8 +2,10 @@
 suppression machinery, the shipped-tree strict gate, and the
 placement_converged GSPMD-rewrite regression."""
 
+import json
 import os
 import re
+import shutil
 import textwrap
 
 import numpy as np
@@ -12,8 +14,10 @@ import pytest
 import jax.numpy as jnp
 
 from p2p_dhts_tpu import analysis
-from p2p_dhts_tpu.analysis import gspmd, lockcheck, trace_safety
-from p2p_dhts_tpu.analysis.common import apply_suppressions
+from p2p_dhts_tpu.analysis import (epochs, gspmd, lifecycle, lockcheck,
+                                   registry, trace_safety, verbs)
+from p2p_dhts_tpu.analysis.common import (Finding, apply_baseline,
+                                          apply_suppressions)
 from p2p_dhts_tpu.analysis.gspmd import KernelSpec
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -214,7 +218,7 @@ def test_unknown_rule_suppression_flagged(tmp_path):
 
 def test_shipped_tree_strict_clean():
     """`python -m p2p_dhts_tpu.analysis --strict` exits 0 on this tree:
-    zero unsuppressed findings across all three passes, and the
+    zero unsuppressed findings across all seven passes, and the
     suppression machinery is genuinely exercised (every suppression in
     the tree carries a reason)."""
     findings, n_sup = analysis.run_all(root=ROOT)
@@ -252,3 +256,315 @@ def test_placement_converged_roll_reduction_semantics(rng):
         min_key=swept.min_key.at[victim].set(
             jnp.asarray([1, 2, 3, 4], jnp.uint32)))
     assert not bool(placement_converged(bad))
+
+
+# ---------------------------------------------------------------------------
+# pass 5 — epoch monotonicity
+# ---------------------------------------------------------------------------
+
+def test_epochs_detects_fixture_corpus_exactly():
+    path = os.path.join(FIXDIR, "epochs_bad.py")
+    got = {(f.rule, f.line) for f in epochs.run([path], ROOT)}
+    want = expected_markers(path)
+    assert want, "fixture lost its LINT-EXPECT markers"
+    assert got == want, (f"missing: {sorted(want - got)}; "
+                         f"spurious: {sorted(got - want)}")
+
+
+def test_epochs_shipped_tree_clean():
+    findings, _ = analysis.run_all(root=ROOT, passes=("epochs",))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_epochs_gate_flips_red_when_guard_deleted(tmp_path):
+    """The negative acceptance: strip RouteTable.apply's monotonic
+    guard from a scratch copy of mesh/routes.py and the install
+    becomes an unguarded epoch write — the exact regression the pass
+    exists to catch."""
+    src_path = os.path.join(ROOT, "p2p_dhts_tpu", "mesh", "routes.py")
+    with open(src_path, encoding="utf-8") as fh:
+        src = fh.read()
+    guard = ("            if epoch <= self._epoch:\n"
+             "                return False\n")
+    assert guard in src, "RouteTable.apply guard shape drifted"
+    assert epochs.run([src_path], ROOT) == []  # guarded: clean
+    stripped = tmp_path / "routes.py"
+    stripped.write_text(src.replace(guard, ""), encoding="utf-8")
+    got = epochs.run([str(stripped)], str(tmp_path))
+    assert any(f.rule == "epoch-unguarded-write" for f in got), got
+
+
+# ---------------------------------------------------------------------------
+# pass 6 — lifecycle / telemetry retirement
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_detects_fixture_corpus_exactly():
+    path = os.path.join(FIXDIR, "lifecycle_bad.py")
+    readme = os.path.join(FIXDIR, "lifecycle_readme.md")
+    got = {(os.path.basename(f.path), f.rule, f.line)
+           for f in lifecycle.run([path], ROOT, readme_path=readme)}
+    want = set()
+    for p in (path, readme):
+        marks = expected_markers(p)
+        assert marks, f"{p} lost its LINT-EXPECT markers"
+        want |= {(os.path.basename(p), rule, line) for rule, line in marks}
+    assert got == want, (f"missing: {sorted(want - got)}; "
+                         f"spurious: {sorted(got - want)}")
+
+
+def test_lifecycle_shipped_tree_clean():
+    findings, _ = analysis.run_all(root=ROOT, passes=("lifecycle",))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_retirement_gate_flips_red_when_retire_site_deleted():
+    """Dropping gateway/metrics_ext.py (the per-ring retirement hub)
+    from the scan set leaves identity-scoped inventory rows with no
+    remove_prefix coverage — the gate must go red, not silently
+    shrink."""
+    files = analysis.package_files(ROOT)
+    readme = os.path.join(ROOT, "README.md")
+    assert lifecycle.retirement_findings(files, ROOT, readme) == []
+    pruned = [p for p in files
+              if not p.replace(os.sep, "/").endswith(
+                  "gateway/metrics_ext.py")]
+    assert len(pruned) == len(files) - 1
+    missing = lifecycle.retirement_findings(pruned, ROOT, readme)
+    assert missing, "deleting the retire hub must surface findings"
+    assert all(f.rule == "telemetry-retire-missing" for f in missing)
+
+
+def test_repair_retirement_covers_every_pair_and_drift_family():
+    """Regression for the ISSUE-18 fix: remove_ring now retires ALL
+    six per-pair families plus both per-ring drift families (the
+    stalled_rounds/round_failures keys used to haunt dashboards)."""
+    sched = os.path.join(ROOT, "p2p_dhts_tpu", "repair", "scheduler.py")
+    pats = {p for p, _, _ in lifecycle.retirement_patterns([sched], ROOT)}
+    for fam in ("backlog", "converged", "tokens", "round_ms",
+                "round_failures", "stalled_rounds"):
+        assert f"repair.{fam}.<*>" in pats, (fam, sorted(pats))
+    for fam in ("converged", "round_failures"):
+        assert f"repair.{fam}.<*>-drift" in pats, (fam, sorted(pats))
+
+
+def test_membership_retirement_covers_documented_families():
+    """Regression for the ISSUE-18 fix: MEMBERSHIP_FAMS gained the
+    four families retire_ring used to leak, and the retire loop's
+    expansion covers every listed family exactly."""
+    from p2p_dhts_tpu.gateway.metrics_ext import MEMBERSHIP_FAMS
+    assert {"fail_vetoed", "flap_suppressed", "rejoins",
+            "listener_errors"} <= set(MEMBERSHIP_FAMS)
+    ext = os.path.join(ROOT, "p2p_dhts_tpu", "gateway", "metrics_ext.py")
+    pats = {p for p, _, _ in lifecycle.retirement_patterns([ext], ROOT)}
+    for fam in MEMBERSHIP_FAMS:
+        assert f"membership.{fam}.<*>" in pats, (fam, sorted(pats))
+
+
+# ---------------------------------------------------------------------------
+# pass 7 — wire-contract drift
+# ---------------------------------------------------------------------------
+
+def _verbs_scratch_tree(tmp_path, drop=None):
+    """Copy the verbs fixture into a scratch package tree (line
+    numbers preserved; `drop` removes matching lines first) so the
+    pass sees it as in-package code with a closed README vocabulary."""
+    pkg = tmp_path / "p2p_dhts_tpu"
+    pkg.mkdir()
+    with open(os.path.join(FIXDIR, "verbs_bad.py"), encoding="utf-8") as fh:
+        src = fh.read()
+    if drop is not None:
+        src = "".join(l for l in src.splitlines(keepends=True)
+                      if drop not in l)
+    (pkg / "verbs_bad.py").write_text(src, encoding="utf-8")
+    readme = tmp_path / "verbs_readme.md"
+    shutil.copy(os.path.join(FIXDIR, "verbs_readme.md"), str(readme))
+    return [str(pkg / "verbs_bad.py")], str(tmp_path), str(readme)
+
+
+def test_verbs_detects_fixture_corpus_exactly(tmp_path):
+    files, root, readme = _verbs_scratch_tree(tmp_path)
+    got = {(os.path.basename(f.path), f.rule, f.line)
+           for f in verbs.run(files, root, readme_path=readme)}
+    want = set()
+    for p in (os.path.join(FIXDIR, "verbs_bad.py"),
+              os.path.join(FIXDIR, "verbs_readme.md")):
+        marks = expected_markers(p)
+        assert marks, f"{p} lost its LINT-EXPECT markers"
+        want |= {(os.path.basename(p), rule, line) for rule, line in marks}
+    assert got == want, (f"missing: {sorted(want - got)}; "
+                         f"spurious: {sorted(got - want)}")
+
+
+def test_verbs_shipped_tree_clean():
+    findings, _ = analysis.run_all(root=ROOT, passes=("verbs",))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_verbs_gate_flips_red_when_registration_deleted(tmp_path):
+    """The negative acceptance: delete PING's handler registration
+    and the same verb becomes simultaneously stale (declared +
+    documented but unregistered) and unregistered (a live client
+    still sends it)."""
+    files, root, readme = _verbs_scratch_tree(
+        tmp_path, drop='"PING": _on_ping,')
+    got = verbs.run(files, root, readme_path=readme)
+    assert any(f.rule == "verb-unregistered" and "'PING'" in f.message
+               for f in got), got
+    assert any(f.rule == "verb-stale" and "'PING'" in f.message
+               for f in got), got
+
+
+# ---------------------------------------------------------------------------
+# registry audits (locks + gspmd coverage)
+# ---------------------------------------------------------------------------
+
+def test_lock_registry_in_sync_and_discovery_sees_native_rpc():
+    """DEFAULT_LOCK_MODULES matches the discovered lock surface on
+    this tree, and discovery sees net/native_rpc.py — the module the
+    curated tuple had silently drifted past before ISSUE 18."""
+    discovered = lockcheck.discover_lock_modules(ROOT)
+    assert "p2p_dhts_tpu/net/native_rpc.py" in {
+        p.replace(os.sep, "/") for p in discovered}
+    assert lockcheck.registry_findings(ROOT, discovered=discovered) == []
+
+
+def test_lock_registry_flags_uncovered_module():
+    fake = dict(lockcheck.discover_lock_modules(ROOT))
+    fake["p2p_dhts_tpu/phantom_locks.py"] = 7
+    got = lockcheck.registry_findings(ROOT, discovered=fake)
+    assert [(f.rule, f.path, f.line) for f in got] == [
+        ("lock-module-uncovered", "p2p_dhts_tpu/phantom_locks.py", 7)]
+
+
+def test_lock_registry_flags_stale_entry(monkeypatch):
+    monkeypatch.setattr(
+        lockcheck, "DEFAULT_LOCK_MODULES",
+        lockcheck.DEFAULT_LOCK_MODULES + ("p2p_dhts_tpu/ghost.py",))
+    got = lockcheck.registry_findings(ROOT)
+    assert any(f.rule == "lock-module-stale" and "ghost.py" in f.message
+               for f in got), got
+
+
+def test_registry_coverage_gate_flips_red_when_entry_deleted(tmp_path):
+    """The negative acceptance: renaming ring_genesis's registry
+    reference away (== deleting the entry) leaves a public jit'd
+    kernel untraced, and the audit says exactly which one."""
+    reg_path = os.path.join(ROOT, "p2p_dhts_tpu", "analysis",
+                            "registry.py")
+    with open(reg_path, encoding="utf-8") as fh:
+        src = fh.read()
+    assert "ring_genesis" in src, "registry no longer traces ring_genesis"
+    control = registry.coverage_findings(ROOT)
+    assert not any("ring_genesis" in f.message for f in control), control
+    stripped = tmp_path / "registry_stripped.py"
+    stripped.write_text(src.replace("ring_genesis", "ring_genesis_gone"),
+                        encoding="utf-8")
+    got = registry.coverage_findings(ROOT, registry_path=str(stripped))
+    assert any(f.rule == "gspmd-kernel-untraced"
+               and f.path.replace(os.sep, "/").endswith("core/ring.py")
+               and "ring_genesis" in f.message for f in got), got
+
+
+def test_registry_coverage_closed_after_suppressions():
+    """Every public jit'd kernel is traced or carries a reasoned
+    inline exemption — the registry, like DEFAULT_LOCK_MODULES, is a
+    declaration the tree is audited against."""
+    raw = registry.coverage_findings(ROOT)
+    findings, _, _ = apply_suppressions(raw, ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_native_rpc_lock_discipline_clean():
+    """Regression for the ISSUE-18 fix: load_library no longer holds
+    _lib_lock across the g++ build (a blocking subprocess)."""
+    path = os.path.join(ROOT, "p2p_dhts_tpu", "net", "native_rpc.py")
+    assert lockcheck.run([path], ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline diff mode
+# ---------------------------------------------------------------------------
+
+def _bf(path="p2p_dhts_tpu/mod.py", line=10, rule="host-sync"):
+    return Finding(path, line, rule, "synthetic", "trace")
+
+
+def _write_baseline(tmp_path, entries):
+    p = tmp_path / "analysis_baseline.json"
+    p.write_text(json.dumps(entries), encoding="utf-8")
+    return str(p)
+
+
+def test_baseline_absorbs_reasoned_entry(tmp_path):
+    _write_baseline(tmp_path, [{"path": "p2p_dhts_tpu/mod.py",
+                                "rule": "host-sync",
+                                "reason": "legacy burn-down"}])
+    kept, n, problems = apply_baseline([_bf()], str(tmp_path))
+    assert (kept, n, problems) == ([], 1, [])
+
+
+def test_baseline_line_pin_matches_only_that_site(tmp_path):
+    _write_baseline(tmp_path, [{"path": "p2p_dhts_tpu/mod.py",
+                                "rule": "host-sync", "line": 10,
+                                "reason": "that one site"}])
+    kept, n, problems = apply_baseline([_bf(line=10), _bf(line=11)],
+                                       str(tmp_path))
+    assert kept == [_bf(line=11)] and n == 1 and problems == []
+
+
+def test_baseline_reasonless_entry_is_its_own_finding(tmp_path):
+    _write_baseline(tmp_path, [{"path": "p2p_dhts_tpu/mod.py",
+                                "rule": "host-sync"}])
+    kept, n, problems = apply_baseline([_bf()], str(tmp_path))
+    assert kept == [_bf()] and n == 0  # invalid entry absorbs nothing
+    assert [p.rule for p in problems] == ["baseline-missing-reason"]
+
+
+def test_baseline_stale_entry_is_its_own_finding(tmp_path):
+    _write_baseline(tmp_path, [{"path": "p2p_dhts_tpu/gone.py",
+                                "rule": "host-sync",
+                                "reason": "matched once"}])
+    kept, n, problems = apply_baseline([_bf()], str(tmp_path))
+    assert kept == [_bf()] and n == 0
+    assert [p.rule for p in problems] == ["baseline-stale"]
+
+
+def test_baseline_cannot_absorb_suppression_hygiene(tmp_path):
+    f = _bf(rule="lint-suppression")
+    _write_baseline(tmp_path, [{"path": f.path,
+                                "rule": "lint-suppression",
+                                "reason": "nice try"}])
+    kept, n, problems = apply_baseline([f], str(tmp_path))
+    assert kept == [f] and n == 0  # hygiene findings stay un-maskable
+    assert [p.rule for p in problems] == ["baseline-stale"]
+
+
+def test_baseline_unparseable_file_is_its_own_finding(tmp_path):
+    p = tmp_path / "analysis_baseline.json"
+    p.write_text("{not json", encoding="utf-8")
+    kept, n, problems = apply_baseline([_bf()], str(tmp_path))
+    assert kept == [_bf()] and n == 0
+    assert [p2.rule for p2 in problems] == ["baseline-missing-reason"]
+
+
+def test_baseline_missing_file_is_no_baseline(tmp_path):
+    kept, n, problems = apply_baseline([_bf()], str(tmp_path))
+    assert (kept, n, problems) == ([_bf()], 0, [])
+
+
+def test_run_all_threads_baseline_problems_into_findings(tmp_path):
+    b = _write_baseline(tmp_path, [{"path": "x.py", "rule": "host-sync"}])
+    findings, _ = analysis.run_all(root=ROOT, passes=("trace",),
+                                   baseline=b)
+    assert any(f.rule == "baseline-missing-reason" for f in findings)
+    assert all(f.rule in ("baseline-missing-reason",)
+               for f in findings), findings
+
+
+def test_shipped_baseline_is_empty():
+    """The shipped tree carries no baselined debt: every genuine
+    finding ISSUE 18 surfaced was FIXED, so the valve starts empty
+    and can only ever shrink back to empty."""
+    with open(os.path.join(ROOT, "analysis_baseline.json"),
+              encoding="utf-8") as fh:
+        assert json.load(fh) == []

@@ -71,15 +71,20 @@ for i in $(seq 1 80); do
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
-  # chordax-lint gate (ISSUE 3; four passes incl. the metric-key
-  # doc-drift gate): a finding means this tree is not the code we want
+  # chordax-lint gate (ISSUE 3, grown through ISSUE 18: all seven
+  # passes — trace/gspmd+registry/locks/metrics/epochs/lifecycle/
+  # verbs): a finding means this tree is not the code we want
   # hardware evidence for — fail the cycle before any bench touches
   # the chip. CPU-pinned so the gate never claims the TPU (same
-  # etiquette as the dryrun respawn).
+  # etiquette as the dryrun respawn). The machine-readable findings
+  # artifact archives next to this round's bench records either way,
+  # so a red gate leaves evidence of WHAT drifted, not just that
+  # something did.
   if ! JAX_PLATFORMS=cpu \
       XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-      python -m p2p_dhts_tpu.analysis --strict >> tpu_watch.log 2>&1; then
-    log "chordax-lint gate FAILED - fix findings before benching"
+      python -m p2p_dhts_tpu.analysis --strict \
+        --json "LINT_r${i}.json" >> tpu_watch.log 2>&1; then
+    log "chordax-lint gate FAILED - fix findings before benching (see LINT_r${i}.json)"
     sleep 300
     continue
   fi
