@@ -17,7 +17,8 @@ metric keys surviving retirement) become lint findings:
     with no reachable off switch.
   * `telemetry-retire-missing` — every README metric-inventory row
     whose dynamic suffix is IDENTITY-scoped (`<ring>`, `<pair>`,
-    `<dest>`, `<addr>`, `<peer>`, `<a>`-`<b>`) must be covered by a
+    `<dest>`, `<addr>`, `<peer>`, `<shard>`, `<a>`-`<b>`) must be
+    covered by a
     retirement site: a `remove_prefix` call whose (f-string) pattern
     reaches the identity segment. Interpolations of loop variables
     over literal/module-constant string tuples are EXPANDED
@@ -64,7 +65,7 @@ LIFECYCLE_VERBS = {"close", "stop", "shutdown", "kill", "cancel"}
 #: retire the key. Everything else (`<op>`, `<kind>`, `<slo>`, ...) is
 #: a bounded, config-chosen vocabulary.
 IDENTITY_PLACEHOLDERS = {"ring", "rid", "pair", "dest", "addr", "peer",
-                         "member", "a", "b"}
+                         "member", "shard", "a", "b"}
 
 _PLACEHOLDER_NAME_RE = re.compile(r"<([^<>]*)>")
 

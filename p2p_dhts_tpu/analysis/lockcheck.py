@@ -96,6 +96,7 @@ DEFAULT_LOCK_MODULES = (
     os.path.join("p2p_dhts_tpu", "edge", "routes.py"),
     os.path.join("p2p_dhts_tpu", "edge", "hedge.py"),
     os.path.join("p2p_dhts_tpu", "edge", "client.py"),
+    os.path.join("p2p_dhts_tpu", "tower", "collector.py"),
     os.path.join("p2p_dhts_tpu", "analysis", "lockcheck.py"),
 )
 

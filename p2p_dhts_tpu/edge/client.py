@@ -45,6 +45,7 @@ import numpy as np
 from p2p_dhts_tpu import trace as trace_mod
 from p2p_dhts_tpu.edge.hedge import HedgePolicy
 from p2p_dhts_tpu.edge.routes import RouteCache
+from p2p_dhts_tpu.health import FLIGHT
 from p2p_dhts_tpu.keyspace import LANES, ints_to_lanes
 from p2p_dhts_tpu.mesh.fold import FoldCore, FoldError
 from p2p_dhts_tpu.mesh.routes import Addr, addr_str
@@ -157,7 +158,8 @@ class Client:
                  retries: int = 1,
                  hedge: Optional[HedgePolicy] = None,
                  hedge_enabled: bool = True,
-                 pull_timeout_s: float = 5.0):
+                 pull_timeout_s: float = 5.0,
+                 request_fields: Optional[Dict[str, object]] = None):
         self.metrics = metrics if metrics is not None else METRICS
         self.routes = RouteCache(gateways, metrics=self.metrics,
                                  pull_timeout_s=pull_timeout_s)
@@ -166,6 +168,12 @@ class Client:
         self._fold = _EdgeCoalescer(self, self.metrics,
                                     max_batch if coalesce else 1,
                                     retries)
+        # Per-client wire identity (chordax-tower, ISSUE 20): fields
+        # stamped on every flushed RPC — the canary's probe client
+        # passes {"NOCACHE": 1}. Folds never mix across Clients, so
+        # the fields can never leak onto another caller's requests.
+        if request_fields:
+            self._fold.extra_fields = dict(request_fields)
         self._lock = threading.Lock()   # LEAF: the backoff table
         self._backoff: Dict[Tuple[str, int], _Backoff] = {}
 
@@ -343,7 +351,15 @@ class Client:
 
     def _backoff_ok(self, dest: Tuple[str, int]) -> None:
         with self._lock:
-            self._backoff.pop(dest, None)
+            b = self._backoff.pop(dest, None)
+            was_open = b is not None and b.opens > 0
+        if was_open:
+            # chordax-tower (ISSUE 20): breaker transitions are
+            # incident-timeline events — the flight ring (leaf lock of
+            # its own, recorded OUTSIDE ours) is what the collector
+            # pulls and the timeline orders.
+            FLIGHT.record("edge", "breaker_close",
+                          dest=f"{dest[0]}:{dest[1]}")
 
     def _backoff_fail(self, dest: Tuple[str, int],
                       busy: bool) -> None:
@@ -364,7 +380,11 @@ class Client:
             # come back in lockstep (the retry-storm rule).
             b.until = time.monotonic() + _JITTER.uniform(
                 base * 0.5, base)
+            fails = b.fails
         self.metrics.inc("edge.backoff.open")
+        FLIGHT.record("edge", "breaker_open",
+                      dest=f"{dest[0]}:{dest[1]}", fails=fails,
+                      busy=bool(busy))
 
     @staticmethod
     def _is_busy_error(exc: BaseException) -> bool:

@@ -27,7 +27,7 @@ import hashlib
 import json
 import threading
 from collections import deque
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
@@ -76,6 +76,25 @@ class DecisionLedger:
     def entries(self) -> List[dict]:
         with self._lock:
             return [dict(e) for e in self._entries]
+
+    def entries_since(self, since: int
+                      ) -> Tuple[List[dict], int, int]:
+        """Incremental pull (chordax-tower, ISSUE 20): `(entries,
+        next_seq, gap)` for every retained entry with seq >= since,
+        oldest first. `gap` counts entries the bounded deque dropped
+        before the cursor read them (eviction-visible); `next_seq`
+        resumes exactly after the last returned entry — the fleet
+        collector's duplicate-free ledger cursor. Seqs are contiguous
+        in the deque, so the slice is one traversal."""
+        since = max(int(since), 0)
+        with self._lock:
+            buf = list(self._entries)
+            total = self._seq
+        oldest = total - len(buf)
+        start = max(since, oldest)
+        gap = start - since if since < oldest else 0
+        out = [dict(e) for e in buf[start - oldest:]]
+        return out, start + len(out), gap
 
     def __len__(self) -> int:
         with self._lock:

@@ -102,12 +102,16 @@ FINGER_RING_ID = "__finger__"
 #: install/uninstall a seeded FaultPlan in THIS process over the wire,
 #: so a multi-process scenario (partition one whole gateway) is seeded
 #: into every process replayably — a test/bench control surface, same
-#: trust domain as the metrics/trace verbs.
+#: trust domain as the metrics/trace verbs. TRACE_PULL is the
+#: chordax-tower collection verb (ISSUE 20): the bounded, since-cursor
+#: incremental span pull the fleet collector advances through — each
+#: reply carries the resume cursor, the eviction gap, and the serving
+#: process's wall clock (the collector's clock-offset sample).
 GATEWAY_COMMANDS = ("FIND_SUCCESSOR", "GET", "PUT", "FINGER_INDEX",
                     "SYNC_RANGE", "REPAIR_STATUS", "JOIN_RING",
                     "HEARTBEAT", "MEMBER_STATUS", "METRICS",
-                    "TRACE_STATUS", "HEALTH", "PULSE", "CAPACITY",
-                    "MESH_ROUTES", "HAVOC")
+                    "TRACE_STATUS", "TRACE_PULL", "HEALTH", "PULSE",
+                    "CAPACITY", "MESH_ROUTES", "HAVOC")
 
 
 def _key_int(v) -> int:
@@ -208,6 +212,10 @@ class Gateway:
         # ring FIND_SUCCESSOR/GET/PUT consults. Lifecycle stays with
         # whoever built it (the detach-never-close rule).
         self._mesh: Optional[Any] = None
+        # chordax-tower wiring (ISSUE 20): the attached elastic
+        # DecisionLedger the HEALTH verb's LEDGER_SINCE cursor serves
+        # (read-side reference only).
+        self._ledger: Optional[Any] = None
 
     # -- ring lifecycle ------------------------------------------------------
     def set_default_ida(self, n: int, m: int, p: int) -> None:
@@ -296,6 +304,20 @@ class Gateway:
     def lens_model(self):
         with self._rings_lock:
             return self._lens
+
+    # -- decision ledger (chordax-tower, ISSUE 20) ---------------------------
+    def attach_ledger(self, ledger) -> None:
+        """Register (or, with None, detach) the elastic DecisionLedger
+        the HEALTH verb's LEDGER_SINCE cursor serves — the fleet
+        collector's wire path to this process's policy decisions.
+        Lifecycle stays with whoever built it (the detach-never-close
+        rule)."""
+        with self._rings_lock:
+            self._ledger = ledger
+
+    def decision_ledger(self):
+        with self._rings_lock:
+            return self._ledger
 
     # -- mesh plane (chordax-mesh, ISSUE 15) ---------------------------------
     def attach_mesh(self, mesh) -> None:
@@ -836,7 +858,8 @@ class Gateway:
         return self._find_successor_routed(backend, k, int(start_row), dl)
 
     def _find_successor_routed(self, backend: RingBackend, k: int,
-                               start_row: int, dl: Deadline
+                               start_row: int, dl: Deadline,
+                               nocache: bool = False
                                ) -> Tuple[int, int]:
         # chordax-fastlane: cache first (a hot key's steady state is a
         # host dict hit), single-flight behind it (a cold storm still
@@ -845,10 +868,14 @@ class Gateway:
         # a degraded ring's requests must keep reaching the serving
         # core or its re-probe (and recovery) would starve behind
         # cache hits — and a fallback-path answer, computed off a
-        # possibly-stale snapshot, must never be memoized.
+        # possibly-stale snapshot, must never be memoized. `nocache`
+        # (the wire NOCACHE flag, chordax-tower ISSUE 20) bypasses
+        # BOTH directions — a canary probe must measure the serving
+        # path, not the cache, and must not fill it either.
         from p2p_dhts_tpu.gateway.router import HEALTHY
         cache = (self._cache if self._cache is not None
-                 and backend.state == HEALTHY else None)
+                 and not nocache and backend.state == HEALTHY
+                 else None)
         ckey = ("fs", backend.ring_id, k, start_row)
         if cache is not None:
             hit, val = cache.get(ckey)
@@ -950,7 +977,8 @@ class Gateway:
     def dhash_get(self, key, *, ring_id: Optional[str] = None,
                   timeout: Optional[float] = None,
                   deadline: Optional[Deadline] = None,
-                  failover: Optional[bool] = None):
+                  failover: Optional[bool] = None,
+                  nocache: bool = False):
         """Read one block. REPLICA-AWARE by default when a replication
         policy is installed and no ring is named: the read tries the
         fastest healthy replica first (the routed primary among the
@@ -973,7 +1001,10 @@ class Gateway:
                              "are contradictory; drop one")
         use_fo = (failover if failover is not None
                   else (writer is not None and ring_id is None))
-        cache = self._cache
+        # The wire NOCACHE flag (chordax-tower, ISSUE 20): canary
+        # probes bypass the hot-key cache in BOTH directions — neither
+        # served from it nor filling it.
+        cache = None if nocache else self._cache
         if not use_fo:
             backend = self.router.route(key_int=k, ring_id=ring_id)
             # HEALTHY rings only (the _find_successor_routed rule): a
@@ -1207,7 +1238,8 @@ class Gateway:
             return {"OWNER": owner, "HOPS": hops, "RING": label}
         backend = self.router.route(key_int=key, ring_id=ring_id)
         owner, hops = self._find_successor_routed(
-            backend, key, int(req.get("START", 0)), dl)
+            backend, key, int(req.get("START", 0)), dl,
+            nocache=bool(req.get("NOCACHE")))
         return {"OWNER": owner, "HOPS": hops, "RING": backend.ring_id}
 
     def _handle_find_successor_fast(self, req: dict, lanes: np.ndarray,
@@ -1407,7 +1439,9 @@ class Gateway:
                 raise mesh.not_owner_error(key)
             segs, ok = mesh.get_one(key, dl)
             return {"SEGMENTS": segs, "OK": bool(ok)}
-        segs, ok = self.dhash_get(req["KEY"], ring_id=ring_id, deadline=dl)
+        segs, ok = self.dhash_get(req["KEY"], ring_id=ring_id,
+                                  deadline=dl,
+                                  nocache=bool(req.get("NOCACHE")))
         return {"SEGMENTS": segs, "OK": bool(ok)}
 
     def _handle_get_fast(self, lanes: np.ndarray,
@@ -1671,10 +1705,22 @@ class Gateway:
         with PREFIX — the bounded counter family under one dotted
         prefix (the cheap periodic-poll form)."""
         base = self.metrics.base
+        # chordax-tower (ISSUE 20): the operator flip for exemplar
+        # capture — the bench's overhead gate toggles a whole live
+        # fleet over the wire without a restart.
+        flip = req.get("SET_EXEMPLARS")
+        if flip is not None:
+            base.set_exemplars(bool(flip))
         prefix = req.get("PREFIX")
         if prefix is not None:
             return {"COUNTERS": base.counters_with_prefix(str(prefix))}
-        return {"METRICS": base.snapshot()}
+        out = {"METRICS": base.snapshot()}
+        # chordax-tower (ISSUE 20): the exemplar rings — (value,
+        # trace_id, t) outlier pointers per histogram — ride along
+        # only when asked for (a periodic METRICS poll stays cheap).
+        if req.get("EXEMPLARS"):
+            out["EXEMPLARS"] = base.exemplars()
+        return out
 
     def handle_trace_status(self, req: dict) -> dict:
         """The tracing plane's status (enabled flag, span-store
@@ -1695,6 +1741,33 @@ class Gateway:
         if req.get("EXPORT"):
             out["CHROME"] = _json.loads(trace_mod.store().export_chrome())
         return out
+
+    def handle_trace_pull(self, req: dict) -> dict:
+        """The chordax-tower collection verb (ISSUE 20): a bounded,
+        duplicate-free incremental span pull. SINCE is the span-store
+        sequence cursor (0 or absent starts from the oldest retained
+        span); LIMIT bounds the reply (default 2048, capped 8192).
+        The reply carries SPANS (oldest first, each with its `seq` and
+        completion `wall` stamp), NEXT (the resume cursor), GAP (spans
+        the ring evicted before the cursor read them — eviction-
+        visible, never a silent skip), EVICTED (store-lifetime
+        eviction count), and WALL (this process's wall clock at reply
+        build — the RTT-midpoint sample the collector's per-peer
+        clock-offset estimate averages over)."""
+        limit = req.get("LIMIT")
+        limit = 2048 if limit is None else int(limit)
+        limit = max(1, min(limit, 8192))
+        st = trace_mod.store()
+        spans, nxt, gap = st.spans_since(
+            int(req.get("SINCE", 0) or 0), limit)
+        rows = []
+        for s in spans:
+            row = dict(s)
+            row["args"] = dict(s["args"]) if s.get("args") else {}
+            row["links"] = list(s.get("links") or ())
+            rows.append(row)
+        return {"SPANS": rows, "NEXT": nxt, "GAP": gap,
+                "EVICTED": st.evicted, "WALL": time.time()}
 
     def handle_health(self, req: dict) -> dict:
         """The unified health plane in one verb: every registered
@@ -1726,8 +1799,31 @@ class Gateway:
                 engines[backend.ring_id] = row_fn()
         out["ENGINES"] = engines
         tail = int(req.get("TAIL", 0) or 0)
-        if tail > 0:
+        since = req.get("SINCE")
+        if since is not None:
+            # chordax-tower (ISSUE 20): the since-cursor TAIL form —
+            # duplicate-free across polls (each event carries its
+            # `seq`; NEXT_SEQ resumes the pull) and eviction-visible
+            # (GAP counts events the ring dropped past the cursor).
+            events, nxt, gap = _FLIGHT.recent_since(
+                int(since), tail if tail > 0 else None)
+            out["FLIGHT"]["tail"] = events
+            out["FLIGHT"]["next_seq"] = nxt
+            out["FLIGHT"]["gap"] = gap
+        elif tail > 0:
             out["FLIGHT"]["tail"] = _FLIGHT.recent(tail)
+        # chordax-tower (ISSUE 20): the attached DecisionLedger's
+        # incremental rows — LEDGER_SINCE is the collector's cursor
+        # (same NEXT/GAP contract as the flight tail). No ledger
+        # attached means no LEDGER section, never an RPC error.
+        ledger_since = req.get("LEDGER_SINCE")
+        if ledger_since is not None:
+            ledger = self.decision_ledger()
+            if ledger is not None:
+                rows, lnxt, lgap = ledger.entries_since(
+                    int(ledger_since))
+                out["LEDGER"] = {"rows": rows, "next_seq": lnxt,
+                                 "gap": lgap}
         resp = {"HEALTH": out}
         self._merge_mesh_rows("HEALTH", req, resp)
         return resp
@@ -1935,6 +2031,7 @@ class Gateway:
             self._pulse = None
             self._lens = None
             self._mesh = None
+            self._ledger = None
         # Membership loops stop FIRST (they submit churn batches and
         # nudge schedulers); then repair, then the writer.
         scheds = managers + scheds
@@ -2001,6 +2098,7 @@ def install_gateway_handlers(server, gateway: Optional[Gateway] = None
         "MEMBER_STATUS": gw.handle_member_status,
         "METRICS": gw.handle_metrics,
         "TRACE_STATUS": gw.handle_trace_status,
+        "TRACE_PULL": gw.handle_trace_pull,
         "HEALTH": gw.handle_health,
         "PULSE": gw.handle_pulse,
         "CAPACITY": gw.handle_capacity,

@@ -43,7 +43,7 @@ import threading
 import time
 import weakref
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from p2p_dhts_tpu.metrics import METRICS, Metrics
 
@@ -325,8 +325,14 @@ class FlightRecorder:
         return item
 
     def record(self, subsystem: str, event: str, **fields) -> None:
+        """Append one MAIN-ring event, stamped with a stable monotonic
+        sequence number (`seq`, chordax-tower ISSUE 20) next to its
+        wall timestamp `t` — the since-cursor `recent_since` pulls
+        advance through, duplicate-free across polls and robust to
+        ring eviction."""
         item = self._item(subsystem, event, fields)
         with self._lock:
+            item["seq"] = self._recorded
             self._recorded += 1
             self._buf.append(item)
 
@@ -362,6 +368,28 @@ class FlightRecorder:
         if subsystem is not None:
             out = [e for e in out if e["subsystem"] == subsystem]
         return out if n is None else out[-int(n):]
+
+    def recent_since(self, since: int, n: Optional[int] = None
+                     ) -> Tuple[List[dict], int, int]:
+        """Incremental MAIN-ring pull: `(events, next_seq, gap)` for
+        every retained event with seq >= since, oldest first, at most
+        `n`. `gap` counts events the ring evicted before the cursor
+        read them (eviction-visible, never a silent skip); `next_seq`
+        resumes exactly after the last returned event — the HEALTH
+        verb's SINCE form (chordax-tower ISSUE 20). Seqs are
+        contiguous in the ring, so the slice is one traversal."""
+        since = max(int(since), 0)
+        with self._lock:
+            buf = list(self._buf)
+            total = self._recorded
+        oldest = total - len(buf)
+        start = max(since, oldest)
+        gap = start - since if since < oldest else 0
+        out = buf[start - oldest:]
+        if n is not None:
+            out = out[:max(int(n), 0)]
+        out = [dict(e) for e in out]
+        return out, start + len(out), gap
 
     def clear(self) -> None:
         with self._lock:

@@ -169,6 +169,13 @@ class FoldCore:
     verbs = FOLD_VERBS
     default_wait_s = DEFAULT_FOLD_WAIT_S
 
+    #: Extra request fields stamped on EVERY flushed RPC (instance-
+    #: overridable). chordax-tower (ISSUE 20): the canary's dedicated
+    #: edge client sets {"NOCACHE": 1} here so its probes bypass the
+    #: owner's hot-key cache — a per-client identity, never mixed
+    #: into another client's folds (each Client owns its own core).
+    extra_fields: Dict[str, object] = {}
+
     def __init__(self, metrics: Optional[Metrics] = None,
                  max_batch: int = 4096, retries: int = 1):
         self.metrics = metrics if metrics is not None else METRICS
@@ -346,6 +353,8 @@ class FoldCore:
         req: dict = {"COMMAND": verb,
                      "KEYS": wire.U128Keys.from_lanes(lanes),
                      "FWD": 1}
+        if self.extra_fields:
+            req.update(self.extra_fields)
         if starts is not None:
             req["STARTS"] = starts
         if deadline_at is not None:

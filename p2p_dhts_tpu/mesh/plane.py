@@ -159,6 +159,16 @@ class MeshPlane:
             self.metrics.gauge(f"mesh.peer_alive.{a}", 1.0)
         self.metrics.gauge("mesh.peers", len(new_addrs))
         self.metrics.gauge("mesh.route_epoch", self.routes.epoch)
+        # chordax-tower (ISSUE 20): membership transitions are
+        # incident-timeline events — each applied table lands in the
+        # flight recorder with the epoch and the peer delta, so the
+        # collector's merged timeline shows drops/rejoins in causal
+        # order next to HAVOC installs and SLO crossings.
+        from p2p_dhts_tpu.health import FLIGHT
+        FLIGHT.record("mesh", "routes_applied",
+                      epoch=self.routes.epoch, peers=len(new_addrs),
+                      joined=sorted(new_addrs - old_addrs),
+                      departed=sorted(old_addrs - new_addrs))
 
     def note_peer(self, member: int, ip: str, port: int) -> None:
         """JOIN_RING address capture: the frontend hands every joiner's

@@ -74,6 +74,14 @@ def main(argv=None) -> int:
     ap.add_argument("--elastic-seed", type=int, default=0x0E1A571C)
     ap.add_argument("--elastic-ledger", default="",
                     help="archive the decision ledger here at shutdown")
+    ap.add_argument("--trace", type=int, default=0,
+                    help="chordax-tower: enable trace recording so "
+                         "TRACE_PULL has spans to serve (0/1)")
+    ap.add_argument("--trace-sample", type=float, default=1.0,
+                    help="root-span sample rate under --trace")
+    ap.add_argument("--exemplars", type=int, default=0,
+                    help="chordax-tower: capture (value, trace_id) "
+                         "exemplars on latency hists (0/1)")
     args = ap.parse_args(argv)
     if args.elastic:
         args.lens = 1
@@ -89,6 +97,16 @@ def main(argv=None) -> int:
     from p2p_dhts_tpu.mesh.plane import MeshPlane
     from p2p_dhts_tpu.net import wire
     from p2p_dhts_tpu.net.rpc import Server
+
+    # chordax-tower (ISSUE 20): the observed-fleet switches — tracing
+    # feeds the TRACE_PULL collection verb, exemplars bridge latency
+    # hists to trace ids. Both default OFF (the PR-14 discipline).
+    if args.trace:
+        from p2p_dhts_tpu import trace
+        trace.enable(True, sample_rate=args.trace_sample)
+    if args.exemplars:
+        from p2p_dhts_tpu.metrics import METRICS
+        METRICS.set_exemplars(True)
 
     rng = np.random.RandomState(args.members_seed)
     member_rows = [int.from_bytes(rng.bytes(16), "little")

@@ -58,6 +58,12 @@ class Metrics:
     #: registry can sit on the per-request serve hot path forever.
     HIST_CAP = 4096
 
+    #: Exemplar ring bound per histogram (chordax-tower, ISSUE 20):
+    #: the newest (value, trace_id) pairs recorded while a SAMPLED
+    #: trace was active — the bridge from a p99 outlier to its full
+    #: stitched trace. Small: an exemplar is a pointer, not a sample.
+    EXEMPLAR_CAP = 8
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
@@ -81,6 +87,12 @@ class Metrics:
         self._hist_epochs: Dict[str, int] = {}
         self._counter_epochs: Dict[str, int] = {}
         self._creations = 0
+        # Exemplars are OPT-IN (chordax-tower): the disabled path is
+        # ONE attribute read on top of the plain hist append — the
+        # PR-14 cost_accounting=False discipline, bound-tested in
+        # tests/test_metrics.py.
+        self._exemplars_on = False
+        self._exemplars: Dict[str, collections.deque] = {}
 
     def inc(self, name: str, value: int = 1) -> None:
         with self._lock:
@@ -143,7 +155,8 @@ class Metrics:
             # which is what tells pulse's cursors to re-seed rather
             # than read a cross-incarnation delta.
             for fam in (self._hist_totals, self._hist_sums,
-                        self._hist_epochs, self._counter_epochs):
+                        self._hist_epochs, self._counter_epochs,
+                        self._exemplars):
                 for k in [k for k in fam if _match(k)]:
                     del fam[k]
         return removed
@@ -159,26 +172,81 @@ class Metrics:
             self._hist_epochs[name] = self._creations
         return h
 
+    # -- exemplars (chordax-tower, ISSUE 20) --------------------------------
+    def set_exemplars(self, on: bool) -> None:
+        """Flip exemplar capture. When ON, every `observe_hist`/
+        `observe_hist_many` that runs under an ACTIVE SAMPLED trace
+        appends one (value, trace_id, t) exemplar to that hist's
+        bounded ring (newest `EXEMPLAR_CAP` win) — the p99-outlier →
+        stitched-trace bridge the tower collector walks. When OFF
+        (the default) the record path is untouched beyond one
+        attribute read."""
+        self._exemplars_on = bool(on)
+
+    @property
+    def exemplars_enabled(self) -> bool:
+        return self._exemplars_on
+
+    @staticmethod
+    def _active_trace_id() -> Optional[str]:
+        """The current thread's SAMPLED trace id, or None. Lazy
+        import: metrics must stay importable without (and below)
+        trace in the module graph."""
+        from p2p_dhts_tpu import trace as _trace
+        if not _trace.enabled():
+            return None
+        ctx = _trace.current()
+        return ctx.trace_id if ctx is not None else None
+
+    def _exemplar_locked(self, name: str, value: float,
+                         trace_id: str) -> None:
+        ring = self._exemplars.get(name)
+        if ring is None:
+            ring = self._exemplars[name] = collections.deque(
+                maxlen=self.EXEMPLAR_CAP)
+        ring.append({"value": value, "trace_id": trace_id,
+                     "t": time.time()})
+
+    def exemplars(self, name: Optional[str] = None
+                  ) -> Dict[str, list]:
+        """{hist name: [exemplar dicts, oldest first]} — the METRICS
+        verb's EXEMPLARS section (whole registry, or one hist)."""
+        with self._lock:
+            if name is not None:
+                ring = self._exemplars.get(name)
+                return {name: [dict(e) for e in ring]} if ring else {}
+            return {k: [dict(e) for e in dq]
+                    for k, dq in self._exemplars.items()}
+
     def observe_hist(self, name: str, value: float) -> None:
         """Append one sample to a bounded reservoir histogram."""
         value = float(value)
+        tid = self._active_trace_id() if self._exemplars_on else None
         with self._lock:
             self._hist_locked(name).append(value)
             self._hist_totals[name] = self._hist_totals.get(name, 0) + 1
             self._hist_sums[name] = \
                 self._hist_sums.get(name, 0.0) + value
+            if tid is not None:
+                self._exemplar_locked(name, value, tid)
 
     def observe_hist_many(self, name: str, values: Sequence[float]) -> None:
         """Append a batch of samples under ONE lock acquisition — the
         serve engine's fan-out path records a whole batch's latencies
-        at once instead of contending per request."""
+        at once instead of contending per request. With exemplars on,
+        the batch contributes its SLOWEST sample as one exemplar (a
+        per-value capture would let one batch flush the whole ring)."""
         vals = [float(v) for v in values]
+        tid = (self._active_trace_id()
+               if self._exemplars_on and vals else None)
         with self._lock:
             self._hist_locked(name).extend(vals)
             self._hist_totals[name] = \
                 self._hist_totals.get(name, 0) + len(vals)
             self._hist_sums[name] = \
                 self._hist_sums.get(name, 0.0) + sum(vals)
+            if tid is not None:
+                self._exemplar_locked(name, max(vals), tid)
 
     def state(self) -> dict:
         """The CHEAP whole-registry state: counters + gauges +
@@ -267,6 +335,7 @@ class Metrics:
             self._hist_sums.clear()
             self._hist_epochs.clear()
             self._counter_epochs.clear()
+            self._exemplars.clear()
 
 
 #: Process-wide default registry (the RPC layer and overlay peers record

@@ -67,9 +67,10 @@ DEFAULT_RING_POINTS = 128
 #: consume. Operator-extensible per sampler. "lens." makes the
 #: chordax-lens capacity plane (ISSUE 14) — busy fraction, headroom,
 #: saturation, queue delay — pulse series (and SLO-selectable) for
-#: free.
+#: free; "tower." does the same for the chordax-tower canary gauges
+#: (ISSUE 20), so canary availability/p99 are SLO-selectable.
 DEFAULT_PREFIXES = ("serve.", "gateway.", "rpc.", "repair.",
-                    "membership.", "lens.")
+                    "membership.", "lens.", "tower.")
 
 #: Verdicts, in escalation order.
 OK, WARN, BREACH = "OK", "WARN", "BREACH"

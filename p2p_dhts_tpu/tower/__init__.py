@@ -1,0 +1,44 @@
+"""chordax-tower: fleet observability (ISSUE 20).
+
+One process's chordax-scope planes (spans, flight recorder, pulse
+series, elastic ledger) already answer "what happened HERE"; tower
+answers "what happened to the FLEET, in one artifact". Four pieces:
+
+  * `Collector` (collector.py) — a PacedLoop that discovers peers from
+    the epoch-stamped route table and incrementally pulls each
+    process's span tail (TRACE_PULL), flight/ledger tails (HEALTH
+    SINCE / LEDGER_SINCE) and pulse deltas over the wire — duplicate-
+    free monotonic cursors, eviction-visible gaps, and a per-peer
+    clock offset estimated from pull RTT midpoints.
+  * `stitch` (stitch.py) — assembles every pulled span sharing a
+    trace_id into ONE Chrome/Perfetto export with one pid-lane per
+    process, wall-clock aligned by the per-peer offsets.
+  * `timeline` (timeline.py) — merges flight events, HAVOC plan
+    installs, elastic ledger actions, membership/ring transitions and
+    SLO burn-rate crossings into one causally-ordered markdown
+    incident timeline.
+  * `Canary` (canary.py) — a black-box prober driving synthetic
+    per-shard GET/PUT/lookup probes through a dedicated `edge.Client`
+    (counted, rate-capped, NOCACHE so probes never warm the hot-key
+    cache), feeding `tower.canary.availability/p99.<shard>` gauges and
+    an availability SLO the pulse engine burns against.
+
+Everything here is stdlib + numpy; no module imports jax.
+"""
+
+from p2p_dhts_tpu.tower.canary import Canary
+from p2p_dhts_tpu.tower.collector import Collector
+from p2p_dhts_tpu.tower.stitch import (stitch_chrome, stitch_trace,
+                                       wall_start)
+from p2p_dhts_tpu.tower.timeline import (build_timeline,
+                                         render_markdown)
+
+__all__ = [
+    "Canary",
+    "Collector",
+    "build_timeline",
+    "render_markdown",
+    "stitch_chrome",
+    "stitch_trace",
+    "wall_start",
+]

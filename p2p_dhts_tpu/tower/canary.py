@@ -1,0 +1,236 @@
+"""chordax-tower: black-box canary probing (ISSUE 20).
+
+Every other signal in the fleet is WHITE-box — the process reporting
+on itself. The canary is the outside view: a PacedLoop driving
+synthetic GET / PUT / lookup probes at every shard through a
+DEDICATED `edge.Client`, measuring what a real client would see
+(routing, folding, breakers — everything but hedging, which is
+disabled so one probe measures ONE gateway's honest latency).
+
+Probe discipline:
+
+  * PER-SHARD — one probe key per shard: the shard's LOWEST owned key
+    (`RouteTable.shard_of`), stable across rounds, guaranteed to
+    route to that member. Storage cost is bounded at one canary value
+    per shard, reused forever.
+  * COUNTED — every probe increments `tower.canary.probes`; failures
+    increment `tower.canary.failures` — the availability SLO's
+    numerator/denominator (`slo_spec()` wires them to the pulse
+    engine). A GET that cleanly answers "not found" is AVAILABLE:
+    the canary measures the serving path, not data presence.
+  * RATE-CAPPED — a token bucket (`rate_cap_per_s`) clips the probe
+    budget per round; clipped probes count `tower.canary.rate_capped`
+    and are skipped, never queued (a slow fleet must not accumulate
+    probe debt).
+  * CACHE-EXCLUDED — the probe client stamps `NOCACHE: 1` on every
+    request, so the same probe key hitting every round can never warm
+    the hot-key cache and fake availability from memory.
+
+Gauges `tower.canary.availability.<shard>` (percent, windowed) and
+`tower.canary.p99.<shard>` (ms) publish the outside view per shard; a
+shard leaving the route table retires both keys and its window (the
+PR-8 rule), counted in `tower.canary.shards_retired`.
+
+LOCK ORDER: no new locks — windows are loop-thread-only state; the
+edge client's own leaf lock is internal. Never imports jax.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from p2p_dhts_tpu.edge.client import Client as EdgeClient
+from p2p_dhts_tpu.health import PacedLoop
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net.rpc import Client as RpcClient
+
+__all__ = ["Canary"]
+
+#: Default sliding probe window per shard (availability/p99 horizon).
+DEFAULT_WINDOW = 64
+
+#: Per-shard gauge families the canary owns — retired with the shard.
+_SHARD_KEYS = ("tower.canary.availability", "tower.canary.p99")
+
+
+class Canary(PacedLoop):
+    """The black-box prober. `gateways` seeds the probe client's route
+    cache; the probed shard set then follows the live table."""
+
+    def __init__(self, gateways, *, metrics: Optional[Metrics] = None,
+                 interval_s: float = 1.0, window: int = DEFAULT_WINDOW,
+                 rate_cap_per_s: float = 50.0,
+                 deadline_ms: float = 1000.0,
+                 put_payload: Optional[Tuple[np.ndarray, int]] = None,
+                 client: Optional[EdgeClient] = None,
+                 registry=None):
+        super().__init__(
+            name="tower-canary", kind="tower",
+            interval_s=interval_s, interval_idle_s=interval_s,
+            backoff_base_s=max(interval_s, 0.25), backoff_cap_s=30.0,
+            metrics=metrics, failure_metric="tower.canary.round_failures",
+            thread_name="tower-canary", registry=registry)
+        # The DEDICATED probe client: folds never mix across Client
+        # instances, so NOCACHE stamps probes only; hedging is off so
+        # a probe's latency is one gateway's honest answer, not the
+        # min of two.
+        self.client = client if client is not None else EdgeClient(
+            gateways, metrics=self.metrics, hedge_enabled=False,
+            request_fields={"NOCACHE": 1})
+        self._owns_client = client is None
+        self.deadline_ms = float(deadline_ms)
+        self.window = int(window)
+        self.rate_cap_per_s = float(rate_cap_per_s)
+        self.put_payload = put_payload
+        #: shard label ("ip:port") -> deque[(ok, seconds)].
+        self._windows: Dict[str, deque] = {}
+        self._tokens = float(rate_cap_per_s)
+        self._last_refill = time.monotonic()
+
+    # -- the round -----------------------------------------------------------
+    def _shards(self) -> List[Tuple[str, int]]:
+        """[(shard label, probe key)] from the live table: the probe
+        key is the shard's lowest owned key — stable, member-owned."""
+        self.client.routes.ensure()
+        table = self.client.routes.table
+        out = []
+        for member, addr in sorted(table.peers().items()):
+            rng = table.shard_of(member)
+            if rng is None:
+                continue
+            out.append((f"{addr[0]}:{addr[1]}", int(rng[0])))
+        return out
+
+    def _admit(self, n: int) -> int:
+        """Token-bucket clip: how many of `n` wanted probes run this
+        round. Clipped probes are counted and DROPPED (no debt)."""
+        now = time.monotonic()
+        self._tokens = min(
+            self.rate_cap_per_s,
+            self._tokens + (now - self._last_refill)
+            * self.rate_cap_per_s)
+        self._last_refill = now
+        grant = int(min(n, self._tokens))
+        self._tokens -= grant
+        if grant < n:
+            self.metrics.inc("tower.canary.rate_capped", n - grant)
+        return grant
+
+    def _round(self) -> None:
+        shards = self._shards()
+        live = {label for label, _ in shards}
+        for label in [s for s in self._windows if s not in live]:
+            self._retire_shard(label)
+        per_shard = 3 if self.put_payload is not None else 2
+        budget = self._admit(len(shards) * per_shard)
+        for label, key in shards:
+            if budget < per_shard:
+                break
+            budget -= per_shard
+            self._probe_shard(label, key)
+        self.rounds += 1
+
+    def _probe_shard(self, label: str, key: int) -> None:
+        probes = [("lookup", lambda: self._lookup(key)),
+                  ("get", lambda: self._get(key))]
+        if self.put_payload is not None:
+            probes.append(("put", lambda: self._put(key)))
+        win = self._windows.setdefault(label,
+                                       deque(maxlen=self.window))
+        for kind, fn in probes:
+            t0 = time.perf_counter()
+            try:
+                ok = bool(fn())
+            # chordax-lint: disable=bare-except -- a probe failure IS the measurement; it lands in the window, never kills the loop
+            except Exception:
+                ok = False
+            dt = time.perf_counter() - t0
+            win.append((ok, dt))
+            self.metrics.inc("tower.canary.probes")
+            if not ok:
+                self.metrics.inc("tower.canary.failures")
+                self.metrics.inc(f"tower.canary.failed.{kind}")
+        self._publish(label, win)
+
+    def _lookup(self, key: int) -> bool:
+        res = self.client.find_successor([key],
+                                         deadline_ms=self.deadline_ms)
+        return not res.failed.any()
+
+    def _get(self, key: int) -> bool:
+        # A clean miss (ok=False, failed=False) is AVAILABLE: the path
+        # answered; the canary does not require its key to exist.
+        res = self.client.get([key], deadline_ms=self.deadline_ms)
+        return not res.failed.any()
+
+    def _put(self, key: int) -> bool:
+        segments, length = self.put_payload
+        owner = self.client.routes.table.owner(key)
+        if owner is None:
+            return False
+        ip, port = owner[1]
+        resp = RpcClient.make_request(
+            str(ip), int(port),
+            {"COMMAND": "PUT", "KEY": format(int(key), "x"),
+             "SEGMENTS": np.ascontiguousarray(segments, np.int32),
+             "LENGTH": int(length), "NOCACHE": 1,
+             "DEADLINE_MS": self.deadline_ms},
+            timeout=self.deadline_ms / 1e3 + 1.0)
+        return bool(resp.get("SUCCESS"))
+
+    # -- publication + retirement --------------------------------------------
+    def _publish(self, label: str, win: deque) -> None:
+        oks = [1.0 if ok else 0.0 for ok, _ in win]
+        lats = sorted(dt for ok, dt in win if ok)
+        pct = 100.0 * sum(oks) / len(oks) if oks else 0.0
+        self.metrics.gauge(f"tower.canary.availability.{label}",
+                           round(pct, 3))
+        if lats:
+            p99 = lats[min(len(lats) - 1,
+                           int(0.99 * (len(lats) - 1) + 0.5))]
+            self.metrics.gauge(f"tower.canary.p99.{label}",
+                               round(p99 * 1e3, 3))
+
+    def _retire_shard(self, label: str) -> None:
+        """A shard left the table: its windows and gauge keys go AWAY
+        (exact-key remove_prefix — labels contain dots), never stale."""
+        self._windows.pop(label, None)
+        for fam in _SHARD_KEYS:
+            self.metrics.remove_prefix(f"{fam}.{label}")
+        self.metrics.inc("tower.canary.shards_retired")
+
+    # -- introspection -------------------------------------------------------
+    def availability(self) -> Optional[float]:
+        """Fleet-wide windowed availability percent (None before any
+        probe) — what the bench compares against its own measured
+        success rate."""
+        total = ok = 0
+        for win in self._windows.values():
+            total += len(win)
+            ok += sum(1 for o, _ in win if o)
+        return 100.0 * ok / total if total else None
+
+    def shard_labels(self) -> List[str]:
+        return sorted(self._windows)
+
+    def slo_spec(self, *, target_pct: float = 99.0,
+                 window_s: float = 60.0,
+                 long_window_s: float = 300.0) -> dict:
+        """The availability Slo over the probe counters — hand to
+        `pulse.SloEngine` so canary failures burn an error budget like
+        any first-class objective."""
+        return {"name": "tower.canary", "kind": "availability",
+                "total": "tower.canary.probes",
+                "errors": "tower.canary.failures",
+                "target_pct": float(target_pct),
+                "window_s": float(window_s),
+                "long_window_s": float(long_window_s)}
+
+    def close(self, timeout: float = 30.0) -> None:
+        super().close(timeout)
+        if self._owns_client:
+            self.client.close()

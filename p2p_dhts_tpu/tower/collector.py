@@ -1,0 +1,407 @@
+"""chordax-tower: the fleet collector (ISSUE 20 — the tentpole).
+
+One `health.PacedLoop` that turns N processes' private observability
+rings into one queryable pool:
+
+  * DISCOVERY — peers come from the epoch-stamped route table (any
+    object with `.addresses()`: a mesh `RouteTable` or an edge
+    `RouteCache`), so the collector follows joins, splits and
+    retirements without its own membership protocol.
+  * INCREMENTAL PULLS — per peer, per round: the span tail
+    (TRACE_PULL SINCE/LIMIT), the flight-recorder tail + elastic
+    ledger rows (HEALTH SINCE / LEDGER_SINCE), and pulse series
+    deltas (PULSE SERIES, deduped client-side by last-seen point
+    time). Every pull resumes a monotonic sequence cursor —
+    duplicate-free across polls, eviction-visible (GAP counts are
+    surfaced as `tower.collector.*_gap` counters, never swallowed).
+  * CLOCK OFFSET — each TRACE_PULL reply carries the peer's wall
+    clock; `offset = peer_wall - (t_send + rtt/2)` is the NTP-style
+    RTT-midpoint sample, and the estimate keeps the sample with the
+    SMALLEST rtt over a sliding window (the tightest bound wins).
+    `stitch`/`timeline` shift each peer's walls by this estimate.
+  * EXEMPLAR RETRACE — metrics exemplars (value, trace_id) pulled
+    per round; `slow_traces(k)` stitches the top-k slowest exemplars'
+    traces from the pool. A trace whose spans the incremental pulls
+    already delivered costs NOTHING extra; only a pool miss falls
+    back to a by-trace fetch (TRACE_STATUS TRACE_ID), counted in
+    `tower.collector.retraces` — zero in steady state (bench-gated).
+  * RETIREMENT — a peer leaving the route table retires its
+    `tower.peer.*.<addr>` metric keys AND its cursor/pool state
+    (the PR-8 rule: keys for departed instances never go stale,
+    they go away), counted in `tower.peers_retired`.
+
+LOCK ORDER: `Collector._lock` is a LEAF — held around pool/cursor
+mutation only, never across an RPC. Pulls run on the loop thread;
+accessors copy under the lock. This module never imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from p2p_dhts_tpu.health import PacedLoop
+from p2p_dhts_tpu.metrics import METRICS, Metrics
+from p2p_dhts_tpu.net.rpc import Client as RpcClient
+from p2p_dhts_tpu.tower import stitch as stitch_mod
+from p2p_dhts_tpu.tower import timeline as timeline_mod
+
+__all__ = ["Collector"]
+
+#: Sliding window of (rtt, offset) samples per peer; the estimate is
+#: the offset of the window's minimum-RTT sample.
+OFFSET_WINDOW = 16
+
+#: Per-peer retained pool bounds (spans / flight events / ledger rows
+#: / pulse points per series).
+SPAN_POOL = 16384
+EVENT_POOL = 4096
+LEDGER_POOL = 4096
+PULSE_POOL = 512
+
+#: Per-peer metric families the collector owns — retired (exact key,
+#: addresses contain dots) when the peer leaves the route table.
+_PEER_KEYS = ("tower.peer.offset_ms", "tower.peer.rtt_ms",
+              "tower.peer.span_cursor")
+
+
+class _PeerState:
+    """One peer's cursors + clock-offset window (loop-thread only)."""
+
+    __slots__ = ("span_cursor", "flight_cursor", "ledger_cursor",
+                 "samples", "offset_s", "rtt_s", "pulse_last")
+
+    def __init__(self) -> None:
+        self.span_cursor = 0
+        self.flight_cursor = 0
+        self.ledger_cursor = 0
+        self.samples: deque = deque(maxlen=OFFSET_WINDOW)
+        self.offset_s = 0.0
+        self.rtt_s: Optional[float] = None
+        #: series id -> last ingested point time (the dedupe cursor —
+        #: PULSE has no seq, but point times are strictly increasing
+        #: per ring).
+        self.pulse_last: Dict[str, float] = {}
+
+
+class Collector(PacedLoop):
+    """The fleet collector loop. `routes` is any object with
+    `.addresses() -> [(ip, port), ...]`; the collector polls exactly
+    that set each round."""
+
+    def __init__(self, routes, *, metrics: Optional[Metrics] = None,
+                 interval_s: float = 1.0,
+                 span_limit: int = 2048, flight_tail: int = 512,
+                 pulse_prefix: Optional[str] = None,
+                 pulse_tail: int = 64,
+                 timeout_s: float = 5.0,
+                 pull_exemplars: bool = True,
+                 registry=None):
+        super().__init__(
+            name="tower-collector", kind="tower",
+            interval_s=interval_s, interval_idle_s=interval_s * 4,
+            backoff_base_s=max(interval_s, 0.25), backoff_cap_s=30.0,
+            metrics=metrics, failure_metric="tower.collector.failures",
+            thread_name="tower-collector", registry=registry)
+        self.routes = routes
+        self.span_limit = int(span_limit)
+        self.flight_tail = int(flight_tail)
+        self.pulse_prefix = pulse_prefix
+        self.pulse_tail = int(pulse_tail)
+        self.timeout_s = float(timeout_s)
+        self.pull_exemplars = bool(pull_exemplars)
+        self._lock = threading.Lock()   # LEAF: pools + peer state
+        self._peers: Dict[str, _PeerState] = {}
+        self._spans: Dict[str, deque] = {}
+        self._events: Dict[str, deque] = {}
+        self._ledger: Dict[str, deque] = {}
+        self._pulse: Dict[str, Dict[str, deque]] = {}
+        #: peer -> hist name -> newest exemplar rows (value, trace_id).
+        self._exemplars: Dict[str, Dict[str, List[dict]]] = {}
+
+    # -- the round -----------------------------------------------------------
+    def _addresses(self) -> List[Tuple[str, int]]:
+        return [(str(ip), int(port))
+                for ip, port in self.routes.addresses()]
+
+    def _round(self) -> None:
+        addrs = self._addresses()
+        live = {f"{ip}:{port}" for ip, port in addrs}
+        with self._lock:
+            gone = [p for p in self._peers if p not in live]
+        for peer in gone:
+            self._retire(peer)
+        for ip, port in addrs:
+            peer = f"{ip}:{port}"
+            try:
+                self._pull_peer(peer, ip, port)
+            # chordax-lint: disable=bare-except -- one unreachable peer must not stall the whole fleet's collection round
+            except Exception:
+                self.metrics.inc("tower.collector.pull_failures")
+        self.rounds += 1
+
+    def _rpc(self, ip: str, port: int, req: dict) -> dict:
+        resp = RpcClient.make_request(ip, port, req,
+                                      timeout=self.timeout_s)
+        if resp.get("SUCCESS") is False:
+            raise RuntimeError(
+                f"{req.get('COMMAND')} failed: {resp.get('ERRORS')}")
+        return resp
+
+    def _pull_peer(self, peer: str, ip: str, port: int) -> None:
+        with self._lock:
+            st = self._peers.setdefault(peer, _PeerState())
+        self._pull_spans(peer, st, ip, port)
+        self._pull_health(peer, st, ip, port)
+        if self.pulse_prefix is not None:
+            self._pull_pulse(peer, st, ip, port)
+        if self.pull_exemplars:
+            self._pull_exemplars(peer, ip, port)
+
+    def _pull_spans(self, peer: str, st: _PeerState, ip: str,
+                    port: int) -> None:
+        t_send = time.time()
+        p0 = time.perf_counter()
+        resp = self._rpc(ip, port, {"COMMAND": "TRACE_PULL",
+                                    "SINCE": st.span_cursor,
+                                    "LIMIT": self.span_limit})
+        rtt = time.perf_counter() - p0
+        # NTP-style midpoint sample: the peer stamped WALL somewhere
+        # inside our [send, recv] window; assuming the midpoint bounds
+        # the error by rtt/2. Keep the window's min-RTT sample — the
+        # tightest bound, robust to one slow pull.
+        wall = resp.get("WALL")
+        if wall is not None:
+            st.samples.append((rtt, float(wall) - (t_send + rtt / 2)))
+            best = min(st.samples, key=lambda s: s[0])
+            st.rtt_s, st.offset_s = best
+        spans = resp.get("SPANS") or []
+        gap = int(resp.get("GAP", 0) or 0)
+        with self._lock:
+            pool = self._spans.setdefault(peer,
+                                          deque(maxlen=SPAN_POOL))
+            pool.extend(spans)
+            st.span_cursor = int(resp.get("NEXT", st.span_cursor))
+        if spans:
+            self.metrics.inc("tower.collector.spans_pulled",
+                             len(spans))
+        if gap:
+            self.metrics.inc("tower.collector.span_gap", gap)
+        self.metrics.gauge(f"tower.peer.span_cursor.{peer}",
+                           st.span_cursor)
+        self.metrics.gauge(f"tower.peer.offset_ms.{peer}",
+                           round(st.offset_s * 1e3, 3))
+        if st.rtt_s is not None:
+            self.metrics.gauge(f"tower.peer.rtt_ms.{peer}",
+                               round(st.rtt_s * 1e3, 3))
+
+    def _pull_health(self, peer: str, st: _PeerState, ip: str,
+                     port: int) -> None:
+        resp = self._rpc(ip, port,
+                         {"COMMAND": "HEALTH",
+                          "SINCE": st.flight_cursor,
+                          "TAIL": self.flight_tail,
+                          "LEDGER_SINCE": st.ledger_cursor})
+        health = resp.get("HEALTH") or {}
+        flight = health.get("FLIGHT") or {}
+        events = flight.get("tail") or []
+        with self._lock:
+            pool = self._events.setdefault(peer,
+                                           deque(maxlen=EVENT_POOL))
+            pool.extend(events)
+            st.flight_cursor = int(flight.get("next_seq",
+                                              st.flight_cursor))
+        if events:
+            self.metrics.inc("tower.collector.events_pulled",
+                             len(events))
+        gap = int(flight.get("gap", 0) or 0)
+        if gap:
+            self.metrics.inc("tower.collector.event_gap", gap)
+        ledger = health.get("LEDGER")
+        if ledger is not None:
+            rows = ledger.get("rows") or []
+            with self._lock:
+                pool = self._ledger.setdefault(
+                    peer, deque(maxlen=LEDGER_POOL))
+                pool.extend(rows)
+                st.ledger_cursor = int(ledger.get("next_seq",
+                                                  st.ledger_cursor))
+            if rows:
+                self.metrics.inc("tower.collector.ledger_pulled",
+                                 len(rows))
+            lgap = int(ledger.get("gap", 0) or 0)
+            if lgap:
+                self.metrics.inc("tower.collector.ledger_gap", lgap)
+
+    def _pull_pulse(self, peer: str, st: _PeerState, ip: str,
+                    port: int) -> None:
+        sel = self.pulse_prefix if self.pulse_prefix else True
+        resp = self._rpc(ip, port, {"COMMAND": "PULSE", "SERIES": sel,
+                                    "TAIL": self.pulse_tail})
+        series = resp.get("SERIES") or {}
+        fresh = 0
+        with self._lock:
+            rings = self._pulse.setdefault(peer, {})
+            for sid, pts in series.items():
+                last = st.pulse_last.get(sid, float("-inf"))
+                ring = rings.setdefault(sid, deque(maxlen=PULSE_POOL))
+                for t, v in pts:
+                    # Dedupe on point time: PULSE tails overlap across
+                    # polls by design; only strictly-newer points land.
+                    if t > last:
+                        ring.append((t, v))
+                        last = t
+                        fresh += 1
+                st.pulse_last[sid] = last
+        if fresh:
+            self.metrics.inc("tower.collector.pulse_points", fresh)
+
+    def _pull_exemplars(self, peer: str, ip: str, port: int) -> None:
+        resp = self._rpc(ip, port, {"COMMAND": "METRICS",
+                                    "EXEMPLARS": 1})
+        ex = resp.get("EXEMPLARS") or {}
+        if ex:
+            with self._lock:
+                self._exemplars[peer] = {
+                    str(h): [dict(r) for r in rows]
+                    for h, rows in ex.items()}
+
+    # -- retirement (the PR-8 rule) ------------------------------------------
+    def _retire(self, peer: str) -> None:
+        """Drop a departed peer's cursors, pools and per-peer metric
+        keys — addresses contain dots, so remove_prefix matches the
+        exact assembled key (the mesh plane's retirement idiom)."""
+        with self._lock:
+            self._peers.pop(peer, None)
+            self._spans.pop(peer, None)
+            self._events.pop(peer, None)
+            self._ledger.pop(peer, None)
+            self._pulse.pop(peer, None)
+            self._exemplars.pop(peer, None)
+        for fam in _PEER_KEYS:
+            self.metrics.remove_prefix(f"{fam}.{peer}")
+        self.metrics.inc("tower.peers_retired")
+
+    # -- accessors (copy under the leaf lock) --------------------------------
+    def peers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._peers)
+
+    def offsets(self) -> Dict[str, float]:
+        """peer -> seconds to ADD to that peer's wall stamps to land
+        on the collector's clock (the stitch/timeline alignment
+        input). The estimate's sign convention: a peer whose clock
+        runs AHEAD has a positive raw offset, so alignment SUBTRACTS
+        it — hence the negation here."""
+        with self._lock:
+            return {p: -st.offset_s for p, st in self._peers.items()}
+
+    def spans_by_peer(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            return {p: list(d) for p, d in self._spans.items()}
+
+    def events_by_peer(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            return {p: list(d) for p, d in self._events.items()}
+
+    def ledger_by_peer(self) -> Dict[str, List[dict]]:
+        with self._lock:
+            return {p: list(d) for p, d in self._ledger.items()}
+
+    def pulse_series(self, peer: str) -> Dict[str, List[Tuple]]:
+        with self._lock:
+            return {sid: list(ring) for sid, ring
+                    in self._pulse.get(peer, {}).items()}
+
+    def exemplars_by_peer(self) -> Dict[str, Dict[str, List[dict]]]:
+        with self._lock:
+            return {p: {h: list(rows) for h, rows in fams.items()}
+                    for p, fams in self._exemplars.items()}
+
+    # -- the stitched artifacts ----------------------------------------------
+    def stitch(self, trace_id: str) -> str:
+        """One trace's cross-process Chrome export from the pool."""
+        return stitch_mod.stitch_trace(self.spans_by_peer(),
+                                       trace_id, self.offsets())
+
+    def timeline(self, title: str = "chordax incident timeline"
+                 ) -> str:
+        """The merged incident timeline over everything collected."""
+        rows = timeline_mod.build_timeline(self.events_by_peer(),
+                                           self.ledger_by_peer(),
+                                           self.offsets())
+        return timeline_mod.render_markdown(rows, title=title)
+
+    def slow_traces(self, k: int = 3,
+                    hist: Optional[str] = None) -> List[dict]:
+        """The top-k slowest exemplars across the fleet (optionally
+        one histogram family), each with its stitched cross-process
+        export. Steady state is FREE: the incremental span pulls
+        already delivered the trace's spans, so stitching is a pool
+        read. Only a pool miss (the trace raced eviction, or landed
+        after the last pull) falls back to a by-trace TRACE_STATUS
+        fetch from every peer — counted in `tower.collector.retraces`
+        and asserted ZERO by the bench's steady-state gate."""
+        rows = []
+        for peer, fams in self.exemplars_by_peer().items():
+            for h, exes in fams.items():
+                if hist is not None and h != hist:
+                    continue
+                for e in exes:
+                    if e.get("trace_id"):
+                        rows.append({"peer": peer, "hist": h,
+                                     "value": float(e["value"]),
+                                     "trace_id": str(e["trace_id"])})
+        rows.sort(key=lambda r: (-r["value"], r["trace_id"]))
+        top: List[dict] = []
+        seen = set()
+        for r in rows:
+            if r["trace_id"] in seen:
+                continue
+            seen.add(r["trace_id"])
+            top.append(r)
+            if len(top) >= int(k):
+                break
+        pool = self.spans_by_peer()
+        offsets = self.offsets()
+        for r in top:
+            tid = r["trace_id"]
+            if not any(s.get("trace_id") == tid
+                       for spans in pool.values() for s in spans):
+                self._retrace(tid, pool)
+            r["chrome"] = stitch_mod.stitch_trace(pool, tid, offsets)
+        return top
+
+    def _retrace(self, trace_id: str,
+                 pool: Dict[str, List[dict]]) -> None:
+        """Pool-miss fallback: fetch one trace's spans by id from
+        every live peer (TRACE_STATUS TRACE_ID). Counted — the bench
+        asserts this stays zero in steady state."""
+        self.metrics.inc("tower.collector.retraces")
+        for ip, port in self._addresses():
+            peer = f"{ip}:{port}"
+            try:
+                resp = self._rpc(ip, port,
+                                 {"COMMAND": "TRACE_STATUS",
+                                  "TRACE_ID": trace_id})
+            # chordax-lint: disable=bare-except -- a retrace is best-effort enrichment; a dead peer's spans are simply absent
+            except Exception:
+                continue
+            spans = resp.get("SPANS") or []
+            if spans:
+                pool.setdefault(peer, []).extend(spans)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "peers": sorted(self._peers),
+                "spans": {p: len(d) for p, d in self._spans.items()},
+                "events": {p: len(d)
+                           for p, d in self._events.items()},
+                "ledger": {p: len(d)
+                           for p, d in self._ledger.items()},
+                "offsets_ms": {p: round(-st.offset_s * 1e3, 3)
+                               for p, st in self._peers.items()},
+            }

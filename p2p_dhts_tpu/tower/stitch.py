@@ -1,0 +1,127 @@
+"""chordax-tower: cross-process trace stitching (ISSUE 20).
+
+`SpanStore.export_chrome` renders ONE process's spans on its private
+perf_counter timeline; a hedged cross-shard request leaves spans in
+two, three, four processes and those timelines are incomparable on the
+wire. The stitcher fixes both halves:
+
+  * TIME — every span carries a wall-clock completion stamp (`wall`,
+    trace.record_span); `wall - (t1 - t0)` is its wall START, and
+    shifting each peer's walls by the collector's estimated clock
+    offset (RTT-midpoint, NTP-style) puts every process on one shared
+    timeline. Sub-millisecond skew is not the goal — causal ordering
+    of multi-millisecond RPC hops is, and the offset bound is the
+    pull's RTT/2.
+  * LANES — one Chrome `pid` lane per process, assigned in sorted
+    peer-name order with `process_name` metadata events, so the
+    Perfetto view reads "gateway A called gateway B" top to bottom.
+
+DETERMINISM CONTRACT (regression-tested): the export is a pure
+function of the span SET — any arrival order, any per-peer
+interleaving, produces byte-identical JSON. Events sort on the
+canonical key (ts, pid, seq, span_id); JSON renders with sorted keys
+and fixed separators.
+
+Pure functions over plain dicts; stdlib only; never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Mapping, Optional, Sequence
+
+__all__ = ["stitch_chrome", "stitch_trace", "wall_start"]
+
+
+def wall_start(span: Mapping) -> float:
+    """A span's wall-clock START instant. Spans are stamped with wall
+    time at COMPLETION (they land in the store when they finish), so
+    the start is `wall - duration`. Spans from a pre-tower peer
+    (no `wall`) fall back to t0 — unaligned but never dropped."""
+    if "wall" in span:
+        return float(span["wall"]) - max(
+            float(span["t1"]) - float(span["t0"]), 0.0)
+    return float(span["t0"])
+
+
+def _canonical_event(span: Mapping, pid: int, base: float,
+                     offset: float) -> dict:
+    """One Chrome `ph: "X"` complete event on the stitched timeline.
+    ts is microseconds from the stitched epoch `base` after shifting
+    this peer's walls by `offset` (peer clock -> collector clock)."""
+    args = dict(span.get("args") or {})
+    args["trace_id"] = span["trace_id"]
+    args["span_id"] = span["span_id"]
+    if span.get("parent_id"):
+        args["parent_id"] = span["parent_id"]
+    if span.get("links"):
+        args["links"] = list(span["links"])
+    if "seq" in span:
+        args["seq"] = int(span["seq"])
+    return {
+        "name": span["name"],
+        "cat": span.get("cat") or "chordax",
+        "ph": "X",
+        "ts": round((wall_start(span) + offset - base) * 1e6, 1),
+        "dur": round(max(float(span["t1"]) - float(span["t0"]), 0.0)
+                     * 1e6, 1),
+        "pid": pid,
+        "tid": int(span.get("tid", 0)),
+        "args": args,
+    }
+
+
+def stitch_chrome(spans_by_peer: Mapping[str, Sequence[Mapping]],
+                  offsets: Optional[Mapping[str, float]] = None
+                  ) -> str:
+    """Stitch every peer's spans into one Chrome trace-event JSON
+    document: one pid lane per peer (sorted peer order, pid 1..N, with
+    `process_name` metadata), wall-aligned via `offsets` (peer ->
+    seconds to ADD to that peer's wall clocks; absent peers shift 0).
+
+    Byte-identical for any arrival order of the same span set: lanes
+    come from sorted names, events from a canonical sort, and the JSON
+    from sorted keys + fixed separators."""
+    offsets = offsets or {}
+    peers = sorted(spans_by_peer)
+    # Stitched epoch: the earliest ALIGNED wall start anywhere, so
+    # every ts is >= 0 regardless of which peer's span began first.
+    base = 0.0
+    starts = [wall_start(s) + float(offsets.get(p, 0.0))
+              for p in peers for s in spans_by_peer[p]]
+    if starts:
+        base = min(starts)
+    events: List[dict] = []
+    for pid, peer in enumerate(peers, start=1):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": peer},
+        })
+    rows: List[dict] = []
+    for pid, peer in enumerate(peers, start=1):
+        off = float(offsets.get(peer, 0.0))
+        for s in spans_by_peer[peer]:
+            rows.append(_canonical_event(s, pid, base, off))
+    rows.sort(key=lambda e: (e["ts"], e["pid"],
+                             e["args"].get("seq", -1),
+                             e["args"]["span_id"]))
+    events.extend(rows)
+    return json.dumps({"traceEvents": events,
+                       "displayTimeUnit": "ms"},
+                      sort_keys=True, separators=(",", ":"))
+
+
+def stitch_trace(spans_by_peer: Mapping[str, Sequence[Mapping]],
+                 trace_id: str,
+                 offsets: Optional[Mapping[str, float]] = None
+                 ) -> str:
+    """One trace's stitched export: filter every peer's pool to
+    `trace_id`, keep only peers that contributed a span (lane count ==
+    process count in the trace — the bench's >= 2-process gate reads
+    it straight off the metadata events), then stitch."""
+    subset: Dict[str, List[Mapping]] = {}
+    for peer, spans in spans_by_peer.items():
+        mine = [s for s in spans if s.get("trace_id") == trace_id]
+        if mine:
+            subset[peer] = mine
+    return stitch_chrome(subset, offsets)

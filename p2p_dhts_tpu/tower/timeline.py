@@ -1,0 +1,120 @@
+"""chordax-tower: the merged incident timeline (ISSUE 20).
+
+Every signal an incident is made of already lands in per-process
+rings: HAVOC plan installs/uninstalls, gateway ring-health
+transitions, breaker opens and loop round-failures in the flight
+recorder; SLO warn/breach/recovered crossings from the pulse engine
+(also flight events); split/grow/shrink actions in the elastic
+decision ledger. This module merges the COLLECTED tails of all of
+them into one causally-ordered document: "19:02:01.213 gw-b havoc
+plan_installed ... 19:02:01.940 gw-a pulse slo_breach ...
+19:02:04.102 gw-b pulse slo_recovered" — the first page of any
+postmortem, generated instead of reconstructed.
+
+Ordering: events sort on (aligned wall time, peer, source, seq) —
+peer walls are shifted by the collector's clock offsets first, and
+the per-peer monotonic `seq` breaks same-millisecond ties in true
+record order. The render is DETERMINISTIC (regression-tested): the
+same event set in any arrival order produces byte-identical markdown.
+
+Pure functions over plain dicts; stdlib only; never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Mapping, Optional, Sequence
+
+__all__ = ["build_timeline", "render_markdown"]
+
+#: Flight-event keys lifted into the timeline row proper; everything
+#: else becomes sorted `detail` pairs.
+_CORE_KEYS = ("t", "seq", "subsystem", "event")
+
+
+def _detail(fields: Mapping, skip: Sequence[str]) -> str:
+    """Deterministic one-line rendering of an event's extra fields:
+    sorted key=value pairs, values via canonical JSON (repr-stable
+    across runs, unlike str() of nested dicts)."""
+    parts = []
+    for k in sorted(fields):
+        if k in skip:
+            continue
+        parts.append(f"{k}={json.dumps(fields[k], sort_keys=True, separators=(',', ':'), default=str)}")
+    return " ".join(parts)
+
+
+def build_timeline(events_by_peer: Mapping[str, Sequence[Mapping]],
+                   ledger_by_peer: Optional[
+                       Mapping[str, Sequence[Mapping]]] = None,
+                   offsets: Optional[Mapping[str, float]] = None
+                   ) -> List[dict]:
+    """Normalize + merge + order every collected signal.
+
+    `events_by_peer` holds flight-recorder events (`t`, `seq`,
+    `subsystem`, `event`, fields) — which already includes HAVOC
+    installs, ring transitions, SLO crossings and loop failures;
+    `ledger_by_peer` holds elastic decision-ledger rows (rendered as
+    subsystem "elastic", event = the row's action or "tick").
+    `offsets` aligns peer walls onto the collector clock.
+
+    Returns ordered rows: {"t" (aligned), "peer", "source", "seq",
+    "subsystem", "event", "detail"}."""
+    offsets = offsets or {}
+    rows: List[dict] = []
+    for peer in sorted(events_by_peer):
+        off = float(offsets.get(peer, 0.0))
+        for e in events_by_peer[peer]:
+            rows.append({
+                "t": float(e.get("t", 0.0)) + off,
+                "peer": peer,
+                "source": "flight",
+                "seq": int(e.get("seq", -1)),
+                "subsystem": str(e.get("subsystem", "?")),
+                "event": str(e.get("event", "?")),
+                "detail": _detail(e, _CORE_KEYS),
+            })
+    for peer in sorted(ledger_by_peer or {}):
+        off = float(offsets.get(peer, 0.0))
+        for e in (ledger_by_peer or {})[peer]:
+            action = e.get("action") or e.get("decision") or "tick"
+            rows.append({
+                "t": float(e.get("t", 0.0)) + off,
+                "peer": peer,
+                "source": "ledger",
+                "seq": int(e.get("seq", -1)),
+                "subsystem": "elastic",
+                "event": str(action),
+                "detail": _detail(
+                    e, ("t", "seq", "action", "decision")),
+            })
+    rows.sort(key=lambda r: (r["t"], r["peer"], r["source"],
+                             r["seq"]))
+    return rows
+
+
+def render_markdown(rows: Sequence[Mapping],
+                    title: str = "chordax incident timeline") -> str:
+    """The timeline document: one markdown table, times both absolute
+    (UTC, for cross-artifact correlation) and relative to the first
+    event (for reading the incident's shape). Byte-identical for the
+    same row set."""
+    lines = [f"# {title}", ""]
+    if not rows:
+        lines.append("(no events)")
+        return "\n".join(lines) + "\n"
+    t0 = rows[0]["t"]
+    lines.append("| time (UTC) | +s | peer | subsystem | event "
+                 "| detail |")
+    lines.append("|---|---|---|---|---|---|")
+    for r in rows:
+        stamp = time.strftime("%H:%M:%S",
+                              time.gmtime(r["t"])) + \
+            f".{int((r['t'] % 1.0) * 1000):03d}"
+        rel = f"+{r['t'] - t0:.3f}"
+        detail = r.get("detail", "").replace("|", "\\|")
+        lines.append(f"| {stamp} | {rel} | {r['peer']} "
+                     f"| {r['subsystem']} | {r['event']} "
+                     f"| {detail} |")
+    return "\n".join(lines) + "\n"

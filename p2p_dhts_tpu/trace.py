@@ -114,17 +114,26 @@ UNSAMPLED_WIRE: Dict[str, int] = {"S": 0}
 
 
 class SpanStore:
-    """Bounded thread-safe ring of finished spans (plain dicts)."""
+    """Bounded thread-safe ring of finished spans (plain dicts).
+
+    chordax-tower (ISSUE 20): every added span is stamped with a
+    monotonic per-store sequence number (`seq`), so a remote collector
+    can pull incrementally with `spans_since(cursor)` — duplicate-free
+    across polls, and eviction-visible (the returned gap counts spans
+    that fell off the ring before the cursor caught up)."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._buf: deque = deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self._evicted = 0
+        self._seq = 0
 
     def add(self, span: dict) -> None:
         with self._lock:
             if len(self._buf) == self._buf.maxlen:
                 self._evicted += 1
+            span["seq"] = self._seq
+            self._seq += 1
             self._buf.append(span)
 
     def __len__(self) -> int:
@@ -147,6 +156,37 @@ class SpanStore:
         if trace_id is not None:
             out = [s for s in out if s["trace_id"] == trace_id]
         return out
+
+    @property
+    def next_seq(self) -> int:
+        """The seq the NEXT added span will carry (== total ever
+        added) — a fresh collector cursor starts here or at 0."""
+        with self._lock:
+            return self._seq
+
+    def spans_since(self, cursor: int, limit: Optional[int] = None
+                    ) -> Tuple[List[dict], int, int]:
+        """Incremental pull: `(spans, next_cursor, gap)` for every
+        retained span with seq >= cursor, oldest first, at most
+        `limit`. `gap` counts spans evicted from the ring before the
+        cursor could read them (never silent); `next_cursor` resumes
+        the pull exactly after the last returned span. Seqs are
+        contiguous in the ring, so the tail slice is one traversal."""
+        cursor = max(int(cursor), 0)
+        with self._lock:
+            n = len(self._buf)
+            oldest = self._seq - n
+            start = max(cursor, oldest)
+            gap = start - cursor if cursor < oldest else 0
+            take = n - (start - oldest)
+            if limit is not None:
+                take = min(take, max(int(limit), 0))
+            if take <= 0:
+                return [], start, gap
+            i0 = start - oldest
+            out = [dict(s) for s in
+                   list(self._buf)[i0:i0 + take]]
+        return out, start + len(out), gap
 
     def trace_ids(self) -> List[str]:
         """Distinct trace ids currently retained, oldest first."""
@@ -318,6 +358,12 @@ def record_span(name: str, t0: float, t1: float, *, trace_id: str,
         "parent_id": parent_id,
         "t0": float(t0),
         "t1": float(t1),
+        # Wall-clock stamp at COMPLETION (spans land when they
+        # finish): `wall - (t1 - t0)` is the span's wall start — the
+        # cross-process alignment anchor chordax-tower's stitcher
+        # shifts by the per-peer clock offset (perf_counter timelines
+        # are per-process and incomparable on the wire).
+        "wall": time.time(),
         "tid": threading.get_ident() & 0xFFFFFFFF,
         "links": list(links) if links else (),
         "args": args or (),
@@ -382,6 +428,7 @@ def status() -> dict:
         "capacity": st._buf.maxlen,
         "evicted": st.evicted,
         "traces": len(st.trace_ids()),
+        "next_seq": st.next_seq,
     }
 
 

@@ -526,6 +526,59 @@ def test_policy_resplit_loop_retires_cleanly(mesh):
         mesh.reset_routes()
 
 
+def test_tower_collector_retires_with_the_resplit_loop(mesh):
+    """ISSUE-20 satellite 2: the retirement matrix above extended to
+    the tower collector — over the same peer-out/peer-back re-split
+    cycles, a departed peer's `tower.peer.*` gauges AND its collector
+    cursors/pools go away (the PR-8 rule), come back clean on rejoin,
+    and N cycles leak no ghost keys, `tower.peers_retired` counting
+    every drop."""
+    from p2p_dhts_tpu.tower import Collector
+
+    a_str = addr_str(mesh.a.addr)
+    b_str = addr_str(mesh.b.addr)
+    peers_full = {mesh.a.member: mesh.a.addr,
+                  mesh.b.member: mesh.b.addr}
+    m = Metrics()
+    col = Collector(mesh.a.plane.routes, metrics=m, interval_s=60.0)
+    try:
+        col._round()                      # foreground, never started
+        gauges = m.snapshot()["gauges"]
+        for fam in ("tower.peer.offset_ms", "tower.peer.rtt_ms",
+                    "tower.peer.span_cursor"):
+            assert f"{fam}.{b_str}" in gauges, \
+                f"collector never published {fam} for the live peer"
+        assert col.peers() == sorted([a_str, b_str])
+        for n in range(1, 4):
+            epoch = mesh.a.plane.routes.epoch + 1
+            mesh.a.plane.apply_routes({mesh.a.member: mesh.a.addr},
+                                      epoch)
+            col._round()
+            gauges = m.snapshot()["gauges"]
+            ghosts = [k for k in gauges
+                      if k.startswith("tower.peer.")
+                      and k.endswith(f".{b_str}")]
+            assert not ghosts, \
+                f"cycle {n}: departed peer's tower keys survived: " \
+                f"{ghosts}"
+            assert b_str not in col.peers(), \
+                f"cycle {n}: departed peer's cursor state survived"
+            assert m.counter("tower.peers_retired") == n
+            mesh.a.plane.apply_routes(dict(peers_full), epoch + 1)
+            col._round()
+            assert f"tower.peer.span_cursor.{b_str}" in \
+                m.snapshot()["gauges"], \
+                f"cycle {n}: rejoined peer not re-collected"
+        alive = sorted(k for k in m.snapshot()["gauges"]
+                       if k.startswith("tower.peer.span_cursor."))
+        assert alive == sorted(f"tower.peer.span_cursor.{s}"
+                               for s in (a_str, b_str)), \
+            "ghost tower.peer cursor gauges after the re-split loop"
+    finally:
+        col.stop()
+        mesh.reset_routes()
+
+
 def test_collect_peer_rows_stale_marker(mesh):
     """ISSUE-16 satellite 1: an unreachable peer's mesh-wide verb row
     is the TYPED stale marker — STALE:true + ERROR + an age-stamped

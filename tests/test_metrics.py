@@ -129,3 +129,95 @@ def test_stabilize_counts_rounds():
     finally:
         p.fail()
     assert METRICS.snapshot()["counters"]["overlay.stabilize_rounds"] == 2
+
+
+# ---------------------------------------------------------------------------
+# exemplars (chordax-tower, ISSUE 20): the p99-outlier -> trace bridge
+# ---------------------------------------------------------------------------
+
+def test_exemplars_disabled_is_zero_touch():
+    """The PR-14 discipline: with exemplars off (the default), the
+    hist record path allocates NOTHING exemplar-shaped — even while a
+    sampled trace is active — and pays one attribute read."""
+    from p2p_dhts_tpu import trace as trace_mod
+
+    m = Metrics()
+    assert not m.exemplars_enabled
+    with trace_mod.tracing():
+        with trace_mod.span("hot"):
+            for _ in range(50):
+                m.observe_hist("lat_ms", 1.0)
+            m.observe_hist_many("lat_ms", [1.0, 2.0])
+    assert m.exemplars() == {}
+    assert m._exemplars == {}, "disabled path must not create rings"
+    # Per-record bound: generous absolute ceiling for CI noise (the
+    # gate is one attribute read on top of the locked append).
+    import time as _time
+    t0 = _time.perf_counter()
+    for _ in range(20_000):
+        m.observe_hist("lat_ms", 1.0)
+    per_call = (_time.perf_counter() - t0) / 20_000
+    assert per_call < 2e-5, f"{per_call * 1e6:.2f} us/record"
+
+
+def test_exemplars_capture_only_under_sampled_trace():
+    from p2p_dhts_tpu import trace as trace_mod
+
+    m = Metrics()
+    m.set_exemplars(True)
+    # No active trace: a record produces no exemplar.
+    m.observe_hist("lat_ms", 5.0)
+    assert m.exemplars() == {}
+    with trace_mod.tracing():
+        with trace_mod.span("op") as ctx:
+            m.observe_hist("lat_ms", 9.0)
+        ex = m.exemplars("lat_ms")["lat_ms"]
+        assert ex[-1]["value"] == 9.0
+        assert ex[-1]["trace_id"] == ctx.trace_id
+        assert "t" in ex[-1]
+        # A batch contributes ONE exemplar: its slowest sample.
+        with trace_mod.span("op2") as c2:
+            m.observe_hist_many("lat_ms", [1.0, 42.0, 3.0])
+        ex = m.exemplars("lat_ms")["lat_ms"]
+        assert ex[-1] == {"value": 42.0, "trace_id": c2.trace_id,
+                          "t": ex[-1]["t"]}
+    # A sampled-OUT trace leaves no exemplar (whole-trace coherence).
+    with trace_mod.tracing(sample_rate=0.0):
+        with trace_mod.span("unsampled"):
+            m.observe_hist("lat_ms", 77.0)
+    assert all(e["value"] != 77.0
+               for e in m.exemplars("lat_ms")["lat_ms"])
+
+
+def test_exemplar_ring_is_bounded_and_per_hist():
+    from p2p_dhts_tpu import trace as trace_mod
+
+    m = Metrics()
+    m.set_exemplars(True)
+    with trace_mod.tracing():
+        with trace_mod.span("op"):
+            for i in range(Metrics.EXEMPLAR_CAP + 5):
+                m.observe_hist("a_ms", float(i))
+            m.observe_hist("b_ms", 1.0)
+    ex = m.exemplars()
+    assert len(ex["a_ms"]) == Metrics.EXEMPLAR_CAP, \
+        "exemplar ring must stay bounded (newest win)"
+    assert ex["a_ms"][-1]["value"] == float(Metrics.EXEMPLAR_CAP + 4)
+    assert len(ex["b_ms"]) == 1
+
+
+def test_exemplars_retired_with_their_hist_and_reset():
+    from p2p_dhts_tpu import trace as trace_mod
+
+    m = Metrics()
+    m.set_exemplars(True)
+    with trace_mod.tracing():
+        with trace_mod.span("op"):
+            m.observe_hist("fam.one.lat", 1.0)
+            m.observe_hist("keep.lat", 2.0)
+    m.remove_prefix("fam")
+    assert "fam.one.lat" not in m.exemplars(), \
+        "remove_prefix must take the exemplar ring too (PR-8 rule)"
+    assert "keep.lat" in m.exemplars()
+    m.reset()
+    assert m.exemplars() == {}

@@ -1,0 +1,645 @@
+"""chordax-tower tests (ISSUE 20): monotonic pull cursors surviving
+ring-eviction wraparound (spans / flight / ledger), byte-identical
+stitching and timeline rendering under any arrival order, ±200ms
+clock-skew alignment, the TRACE_PULL verb and HEALTH since-cursor
+forms over the wire, the fleet collector's duplicate-free incremental
+pulls + peer retirement, exemplar-driven slow-trace stitching with the
+zero-steady-state-retrace guarantee, and the black-box canary's
+per-shard probes, rate cap, NOCACHE cache exclusion, shard retirement
+and SLO spec.
+
+Topology under test: the edge tests' in-proc rim — two real gateway
+stacks on localhost sockets in one process (the bench's 4-subprocess
+mesh covers the true multi-process story)."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from p2p_dhts_tpu import trace as trace_mod
+from p2p_dhts_tpu.config import RingConfig
+from p2p_dhts_tpu.core.ring import build_ring
+from p2p_dhts_tpu.dhash.store import empty_store
+from p2p_dhts_tpu.elastic.ledger import DecisionLedger
+from p2p_dhts_tpu.gateway import Gateway, install_gateway_handlers
+from p2p_dhts_tpu.health import FlightRecorder
+from p2p_dhts_tpu.mesh import MeshPlane, addr_str
+from p2p_dhts_tpu.metrics import Metrics
+from p2p_dhts_tpu.net import wire
+from p2p_dhts_tpu.net.rpc import Client, Server
+from p2p_dhts_tpu.pulse import Slo
+from p2p_dhts_tpu.tower import (Canary, Collector, build_timeline,
+                                render_markdown, stitch_chrome,
+                                stitch_trace)
+
+pytestmark = pytest.mark.tower
+
+RNG = np.random.RandomState(0x70E2)
+RING_ROWS = [int.from_bytes(RNG.bytes(16), "little") for _ in range(48)]
+
+
+class _Node:
+    def __init__(self, name):
+        self.metrics = Metrics()
+        self.server = Server(0, {})
+        self.gateway = Gateway(metrics=self.metrics, name=name)
+        self.gateway.add_ring(
+            "shard",
+            build_ring(RING_ROWS, RingConfig(finger_mode="materialized")),
+            empty_store(640, 4), default=True, bucket_min=8,
+            bucket_max=32, reprobe_s=300.0,
+            warmup=["find_successor", "dhash_get", "dhash_put"])
+        self.addr = ("127.0.0.1", self.server.port)
+        self.plane = MeshPlane(self.gateway, self.addr, ring_id="shard")
+        self.member = self.plane.member_id
+        install_gateway_handlers(self.server, self.gateway)
+        self.server.run_in_background()
+
+    def close(self):
+        self.plane.close()
+        self.server.kill()
+        self.gateway.close()
+
+
+class _Rim:
+    def __init__(self):
+        self.a = _Node("tower-a")
+        self.b = _Node("tower-b")
+        peers = {self.a.member: self.a.addr, self.b.member: self.b.addr}
+        self.a.plane.apply_routes(peers, 1)
+        self.b.plane.apply_routes(peers, 1)
+
+    def owned_by(self, node, n, rng=None):
+        rng = rng if rng is not None else RNG
+        out = []
+        while len(out) < n:
+            k = int.from_bytes(rng.bytes(16), "little")
+            own = self.a.plane.routes.owner(k)
+            if own is not None and own[1] == node.addr:
+                out.append(k)
+        return out
+
+    def close(self):
+        self.b.close()
+        self.a.close()
+        wire.reset_pool()
+
+
+@pytest.fixture(scope="module")
+def rim():
+    r = _Rim()
+    yield r
+    r.close()
+
+
+class _RoutesStub:
+    """The collector's route source: any object with addresses()."""
+
+    def __init__(self, addrs):
+        self.addrs = list(addrs)
+
+    def addresses(self):
+        return list(self.addrs)
+
+
+def _rpc(node, req, timeout=120.0):
+    return Client.make_request("127.0.0.1", node.server.port, req,
+                               timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# cursor semantics under eviction wraparound (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_spanstore_cursor_survives_eviction_wraparound():
+    """A collector that polls slower than the ring fills sees every
+    retained span exactly once and an honest GAP for the evicted
+    ones — never a duplicate, never a silent skip."""
+    st = trace_mod.SpanStore(capacity=8)
+    for i in range(5):
+        st.add({"trace_id": "t", "span_id": f"s{i}", "name": "n",
+                "t0": 0.0, "t1": 1.0})
+    spans, cur, gap = st.spans_since(0)
+    assert [s["seq"] for s in spans] == list(range(5))
+    assert (cur, gap) == (5, 0)
+    # Wrap the ring PAST the cursor: 20 more spans into capacity 8.
+    for i in range(5, 25):
+        st.add({"trace_id": "t", "span_id": f"s{i}", "name": "n",
+                "t0": 0.0, "t1": 1.0})
+    spans, cur2, gap = st.spans_since(cur)
+    assert gap == 25 - 8 - cur, "eviction must be counted, not silent"
+    assert [s["seq"] for s in spans] == list(range(17, 25))
+    assert cur2 == 25
+    # Caught up: the next pull is empty, duplicate-free.
+    spans, cur3, gap = st.spans_since(cur2)
+    assert spans == [] and gap == 0 and cur3 == 25
+    # LIMIT bounds a pull without losing position.
+    spans, cur4, gap = st.spans_since(20, limit=2)
+    assert [s["seq"] for s in spans] == [20, 21] and cur4 == 22
+
+
+def test_flight_recent_since_eviction_wraparound():
+    fl = FlightRecorder(capacity=8)
+    for i in range(6):
+        fl.record("t", f"e{i}")
+    events, cur, gap = fl.recent_since(0)
+    assert [e["seq"] for e in events] == list(range(6)) and gap == 0
+    for i in range(6, 30):
+        fl.record("t", f"e{i}")
+    events, cur2, gap = fl.recent_since(cur)
+    assert gap == 30 - 8 - cur
+    assert [e["seq"] for e in events] == list(range(22, 30))
+    assert cur2 == 30
+    # The n bound caps one poll; the cursor resumes mid-ring.
+    events, cur3, gap = fl.recent_since(cur2 - 4, n=2)
+    assert [e["seq"] for e in events] == [26, 27] and cur3 == 28
+    # Wall timestamps ride every event (the timeline's time axis).
+    assert all("t" in e for e in events)
+
+
+def test_ledger_entries_since_cursor():
+    led = DecisionLedger(7, capacity=4, metrics=Metrics())
+    for i in range(3):
+        led.record({"action": f"a{i}"})
+    rows, cur, gap = led.entries_since(0)
+    assert [r["seq"] for r in rows] == [0, 1, 2] and gap == 0
+    for i in range(3, 10):
+        led.record({"action": f"a{i}"})
+    rows, cur2, gap = led.entries_since(cur)
+    assert gap == 10 - 4 - cur
+    assert [r["seq"] for r in rows] == [6, 7, 8, 9] and cur2 == 10
+
+
+# ---------------------------------------------------------------------------
+# stitching: determinism + skew alignment (satellite 3)
+# ---------------------------------------------------------------------------
+
+def _span(peer_wall_start, dur, trace_id, span_id, parent=None,
+          seq=0, name="op"):
+    return {"name": name, "cat": "t", "trace_id": trace_id,
+            "span_id": span_id, "parent_id": parent, "t0": 50.0,
+            "t1": 50.0 + dur, "wall": peer_wall_start + dur,
+            "tid": 1, "links": (), "args": {}, "seq": seq}
+
+
+def test_stitch_chrome_byte_identical_any_order():
+    """The determinism contract: the export is a pure function of the
+    span SET — shuffled arrival orders and shuffled peer insertion
+    orders produce byte-identical JSON."""
+    a = [_span(1000.0, 0.05, "T1", "aa", seq=0, name="edge.request"),
+         _span(1000.001, 0.02, "T1", "ab", parent="aa", seq=1),
+         _span(1000.04, 0.004, "T2", "ac", seq=2)]
+    b = [_span(1000.01, 0.02, "T1", "ba", parent="ab", seq=0,
+               name="rpc.server.GET")]
+    ref = stitch_chrome({"gw-a": a, "gw-b": b})
+    rng = random.Random(20)
+    for _ in range(6):
+        sa, sb = list(a), list(b)
+        rng.shuffle(sa)
+        rng.shuffle(sb)
+        pools = [("gw-a", sa), ("gw-b", sb)]
+        rng.shuffle(pools)
+        assert stitch_chrome(dict(pools)) == ref
+    doc = json.loads(ref)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert [(m["pid"], m["args"]["name"]) for m in meta] == \
+        [(1, "gw-a"), (2, "gw-b")], "pid lanes must follow sorted peers"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs == sorted(xs, key=lambda e: (e["ts"], e["pid"],
+                                           e["args"].get("seq", -1),
+                                           e["args"]["span_id"]))
+
+
+def test_stitch_trace_aligns_200ms_skewed_peer():
+    """The clock-offset unit: peer B's clock runs +200ms ahead. RAW
+    stitching puts B's server span OUTSIDE its caller's window;
+    aligned with the collector's offset it nests back inside."""
+    skew = 0.200
+    a = [_span(1000.0, 0.050, "T1", "aa", name="edge.request")]
+    b = [_span(1000.010 + skew, 0.020, "T1", "ba", parent="aa",
+               name="rpc.server.GET")]
+    raw = json.loads(stitch_trace({"gw-a": a, "gw-b": b}, "T1"))
+    ev = {e["args"]["span_id"]: e for e in raw["traceEvents"]
+          if e["ph"] == "X"}
+    assert ev["ba"]["ts"] > ev["aa"]["ts"] + ev["aa"]["dur"], \
+        "without alignment the skew breaks causal nesting"
+    fixed = json.loads(stitch_trace({"gw-a": a, "gw-b": b}, "T1",
+                                    offsets={"gw-b": -skew}))
+    ev = {e["args"]["span_id"]: e for e in fixed["traceEvents"]
+          if e["ph"] == "X"}
+    assert ev["aa"]["ts"] <= ev["ba"]["ts"] <= \
+        ev["aa"]["ts"] + ev["aa"]["dur"], \
+        "aligned child must start inside its parent's window"
+    # One pid lane per CONTRIBUTING process.
+    meta = [e for e in fixed["traceEvents"] if e["ph"] == "M"]
+    assert len(meta) == 2
+    # T2 has no spans from b: its export carries only a's lane.
+    solo = json.loads(stitch_trace(
+        {"gw-a": a + [_span(1001.0, 0.01, "T2", "az")], "gw-b": b},
+        "T2"))
+    assert [m["args"]["name"] for m in solo["traceEvents"]
+            if m["ph"] == "M"] == ["gw-a"]
+
+
+# ---------------------------------------------------------------------------
+# timeline: ordering, skew, determinism (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_timeline_orders_and_aligns_and_renders_deterministically():
+    """An inject -> breach -> recover incident recorded across two
+    peers — one of them 200ms fast — merges in TRUE causal order once
+    offsets align it, and the markdown is byte-identical for any
+    arrival order."""
+    skew = 0.200
+    ev_a = [{"t": 100.00, "seq": 0, "subsystem": "havoc",
+             "event": "plan_installed", "seed": 7},
+            {"t": 100.90, "seq": 1, "subsystem": "pulse",
+             "event": "slo_recovered", "slo": "gw-avail"}]
+    ev_b = [{"t": 100.40 + skew, "seq": 0, "subsystem": "pulse",
+             "event": "slo_breach", "slo": "gw-avail",
+             "burn_short": 2.0}]
+    led_a = [{"t": 100.60, "seq": 0, "action": "grow",
+              "ring": "shard"}]
+    offsets = {"gw-b": -skew}
+    rows = build_timeline({"gw-a": ev_a, "gw-b": ev_b},
+                          {"gw-a": led_a}, offsets)
+    assert [r["event"] for r in rows] == \
+        ["plan_installed", "slo_breach", "grow", "slo_recovered"]
+    assert [r["source"] for r in rows] == \
+        ["flight", "flight", "ledger", "flight"]
+    md = render_markdown(rows)
+    # Determinism: shuffled event lists, same bytes.
+    rng = random.Random(3)
+    for _ in range(4):
+        sa, sb = list(ev_a), list(ev_b)
+        rng.shuffle(sa)
+        rng.shuffle(sb)
+        rows2 = build_timeline({"gw-b": sb, "gw-a": sa},
+                               {"gw-a": list(led_a)}, offsets)
+        assert render_markdown(rows2) == md
+    # The render is readable markdown: one table row per event,
+    # detail fields as sorted key=value pairs.
+    assert "| havoc | plan_installed | seed=7 |" in md
+    assert md.count("\n| 0") + md.count("\n| 1") + \
+        md.count("\n| 2") >= 4
+    # WITHOUT alignment the fast peer's breach lands after the
+    # recovery that actually followed it — the bug alignment fixes.
+    unaligned = build_timeline({"gw-a": ev_a, "gw-b": ev_b},
+                               {"gw-a": led_a}, None)
+    assert [r["event"] for r in unaligned][-1] != "slo_recovered" or \
+        [r["event"] for r in unaligned] != \
+        [r["event"] for r in rows]
+
+
+def test_timeline_empty_renders():
+    assert render_markdown([]).startswith("# chordax")
+
+
+# ---------------------------------------------------------------------------
+# the wire verbs: TRACE_PULL + HEALTH SINCE / LEDGER_SINCE
+# ---------------------------------------------------------------------------
+
+def test_trace_pull_verb_incremental(rim):
+    with trace_mod.tracing():
+        with trace_mod.span("tower.test", cat="test"):
+            pass
+        r = _rpc(rim.a, {"COMMAND": "TRACE_PULL", "SINCE": 0})
+        assert r.get("SUCCESS"), r.get("ERRORS")
+        assert r["GAP"] == 0 and isinstance(r["WALL"], float)
+        spans = r["SPANS"]
+        assert any(s["name"] == "tower.test" for s in spans)
+        assert all("seq" in s and "wall" in s for s in spans)
+        cur = r["NEXT"]
+        # Resuming from the cursor never re-delivers: the only spans
+        # past it are the pull RPC's OWN server spans (tracing is on),
+        # never a duplicate of what round one returned.
+        r2 = _rpc(rim.a, {"COMMAND": "TRACE_PULL", "SINCE": cur})
+        assert all(s["seq"] >= cur for s in r2["SPANS"])
+        assert all(s["name"] != "tower.test" for s in r2["SPANS"])
+        assert r2["NEXT"] >= cur
+        # A new span arrives exactly once on the next pull.
+        with trace_mod.span("tower.test2", cat="test"):
+            pass
+        r3 = _rpc(rim.a, {"COMMAND": "TRACE_PULL",
+                          "SINCE": r2["NEXT"]})
+        names = [s["name"] for s in r3["SPANS"]]
+        assert names.count("tower.test2") == 1
+        assert "tower.test" not in names
+        # LIMIT is clamped to the documented cap.
+        r4 = _rpc(rim.a, {"COMMAND": "TRACE_PULL", "SINCE": 0,
+                          "LIMIT": 10 ** 9})
+        assert r4.get("SUCCESS")
+
+
+def test_health_since_and_ledger_cursor(rim):
+    from p2p_dhts_tpu.health import FLIGHT
+    FLIGHT.record("tower-test", "marker_one")
+    r = _rpc(rim.a, {"COMMAND": "HEALTH", "SINCE": 0, "TAIL": 4096})
+    fl = r["HEALTH"]["FLIGHT"]
+    assert any(e["event"] == "marker_one" for e in fl["tail"])
+    assert all("seq" in e and "t" in e for e in fl["tail"])
+    cur = fl["next_seq"]
+    FLIGHT.record("tower-test", "marker_two")
+    r2 = _rpc(rim.a, {"COMMAND": "HEALTH", "SINCE": cur,
+                      "TAIL": 4096})
+    tail = r2["HEALTH"]["FLIGHT"]["tail"]
+    assert [e["event"] for e in tail
+            if e["subsystem"] == "tower-test"] == ["marker_two"]
+    # No ledger attached: no LEDGER section, never an error.
+    assert "LEDGER" not in r2["HEALTH"]
+    led = DecisionLedger(3, metrics=Metrics())
+    rim.a.gateway.attach_ledger(led)
+    try:
+        led.record({"action": "split", "ring": "shard"})
+        r3 = _rpc(rim.a, {"COMMAND": "HEALTH", "LEDGER_SINCE": 0})
+        sec = r3["HEALTH"]["LEDGER"]
+        assert [e["action"] for e in sec["rows"]] == ["split"]
+        assert sec["next_seq"] == 1 and sec["gap"] == 0
+        r4 = _rpc(rim.a, {"COMMAND": "HEALTH",
+                          "LEDGER_SINCE": sec["next_seq"]})
+        assert r4["HEALTH"]["LEDGER"]["rows"] == []
+    finally:
+        rim.a.gateway.attach_ledger(None)
+
+
+# ---------------------------------------------------------------------------
+# the collector (the tentpole's pull plane)
+# ---------------------------------------------------------------------------
+
+def test_collector_incremental_pull_and_artifacts(rim):
+    """Two rounds against two live peers: spans/events arrive once
+    (duplicate-free cursors), offsets are near zero in-proc, and the
+    pool stitches a cross-peer export + a timeline containing the
+    recorded incident markers."""
+    from p2p_dhts_tpu.health import FLIGHT
+    m = Metrics()
+    with trace_mod.tracing():
+        with trace_mod.span("tower.pull_me", cat="test") as ctx:
+            tid = ctx.trace_id
+        FLIGHT.record("tower-test", "collector_marker")
+        routes = _RoutesStub([rim.a.addr, rim.b.addr])
+        col = Collector(routes, metrics=m, pulse_prefix=None)
+        try:
+            col._round()
+            pools = col.spans_by_peer()
+            assert sorted(pools) == sorted(
+                [addr_str(rim.a.addr), addr_str(rim.b.addr)])
+            n0 = {p: len(s) for p, s in pools.items()}
+            assert all(n > 0 for n in n0.values())
+            # Round 2 pulls ONLY the new span (cursors advanced).
+            with trace_mod.span("tower.pull_me_2", cat="test"):
+                pass
+            col._round()
+            pools = col.spans_by_peer()
+            for p in pools:
+                fresh = [s["name"] for s in pools[p][n0[p]:]]
+                # Round 2's fresh slice: the new span exactly once,
+                # plus round 1's own pull-RPC server spans — but
+                # NEVER a re-delivery of round 1's payload.
+                assert fresh.count("tower.pull_me_2") == 1, \
+                    f"missed/duplicated span on {p}: {fresh}"
+                assert "tower.pull_me" not in fresh, \
+                    f"cursor re-delivered on {p}"
+            assert m.counter("tower.collector.pull_failures") == 0
+            # In-proc peers share one wall clock: the RTT-midpoint
+            # estimate must land near zero (bound: the pull RTT).
+            for off in col.offsets().values():
+                assert abs(off) < 0.25
+            chrome = json.loads(col.stitch(tid))
+            lanes = [e["args"]["name"] for e in chrome["traceEvents"]
+                     if e["ph"] == "M"]
+            assert len(lanes) == 2, \
+                "both peers must contribute a pid lane"
+            md = col.timeline()
+            assert "collector_marker" in md
+        finally:
+            col.stop()
+
+
+def test_collector_retires_departed_peer(rim):
+    """The PR-8 rule at fleet scope: a peer leaving the route table
+    takes its tower.peer.* keys, cursors and pools with it."""
+    m = Metrics()
+    with trace_mod.tracing():
+        routes = _RoutesStub([rim.a.addr, rim.b.addr])
+        col = Collector(routes, metrics=m, pulse_prefix=None)
+        b_str = addr_str(rim.b.addr)
+        try:
+            col._round()
+            gauges = m.snapshot()["gauges"]
+            assert f"tower.peer.offset_ms.{b_str}" in gauges
+            assert f"tower.peer.span_cursor.{b_str}" in gauges
+            routes.addrs = [rim.a.addr]
+            col._round()
+            gauges = m.snapshot()["gauges"]
+            for fam in ("tower.peer.offset_ms", "tower.peer.rtt_ms",
+                        "tower.peer.span_cursor"):
+                assert f"{fam}.{b_str}" not in gauges, \
+                    f"departed peer's {fam} key survived"
+            assert b_str not in col.peers()
+            assert b_str not in col.spans_by_peer()
+            assert m.counter("tower.peers_retired") == 1
+            # The survivor's keys are untouched.
+            assert f"tower.peer.offset_ms.{addr_str(rim.a.addr)}" \
+                in gauges
+        finally:
+            col.stop()
+
+
+def test_collector_slow_traces_and_retrace_counter(rim):
+    """Exemplar-driven slow-trace stitching: a trace the incremental
+    pulls already delivered stitches for FREE (zero retraces); only a
+    pool miss pays the by-trace fallback, and it is counted."""
+    m = Metrics()
+    base = rim.a.metrics
+    base.set_exemplars(True)
+    try:
+        with trace_mod.tracing():
+            with trace_mod.span("tower.slow_op", cat="test") as ctx:
+                tid = ctx.trace_id
+                base.observe_hist("tower.test_latency_ms", 123.0)
+            routes = _RoutesStub([rim.a.addr])
+            col = Collector(routes, metrics=m, pulse_prefix=None)
+            try:
+                col._round()
+                ex = col.exemplars_by_peer()[addr_str(rim.a.addr)]
+                assert ex["tower.test_latency_ms"][-1]["trace_id"] \
+                    == tid
+                top = col.slow_traces(1)
+                assert len(top) == 1 and top[0]["trace_id"] == tid
+                doc = json.loads(top[0]["chrome"])
+                assert any(e.get("args", {}).get("trace_id") == tid
+                           for e in doc["traceEvents"]
+                           if e["ph"] == "X")
+                assert m.counter("tower.collector.retraces") == 0, \
+                    "steady state must stitch from the pool, free"
+                # A pool miss (exemplar for a trace the pulls never
+                # saw) falls back to TRACE_STATUS, counted.
+                with trace_mod.span("tower.missed", cat="test") as c2:
+                    tid2 = c2.trace_id
+                with col._lock:
+                    col._exemplars[addr_str(rim.a.addr)] = {
+                        "tower.test_latency_ms":
+                            [{"value": 999.0, "trace_id": tid2}]}
+                top2 = col.slow_traces(1)
+                assert top2[0]["trace_id"] == tid2
+                assert m.counter("tower.collector.retraces") == 1
+                doc2 = json.loads(top2[0]["chrome"])
+                assert any(e.get("args", {}).get("trace_id") == tid2
+                           for e in doc2["traceEvents"]
+                           if e["ph"] == "X"), \
+                    "retrace must recover the missed trace's spans"
+            finally:
+                col.stop()
+    finally:
+        base.set_exemplars(False)
+
+
+def test_collector_pulse_dedupe(rim):
+    """PULSE tails overlap across polls by design; the collector's
+    last-point-time cursor keeps only strictly-new points."""
+    from p2p_dhts_tpu.pulse import PulseSampler
+    sampler = PulseSampler(metrics=rim.a.metrics, interval_s=3600.0)
+    rim.a.gateway.attach_pulse(sampler)
+    try:
+        rim.a.metrics.inc("rpc.client.requests", 3)
+        sampler.sample(now=1.0)         # seed tick
+        rim.a.metrics.inc("rpc.client.requests", 2)
+        sampler.sample(now=2.0)         # first rate point lands
+        m = Metrics()
+        with trace_mod.tracing():
+            col = Collector(_RoutesStub([rim.a.addr]), metrics=m,
+                            pulse_prefix="rpc.client.requests")
+            try:
+                col._round()
+                peer = addr_str(rim.a.addr)
+                n0 = sum(len(pts) for pts
+                         in col.pulse_series(peer).values())
+                assert n0 > 0
+                col._round()     # same tail again -> zero new points
+                n1 = sum(len(pts) for pts
+                         in col.pulse_series(peer).values())
+                assert n1 == n0, "overlapping tails must dedupe"
+                sampler.sample(now=3.0)  # one new tick -> new points
+                col._round()
+                n2 = sum(len(pts) for pts
+                         in col.pulse_series(peer).values())
+                assert n2 > n1
+            finally:
+                col.stop()
+    finally:
+        rim.a.gateway.attach_pulse(None)
+        sampler.stop()
+
+
+# ---------------------------------------------------------------------------
+# the canary (black-box probes)
+# ---------------------------------------------------------------------------
+
+def test_canary_probes_every_shard(rim):
+    m = Metrics()
+    can = Canary([rim.a.addr, rim.b.addr], metrics=m,
+                 rate_cap_per_s=1000.0,
+                 put_payload=(np.zeros((4, 10), np.int32), 4))
+    try:
+        assert can.client._fold.extra_fields == {"NOCACHE": 1}
+        can._round()
+        labels = can.shard_labels()
+        assert sorted(labels) == sorted(
+            [addr_str(rim.a.addr), addr_str(rim.b.addr)])
+        # 2 shards x (lookup, get, put) probes, all available.
+        assert m.counter("tower.canary.probes") == 6
+        assert m.counter("tower.canary.failures") == 0
+        assert can.availability() == 100.0
+        gauges = m.snapshot()["gauges"]
+        for lab in labels:
+            assert gauges[f"tower.canary.availability.{lab}"] == 100.0
+            assert gauges[f"tower.canary.p99.{lab}"] > 0.0
+        # The PUT landed: the probe key now GETs ok=True end to end.
+        can._round()
+        assert m.counter("tower.canary.failures") == 0
+    finally:
+        can.close()
+
+
+def test_canary_rate_cap_drops_not_queues(rim):
+    m = Metrics()
+    can = Canary([rim.a.addr, rim.b.addr], metrics=m,
+                 rate_cap_per_s=1.0)
+    try:
+        can._round()
+        # Budget 1 token < 2 probes/shard: nothing runs, the clip is
+        # counted, no probe debt accumulates.
+        assert m.counter("tower.canary.probes") == 0
+        assert m.counter("tower.canary.rate_capped") >= 3
+        assert can.availability() is None
+    finally:
+        can.close()
+
+
+def test_canary_shard_retirement(rim):
+    from collections import deque
+    m = Metrics()
+    can = Canary([rim.a.addr, rim.b.addr], metrics=m,
+                 rate_cap_per_s=1000.0)
+    try:
+        can._round()
+        ghost = "10.0.0.9:1"
+        can._windows[ghost] = deque([(True, 0.001)])
+        m.gauge(f"tower.canary.availability.{ghost}", 100.0)
+        m.gauge(f"tower.canary.p99.{ghost}", 1.0)
+        can._round()
+        gauges = m.snapshot()["gauges"]
+        assert f"tower.canary.availability.{ghost}" not in gauges
+        assert f"tower.canary.p99.{ghost}" not in gauges
+        assert ghost not in can._windows
+        assert m.counter("tower.canary.shards_retired") == 1
+        live = addr_str(rim.a.addr)
+        assert f"tower.canary.availability.{live}" in gauges
+    finally:
+        can.close()
+
+
+def test_canary_nocache_excludes_probes_from_hot_key_cache(rim):
+    """The cache-exclusion rule end to end: NOCACHE single-key GETs
+    neither fill nor read the gateway's hot-key cache, while the same
+    request without the flag does both."""
+    key = rim.owned_by(rim.a, 1)[0]
+    seg = np.arange(40, dtype=np.int32).reshape(4, 10)
+    r = _rpc(rim.a, {"COMMAND": "PUT", "KEY": format(key, "x"),
+                     "SEGMENTS": seg, "LENGTH": 4})
+    assert r.get("SUCCESS") and r.get("OK"), r
+    probe = {"COMMAND": "GET", "KEY": format(key, "x"), "NOCACHE": 1}
+    hits0 = rim.a.metrics.counter("gateway.cache.hits")
+    misses0 = rim.a.metrics.counter("gateway.cache.misses")
+    for _ in range(3):
+        r = _rpc(rim.a, probe)
+        assert r.get("SUCCESS") and r.get("OK"), r
+    assert rim.a.metrics.counter("gateway.cache.hits") == hits0
+    assert rim.a.metrics.counter("gateway.cache.misses") == misses0, \
+        "NOCACHE probes must not touch the cache at all"
+    # Control: the same GET without the flag fills then hits.
+    plain = {"COMMAND": "GET", "KEY": format(key, "x")}
+    _rpc(rim.a, plain)
+    hits1 = rim.a.metrics.counter("gateway.cache.hits")
+    _rpc(rim.a, plain)
+    assert rim.a.metrics.counter("gateway.cache.hits") == hits1 + 1
+    # And a NOCACHE probe against the now-warm entry still bypasses.
+    _rpc(rim.a, probe)
+    assert rim.a.metrics.counter("gateway.cache.hits") == hits1 + 1
+
+
+def test_canary_slo_spec_is_a_valid_pulse_objective(rim):
+    m = Metrics()
+    can = Canary([rim.a.addr], metrics=m, rate_cap_per_s=10.0)
+    try:
+        slo = Slo(can.slo_spec(target_pct=99.0, window_s=2.0,
+                               long_window_s=8.0))
+        assert slo.kind == "availability"
+        assert slo.total == "tower.canary.probes"
+        assert slo.errors == "tower.canary.failures"
+        assert abs(slo.budget - 0.01) < 1e-9
+    finally:
+        can.close()

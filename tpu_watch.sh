@@ -1,38 +1,42 @@
 #!/bin/bash
-# TPU recovery watcher, round 17: seventeen configs want on-chip
-# records (greens from r07-r16 carry over; chordax-edge joins the
+# TPU recovery watcher, round 20: eighteen configs want on-chip
+# records (greens from r07-r17 carry over; chordax-tower joins the
 # want list). Wait for the chip to be free, probe the remote-compile
 # service (dead since round 4: connection-refused on its port while
 # cached programs kept executing), and when it answers, run the
 # configs without a green record one at a time into
-# BENCH_ATTEMPT_r17.jsonl (bench's _record_lkg promotes each green
+# BENCH_ATTEMPT_r20.jsonl (bench's _record_lkg promotes each green
 # on-chip record into BENCH_LKG.json). On-chip attempts keep the
-# --trace device-timeline archiving (now into BENCH_TRACE_r17). All
+# --trace device-timeline archiving (now into BENCH_TRACE_r20). All
 # prior gates stay (wire-isolated binary >= 3x JSON keys/s at <= 1/2
 # p50, traced chain, havoc scenario matrix >= 99% availability, pulse
-# + fastlane + fuse + lens + mesh + elastic smokes, zero retraces).
-# NEW in round 17 (chordax-edge): an EDGE SMOKE pre-bench gate — the
-# zero-hop client SDK against a real 4-process ring: 1000-key
-# routed-vs-forwarded byte parity with the gateway forward counters
-# PROVABLY frozen (the hop is deleted, not hidden), client-routed
-# keys/s beating the gateway-forwarded baseline at equal-or-better
-# p50, the hedged tail run cutting p99 under a seeded 4% stall while
-# staying inside the ~5% hedge budget, the stale-route storm healing
-# in ONE refresh round per client through a live JOIN re-split at
-# >= 99% availability, zero steady-state refresh traffic after
-# convergence, zero retraces in every process polled over HEALTH —
-# must pass on CPU before anything claims the chip. The want-list
-# headline stays the fuse on-chip record + the IDA A/B + the lens
-# cost table + the mesh/elastic process records, now joined by the
-# edge config's zero-hop A/B + hedged-tail + storm record. Never
-# kills anything mid-TPU-work; every probe and bench attempt runs to
-# completion (a blocked fresh-shape jit takes ~25 min to fail — that
-# is the probe's cost when the service is down, accepted).
+# + fastlane + fuse + lens + mesh + elastic + edge smokes, zero
+# retraces).
+# NEW in round 20 (chordax-tower): a TOWER SMOKE pre-bench gate — the
+# fleet-observability plane against a real 4-process ring: collector
+# + fleet-wide exemplar capture costing <= 1.05x the closed-loop p50,
+# ONE hedged cross-shard request stitched into a Chrome export with
+# pid lanes from >= 2 child processes (byte-identical re-stitch),
+# slow-trace ranking served entirely from the incremental span pool
+# (ZERO retraces), a seeded whole-process partition producing a
+# merged incident timeline ordered plan_installed -> breaker_open ->
+# slo_breach -> rejoin -> slo_recovered, black-box canary
+# availability within 1 point of an independent mirror measurement,
+# zero steady-state retraces in every process — must pass on CPU
+# before anything claims the chip. The smoke's stitched trace +
+# incident timeline archive next to this round's records. The
+# want-list headline stays the fuse on-chip record + the IDA A/B +
+# the lens cost table + the mesh/elastic/edge process records, now
+# joined by the tower config's overhead A/B + stitched-trace +
+# incident record. Never kills anything mid-TPU-work; every probe
+# and bench attempt runs to completion (a blocked fresh-shape jit
+# takes ~25 min to fail — that is the probe's cost when the service
+# is down, accepted).
 cd /root/repo
 log() { echo "[tpu_watch] $1 $(date -u +%H:%M:%S)" >> tpu_watch.log; }
-log "round-17 watcher start (seventeen configs + wire/havoc/pulse/fastlane/fuse/lens/mesh/elastic/edge smoke gates)"
+log "round-20 watcher start (eighteen configs + wire/havoc/pulse/fastlane/fuse/lens/mesh/elastic/edge/tower smoke gates)"
 
-needed() {  # configs without a green record yet (r07-r16 greens count)
+needed() {  # configs without a green record yet (r07-r17 greens count)
   python - <<'EOF'
 import json
 ok = set()
@@ -41,7 +45,7 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
                 "BENCH_ATTEMPT_r11.jsonl", "BENCH_ATTEMPT_r12.jsonl",
                 "BENCH_ATTEMPT_r13.jsonl", "BENCH_ATTEMPT_r14.jsonl",
                 "BENCH_ATTEMPT_r15.jsonl", "BENCH_ATTEMPT_r16.jsonl",
-                "BENCH_ATTEMPT_r17.jsonl"):
+                "BENCH_ATTEMPT_r17.jsonl", "BENCH_ATTEMPT_r20.jsonl"):
     try:
         for line in open(attempt):
             try:
@@ -55,7 +59,7 @@ for attempt in ("BENCH_ATTEMPT_r07.jsonl", "BENCH_ATTEMPT_r08.jsonl",
 want = ["chord16", "ida", "dhash", "dhash_sharded", "lookup_1m",
         "sweep_10m", "serve", "gateway", "repair", "membership",
         "pulse", "fastlane", "fuse", "lens", "mesh", "elastic",
-        "edge"]
+        "edge", "tower"]
 print(" ".join(c for c in want if c not in ok))
 EOF
 }
@@ -67,7 +71,7 @@ for i in $(seq 1 80); do
   done
   CONFIGS=$(needed)
   if [ -z "$CONFIGS" ]; then
-    log "all seventeen configs recorded green — done"
+    log "all eighteen configs recorded green — done"
     exit 0
   fi
   log "attempt $i; pending: $CONFIGS"
@@ -137,9 +141,9 @@ for i in $(seq 1 80); do
   # mid-bench), one linked digest->diff->heal repair trace, zero
   # retraces — on CPU before anything claims the chip. The sampled
   # series artifact lands next to this round's records.
-  mkdir -p BENCH_TRACE_r17
+  mkdir -p BENCH_TRACE_r20
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_PULSE_SERIES=BENCH_TRACE_r17/pulse_series_smoke.json \
+      CHORDAX_PULSE_SERIES=BENCH_TRACE_r20/pulse_series_smoke.json \
       python bench.py --config pulse --smoke \
       >> tpu_watch.log 2>&1; then
     log "pulse smoke FAILED - fix the telemetry plane before benching"
@@ -180,7 +184,7 @@ for i in $(seq 1 80); do
   # (Chrome export + rendered per-kind cost breakdown) archives next
   # to this round's records.
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_LENS_PROFILE=BENCH_TRACE_r17/lens_profile_smoke \
+      CHORDAX_LENS_PROFILE=BENCH_TRACE_r20/lens_profile_smoke \
       python bench.py --config lens --smoke \
       >> tpu_watch.log 2>&1; then
     log "lens smoke FAILED - fix the cost/capacity plane before benching"
@@ -211,7 +215,7 @@ for i in $(seq 1 80); do
   # engine the policy built — on CPU before anything claims the
   # chip. The smoke's ledger archives next to this round's records.
   if ! JAX_PLATFORMS=cpu \
-      CHORDAX_ELASTIC_LEDGER=BENCH_TRACE_r17/elastic_ledger_smoke.json \
+      CHORDAX_ELASTIC_LEDGER=BENCH_TRACE_r20/elastic_ledger_smoke.json \
       python bench.py --config elastic --smoke \
       >> tpu_watch.log 2>&1; then
     log "elastic smoke FAILED - fix the control plane before benching"
@@ -235,6 +239,28 @@ for i in $(seq 1 80); do
     sleep 300
     continue
   fi
+  # Tower smoke (ISSUE 20): the fleet-observability plane must hold —
+  # collector + fleet-wide exemplar capture <= 1.05x the closed-loop
+  # p50, one hedged cross-shard request stitched into a Chrome export
+  # with pid lanes from >= 2 child processes (byte-identical
+  # re-stitch), slow-trace ranking from the incremental pool with
+  # ZERO retraces, the seeded whole-process partition producing a
+  # merged incident timeline ordered plan_installed -> breaker_open
+  # -> slo_breach -> rejoin -> slo_recovered, canary availability
+  # within 1 point of the independent mirror, zero steady-state
+  # retraces in every process — on CPU before anything claims the
+  # chip. The smoke's stitched trace + incident timeline archive next
+  # to this round's records.
+  if ! JAX_PLATFORMS=cpu python bench.py --config tower --smoke \
+      >> tpu_watch.log 2>&1; then
+    log "tower smoke FAILED - fix the observability plane before benching"
+    sleep 300
+    continue
+  fi
+  cp -f TOWER_TRACE.json BENCH_TRACE_r20/tower_trace_smoke.json \
+      2>/dev/null || true
+  cp -f TOWER_TIMELINE.md BENCH_TRACE_r20/tower_timeline_smoke.md \
+      2>/dev/null || true
   # Gentle compile-service probe: tiny jit with a fresh shape (a salted
   # length so the persistent cache can't mask a dead service).
   if python - >> tpu_watch.log 2>&1 <<EOF
@@ -245,25 +271,33 @@ assert int(np.asarray(y)[-1]) >= 0
 print("compile service OK")
 EOF
   then
-    mkdir -p BENCH_TRACE_r17
+    mkdir -p BENCH_TRACE_r20
     for c in $CONFIGS; do
-      log "running --config $c (device trace -> BENCH_TRACE_r17/$c)"
+      log "running --config $c (device trace -> BENCH_TRACE_r20/$c)"
       # The pulse config archives its sampled series + verdicts, the
       # lens config its ANALYZED profile (Chrome export + per-kind
       # cost-breakdown markdown), and the elastic config its decision
       # ledger (ring tier + mesh tier), next to this round's records
       # (the mid-bench PULSE/HEALTH/CAPACITY polls are inside the
       # configs themselves).
-      CHORDAX_PULSE_SERIES="BENCH_TRACE_r17/pulse_series_$c.json" \
-        CHORDAX_LENS_PROFILE="BENCH_TRACE_r17/lens_profile_$c" \
-        CHORDAX_ELASTIC_LEDGER="BENCH_TRACE_r17/elastic_ledger_$c.json" \
-        python bench.py --config "$c" --trace "BENCH_TRACE_r17" \
-        >> BENCH_ATTEMPT_r17.jsonl 2>> BENCH_ATTEMPT_r17.err
+      CHORDAX_PULSE_SERIES="BENCH_TRACE_r20/pulse_series_$c.json" \
+        CHORDAX_LENS_PROFILE="BENCH_TRACE_r20/lens_profile_$c" \
+        CHORDAX_ELASTIC_LEDGER="BENCH_TRACE_r20/elastic_ledger_$c.json" \
+        python bench.py --config "$c" --trace "BENCH_TRACE_r20" \
+        >> BENCH_ATTEMPT_r20.jsonl 2>> BENCH_ATTEMPT_r20.err
       log "config $c rc=$?"
+      if [ "$c" = "tower" ]; then
+        # The tower config's stitched trace + incident timeline are
+        # the record's evidence — archive them with the round.
+        cp -f TOWER_TRACE.json BENCH_TRACE_r20/tower_trace.json \
+            2>/dev/null || true
+        cp -f TOWER_TIMELINE.md BENCH_TRACE_r20/tower_timeline.md \
+            2>/dev/null || true
+      fi
       # Digest the round's trajectory after each record lands: the
       # stale-flagged table is the artifact a reviewer reads first.
       python -m p2p_dhts_tpu.lens.bench_report \
-        --out BENCH_TRACE_r17/trajectory.md >> tpu_watch.log 2>&1
+        --out BENCH_TRACE_r20/trajectory.md >> tpu_watch.log 2>&1
     done
   else
     log "compile service still down"
